@@ -1,0 +1,10 @@
+"""authorino_tpu — TPU-native external-authorization framework.
+
+Capabilities of Authorino (Envoy ext_authz, AuthConfig-driven) re-designed
+TPU-first: every pattern-matching rule and condition across all indexed
+AuthConfigs is compiled into dense (rules × attributes) tensors at reconcile
+time, and Check() requests are micro-batched and evaluated as one JAX/XLA
+kernel.  See SURVEY.md for the structural analysis of the reference.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+"""Pattern-expression layer: the DSL that compiles to TPU tensors."""
+
+from .ast import (  # noqa: F401
+    All,
+    And,
+    Any_,
+    Expression,
+    Operator,
+    Or,
+    Pattern,
+    PatternError,
+    TRUE,
+    FALSE,
+)

@@ -1,0 +1,131 @@
+"""Pattern-expression AST + CPU reference evaluator.
+
+Semantics mirrored from the reference's pkg/jsonexp
+(ref: pkg/jsonexp/expressions.go:53-178):
+
+  - ``Pattern{selector, operator, value}`` with operators
+    eq / neq / incl / excl / matches
+  - eq/neq compare the gjson-String() rendering of the resolved value
+  - incl/excl walk Result.Array() comparing element String() renderings
+  - matches applies an RE2-style regex to the String() rendering
+  - ``And`` / ``Or`` trees; ``All()`` / ``Any()`` build n-ary combinators;
+    an empty And is vacuously true, an empty Or is false
+    (ref: pkg/jsonexp/expressions.go:111-125, 136-154)
+
+This CPU evaluator is the correctness oracle for the TPU kernel
+(differential-tested in tests/test_compiler_differential.py).  In the
+reference the ``matches`` operator recompiles its regex on every call
+(ref: pkg/jsonexp/expressions.go:87); here patterns precompile once.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any, Tuple, Union
+
+from ..authjson import selector
+
+__all__ = [
+    "Operator", "Pattern", "And", "Or", "All", "Any_", "Expression",
+    "PatternError", "TRUE", "FALSE",
+]
+
+
+class PatternError(Exception):
+    """Evaluation error (e.g. invalid regex) — propagates as a deny in the
+    authorization phase, like the reference's error return."""
+
+
+class Operator(str, Enum):
+    EQ = "eq"
+    NEQ = "neq"
+    INCL = "incl"
+    EXCL = "excl"
+    MATCHES = "matches"
+
+    @classmethod
+    def from_string(cls, s: str) -> "Operator":
+        try:
+            return cls(s)
+        except ValueError:
+            raise PatternError(f"unsupported operator for json authorization: {s!r}")
+
+
+@dataclass(frozen=True)
+class Pattern:
+    selector: str
+    operator: Operator
+    value: str
+
+    def __post_init__(self):
+        # coerce plain-string operators ("eq" == Operator.EQ under str-Enum
+        # equality, but dispatch below uses identity) and validate early
+        if not isinstance(self.operator, Operator):
+            object.__setattr__(self, "operator", Operator.from_string(str(self.operator)))
+        if self.operator is Operator.MATCHES:
+            try:
+                object.__setattr__(self, "_regex", re.compile(self.value))
+            except re.error as e:
+                object.__setattr__(self, "_regex", None)
+                object.__setattr__(self, "_regex_error", str(e))
+        else:
+            object.__setattr__(self, "_regex", None)
+
+    def matches(self, doc: Any) -> bool:
+        obtained = selector.get(doc, self.selector)
+        op = self.operator
+        if op is Operator.EQ:
+            return self.value == obtained.string()
+        if op is Operator.NEQ:
+            return self.value != obtained.string()
+        if op is Operator.INCL:
+            return any(self.value == item.string() for item in obtained.array())
+        if op is Operator.EXCL:
+            return all(self.value != item.string() for item in obtained.array())
+        if op is Operator.MATCHES:
+            rx = getattr(self, "_regex", None)
+            if rx is None:
+                raise PatternError(getattr(self, "_regex_error", "invalid regex"))
+            return rx.search(obtained.string()) is not None
+        raise PatternError("unsupported operator for json authorization")
+
+    def __str__(self):
+        return f"{self.selector} {self.operator.value} {self.value}"
+
+
+@dataclass(frozen=True)
+class And:
+    children: Tuple["Expression", ...] = ()
+
+    def matches(self, doc: Any) -> bool:
+        return all(c.matches(doc) for c in self.children)
+
+    def __str__(self):
+        return "(" + " && ".join(str(c) for c in self.children) + ")"
+
+
+@dataclass(frozen=True)
+class Or:
+    children: Tuple["Expression", ...] = ()
+
+    def matches(self, doc: Any) -> bool:
+        return any(c.matches(doc) for c in self.children)
+
+    def __str__(self):
+        return "(" + " || ".join(str(c) for c in self.children) + ")"
+
+
+Expression = Union[Pattern, And, Or]
+
+TRUE: Expression = And(())    # empty And — vacuous truth (ref :111-125)
+FALSE: Expression = Or(())    # empty Or (ref :136-154)
+
+
+def All(*expressions: Expression) -> Expression:
+    return And(tuple(expressions))
+
+
+def Any_(*expressions: Expression) -> Expression:
+    return Or(tuple(expressions))

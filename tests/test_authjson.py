@@ -1,0 +1,179 @@
+"""Selector / value / template engine tests — semantics pinned to the
+reference's gjson usage (ref: pkg/json/json_test.go, pkg/jsonexp)."""
+
+import base64
+
+import pytest
+
+from authorino_tpu.authjson import (
+    JSONValue,
+    Result,
+    build_authorization_json,
+    CheckRequestModel,
+    HttpRequestAttributes,
+    get,
+    is_template,
+    replace_placeholders,
+    stringify_json,
+)
+
+DOC = {
+    "auth": {
+        "identity": {
+            "username": "john",
+            "sub": "abc-123",
+            "roles": ["admin", "dev"],
+            "age": 42,
+            "ratio": 0.5,
+            "active": True,
+            "nothing": None,
+            "nested": {"deep.key": "v"},
+        },
+        "metadata": {
+            "resources": [
+                {"uri": "/a", "owner": "john", "n": 1},
+                {"uri": "/b", "owner": "jane", "n": 2},
+                {"uri": "/c", "owner": "john", "n": 3},
+            ]
+        },
+    },
+    "request": {
+        "http": {
+            "headers": {"authorization": "Bearer tok-xyz", "x-tag": "One Two Three"},
+            "path": "/hello",
+        }
+    },
+}
+
+
+class TestSelector:
+    def test_simple_paths(self):
+        assert get(DOC, "auth.identity.username").string() == "john"
+        assert get(DOC, "request.http.path").py() == "/hello"
+
+    def test_string_rendering(self):
+        # gjson Result.String(): numbers minimal, bools lowercase, null -> ""
+        assert get(DOC, "auth.identity.age").string() == "42"
+        assert get(DOC, "auth.identity.ratio").string() == "0.5"
+        assert get(DOC, "auth.identity.active").string() == "true"
+        assert get(DOC, "auth.identity.nothing").string() == ""
+        assert get(DOC, "auth.identity.missing").string() == ""
+        assert get(DOC, "auth.identity.roles").string() == '["admin","dev"]'
+
+    def test_array_index_and_length(self):
+        assert get(DOC, "auth.identity.roles.0").string() == "admin"
+        assert get(DOC, "auth.identity.roles.1").string() == "dev"
+        assert get(DOC, "auth.identity.roles.#").py() == 2
+        assert not get(DOC, "auth.identity.roles.5").exists
+
+    def test_hash_mapping(self):
+        assert get(DOC, "auth.metadata.resources.#.uri").py() == ["/a", "/b", "/c"]
+
+    def test_escaped_dot(self):
+        assert get(DOC, "auth.identity.nested.deep\\.key").string() == "v"
+
+    def test_query_first_and_all(self):
+        assert get(DOC, 'auth.metadata.resources.#(owner=="john").uri').py() == "/a"
+        assert get(DOC, 'auth.metadata.resources.#(owner=="john")#.uri').py() == ["/a", "/c"]
+        assert get(DOC, "auth.metadata.resources.#(n>1)#.uri").py() == ["/b", "/c"]
+        assert not get(DOC, 'auth.metadata.resources.#(owner=="nobody")').exists
+
+    def test_array_semantics_of_scalars(self):
+        # gjson Result.Array(): scalar -> [itself], null/missing -> []
+        assert [r.string() for r in get(DOC, "auth.identity.username").array()] == ["john"]
+        assert get(DOC, "auth.identity.nothing").array() == []
+        assert get(DOC, "auth.identity.missing").array() == []
+
+
+class TestModifiers:
+    def test_extract(self):
+        assert (
+            get(DOC, 'request.http.headers.authorization.@extract:{"pos":1}').string()
+            == "tok-xyz"
+        )
+        assert (
+            get(DOC, 'request.http.headers.x-tag.@extract:{"sep":" ","pos":2}').string()
+            == "Three"
+        )
+        # out-of-range pos → the reference returns raw "n" (pkg/json/json.go:181)
+        assert get(DOC, 'request.http.headers.x-tag.@extract:{"pos":9}').string() == "n"
+
+    def test_case(self):
+        assert get(DOC, "auth.identity.username.@case:upper").string() == "JOHN"
+        assert get(DOC, "request.http.headers.x-tag|@case:lower").string() == "one two three"
+
+    def test_replace(self):
+        assert (
+            get(DOC, 'request.http.headers.x-tag.@replace:{"old":"Two","new":"2"}').string()
+            == "One 2 Three"
+        )
+
+    def test_base64(self):
+        encoded = base64.b64encode(b"john").decode()
+        doc = {"v": encoded}
+        assert get(doc, "v.@base64:decode").string() == "john"
+        assert get({"v": "john"}, "v.@base64:encode").string() == encoded
+
+    def test_strip(self):
+        doc = {"v": "a\x00b\tc"}
+        assert get(doc, "v.@strip").string() == "abc"
+
+    def test_builtin_mods(self):
+        assert get(DOC, "auth.identity.@keys").py() == [
+            "username", "sub", "roles", "age", "ratio", "active", "nothing", "nested",
+        ]
+        assert get(DOC, "auth.identity.roles.@reverse").py() == ["dev", "admin"]
+
+
+class TestTemplates:
+    def test_is_template(self):
+        assert is_template("Hello, {auth.identity.username}!")
+        assert not is_template("auth.identity.username")
+        # modifier braces alone do not make a template (ref pkg/json/json.go:59)
+        assert not is_template('request.http.headers.authorization.@extract:{"pos":1}')
+
+    def test_replace_placeholders(self):
+        assert (
+            replace_placeholders("Hello, {auth.identity.username}!", DOC) == "Hello, john!"
+        )
+        assert (
+            replace_placeholders(
+                'tok={request.http.headers.authorization.@extract:{"pos":1}}', DOC
+            )
+            == "tok=tok-xyz"
+        )
+        # \{ escapes a literal brace
+        assert replace_placeholders(r"lit\{brace", DOC) == "lit{brace"
+
+    def test_jsonvalue(self):
+        assert JSONValue(static=42).resolve_for(DOC) == 42
+        assert JSONValue(pattern="auth.identity.username").resolve_for(DOC) == "john"
+        assert (
+            JSONValue(pattern="u={auth.identity.username}").resolve_for(DOC) == "u=john"
+        )
+
+    def test_stringify(self):
+        assert stringify_json("plain") == "plain"
+        assert stringify_json(42) == "42"
+        assert stringify_json({"a": 1}) == '{"a":1}'
+        assert stringify_json(None) == ""
+
+
+class TestWellKnown:
+    def test_build(self):
+        req = CheckRequestModel(
+            http=HttpRequestAttributes(
+                method="POST",
+                path="/foo?bar=baz",
+                host="svc.example.com",
+                headers={"user-agent": "curl", "referer": "r"},
+            ),
+            context_extensions={"host": "override.example.com"},
+        )
+        doc = build_authorization_json(req, {"identity": {"u": 1}})
+        assert doc["request"]["url_path"] == "/foo"
+        assert doc["request"]["query"] == "bar=baz"
+        assert doc["request"]["user_agent"] == "curl"
+        assert doc["context"]["request"]["http"]["path"] == "/foo?bar=baz"
+        assert doc["auth"]["identity"] == {"u": 1}
+        assert req.host() == "override.example.com"

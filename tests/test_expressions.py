@@ -1,0 +1,89 @@
+"""Pattern expression oracle tests (semantics: pkg/jsonexp/expressions.go)."""
+
+import pytest
+
+from authorino_tpu.expressions import (
+    All,
+    And,
+    Any_,
+    FALSE,
+    Operator,
+    Or,
+    Pattern,
+    PatternError,
+    TRUE,
+)
+
+DOC = {
+    "auth": {
+        "identity": {"username": "john", "roles": ["admin", "dev"], "age": 42},
+    },
+    "request": {"http": {"path": "/pets/1", "method": "GET"}},
+}
+
+
+def P(sel, op, val):
+    return Pattern(sel, Operator.from_string(op), val)
+
+
+class TestPattern:
+    def test_eq(self):
+        assert P("auth.identity.username", "eq", "john").matches(DOC)
+        assert not P("auth.identity.username", "eq", "jane").matches(DOC)
+        # numbers compare through String() rendering
+        assert P("auth.identity.age", "eq", "42").matches(DOC)
+        # missing resolves to "" (gjson String of missing)
+        assert P("auth.identity.nope", "eq", "").matches(DOC)
+
+    def test_neq(self):
+        assert P("auth.identity.username", "neq", "jane").matches(DOC)
+        assert not P("auth.identity.username", "neq", "john").matches(DOC)
+
+    def test_incl_excl(self):
+        assert P("auth.identity.roles", "incl", "admin").matches(DOC)
+        assert not P("auth.identity.roles", "incl", "root").matches(DOC)
+        assert P("auth.identity.roles", "excl", "root").matches(DOC)
+        assert not P("auth.identity.roles", "excl", "dev").matches(DOC)
+        # scalar behaves as single-element array (gjson Result.Array())
+        assert P("auth.identity.username", "incl", "john").matches(DOC)
+        # missing → empty array → incl false, excl true
+        assert not P("auth.identity.nope", "incl", "x").matches(DOC)
+        assert P("auth.identity.nope", "excl", "x").matches(DOC)
+
+    def test_matches(self):
+        assert P("request.http.path", "matches", r"^/pets/\d+$").matches(DOC)
+        assert not P("request.http.path", "matches", r"^/cats").matches(DOC)
+        with pytest.raises(PatternError):
+            P("request.http.path", "matches", r"([").matches(DOC)
+
+    def test_unknown_operator(self):
+        with pytest.raises(PatternError):
+            Operator.from_string("contains")
+
+
+class TestCombinators:
+    def test_all_any(self):
+        ok = P("auth.identity.username", "eq", "john")
+        bad = P("auth.identity.username", "eq", "jane")
+        assert All(ok, ok).matches(DOC)
+        assert not All(ok, bad).matches(DOC)
+        assert Any_(bad, ok).matches(DOC)
+        assert not Any_(bad, bad).matches(DOC)
+
+    def test_empty(self):
+        # empty And vacuously true; empty Or false (ref :111-125, :136-154)
+        assert TRUE.matches(DOC)
+        assert not FALSE.matches(DOC)
+
+    def test_nesting(self):
+        expr = All(
+            P("request.http.method", "eq", "GET"),
+            Any_(
+                P("auth.identity.roles", "incl", "root"),
+                All(
+                    P("auth.identity.roles", "incl", "admin"),
+                    P("request.http.path", "matches", r"^/pets"),
+                ),
+            ),
+        )
+        assert expr.matches(DOC)
