@@ -1,0 +1,5 @@
+"""Reconcile-time rule compiler: pattern ASTs → dense tensor operands."""
+
+from .compile import CompiledPolicy, ConfigRules, compile_corpus  # noqa: F401
+from .encode import EncodedBatch, encode_batch  # noqa: F401
+from .intern import EMPTY_ID, PAD, UNSEEN, StringInterner  # noqa: F401

@@ -1,0 +1,410 @@
+"""Rule compiler: lower pattern-expression trees across all AuthConfigs into
+dense tensor operands for the batched TPU kernel.
+
+This is the TPU-era analog of the reference's reconcile-time OPA precompile
+(ref: pkg/evaluators/authorization/opa.go:141): all compilation cost is paid
+once per corpus change, never per request.
+
+Lowering model
+--------------
+All expressions from all configs share one flat *result buffer* per request:
+
+  slot 0           constant TRUE   (empty And — ref pkg/jsonexp/expressions.go:111)
+  slot 1           constant FALSE  (empty Or  — ref :136)
+  slots 2..2+L     leaf pattern results (deduped globally by (attr, op, const))
+  slots 2+L..      internal And/Or nodes, grouped by tree depth
+
+Each And/Or node stores child *buffer indices*; children always live at
+earlier buffer positions, so the kernel evaluates level-by-level with static
+shapes.  And-rows pad with slot 0 (identity of ∧), Or-rows with slot 1.
+
+Per config, each authorization evaluator contributes a (condition, rule)
+pair of buffer indices; the verdict is
+
+  verdict[cfg] = ∧ over evaluators of (¬cond ∨ rule)       # skipped ⇒ pass
+                                            (ref: pkg/service/auth_pipeline.go:120-125,
+                                             307-318 — all-must-pass, conditions gate)
+
+Regex (`matches`) leaves and incl/excl membership overflow are routed through
+a CPU lane: the encoder supplies exact per-(request, leaf) booleans and the
+kernel selects them by op code / overflow mask (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..expressions.ast import And, Expression, Operator, Or, Pattern
+from .intern import PAD, StringInterner
+
+__all__ = [
+    "OP_EQ", "OP_NEQ", "OP_INCL", "OP_EXCL", "OP_CPU", "OP_ERROR",
+    "ConfigRules", "CompiledPolicy", "ShapeTargets", "compile_corpus",
+    "TRUE_SLOT", "FALSE_SLOT",
+]
+
+OP_EQ, OP_NEQ, OP_INCL, OP_EXCL, OP_CPU, OP_ERROR = 0, 1, 2, 3, 4, 5
+
+TRUE_SLOT = 0
+FALSE_SLOT = 1
+_LEAF_BASE = 2
+
+
+@dataclass
+class ShapeTargets:
+    """Forced operand shapes so independently-compiled sub-corpora (one per
+    tensor-parallel shard) stack into a single leading-axis array with
+    identical buffer layouts (parallel/sharded_eval.py)."""
+
+    n_leaves: int                      # padded L
+    n_attrs: int                       # padded A
+    max_e: int                         # evaluator columns
+    levels: Tuple[Tuple[int, int], ...]  # per level: (rows, children width)
+
+    @staticmethod
+    def union(shapes: Sequence["ShapeTargets"]) -> "ShapeTargets":
+        n_levels = max((len(s.levels) for s in shapes), default=0)
+        levels = []
+        for l in range(n_levels):
+            rows = max((s.levels[l][0] for s in shapes if l < len(s.levels)), default=1)
+            width = max((s.levels[l][1] for s in shapes if l < len(s.levels)), default=1)
+            levels.append((rows, width))
+        return ShapeTargets(
+            n_leaves=max(s.n_leaves for s in shapes),
+            n_attrs=max(s.n_attrs for s in shapes),
+            max_e=max(s.max_e for s in shapes),
+            levels=tuple(levels),
+        )
+
+
+@dataclass
+class ConfigRules:
+    """One AuthConfig's compilable authorization surface: a list of
+    (conditions, rules) expression pairs — one per pattern-matching
+    authorization evaluator (conditions may be None)."""
+
+    name: str
+    evaluators: List[Tuple[Optional[Expression], Expression]] = field(default_factory=list)
+
+
+@dataclass
+class _Leaf:
+    op: int
+    attr: int
+    const: int
+    regex: Optional[str] = None  # for CPU lane
+
+
+@dataclass
+class CompiledPolicy:
+    """Dense device operands + CPU-side metadata for one compiled corpus."""
+
+    # --- device operands (numpy here; moved to device by the engine) ---
+    leaf_op: np.ndarray        # [L] int32
+    leaf_attr: np.ndarray      # [L] int32
+    leaf_const: np.ndarray     # [L] int32
+    levels: Tuple[Tuple[np.ndarray, np.ndarray], ...]  # per level: (children [N,C] i32, is_and [N] bool)
+    eval_cond: np.ndarray      # [G, E] int32 buffer idx (TRUE_SLOT when absent)
+    eval_rule: np.ndarray      # [G, E] int32 buffer idx
+    eval_has_cond: np.ndarray  # [G, E] bool
+
+    # --- CPU-side metadata ---
+    interner: StringInterner
+    attr_selectors: List[str]            # attr idx -> selector string
+    config_ids: Dict[str, int]           # config name -> row in eval_* tables
+    config_attrs: List[List[int]]        # per config: attr idxs to resolve
+    config_cpu_leaves: List[List[int]]   # per config: leaf idxs needing CPU lane
+    leaf_regex: List[Optional["re.Pattern"]]  # per leaf: compiled regex or None
+    leaf_is_membership: np.ndarray       # [L] bool — incl/excl (overflow-capable)
+    members_k: int                       # K: membership vector width
+
+    @property
+    def n_leaves(self) -> int:
+        return int(self.leaf_op.shape[0])
+
+    @property
+    def n_attrs(self) -> int:
+        return len(self.attr_selectors)
+
+    @property
+    def n_configs(self) -> int:
+        return int(self.eval_rule.shape[0])
+
+    @property
+    def buffer_size(self) -> int:
+        return _LEAF_BASE + self.n_leaves + sum(lv[0].shape[0] for lv in self.levels)
+
+    def shape_key(self) -> tuple:
+        """Everything jit specializes on — used to bound recompiles."""
+        return (
+            self.n_leaves,
+            self.n_attrs,
+            self.members_k,
+            tuple((lv[0].shape, ) for lv in self.levels),
+            self.eval_rule.shape,
+        )
+
+    def shape_targets(self) -> ShapeTargets:
+        return ShapeTargets(
+            n_leaves=self.n_leaves,
+            n_attrs=len(self.attr_selectors),
+            max_e=int(self.eval_rule.shape[1]),
+            levels=tuple((int(c.shape[0]), int(c.shape[1])) for c, _ in self.levels),
+        )
+
+
+def _round_up(n: int, multiple: int = 8, minimum: int = 8) -> int:
+    """Pad to the next power-of-two-ish bucket so shape changes (and thus XLA
+    recompiles) are logarithmic in corpus growth (SURVEY.md §7 bucketing)."""
+    n = max(n, minimum)
+    bucket = minimum
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+class _Lowerer:
+    def __init__(self, interner: StringInterner, members_k: int):
+        self.interner = interner
+        self.members_k = members_k
+        self.attrs: Dict[str, int] = {}
+        self.leaves: List[_Leaf] = []
+        self.leaf_dedupe: Dict[Tuple[int, int, int, Optional[str]], int] = {}
+        # nodes: (depth, is_and, children buffer idxs)
+        self.nodes: List[Tuple[int, bool, List[int]]] = []
+        self.depth_of: Dict[int, int] = {TRUE_SLOT: 0, FALSE_SLOT: 0}
+
+    def attr_idx(self, selector: str) -> int:
+        i = self.attrs.get(selector)
+        if i is None:
+            i = len(self.attrs)
+            self.attrs[selector] = i
+        return i
+
+    def lower_leaf(self, p: Pattern) -> int:
+        attr = self.attr_idx(p.selector)
+        if p.operator is Operator.MATCHES:
+            rx = getattr(p, "_regex", None)
+            if rx is None:
+                # invalid regex: evaluation errors deny in the reference
+                # (error return from Pattern.Matches → deny); constant-false
+                key = (OP_ERROR, attr, 0, p.value)
+            else:
+                key = (OP_CPU, attr, 0, p.value)
+        else:
+            op = {
+                Operator.EQ: OP_EQ,
+                Operator.NEQ: OP_NEQ,
+                Operator.INCL: OP_INCL,
+                Operator.EXCL: OP_EXCL,
+            }[p.operator]
+            key = (op, attr, self.interner.intern(p.value), None)
+        idx = self.leaf_dedupe.get(key)
+        if idx is None:
+            idx = len(self.leaves)
+            self.leaves.append(_Leaf(op=key[0], attr=key[1], const=key[2], regex=key[3]))
+            self.leaf_dedupe[key] = idx
+        buf = _LEAF_BASE + idx
+        self.depth_of[buf] = 0
+        return buf
+
+    def lower(self, expr: Expression) -> int:
+        """Return the buffer index holding this expression's result."""
+        if isinstance(expr, Pattern):
+            return self.lower_leaf(expr)
+        is_and = isinstance(expr, And)
+        children = [self.lower(c) for c in expr.children]
+        if not children:
+            return TRUE_SLOT if is_and else FALSE_SLOT
+        if len(children) == 1:
+            return children[0]
+        depth = 1 + max(self.depth_of[c] for c in children)
+        node_id = len(self.nodes)
+        self.nodes.append((depth, is_and, children))
+        # buffer position assigned later (after level grouping); use a
+        # placeholder key: negative ids -(node_id+1)
+        self.depth_of[-(node_id + 1)] = depth
+        return -(node_id + 1)
+
+
+def compile_corpus(
+    configs: Sequence[ConfigRules],
+    members_k: int = 16,
+    pad: bool = True,
+    targets: Optional[ShapeTargets] = None,
+    interner: Optional[StringInterner] = None,
+) -> CompiledPolicy:
+    """Compile all configs' pattern rules into one CompiledPolicy.
+
+    ``targets`` forces final operand shapes (must dominate the natural ones);
+    ``interner`` lets tensor-parallel shards share one global string table."""
+    interner = interner if interner is not None else StringInterner()
+    lw = _Lowerer(interner, members_k)
+
+    # 1. lower every expression; remember (cond_ref, rule_ref) per evaluator
+    per_config: List[Tuple[str, List[Tuple[Optional[int], int]]]] = []
+    for cfg in configs:
+        pairs: List[Tuple[Optional[int], int]] = []
+        for cond, rule in cfg.evaluators:
+            cond_ref = lw.lower(cond) if cond is not None else None
+            rule_ref = lw.lower(rule)
+            pairs.append((cond_ref, rule_ref))
+        per_config.append((cfg.name, pairs))
+
+    # 2. assign buffer positions: leaves first, then nodes grouped by depth.
+    # Node positions must account for leaf AND level-row PADDING — the
+    # kernel's result buffer holds the padded leaf block, then each padded
+    # level's rows, in order.
+    n_leaves = len(lw.leaves)
+    Lp = _round_up(n_leaves) if pad else max(n_leaves, 1)
+    if targets is not None:
+        assert targets.n_leaves >= n_leaves, "targets.n_leaves too small"
+        Lp = targets.n_leaves
+    by_depth: Dict[int, List[int]] = {}
+    for node_id, (depth, _, _) in enumerate(lw.nodes):
+        by_depth.setdefault(depth, []).append(node_id)
+    levels_raw: List[List[int]] = [by_depth[d] for d in sorted(by_depth)]
+    n_levels = len(levels_raw)
+    if targets is not None:
+        assert len(targets.levels) >= n_levels, "targets.levels too shallow"
+        n_levels = len(targets.levels)
+        levels_raw += [[] for _ in range(n_levels - len(levels_raw))]
+
+    def level_rows(l: int) -> int:
+        natural = len(levels_raw[l])
+        if targets is not None:
+            assert targets.levels[l][0] >= natural, "targets level rows too small"
+            return targets.levels[l][0]
+        return natural
+
+    node_pos: Dict[int, int] = {}
+    cursor = _LEAF_BASE + Lp
+    for l, level_nodes in enumerate(levels_raw):
+        for row, node_id in enumerate(level_nodes):
+            node_pos[node_id] = cursor + row
+        cursor += level_rows(l)
+
+    def ref_to_buf(ref: int) -> int:
+        # negative refs encode node placeholders -(node_id+1); others are
+        # already buffer positions (TRUE/FALSE slots or leaves)
+        if ref < 0:
+            return node_pos[-ref - 1]
+        return ref
+
+    # 3. build level tensors (padded rows evaluate And() ≡ True, harmless)
+    levels: List[Tuple[np.ndarray, np.ndarray]] = []
+    for l, level_nodes in enumerate(levels_raw):
+        max_c = max((len(lw.nodes[nid][2]) for nid in level_nodes), default=1)
+        if targets is not None:
+            assert targets.levels[l][1] >= max_c, "targets level width too small"
+            max_c = targets.levels[l][1]
+        rows = level_rows(l)
+        children = np.full((rows, max_c), TRUE_SLOT, dtype=np.int32)
+        is_and = np.ones((rows,), dtype=bool)
+        for row, nid in enumerate(level_nodes):
+            _, node_is_and, kids = lw.nodes[nid]
+            is_and[row] = node_is_and
+            padv = TRUE_SLOT if node_is_and else FALSE_SLOT
+            buf_kids = [ref_to_buf(k) for k in kids]
+            children[row, : len(buf_kids)] = buf_kids
+            children[row, len(buf_kids):] = padv
+        levels.append((children, is_and))
+
+    # 4. per-config evaluator tables
+    n_configs = len(per_config)
+    max_e = max((len(p[1]) for p in per_config), default=1) or 1
+    if targets is not None:
+        assert targets.max_e >= max_e, "targets.max_e too small"
+        max_e = targets.max_e
+    elif pad:
+        max_e = _round_up(max_e, minimum=2)
+    eval_cond = np.full((n_configs, max_e), TRUE_SLOT, dtype=np.int32)
+    eval_rule = np.full((n_configs, max_e), TRUE_SLOT, dtype=np.int32)
+    eval_has_cond = np.zeros((n_configs, max_e), dtype=bool)
+    config_ids: Dict[str, int] = {}
+    for row, (name, pairs) in enumerate(per_config):
+        config_ids[name] = row
+        for col, (cond_ref, rule_ref) in enumerate(pairs):
+            if cond_ref is not None:
+                eval_cond[row, col] = ref_to_buf(cond_ref)
+                eval_has_cond[row, col] = True
+            eval_rule[row, col] = ref_to_buf(rule_ref)
+
+    # 5. leaf tensors (padded to the bucket chosen in step 2)
+    leaf_op = np.full((Lp,), OP_EQ, dtype=np.int32)
+    leaf_attr = np.zeros((Lp,), dtype=np.int32)
+    leaf_const = np.full((Lp,), PAD, dtype=np.int32)  # PAD const: matches nothing
+    leaf_regex: List[Optional[re.Pattern]] = [None] * Lp
+    leaf_is_membership = np.zeros((Lp,), dtype=bool)
+    for i, leaf in enumerate(lw.leaves):
+        leaf_op[i] = leaf.op
+        leaf_attr[i] = leaf.attr
+        leaf_const[i] = leaf.const
+        leaf_is_membership[i] = leaf.op in (OP_INCL, OP_EXCL)
+        if leaf.op == OP_CPU and leaf.regex is not None:
+            leaf_regex[i] = re.compile(leaf.regex)
+
+    n_attrs = len(lw.attrs)
+    Ap = _round_up(n_attrs) if pad else max(n_attrs, 1)
+    if targets is not None:
+        assert targets.n_attrs >= n_attrs, "targets.n_attrs too small"
+        Ap = targets.n_attrs
+    attr_selectors = [""] * Ap
+    for sel, idx in lw.attrs.items():
+        attr_selectors[idx] = sel
+
+    # 6. per-config CPU metadata
+    config_attrs: List[List[int]] = []
+    config_cpu_leaves: List[List[int]] = []
+    # which leaves belong to which config: walk expressions again via dedupe map
+    leaf_of_attr: Dict[int, List[int]] = {}
+    for i, leaf in enumerate(lw.leaves):
+        leaf_of_attr.setdefault(leaf.attr, []).append(i)
+
+    def collect_attrs(expr: Expression, acc_attrs: set, acc_cpu: set):
+        if isinstance(expr, Pattern):
+            attr = lw.attrs[expr.selector]
+            acc_attrs.add(attr)
+            if expr.operator is Operator.MATCHES:
+                rx = getattr(expr, "_regex", None)
+                key = (OP_ERROR if rx is None else OP_CPU, attr, 0, expr.value)
+                acc_cpu.add(lw.leaf_dedupe[key])
+            elif expr.operator in (Operator.INCL, Operator.EXCL):
+                op = OP_INCL if expr.operator is Operator.INCL else OP_EXCL
+                key = (op, attr, interner.intern(expr.value), None)
+                acc_cpu.add(lw.leaf_dedupe[key])  # overflow lane candidates
+        else:
+            for c in expr.children:
+                collect_attrs(c, acc_attrs, acc_cpu)
+
+    for cfg in configs:
+        a: set = set()
+        cl: set = set()
+        for cond, rule in cfg.evaluators:
+            if cond is not None:
+                collect_attrs(cond, a, cl)
+            collect_attrs(rule, a, cl)
+        config_attrs.append(sorted(a))
+        config_cpu_leaves.append(sorted(cl))
+
+    return CompiledPolicy(
+        leaf_op=leaf_op,
+        leaf_attr=leaf_attr,
+        leaf_const=leaf_const,
+        levels=tuple((c.astype(np.int32), a) for c, a in levels),
+        eval_cond=eval_cond,
+        eval_rule=eval_rule,
+        eval_has_cond=eval_has_cond,
+        interner=interner,
+        attr_selectors=attr_selectors,
+        config_ids=config_ids,
+        config_attrs=config_attrs,
+        config_cpu_leaves=config_cpu_leaves,
+        leaf_regex=leaf_regex,
+        leaf_is_membership=leaf_is_membership,
+        members_k=members_k,
+    )

@@ -1,0 +1,51 @@
+"""String interning — the bridge between string-heavy pattern semantics and
+integer tensor compares.
+
+The reference compares gjson-String() renderings per pattern per request
+(ref: pkg/jsonexp/expressions.go:59-96).  Here every constant that appears in
+any rule is interned to an int32 id at compile time; at request time resolved
+attribute values are *looked up* (never inserted), so device-side equality of
+ids is exact string equality — no hash-collision false-allows.
+
+Sentinels:
+  - id 0 is always the empty string "" (a missing gjson value renders as "")
+  - UNSEEN (-2): a request value that matches no rule constant
+  - PAD (-3): padding slot in membership vectors (never equals a real id)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+__all__ = ["StringInterner", "UNSEEN", "PAD", "EMPTY_ID"]
+
+UNSEEN = -2
+PAD = -3
+EMPTY_ID = 0
+
+
+class StringInterner:
+    __slots__ = ("_table",)
+
+    def __init__(self):
+        self._table: Dict[str, int] = {"": EMPTY_ID}
+
+    def intern(self, s: str) -> int:
+        """Compile-time: insert and return the id."""
+        i = self._table.get(s)
+        if i is None:
+            i = len(self._table)
+            self._table[s] = i
+        return i
+
+    def lookup(self, s: str) -> int:
+        """Request-time: id if known, else UNSEEN (cannot equal any constant)."""
+        return self._table.get(s, UNSEEN)
+
+    def __len__(self) -> int:
+        return len(self._table)
+
+    def freeze_copy(self) -> "StringInterner":
+        out = StringInterner()
+        out._table = dict(self._table)
+        return out

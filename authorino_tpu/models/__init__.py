@@ -1,0 +1,3 @@
+"""Policy models: compiled rule corpora + their batched evaluation steps."""
+
+from .policy_model import PolicyModel  # noqa: F401

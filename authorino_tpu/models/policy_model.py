@@ -1,0 +1,82 @@
+"""PolicyModel — the framework's flagship "model": a compiled rule corpus
+plus its batched evaluation function.
+
+The analog of a forward pass here is one micro-batched policy evaluation:
+(requests × rules) int32 compares + boolean-circuit reduction → per-request
+allow verdicts (SURVEY.md north star; replaces the per-request Go hot loop at
+ref: pkg/service/auth_pipeline.go:287-322 + pkg/jsonexp/expressions.go:59).
+There is no gradient training in this domain; the "training-step analog" is
+corpus compilation (reconcile-time) + this evaluation step (request-time).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.compile import CompiledPolicy, ConfigRules, compile_corpus
+from ..compiler.encode import EncodedBatch, encode_batch
+from ..ops.pattern_eval import eval_verdicts, to_device
+
+__all__ = ["PolicyModel"]
+
+
+def _forward(params, attrs_val, attrs_members, overflow, cpu_lane, config_id):
+    """Jittable forward step: encoded micro-batch → own-config verdicts."""
+    verdict, _ = eval_verdicts(params, attrs_val, attrs_members, overflow, cpu_lane)
+    own = jnp.take_along_axis(verdict, config_id[:, None], axis=1)[:, 0]
+    return own, verdict
+
+
+class PolicyModel:
+    """Single-corpus model: replicated params, batch (data) parallel only.
+    For the rules-axis-sharded variant see parallel/sharded_eval.py."""
+
+    def __init__(self, policy: CompiledPolicy, device=None):
+        self.policy = policy
+        self.params = to_device(policy, device=device)
+        self._apply = jax.jit(_forward)
+
+    @classmethod
+    def from_configs(cls, configs: Sequence[ConfigRules], members_k: int = 16, device=None) -> "PolicyModel":
+        return cls(compile_corpus(configs, members_k=members_k), device=device)
+
+    # ---- request path ----------------------------------------------------
+
+    def encode(self, docs: Sequence[Any], config_rows: Sequence[int], batch_pad: int = 0) -> EncodedBatch:
+        return encode_batch(self.policy, docs, config_rows, batch_pad=batch_pad)
+
+    def apply(self, encoded: EncodedBatch) -> Tuple[np.ndarray, np.ndarray]:
+        own, verdict = self._apply(
+            self.params,
+            jnp.asarray(encoded.attrs_val),
+            jnp.asarray(encoded.attrs_members),
+            jnp.asarray(encoded.overflow),
+            jnp.asarray(encoded.cpu_lane),
+            jnp.asarray(encoded.config_id),
+        )
+        return np.asarray(own), np.asarray(verdict)
+
+    def decide(self, docs: Sequence[Any], config_names: Sequence[str]) -> List[bool]:
+        rows = [self.policy.config_ids[n] for n in config_names]
+        own, _ = self.apply(self.encode(docs, rows))
+        return [bool(b) for b in own[: len(docs)]]
+
+    # ---- graft-entry support --------------------------------------------
+
+    def forward_fn_and_args(self, batch: int = 64):
+        """A jittable forward fn + realistic example args (for compile checks)."""
+        enc = encode_batch(self.policy, [], [], batch_pad=batch)
+        args = (
+            self.params,
+            jnp.asarray(enc.attrs_val),
+            jnp.asarray(enc.attrs_members),
+            jnp.asarray(enc.overflow),
+            jnp.asarray(enc.cpu_lane),
+            jnp.asarray(enc.config_id),
+        )
+        return _forward, args

@@ -1,0 +1,4 @@
+"""TPU kernels: batched policy evaluation (pure JAX/XLA; Pallas variants live
+in ops/pallas_kernels.py as they land)."""
+
+from .pattern_eval import eval_batch_jit, eval_verdicts, to_device  # noqa: F401

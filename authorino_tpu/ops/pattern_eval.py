@@ -1,0 +1,136 @@
+"""Batched policy-evaluation kernel (pure JAX/XLA; int32 compares + masked
+boolean reductions — VPU-friendly, static shapes, no data-dependent control
+flow).
+
+One call evaluates a micro-batch of requests against the *entire* compiled
+rule corpus and returns per-request per-config allow verdicts.  This replaces
+the reference's per-request goroutine fan-out + per-pattern gjson walk
+(ref: pkg/service/auth_pipeline.go:150-182, pkg/jsonexp/expressions.go:59):
+equal-priority rules across all configs fuse into one kernel launch
+(SURVEY.md §2 P1/P2 mapping).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.compile import (
+    FALSE_SLOT,
+    OP_CPU,
+    OP_EQ,
+    OP_ERROR,
+    OP_EXCL,
+    OP_INCL,
+    OP_NEQ,
+    TRUE_SLOT,
+    CompiledPolicy,
+)
+
+__all__ = ["DevicePolicy", "to_device", "eval_verdicts", "eval_batch_jit"]
+
+
+def to_device(policy: CompiledPolicy, device=None) -> dict:
+    """Upload a compiled corpus's operands as a pytree of device arrays.
+    The engine double-buffers these and swaps atomically on reconcile
+    (SURVEY.md §3.4: rule-tensor compile + device upload on index Set)."""
+    put = partial(jax.device_put, device=device) if device is not None else jax.device_put
+    return {
+        "leaf_op": put(jnp.asarray(policy.leaf_op)),
+        "leaf_attr": put(jnp.asarray(policy.leaf_attr)),
+        "leaf_const": put(jnp.asarray(policy.leaf_const)),
+        "levels": tuple(
+            (put(jnp.asarray(children)), put(jnp.asarray(is_and)))
+            for children, is_and in policy.levels
+        ),
+        "eval_cond": put(jnp.asarray(policy.eval_cond)),
+        "eval_rule": put(jnp.asarray(policy.eval_rule)),
+        "eval_has_cond": put(jnp.asarray(policy.eval_has_cond)),
+    }
+
+
+DevicePolicy = dict
+
+
+def eval_verdicts(
+    params: DevicePolicy,
+    attrs_val: jnp.ndarray,      # [B, A] int32
+    attrs_members: jnp.ndarray,  # [B, A, K] int32
+    overflow: jnp.ndarray,       # [B, A] bool
+    cpu_lane: jnp.ndarray,       # [B, L] bool
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (verdict [B, G] bool, leaf_results [B, L] bool)."""
+    leaf_op = params["leaf_op"]          # [L]
+    leaf_attr = params["leaf_attr"]      # [L]
+    leaf_const = params["leaf_const"]    # [L]
+
+    B = attrs_val.shape[0]
+
+    # ---- leaf evaluation -------------------------------------------------
+    val = jnp.take(attrs_val, leaf_attr, axis=1)            # [B, L]
+    eq = val == leaf_const[None, :]
+    memb = jnp.take(attrs_members, leaf_attr, axis=1)       # [B, L, K]
+    incl = jnp.any(memb == leaf_const[None, :, None], axis=-1)
+    ovf = jnp.take(overflow, leaf_attr, axis=1)             # [B, L]
+
+    op = leaf_op[None, :]
+    res = jnp.where(
+        op == OP_EQ, eq,
+        jnp.where(
+            op == OP_NEQ, ~eq,
+            jnp.where(
+                op == OP_INCL, jnp.where(ovf, cpu_lane, incl),
+                jnp.where(
+                    op == OP_EXCL, jnp.where(ovf, cpu_lane, ~incl),
+                    jnp.where(op == OP_CPU, cpu_lane, False),  # OP_ERROR → False
+                ),
+            ),
+        ),
+    )
+
+    # ---- boolean-circuit reduction, level by level -----------------------
+    true_col = jnp.ones((B, 1), dtype=bool)
+    false_col = jnp.zeros((B, 1), dtype=bool)
+    buffer = jnp.concatenate([true_col, false_col, res], axis=1)
+    for children, is_and in params["levels"]:
+        ch = jnp.take(buffer, children.reshape(-1), axis=1)
+        ch = ch.reshape(B, children.shape[0], children.shape[1])
+        node = jnp.where(is_and[None, :], jnp.all(ch, axis=-1), jnp.any(ch, axis=-1))
+        buffer = jnp.concatenate([buffer, node], axis=1)
+
+    # ---- per-config verdicts: ∧ over evaluators of (¬cond ∨ rule) --------
+    cond = jnp.take(buffer, params["eval_cond"].reshape(-1), axis=1)
+    rule = jnp.take(buffer, params["eval_rule"].reshape(-1), axis=1)
+    G, E = params["eval_rule"].shape
+    cond = cond.reshape(B, G, E)
+    rule = rule.reshape(B, G, E)
+    skipped = params["eval_has_cond"][None, :, :] & ~cond
+    contrib = jnp.where(skipped, True, rule)
+    verdict = jnp.all(contrib, axis=-1)                      # [B, G]
+    return verdict, res
+
+
+@partial(jax.jit, static_argnames=())
+def _eval_jit(params, attrs_val, attrs_members, overflow, cpu_lane, config_id):
+    verdict, _ = eval_verdicts(params, attrs_val, attrs_members, overflow, cpu_lane)
+    # select each request's own config column
+    own = jnp.take_along_axis(verdict, config_id[:, None], axis=1)[:, 0]
+    return own, verdict
+
+
+def eval_batch_jit(params, encoded) -> Tuple[np.ndarray, np.ndarray]:
+    """Convenience wrapper: encoded batch (numpy) → (own verdicts [B],
+    full verdict matrix [B, G]) as numpy."""
+    own, verdict = _eval_jit(
+        params,
+        jnp.asarray(encoded.attrs_val),
+        jnp.asarray(encoded.attrs_members),
+        jnp.asarray(encoded.overflow),
+        jnp.asarray(encoded.cpu_lane),
+        jnp.asarray(encoded.config_id),
+    )
+    return np.asarray(own), np.asarray(verdict)

@@ -1,0 +1,3 @@
+"""Mesh/sharding layer: dp (batch) × mp (rules/configs) policy evaluation."""
+
+from .sharded_eval import ShardedPolicyModel, build_mesh  # noqa: F401

@@ -1,0 +1,233 @@
+"""Tensor-parallel (rules-axis) + data-parallel (batch-axis) policy
+evaluation over a jax.sharding.Mesh.
+
+The reference scales horizontally by label-selector sharding of AuthConfigs
+across replicas (ref: controllers/label_selector.go:14-45,
+docs/user-guides/sharding.md).  The TPU-era equivalent (SURVEY.md §2 P3):
+partition the *config axis* of the rule corpus across mesh shards — each
+shard holds the full boolean circuit of its configs, so the tree reduction
+stays shard-local and the only cross-shard communication is the final
+verdict gather, which XLA lays onto ICI.
+
+Layout:
+  - configs are round-robined into ``mp`` groups; each group compiles as its
+    own sub-corpus against a shared interner, with ShapeTargets forcing
+    identical operand shapes; arrays stack on a leading [S] axis
+  - mesh ('dp', 'mp'): batch is sharded over dp, the [S] corpus axis over mp
+  - shard_map evaluates each (dp, mp) block locally → verdict [B, S*G]
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..authjson import selector as sel
+from ..compiler.compile import (
+    CompiledPolicy,
+    ConfigRules,
+    ShapeTargets,
+    compile_corpus,
+)
+from ..compiler.encode import encode_batch
+from ..compiler.intern import StringInterner
+from ..ops.pattern_eval import eval_verdicts, to_device
+
+__all__ = ["ShardedPolicyModel", "build_mesh"]
+
+
+def build_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mesh:
+    devices = np.asarray(jax.devices()[: n_devices or len(jax.devices())])
+    n = devices.size
+    if dp is None:
+        dp = 2 if n % 2 == 0 and n > 1 else 1
+    mp = n // dp
+    return Mesh(devices[: dp * mp].reshape(dp, mp), ("dp", "mp"))
+
+
+@dataclass
+class _ShardedEncoded:
+    attrs_val: np.ndarray      # [B, S, A]
+    attrs_members: np.ndarray  # [B, S, A, K]
+    overflow: np.ndarray       # [B, S, A]
+    cpu_lane: np.ndarray       # [B, S, L]
+    shard_of: np.ndarray       # [B] which shard owns the request's config
+    row_of: np.ndarray         # [B] row within that shard
+
+
+class ShardedPolicyModel:
+    """Rule corpus partitioned over the 'mp' mesh axis; batch over 'dp'."""
+
+    def __init__(self, configs: Sequence[ConfigRules], mesh: Mesh, members_k: int = 16):
+        self.mesh = mesh
+        S = mesh.shape["mp"]
+        self.n_shards = S
+        interner = StringInterner()
+        groups: List[List[ConfigRules]] = [[] for _ in range(S)]
+        self.locator: Dict[str, Tuple[int, int]] = {}
+        for i, cfg in enumerate(configs):
+            shard = i % S
+            self.locator[cfg.name] = (shard, len(groups[shard]))
+            groups[shard].append(cfg)
+
+        # two-pass compile: natural shapes → union targets → final compile
+        first = [compile_corpus(g, members_k=members_k, interner=interner) for g in groups]
+        targets = ShapeTargets.union([p.shape_targets() for p in first])
+        self.shards: List[CompiledPolicy] = [
+            compile_corpus(g, members_k=members_k, interner=interner, targets=targets)
+            for g in groups
+        ]
+        # eval tables may still differ in row count (configs per shard): pad G
+        G = max(p.n_configs for p in self.shards)
+        self.configs_per_shard = G
+
+        def pad_rows(a: np.ndarray, fill) -> np.ndarray:
+            if a.shape[0] == G:
+                return a
+            pad = np.full((G - a.shape[0],) + a.shape[1:], fill, dtype=a.dtype)
+            return np.concatenate([a, pad], axis=0)
+
+        stacked: Dict[str, Any] = {}
+        per_shard_params = [to_device(p) for p in self.shards]
+        # stack on leading S axis (device-side stack is fine at these sizes)
+        from ..compiler.compile import TRUE_SLOT
+
+        def stack(key):
+            return jnp.stack([pp[key] for pp in per_shard_params])
+
+        eval_cond = np.stack([pad_rows(p.eval_cond, TRUE_SLOT) for p in self.shards])
+        eval_rule = np.stack([pad_rows(p.eval_rule, TRUE_SLOT) for p in self.shards])
+        eval_has = np.stack([pad_rows(p.eval_has_cond, False) for p in self.shards])
+        n_levels = len(self.shards[0].levels)
+        self.params = {
+            "leaf_op": stack("leaf_op"),
+            "leaf_attr": stack("leaf_attr"),
+            "leaf_const": stack("leaf_const"),
+            "levels": tuple(
+                (
+                    jnp.stack([jnp.asarray(p.levels[l][0]) for p in self.shards]),
+                    jnp.stack([jnp.asarray(p.levels[l][1]) for p in self.shards]),
+                )
+                for l in range(n_levels)
+            ),
+            "eval_cond": jnp.asarray(eval_cond),
+            "eval_rule": jnp.asarray(eval_rule),
+            "eval_has_cond": jnp.asarray(eval_has),
+        }
+        self._place_params()
+        self._step = self._build_step()
+
+    # ------------------------------------------------------------------
+
+    def _param_specs(self):
+        lspec = tuple((P("mp"), P("mp")) for _ in self.params["levels"])
+        return {
+            "leaf_op": P("mp"),
+            "leaf_attr": P("mp"),
+            "leaf_const": P("mp"),
+            "levels": lspec,
+            "eval_cond": P("mp"),
+            "eval_rule": P("mp"),
+            "eval_has_cond": P("mp"),
+        }
+
+    def _place_params(self):
+        specs = self._param_specs()
+
+        def place(a, spec):
+            return jax.device_put(a, NamedSharding(self.mesh, spec))
+
+        p = self.params
+        self.params = {
+            "leaf_op": place(p["leaf_op"], specs["leaf_op"]),
+            "leaf_attr": place(p["leaf_attr"], specs["leaf_attr"]),
+            "leaf_const": place(p["leaf_const"], specs["leaf_const"]),
+            "levels": tuple(
+                (place(c, P("mp")), place(a, P("mp"))) for c, a in p["levels"]
+            ),
+            "eval_cond": place(p["eval_cond"], specs["eval_cond"]),
+            "eval_rule": place(p["eval_rule"], specs["eval_rule"]),
+            "eval_has_cond": place(p["eval_has_cond"], specs["eval_has_cond"]),
+        }
+
+    def _build_step(self):
+        shard_map = jax.shard_map
+
+        mesh = self.mesh
+        specs = self._param_specs()
+
+        def local_eval(params, attrs_val, attrs_members, overflow, cpu_lane):
+            # params leading axis is the local S slice (size 1 per mp shard)
+            sq = jax.tree_util.tree_map(lambda a: a[0], params)
+            verdict, _ = eval_verdicts(
+                sq, attrs_val[:, 0], attrs_members[:, 0], overflow[:, 0], cpu_lane[:, 0]
+            )
+            return verdict  # [B_local, G]
+
+        step = shard_map(
+            local_eval,
+            mesh=mesh,
+            in_specs=(
+                specs,
+                P("dp", "mp", None),
+                P("dp", "mp", None, None),
+                P("dp", "mp", None),
+                P("dp", "mp", None),
+            ),
+            out_specs=P("dp", "mp"),
+        )
+        return jax.jit(step)
+
+    # ------------------------------------------------------------------
+
+    def encode(self, docs: Sequence[Any], config_names: Sequence[str], batch_pad: int = 0) -> _ShardedEncoded:
+        from ..compiler.intern import EMPTY_ID, PAD
+        from ..compiler.compile import OP_CPU, OP_ERROR, OP_INCL
+
+        B = max(len(docs), 1)
+        if batch_pad and batch_pad > B:
+            B = batch_pad
+        dp = self.mesh.shape["dp"]
+        if B % dp:
+            B += dp - B % dp
+        S = self.n_shards
+        p0 = self.shards[0]
+        A, K, L = p0.n_attrs, p0.members_k, p0.n_leaves
+        attrs_val = np.full((B, S, A), EMPTY_ID, dtype=np.int32)
+        attrs_members = np.full((B, S, A, K), PAD, dtype=np.int32)
+        overflow = np.zeros((B, S, A), dtype=bool)
+        cpu_lane = np.zeros((B, S, L), dtype=bool)
+        shard_of = np.zeros((B,), dtype=np.int32)
+        row_of = np.zeros((B,), dtype=np.int32)
+        for r, (doc, name) in enumerate(zip(docs, config_names)):
+            shard, row = self.locator[name]
+            shard_of[r], row_of[r] = shard, row
+            p = self.shards[shard]
+            enc = encode_batch(p, [doc], [row])
+            attrs_val[r, shard] = enc.attrs_val[0]
+            attrs_members[r, shard] = enc.attrs_members[0]
+            overflow[r, shard] = enc.overflow[0]
+            cpu_lane[r, shard] = enc.cpu_lane[0]
+        return _ShardedEncoded(attrs_val, attrs_members, overflow, cpu_lane, shard_of, row_of)
+
+    def apply(self, encoded: _ShardedEncoded) -> np.ndarray:
+        verdict = self._step(
+            self.params,
+            jnp.asarray(encoded.attrs_val),
+            jnp.asarray(encoded.attrs_members),
+            jnp.asarray(encoded.overflow),
+            jnp.asarray(encoded.cpu_lane),
+        )
+        v = np.asarray(verdict)  # [B, S*G]
+        flat = encoded.shard_of * self.configs_per_shard + encoded.row_of
+        return v[np.arange(v.shape[0]), flat]
+
+    def decide(self, docs: Sequence[Any], config_names: Sequence[str]) -> List[bool]:
+        enc = self.encode(docs, config_names)
+        own = self.apply(enc)
+        return [bool(b) for b in own[: len(docs)]]

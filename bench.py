@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""North-star benchmark: batched policy-decision throughput at the
+BASELINE.json workload — 10k pattern rules over 1k AuthConfigs.
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "req/s", "vs_baseline": N}
+
+vs_baseline is measured RPS / 100_000 (the driver-set target: ≥100k Check()
+RPS at p99 < 2ms on one v5e-1; the Go reference's full pipeline runs one
+request in 363.9 µs/op ≈ 2.7k sequential evals per core-second —
+BASELINE.md).  Extra detail goes to stderr.
+
+Run on the real chip (default platform); CPU fallback works for smoke runs:
+  JAX_PLATFORMS=cpu python bench.py --seconds 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_corpus(n_configs: int, rules_per_config: int, seed: int = 42):
+    from authorino_tpu.compiler import ConfigRules
+    from authorino_tpu.expressions import All, Any_, Operator, Pattern
+
+    rng = random.Random(seed)
+    configs = []
+    for i in range(n_configs):
+        pats = []
+        # realistic mix: host/method/path eq, role membership, tier checks;
+        # ~5% regex rules (CPU lane)
+        # constants are mostly config-unique so global leaf dedupe cannot
+        # collapse the corpus: the compiled rule axis stays ~n_configs×rules
+        pats.append(Pattern("request.method", Operator.EQ, rng.choice(["GET", "POST"])))
+        pats.append(Pattern("auth.identity.org", Operator.EQ, f"org-{i}"))
+        for j in range(rules_per_config - 3):
+            kind = rng.random()
+            if kind < 0.05:
+                pats.append(Pattern("request.url_path", Operator.MATCHES, rf"^/api/v\d+/r{j}"))
+            elif kind < 0.45:
+                pats.append(Pattern("auth.identity.roles", Operator.INCL, f"role-{i}-{rng.randrange(50)}"))
+            elif kind < 0.65:
+                pats.append(Pattern("auth.identity.groups", Operator.EXCL, f"banned-{i}-{rng.randrange(20)}"))
+            else:
+                pats.append(Pattern(f"request.headers.x-attr-{rng.randrange(8)}", Operator.NEQ, f"v-{i}-{rng.randrange(9)}"))
+        rule = All(pats[0], Any_(*pats[1:]))
+        configs.append(ConfigRules(name=f"cfg-{i}", evaluators=[(None, rule)]))
+    return configs
+
+
+def build_docs(n_docs: int, seed: int = 7):
+    rng = random.Random(seed)
+    docs = []
+    for _ in range(n_docs):
+        docs.append(
+            {
+                "request": {
+                    "method": rng.choice(["GET", "POST", "DELETE"]),
+                    "url_path": rng.choice(["/api/v1/r0", "/api/v2/r1", "/x"]),
+                    "headers": {f"x-attr-{k}": f"v{rng.randrange(9)}" for k in range(4)},
+                },
+                "auth": {
+                    "identity": {
+                        "org": f"org-{rng.randrange(1000)}",
+                        "roles": [f"role-{rng.randrange(1000)}-{rng.randrange(50)}" for _ in range(rng.randrange(1, 6))],
+                        "groups": [f"g-{rng.randrange(30)}" for _ in range(rng.randrange(0, 4))],
+                    }
+                },
+            }
+        )
+    return docs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", type=int, default=1000)
+    ap.add_argument("--rules", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--docs", type=int, default=4096)
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    import jax
+
+    # honor an explicit CPU request even under the TPU-tunnel sitecustomize,
+    # which imports jax at interpreter start and forces the axon platform
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    log(f"jax {jax.__version__} devices={jax.devices()} (init {time.perf_counter()-t0:.1f}s)")
+
+    from authorino_tpu.models import PolicyModel
+
+    t0 = time.perf_counter()
+    configs = build_corpus(args.configs, args.rules)
+    t_build = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    model = PolicyModel.from_configs(configs, members_k=8)
+    t_compile = time.perf_counter() - t0
+    p = model.policy
+    log(
+        f"corpus: {args.configs} configs × {args.rules} rules → "
+        f"{p.n_leaves} leaf slots, {p.n_attrs} attrs, buffer {p.buffer_size} "
+        f"(build {t_build:.2f}s, compile+upload {t_compile:.2f}s)"
+    )
+
+    docs = build_docs(args.docs)
+    rng = random.Random(3)
+    rows = [rng.randrange(args.configs) for _ in range(args.docs)]
+
+    B = args.batch
+    # warmup (includes XLA compile)
+    enc = model.encode(docs[:B], rows[:B], batch_pad=B)
+    t0 = time.perf_counter()
+    model.apply(enc)
+    log(f"warmup apply (XLA compile): {time.perf_counter()-t0:.2f}s")
+
+    # measured loop: encode + eval per batch (latency = full batch path)
+    lat = []
+    total = 0
+    start = time.perf_counter()
+    i = 0
+    enc_time = 0.0
+    dev_time = 0.0
+    while time.perf_counter() - start < args.seconds:
+        lo = (i * B) % (args.docs - B + 1)
+        t1 = time.perf_counter()
+        enc = model.encode(docs[lo : lo + B], rows[lo : lo + B], batch_pad=B)
+        t2 = time.perf_counter()
+        own, _ = model.apply(enc)
+        t3 = time.perf_counter()
+        enc_time += t2 - t1
+        dev_time += t3 - t2
+        lat.append(t3 - t1)
+        total += B
+        i += 1
+    elapsed = time.perf_counter() - start
+    rps = total / elapsed
+    lat.sort()
+    p50 = lat[len(lat) // 2] * 1e3
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3
+    log(
+        f"batches={len(lat)} B={B} rps={rps:,.0f} "
+        f"batch p50={p50:.2f}ms p99={p99:.2f}ms "
+        f"(encode {enc_time/len(lat)*1e3:.2f}ms/batch, device {dev_time/len(lat)*1e3:.2f}ms/batch)"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "policy_decisions_per_sec_10k_rules_1k_configs",
+                "value": round(rps, 1),
+                "unit": "req/s",
+                "vs_baseline": round(rps / 100_000.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,12 +1,21 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh *before* jax imports,
-so multi-chip sharding paths are exercised without TPU hardware."""
+"""Test bootstrap: force an 8-device virtual CPU mesh so multi-chip sharding
+paths are exercised without TPU hardware.
+
+NOTE: on this image a sitecustomize shim registers the TPU-tunnel ("axon")
+PJRT plugin at interpreter startup and imports jax before conftest runs, so
+env-var overrides alone are too late; backend *initialization* is still lazy,
+so `jax.config.update("jax_platforms", "cpu")` after import wins."""
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

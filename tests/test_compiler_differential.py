@@ -1,0 +1,202 @@
+"""Differential tests: CPU pattern oracle vs compiled TPU kernel.
+
+The analog of the reference's OPA-vs-JSON benchmark comparison table
+(SURVEY.md §4): same rule corpus + request batch must produce identical
+allow/deny bitmasks on both paths.
+"""
+
+import random
+import string
+
+import numpy as np
+import pytest
+
+from authorino_tpu.compiler import ConfigRules, compile_corpus, encode_batch
+from authorino_tpu.expressions import All, Any_, Operator, Pattern
+from authorino_tpu.ops import eval_batch_jit, to_device
+
+SELECTORS = [
+    "request.method",
+    "request.url_path",
+    "request.headers.x-org",
+    "request.headers.x-tier",
+    "auth.identity.username",
+    "auth.identity.roles",
+    "auth.identity.groups",
+    "auth.identity.age",
+]
+
+VALUES = ["GET", "POST", "DELETE", "/a", "/b/c", "acme", "umbrella", "gold",
+          "john", "jane", "admin", "dev", "ops", "42", ""]
+
+
+def random_pattern(rng):
+    op = rng.choice([Operator.EQ, Operator.NEQ, Operator.INCL, Operator.EXCL, Operator.MATCHES])
+    sel = rng.choice(SELECTORS)
+    if op is Operator.MATCHES:
+        val = rng.choice([r"^/a", r"\d+", r"^(GET|POST)$", r"adm.n", r"^$"])
+    else:
+        val = rng.choice(VALUES)
+    return Pattern(sel, op, val)
+
+
+def random_expr(rng, depth=0):
+    if depth >= 3 or rng.random() < 0.5:
+        return random_pattern(rng)
+    comb = All if rng.random() < 0.5 else Any_
+    n = rng.randint(1, 4)
+    return comb(*[random_expr(rng, depth + 1) for _ in range(n)])
+
+
+def random_doc(rng):
+    roles = rng.sample(["admin", "dev", "ops", "root", "qa"], k=rng.randint(0, 4))
+    groups = [rng.choice(VALUES) for _ in range(rng.randint(0, 20))]  # may overflow K
+    doc = {
+        "request": {
+            "method": rng.choice(["GET", "POST", "DELETE", "PUT"]),
+            "url_path": rng.choice(["/a", "/b/c", "/x/9", ""]),
+            "headers": {},
+        },
+        "auth": {"identity": {}},
+    }
+    if rng.random() < 0.8:
+        doc["request"]["headers"]["x-org"] = rng.choice(VALUES + ["unseen-org-xyz"])
+    if rng.random() < 0.5:
+        doc["request"]["headers"]["x-tier"] = rng.choice(["gold", "silver"])
+    ident = doc["auth"]["identity"]
+    if rng.random() < 0.9:
+        ident["username"] = rng.choice(["john", "jane", "nobody-seen"])
+    if rng.random() < 0.9:
+        ident["roles"] = roles
+    if rng.random() < 0.6:
+        ident["groups"] = groups
+    if rng.random() < 0.5:
+        ident["age"] = rng.choice([42, 17, 0.5, None])
+    return doc
+
+
+def oracle_verdict(cfg: ConfigRules, doc) -> bool:
+    """Reference semantics: all-must-pass; conditions gate each evaluator
+    (skip counts as pass); evaluation errors deny
+    (ref: pkg/service/auth_pipeline.go:287-322, 120-125)."""
+    for cond, rule in cfg.evaluators:
+        if cond is not None:
+            try:
+                if not cond.matches(doc):
+                    continue
+            except Exception:
+                continue  # condition error → evaluator skipped (ignored)
+        try:
+            if not rule.matches(doc):
+                return False
+        except Exception:
+            return False
+    return True
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_differential_random_corpora(seed):
+    rng = random.Random(seed)
+    n_configs = rng.randint(2, 12)
+    configs = []
+    for i in range(n_configs):
+        n_evals = rng.randint(1, 4)
+        evaluators = []
+        for _ in range(n_evals):
+            cond = random_expr(rng) if rng.random() < 0.4 else None
+            evaluators.append((cond, random_expr(rng)))
+        configs.append(ConfigRules(name=f"cfg-{i}", evaluators=evaluators))
+
+    policy = compile_corpus(configs, members_k=8)  # small K to force overflow lane
+    params = to_device(policy)
+
+    docs = [random_doc(rng) for _ in range(64)]
+    rows = [rng.randrange(n_configs) for _ in docs]
+    encoded = encode_batch(policy, docs, rows)
+    own, full = eval_batch_jit(params, encoded)
+
+    for r, (doc, row) in enumerate(zip(docs, rows)):
+        expected = oracle_verdict(configs[row], doc)
+        assert bool(own[r]) == expected, (
+            f"seed={seed} req={r} cfg={row}: kernel={bool(own[r])} oracle={expected}\n"
+            f"evaluators={[(str(c) if c else None, str(ru)) for c, ru in configs[row].evaluators]}\n"
+            f"doc={doc}"
+        )
+
+
+def test_empty_and_edge_expressions():
+    from authorino_tpu.expressions import TRUE, FALSE
+
+    configs = [
+        ConfigRules("allow-all", evaluators=[(None, TRUE)]),
+        ConfigRules("deny-all", evaluators=[(None, FALSE)]),
+        ConfigRules("no-evaluators", evaluators=[]),
+        ConfigRules("gated", evaluators=[(Pattern("request.method", Operator.EQ, "GET"), FALSE)]),
+    ]
+    policy = compile_corpus(configs)
+    params = to_device(policy)
+    docs = [{"request": {"method": m}} for m in ("GET", "POST")]
+    # NOTE: the encoder resolves only each request's own config's attributes —
+    # other configs' verdict columns are garbage by design. Route per config.
+    encoded = encode_batch(policy, docs + docs + docs + docs, [0, 0, 1, 1, 2, 2, 3, 3])
+    own, _ = eval_batch_jit(params, encoded)
+    # allow-all allows everything; deny-all denies; no evaluators → allow
+    assert own[0] and own[1]
+    assert not own[2] and not own[3]
+    assert own[4] and own[5]
+    # gated: cond GET → rule FALSE denies; cond POST unmatched → skip → allow
+    assert not own[6]
+    assert own[7]
+
+
+def test_interning_exactness_no_collisions():
+    # unseen request values must not equal any constant
+    configs = [ConfigRules("c", evaluators=[(None, Pattern("a.b", Operator.EQ, "secret-value"))])]
+    policy = compile_corpus(configs)
+    params = to_device(policy)
+    docs = [{"a": {"b": "secret-value"}}, {"a": {"b": "other"}}, {"a": {}}, {}]
+    encoded = encode_batch(policy, docs, [0, 0, 0, 0])
+    own, _ = eval_batch_jit(params, encoded)
+    assert list(own) == [True, False, False, False]
+
+    # eq "" matches a missing value (gjson String() of missing is "")
+    configs = [ConfigRules("c", evaluators=[(None, Pattern("a.b", Operator.EQ, ""))])]
+    policy = compile_corpus(configs)
+    encoded = encode_batch(policy, [{}, {"a": {"b": "x"}}], [0, 0])
+    own, _ = eval_batch_jit(to_device(policy), encoded)
+    assert list(own) == [True, False]
+
+
+def test_membership_overflow_exact():
+    # array longer than K must still evaluate incl/excl exactly via CPU lane
+    K = 4
+    configs = [
+        ConfigRules("c", evaluators=[
+            (None, Pattern("roles", Operator.INCL, "needle")),
+            (None, Pattern("roles", Operator.EXCL, "banned")),
+        ])
+    ]
+    policy = compile_corpus(configs, members_k=K)
+    params = to_device(policy)
+    long_with_needle = {"roles": [f"r{i}" for i in range(10)] + ["needle"]}
+    long_without = {"roles": [f"r{i}" for i in range(10)]}
+    long_banned = {"roles": [f"r{i}" for i in range(10)] + ["needle", "banned"]}
+    short_hit = {"roles": ["needle"]}
+    docs = [long_with_needle, long_without, long_banned, short_hit]
+    encoded = encode_batch(policy, docs, [0] * 4)
+    own, _ = eval_batch_jit(params, encoded)
+    assert list(own) == [True, False, False, True]
+
+
+def test_regex_lane():
+    configs = [
+        ConfigRules("c", evaluators=[(None, Pattern("path", Operator.MATCHES, r"^/pets/\d+$"))]),
+        ConfigRules("bad", evaluators=[(None, Pattern("path", Operator.MATCHES, "(["))]),
+    ]
+    policy = compile_corpus(configs)
+    params = to_device(policy)
+    docs = [{"path": "/pets/1"}, {"path": "/pets/x"}, {"path": "/pets/2"}]
+    encoded = encode_batch(policy, docs, [0, 0, 1])
+    own, _ = eval_batch_jit(params, encoded)
+    # invalid regex → evaluation error → deny (ref: error return denies)
+    assert list(own) == [True, False, False]
