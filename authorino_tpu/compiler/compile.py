@@ -42,12 +42,12 @@ from ..expressions.ast import And, Expression, Operator, Or, Pattern
 from .intern import PAD, StringInterner
 
 __all__ = [
-    "OP_EQ", "OP_NEQ", "OP_INCL", "OP_EXCL", "OP_CPU", "OP_ERROR",
+    "OP_EQ", "OP_NEQ", "OP_INCL", "OP_EXCL", "OP_CPU", "OP_ERROR", "OP_TREE_CPU",
     "ConfigRules", "CompiledPolicy", "ShapeTargets", "compile_corpus",
     "TRUE_SLOT", "FALSE_SLOT",
 ]
 
-OP_EQ, OP_NEQ, OP_INCL, OP_EXCL, OP_CPU, OP_ERROR = 0, 1, 2, 3, 4, 5
+OP_EQ, OP_NEQ, OP_INCL, OP_EXCL, OP_CPU, OP_ERROR, OP_TREE_CPU = 0, 1, 2, 3, 4, 5, 6
 
 TRUE_SLOT = 0
 FALSE_SLOT = 1
@@ -97,6 +97,13 @@ class _Leaf:
     attr: int
     const: int
     regex: Optional[str] = None  # for CPU lane
+    tree: Optional[Expression] = None  # for OP_TREE_CPU whole-tree fallback
+
+
+def _has_invalid_regex(expr: Expression) -> bool:
+    if isinstance(expr, Pattern):
+        return expr.operator is Operator.MATCHES and getattr(expr, "_regex", None) is None
+    return any(_has_invalid_regex(c) for c in expr.children)
 
 
 @dataclass
@@ -119,6 +126,7 @@ class CompiledPolicy:
     config_attrs: List[List[int]]        # per config: attr idxs to resolve
     config_cpu_leaves: List[List[int]]   # per config: leaf idxs needing CPU lane
     leaf_regex: List[Optional["re.Pattern"]]  # per leaf: compiled regex or None
+    leaf_tree: List[Optional[Expression]]     # per leaf: whole-tree CPU fallback
     leaf_is_membership: np.ndarray       # [L] bool — incl/excl (overflow-capable)
     members_k: int                       # K: membership vector width
 
@@ -177,6 +185,7 @@ class _Lowerer:
         # nodes: (depth, is_and, children buffer idxs)
         self.nodes: List[Tuple[int, bool, List[int]]] = []
         self.depth_of: Dict[int, int] = {TRUE_SLOT: 0, FALSE_SLOT: 0}
+        self.tree_leaf_by_expr: Dict[int, int] = {}
 
     def attr_idx(self, selector: str) -> int:
         i = self.attrs.get(selector)
@@ -212,8 +221,24 @@ class _Lowerer:
         self.depth_of[buf] = 0
         return buf
 
+    def lower_tree_cpu(self, expr: Expression) -> int:
+        """Whole-tree CPU-fallback leaf: used when a tree contains an invalid
+        regex, whose error must propagate with the reference's left-to-right
+        short-circuit semantics (error ⇒ deny for rules, ⇒ skip for
+        conditions; both read as False at the tree root —
+        ref pkg/jsonexp/expressions.go:87-91,111-154).  Un-tensorizable, so
+        the encoder evaluates the expression with the CPU oracle."""
+        idx = len(self.leaves)
+        self.leaves.append(_Leaf(op=OP_TREE_CPU, attr=0, const=0, tree=expr))
+        self.tree_leaf_by_expr[id(expr)] = idx
+        buf = _LEAF_BASE + idx
+        self.depth_of[buf] = 0
+        return buf
+
     def lower(self, expr: Expression) -> int:
         """Return the buffer index holding this expression's result."""
+        if _has_invalid_regex(expr):
+            return self.lower_tree_cpu(expr)
         if isinstance(expr, Pattern):
             return self.lower_leaf(expr)
         is_and = isinstance(expr, And)
@@ -339,6 +364,7 @@ def compile_corpus(
     leaf_attr = np.zeros((Lp,), dtype=np.int32)
     leaf_const = np.full((Lp,), PAD, dtype=np.int32)  # PAD const: matches nothing
     leaf_regex: List[Optional[re.Pattern]] = [None] * Lp
+    leaf_tree: List[Optional[Expression]] = [None] * Lp
     leaf_is_membership = np.zeros((Lp,), dtype=bool)
     for i, leaf in enumerate(lw.leaves):
         leaf_op[i] = leaf.op
@@ -347,6 +373,8 @@ def compile_corpus(
         leaf_is_membership[i] = leaf.op in (OP_INCL, OP_EXCL)
         if leaf.op == OP_CPU and leaf.regex is not None:
             leaf_regex[i] = re.compile(leaf.regex)
+        if leaf.op == OP_TREE_CPU:
+            leaf_tree[i] = leaf.tree
 
     n_attrs = len(lw.attrs)
     Ap = _round_up(n_attrs) if pad else max(n_attrs, 1)
@@ -366,6 +394,10 @@ def compile_corpus(
         leaf_of_attr.setdefault(leaf.attr, []).append(i)
 
     def collect_attrs(expr: Expression, acc_attrs: set, acc_cpu: set):
+        if _has_invalid_regex(expr):
+            # whole tree rode the CPU-fallback leaf; no attrs were lowered
+            acc_cpu.add(lw.tree_leaf_by_expr[id(expr)])
+            return
         if isinstance(expr, Pattern):
             attr = lw.attrs[expr.selector]
             acc_attrs.add(attr)
@@ -405,6 +437,7 @@ def compile_corpus(
         config_attrs=config_attrs,
         config_cpu_leaves=config_cpu_leaves,
         leaf_regex=leaf_regex,
+        leaf_tree=leaf_tree,
         leaf_is_membership=leaf_is_membership,
         members_k=members_k,
     )

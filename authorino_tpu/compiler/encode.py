@@ -20,7 +20,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..authjson import selector as sel
-from .compile import OP_CPU, OP_ERROR, OP_EXCL, OP_INCL, CompiledPolicy
+from .compile import OP_CPU, OP_ERROR, OP_EXCL, OP_INCL, OP_TREE_CPU, CompiledPolicy
 from .intern import EMPTY_ID, PAD
 
 __all__ = ["EncodedBatch", "encode_batch"]
@@ -59,9 +59,14 @@ def _fast_resolvers(policy: CompiledPolicy):
                         if cur is _MISSING:
                             return _MISSING
                     elif isinstance(cur, list):
+                        # match selector.get: only non-negative in-range indices
                         try:
-                            cur = cur[int(k)]
-                        except (ValueError, IndexError):
+                            idx = int(k)
+                        except ValueError:
+                            return _MISSING
+                        if 0 <= idx < len(cur):
+                            cur = cur[idx]
+                        else:
                             return _MISSING
                     else:
                         return _MISSING
@@ -171,7 +176,18 @@ def encode_batch(
         # CPU lane: regex always; incl/excl only when overflowed
         for leaf in config_cpu_leaves[row]:
             op = leaf_op[leaf]
-            if op == OP_CPU:
+            if op == OP_TREE_CPU:
+                # whole-tree oracle fallback (invalid-regex trees): error ⇒
+                # False (deny for rules, skip for conditions — exact at root)
+                expr = policy.leaf_tree[leaf]
+                try:
+                    v_tree = bool(expr.matches(doc)) if expr is not None else False
+                except Exception:
+                    v_tree = False
+                c_r.append(r)
+                c_l.append(leaf)
+                c_v.append(v_tree)
+            elif op == OP_CPU:
                 rx = leaf_regex[leaf]
                 v = res_by_attr.get(leaf_attr[leaf], _MISSING)
                 c_r.append(r)

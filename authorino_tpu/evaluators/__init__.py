@@ -1,0 +1,18 @@
+"""Evaluator framework: phase wrappers, runtime AuthConfig, leaf evaluators."""
+
+from .base import (  # noqa: F401
+    AuthorizationConfig,
+    CallbackConfig,
+    DenyWith,
+    DenyWithValues,
+    EvaluationError,
+    IdentityConfig,
+    IdentityExtension,
+    MetadataConfig,
+    PhaseConfig,
+    ResponseConfig,
+    RuntimeAuthConfig,
+    wrap_responses,
+)
+from .cache import EvaluatorCache  # noqa: F401
+from .credentials import AuthCredentials, CredentialNotFound  # noqa: F401

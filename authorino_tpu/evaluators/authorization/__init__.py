@@ -1,0 +1,3 @@
+"""Authorization leaf evaluators."""
+
+from .pattern_matching import PatternMatching  # noqa: F401

@@ -1,0 +1,51 @@
+"""Pattern-matching authorization — the north-star TPU evaluator.
+
+Two execution modes behind one evaluator seam (the reference's plugin
+interface, ref: pkg/auth/auth.go:26-28; leaf semantics
+ref: pkg/evaluators/authorization/json.go:11-27):
+
+- *inline*: evaluate the precompiled expression structurally over the live
+  Authorization JSON (already removes the reference's per-request
+  re-marshal + gjson parse + regex recompile costs);
+- *batched*: await a verdict from a micro-batching policy engine that
+  evaluates the whole corpus on TPU (runtime/engine.py); the pipeline seam
+  is identical, so mixed CPU/TPU AuthConfigs compose (BASELINE.json north
+  star).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Awaitable, Callable, Optional
+
+from ...expressions.ast import Expression, PatternError
+from ..base import EvaluationError, SkippedError
+
+# a BatchedVerdictProvider resolves (pipeline, evaluator_slot) →
+# (allowed, skipped); skipped means the compiled conditions gated it off
+BatchedVerdictProvider = Callable[[Any, int], "Awaitable[tuple[bool, bool]]"]
+
+
+class PatternMatching:
+    def __init__(
+        self,
+        rules: Expression,
+        batched_provider: Optional[BatchedVerdictProvider] = None,
+        evaluator_slot: int = 0,
+    ):
+        self.rules = rules
+        self.batched_provider = batched_provider
+        self.evaluator_slot = evaluator_slot
+
+    async def call(self, pipeline) -> Any:
+        if self.batched_provider is not None:
+            allowed, skipped = await self.batched_provider(pipeline, self.evaluator_slot)
+            if skipped:
+                raise SkippedError()
+        else:
+            try:
+                allowed = self.rules.matches(pipeline.authorization_json())
+            except PatternError as e:
+                raise EvaluationError(str(e))
+        if not allowed:
+            raise EvaluationError("Unauthorized")
+        return True

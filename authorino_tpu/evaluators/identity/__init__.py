@@ -1,0 +1,4 @@
+"""Identity leaf evaluators."""
+
+from .noop import Noop  # noqa: F401
+from .plain import Plain  # noqa: F401

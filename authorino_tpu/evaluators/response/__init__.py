@@ -1,0 +1,4 @@
+"""Response leaf evaluators."""
+
+from .dynamic_json import DynamicJSON  # noqa: F401
+from .plain import Plain  # noqa: F401

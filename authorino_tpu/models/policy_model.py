@@ -20,16 +20,9 @@ import numpy as np
 
 from ..compiler.compile import CompiledPolicy, ConfigRules, compile_corpus
 from ..compiler.encode import EncodedBatch, encode_batch
-from ..ops.pattern_eval import eval_verdicts, to_device
+from ..ops.pattern_eval import _eval_jit, forward, to_device
 
 __all__ = ["PolicyModel"]
-
-
-def _forward(params, attrs_val, attrs_members, overflow, cpu_lane, config_id):
-    """Jittable forward step: encoded micro-batch → own-config verdicts."""
-    verdict, _ = eval_verdicts(params, attrs_val, attrs_members, overflow, cpu_lane)
-    own = jnp.take_along_axis(verdict, config_id[:, None], axis=1)[:, 0]
-    return own, verdict
 
 
 class PolicyModel:
@@ -39,7 +32,8 @@ class PolicyModel:
     def __init__(self, policy: CompiledPolicy, device=None):
         self.policy = policy
         self.params = to_device(policy, device=device)
-        self._apply = jax.jit(_forward)
+        # module-level jit: identical-shape models share one trace cache
+        self._apply = _eval_jit
 
     @classmethod
     def from_configs(cls, configs: Sequence[ConfigRules], members_k: int = 16, device=None) -> "PolicyModel":
@@ -79,4 +73,4 @@ class PolicyModel:
             jnp.asarray(enc.cpu_lane),
             jnp.asarray(enc.config_id),
         )
-        return _forward, args
+        return forward, args

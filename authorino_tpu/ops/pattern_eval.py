@@ -27,6 +27,7 @@ from ..compiler.compile import (
     OP_EXCL,
     OP_INCL,
     OP_NEQ,
+    OP_TREE_CPU,
     TRUE_SLOT,
     CompiledPolicy,
 )
@@ -62,8 +63,8 @@ def eval_verdicts(
     attrs_members: jnp.ndarray,  # [B, A, K] int32
     overflow: jnp.ndarray,       # [B, A] bool
     cpu_lane: jnp.ndarray,       # [B, L] bool
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (verdict [B, G] bool, leaf_results [B, L] bool)."""
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Returns (verdict [B, G] bool, (rule_results [B, G, E], skipped [B, G, E]))."""
     leaf_op = params["leaf_op"]          # [L]
     leaf_attr = params["leaf_attr"]      # [L]
     leaf_const = params["leaf_const"]    # [L]
@@ -86,7 +87,8 @@ def eval_verdicts(
                 op == OP_INCL, jnp.where(ovf, cpu_lane, incl),
                 jnp.where(
                     op == OP_EXCL, jnp.where(ovf, cpu_lane, ~incl),
-                    jnp.where(op == OP_CPU, cpu_lane, False),  # OP_ERROR → False
+                    # OP_CPU (regex) and OP_TREE_CPU ride the lane; OP_ERROR → False
+                    jnp.where((op == OP_CPU) | (op == OP_TREE_CPU), cpu_lane, False),
                 ),
             ),
         ),
@@ -111,15 +113,33 @@ def eval_verdicts(
     skipped = params["eval_has_cond"][None, :, :] & ~cond
     contrib = jnp.where(skipped, True, rule)
     verdict = jnp.all(contrib, axis=-1)                      # [B, G]
-    return verdict, res
+    return verdict, (rule, skipped)
 
 
-@partial(jax.jit, static_argnames=())
-def _eval_jit(params, attrs_val, attrs_members, overflow, cpu_lane, config_id):
+def forward(params, attrs_val, attrs_members, overflow, cpu_lane, config_id):
+    """Canonical forward step: encoded micro-batch → (own verdicts [B],
+    full verdict matrix [B, G]).  The single source of truth for
+    verdict-selection logic (PolicyModel and the engine both use it)."""
     verdict, _ = eval_verdicts(params, attrs_val, attrs_members, overflow, cpu_lane)
     # select each request's own config column
     own = jnp.take_along_axis(verdict, config_id[:, None], axis=1)[:, 0]
     return own, verdict
+
+
+_eval_jit = jax.jit(forward)
+
+
+@partial(jax.jit, static_argnames=())
+def eval_full_jit(params, attrs_val, attrs_members, overflow, cpu_lane, config_id):
+    """Like _eval_jit but also returns each request's own per-evaluator rule
+    results + skipped flags [B, E] — what the pipeline's batched
+    pattern-matching evaluators consume (runtime/engine.py)."""
+    verdict, (rule, skipped) = eval_verdicts(params, attrs_val, attrs_members, overflow, cpu_lane)
+    own = jnp.take_along_axis(verdict, config_id[:, None], axis=1)[:, 0]
+    idx = config_id[:, None, None]
+    own_rule = jnp.take_along_axis(rule, idx, axis=1)[:, 0, :]
+    own_skipped = jnp.take_along_axis(skipped, idx, axis=1)[:, 0, :]
+    return own, own_rule, own_skipped
 
 
 def eval_batch_jit(params, encoded) -> Tuple[np.ndarray, np.ndarray]:

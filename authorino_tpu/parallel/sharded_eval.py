@@ -27,7 +27,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..authjson import selector as sel
 from ..compiler.compile import (
     CompiledPolicy,
     ConfigRules,
@@ -187,7 +186,6 @@ class ShardedPolicyModel:
 
     def encode(self, docs: Sequence[Any], config_names: Sequence[str], batch_pad: int = 0) -> _ShardedEncoded:
         from ..compiler.intern import EMPTY_ID, PAD
-        from ..compiler.compile import OP_CPU, OP_ERROR, OP_INCL
 
         B = max(len(docs), 1)
         if batch_pad and batch_pad > B:
@@ -204,15 +202,23 @@ class ShardedPolicyModel:
         cpu_lane = np.zeros((B, S, L), dtype=bool)
         shard_of = np.zeros((B,), dtype=np.int32)
         row_of = np.zeros((B,), dtype=np.int32)
+        # group requests by owning shard and encode each group in ONE
+        # batched call (per-request encode_batch would dominate the hot path)
+        by_shard: Dict[int, List[int]] = {}
         for r, (doc, name) in enumerate(zip(docs, config_names)):
             shard, row = self.locator[name]
             shard_of[r], row_of[r] = shard, row
-            p = self.shards[shard]
-            enc = encode_batch(p, [doc], [row])
-            attrs_val[r, shard] = enc.attrs_val[0]
-            attrs_members[r, shard] = enc.attrs_members[0]
-            overflow[r, shard] = enc.overflow[0]
-            cpu_lane[r, shard] = enc.cpu_lane[0]
+            by_shard.setdefault(shard, []).append(r)
+        for shard, rs in by_shard.items():
+            enc = encode_batch(
+                self.shards[shard],
+                [docs[r] for r in rs],
+                [int(row_of[r]) for r in rs],
+            )
+            attrs_val[rs, shard] = enc.attrs_val[: len(rs)]
+            attrs_members[rs, shard] = enc.attrs_members[: len(rs)]
+            overflow[rs, shard] = enc.overflow[: len(rs)]
+            cpu_lane[rs, shard] = enc.cpu_lane[: len(rs)]
         return _ShardedEncoded(attrs_val, attrs_members, overflow, cpu_lane, shard_of, row_of)
 
     def apply(self, encoded: _ShardedEncoded) -> np.ndarray:

@@ -1,0 +1,3 @@
+"""Request-time engine: 5-phase AuthPipeline + micro-batching."""
+
+from .pipeline import AuthPipeline, AuthResult  # noqa: F401

@@ -1,0 +1,327 @@
+"""The 5-phase auth pipeline: identity → metadata → authorization →
+response → callbacks, with per-priority concurrent groups and one/all/any
+short-circuit semantics (contract: ref pkg/service/auth_pipeline.go:451-502,
+150-201, 203-376).
+
+asyncio translation of the reference's goroutine fan-out:
+  - identity: within a priority bucket, all configs race; first success
+    cancels the rest (evaluateOneAuthConfig, ref :166-170); total failure →
+    UNAUTHENTICATED + WWW-Authenticate challenges + denyWith
+  - metadata/callbacks: fire-all, failures tolerated (evaluateAnyAuthConfig)
+  - authorization/response: all evaluated, authorization cancels on first
+    denial → PERMISSION_DENIED (evaluateAllAuthConfigs)
+
+TPU-first difference: the Authorization JSON is one live dict mutated as
+phases complete — the reference re-marshals the whole document on every
+evaluator read (ref :542-579), which is its dominant pipeline cost."""
+
+from __future__ import annotations
+
+import asyncio
+import json as _json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..authjson.value import stringify_json
+from ..authjson.wellknown import CheckRequestModel, build_authorization_json
+from ..evaluators.base import (
+    DenyWithValues,
+    EvaluationError,
+    PhaseConfig,
+    RuntimeAuthConfig,
+    SkippedError,
+    wrap_responses,
+)
+from ..utils import metrics as metrics_mod
+from ..utils.rpc import OK, PERMISSION_DENIED, UNAUTHENTICATED
+
+__all__ = ["AuthPipeline", "AuthResult"]
+
+
+@dataclass
+class AuthResult:
+    """Result data for building the check response
+    (ref: pkg/auth/auth.go:76-98)."""
+
+    code: int = OK
+    status: int = 0  # HTTP status override (denyWith.code)
+    message: str = ""
+    headers: List[Dict[str, str]] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    body: str = ""
+
+    def success(self) -> bool:
+        return self.code == OK
+
+
+class _Skip(Exception):
+    """Evaluator ignored: unmatched conditions or cancelled context."""
+
+
+class AuthPipeline:
+    def __init__(
+        self,
+        request: CheckRequestModel,
+        config: RuntimeAuthConfig,
+        timeout: Optional[float] = None,
+    ):
+        self.request = request
+        self.config = config
+        self.timeout = timeout
+        self.identity_results: Dict[Any, Any] = {}
+        self.metadata_results: Dict[Any, Any] = {}
+        self.authorization_results: Dict[Any, Any] = {}
+        self.response_results: Dict[Any, Any] = {}
+        self.callback_results: Dict[Any, Any] = {}
+        # the live Authorization JSON — mutated in place as phases complete
+        self._doc = build_authorization_json(request, {})
+
+    # ---- authorization JSON ---------------------------------------------
+
+    def authorization_json(self) -> Dict[str, Any]:
+        return self._doc
+
+    def resolved_identity(self) -> Tuple[Any, Any]:
+        for conf, obj in self.identity_results.items():
+            if obj is not None:
+                return conf, obj
+        return None, None
+
+    def _sync_auth(self) -> None:
+        auth = self._doc["auth"]
+        _, auth["identity"] = self.resolved_identity()
+        auth["metadata"] = {c.name: o for c, o in self.metadata_results.items()}
+        auth["authorization"] = {c.name: o for c, o in self.authorization_results.items()}
+        auth["response"] = {c.name: o for c, o in self.response_results.items()}
+        if self.callback_results:
+            auth["callbacks"] = {c.name: o for c, o in self.callback_results.items()}
+
+    # ---- evaluator invocation -------------------------------------------
+
+    async def _call_one(self, conf: PhaseConfig) -> Any:
+        labels = self.config.labels
+        mlabels = (labels.get("namespace", ""), labels.get("name", ""), conf.type, conf.name)
+        metrics_mod.evaluator_total.labels(*mlabels).inc()
+        if conf.conditions is not None:
+            try:
+                if not conf.conditions.matches(self._doc):
+                    metrics_mod.evaluator_ignored.labels(*mlabels).inc()
+                    raise _Skip()
+            except _Skip:
+                raise
+            except Exception:
+                metrics_mod.evaluator_ignored.labels(*mlabels).inc()
+                raise _Skip()
+        with metrics_mod.evaluator_duration.labels(*mlabels).time():
+            try:
+                return await conf.call(self)
+            except SkippedError:
+                metrics_mod.evaluator_ignored.labels(*mlabels).inc()
+                raise _Skip()
+            except EvaluationError:
+                metrics_mod.evaluator_denied.labels(*mlabels).inc()
+                raise
+            except asyncio.CancelledError:
+                metrics_mod.evaluator_cancelled.labels(*mlabels).inc()
+                raise
+
+    @staticmethod
+    def _priority_buckets(configs: List[PhaseConfig]) -> List[List[PhaseConfig]]:
+        buckets: Dict[int, List[PhaseConfig]] = {}
+        for c in configs:
+            buckets.setdefault(c.priority, []).append(c)
+        return [buckets[p] for p in sorted(buckets)]
+
+    # ---- phases ----------------------------------------------------------
+
+    async def _evaluate_identity(self) -> Optional[str]:
+        """Returns None on success; an error message on failure
+        (ref :203-258)."""
+        configs = self.config.identity
+        if not configs:
+            return None  # no identity configs: nothing to verify
+        count = len(configs)
+        errors: Dict[str, str] = {}
+        for bucket in self._priority_buckets(configs):
+            tasks = {
+                asyncio.ensure_future(self._call_one(conf)): conf for conf in bucket
+            }
+            pending = set(tasks)
+            try:
+                while pending:
+                    done, pending = await asyncio.wait(
+                        pending, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    for t in done:
+                        conf = tasks[t]
+                        try:
+                            obj = t.result()
+                        except _Skip:
+                            continue
+                        except asyncio.CancelledError:
+                            continue
+                        except Exception as e:
+                            if count == 1:
+                                return str(e)
+                            errors[conf.name] = str(e)
+                            continue
+                        # success: store, extend, store again (ref :222-241)
+                        self.identity_results[conf] = obj
+                        self._sync_auth()
+                        try:
+                            extended = await conf.resolve_extended_properties(self)
+                        except Exception as e:
+                            del self.identity_results[conf]
+                            self._sync_auth()
+                            if count == 1:
+                                return str(e)
+                            errors[conf.name] = str(e)
+                            continue
+                        self.identity_results[conf] = extended
+                        self._sync_auth()
+                        return None
+            finally:
+                for t in tasks:
+                    if not t.done():
+                        t.cancel()
+        return _json.dumps(errors, separators=(",", ":"), sort_keys=True)
+
+    async def _evaluate_fire_all(self, configs: List[PhaseConfig], results: Dict[Any, Any]) -> None:
+        """metadata/callbacks: failures tolerated (ref :260-285, :351-376)."""
+        for bucket in self._priority_buckets(configs):
+            outs = await asyncio.gather(
+                *(self._call_one(c) for c in bucket), return_exceptions=True
+            )
+            for conf, out in zip(bucket, outs):
+                if isinstance(out, BaseException):
+                    continue
+                results[conf] = out
+            self._sync_auth()
+
+    async def _evaluate_authorization(self) -> Optional[str]:
+        """All must pass; cancel others on first denial (ref :287-322)."""
+        for bucket in self._priority_buckets(self.config.authorization):
+            tasks = {asyncio.ensure_future(self._call_one(c)): c for c in bucket}
+            pending = set(tasks)
+            failure: Optional[str] = None
+            try:
+                while pending and failure is None:
+                    done, pending = await asyncio.wait(
+                        pending, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    for t in done:
+                        conf = tasks[t]
+                        try:
+                            obj = t.result()
+                        except _Skip:
+                            continue
+                        except asyncio.CancelledError:
+                            continue
+                        except Exception as e:
+                            failure = str(e)
+                            break
+                        self.authorization_results[conf] = obj
+                self._sync_auth()
+                if failure is not None:
+                    return failure
+            finally:
+                for t in tasks:
+                    if not t.done():
+                        t.cancel()
+        return None
+
+    async def _evaluate_response(self) -> Tuple[Dict[str, str], Dict[str, Any]]:
+        for bucket in self._priority_buckets(self.config.response):
+            outs = await asyncio.gather(
+                *(self._call_one(c) for c in bucket), return_exceptions=True
+            )
+            for conf, out in zip(bucket, outs):
+                if isinstance(out, BaseException):
+                    continue
+                self.response_results[conf] = out
+            self._sync_auth()
+        return wrap_responses(self.response_results)
+
+    # ---- entry -----------------------------------------------------------
+
+    async def evaluate(self) -> AuthResult:
+        """(ref :451-502)"""
+        result = AuthResult(code=OK)
+
+        # top-level conditions gate: skip whole pipeline → OK (ref :454-457)
+        conds = self.config.conditions
+        if conds is not None:
+            try:
+                if not conds.matches(self._doc):
+                    return result
+            except Exception:
+                return result
+
+        labels = self.config.labels
+        alabels = (labels.get("namespace", ""), labels.get("name", ""))
+        metrics_mod.authconfig_total.labels(*alabels).inc()
+
+        with metrics_mod.authconfig_duration.labels(*alabels).time():
+            try:
+                async with asyncio.timeout(self.timeout) if self.timeout else _null_async_ctx():
+                    result = await self._evaluate_phases()
+            except TimeoutError:
+                result = AuthResult(code=PERMISSION_DENIED, message="context deadline exceeded")
+
+        metrics_mod.authconfig_response_status.labels(*alabels, _code_name(result.code)).inc()
+        return result
+
+    async def _evaluate_phases(self) -> AuthResult:
+        result = AuthResult(code=OK)
+        identity_err = await self._evaluate_identity()
+        if identity_err is not None:
+            result.code = UNAUTHENTICATED
+            result.message = identity_err
+            result.headers = self.config.challenge_headers()
+            result = self._customize_deny_with(result, self.config.deny_with.unauthenticated)
+        else:
+            await self._evaluate_fire_all(self.config.metadata, self.metadata_results)
+            authz_err = await self._evaluate_authorization()
+            if authz_err is not None:
+                result.code = PERMISSION_DENIED
+                result.message = authz_err
+                result = self._customize_deny_with(result, self.config.deny_with.unauthorized)
+            else:
+                headers, metadata = await self._evaluate_response()
+                result.headers = [headers]
+                result.metadata = metadata
+        # phase 5: callbacks always run (ref :492)
+        await self._evaluate_fire_all(self.config.callbacks, self.callback_results)
+        return result
+
+    def _customize_deny_with(self, result: AuthResult, deny: Optional[DenyWithValues]) -> AuthResult:
+        """(ref :581-608)"""
+        if deny is None:
+            return result
+        if deny.code:
+            result.status = deny.code
+        doc = self._doc
+        if deny.message is not None:
+            result.message = stringify_json(deny.message.resolve_for(doc))
+        if deny.body is not None:
+            result.body = stringify_json(deny.body.resolve_for(doc))
+        if deny.headers:
+            result.headers = [
+                {h.name: stringify_json(h.value.resolve_for(doc))} for h in deny.headers
+            ]
+        return result
+
+
+class _null_async_ctx:
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *a):
+        return False
+
+
+_CODE_NAMES = {OK: "OK", UNAUTHENTICATED: "UNAUTHENTICATED", PERMISSION_DENIED: "PERMISSION_DENIED"}
+
+
+def _code_name(code: int) -> str:
+    return _CODE_NAMES.get(code, str(code))

@@ -1,0 +1,24 @@
+"""Wire-compatible protobuf gencode for Envoy ext_authz v3 + gRPC health.
+
+The .proto sources under ``src/`` are a minimal re-declaration of the public
+Envoy/google API message shapes (same packages + field numbers, so byte-level
+wire compatibility), NOT copies of the full envoy api tree.  ``generate.sh``
+rebuilds ``gen/`` with protoc.
+
+``envoy.*`` and ``google.rpc`` import via namespace-package merging by
+putting ``gen/`` on sys.path; the health gencode lives under
+``grpc_health_gen`` because grpcio's regular ``grpc`` package cannot merge
+namespaces."""
+
+import os
+import sys
+
+_GEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "gen")
+if _GEN not in sys.path:
+    sys.path.insert(0, _GEN)
+
+from envoy.service.auth.v3 import attribute_context_pb2, external_auth_pb2  # noqa: E402,F401
+from envoy.config.core.v3 import address_pb2, base_pb2  # noqa: E402,F401
+from envoy.type.v3 import http_status_pb2  # noqa: E402,F401
+from google.rpc import status_pb2  # noqa: E402,F401
+from grpc_health_gen.health.v1 import health_pb2  # noqa: E402,F401

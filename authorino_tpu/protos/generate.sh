@@ -1,0 +1,15 @@
+#!/bin/sh
+# Regenerate python gencode from the wire-compatible proto subset.
+# grpc_tools is not in the image; service stubs are hand-wired with
+# grpc.method_handlers_generic_handler (see service/grpc_server.py).
+cd "$(dirname "$0")"
+protoc -Isrc \
+  src/google/rpc/status.proto \
+  src/envoy/type/v3/http_status.proto \
+  src/envoy/config/core/v3/base.proto \
+  src/envoy/config/core/v3/address.proto \
+  src/envoy/service/auth/v3/attribute_context.proto \
+  src/envoy/service/auth/v3/external_auth.proto \
+  src/grpc/health/v1/health.proto \
+  --python_out=gen
+mv gen/grpc gen/grpc_health_gen 2>/dev/null || true

@@ -1,0 +1,3 @@
+"""Serving runtime: compiled-corpus engine + micro-batching dispatch."""
+
+from .engine import EngineEntry, PolicyEngine  # noqa: F401

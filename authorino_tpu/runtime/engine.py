@@ -1,0 +1,201 @@
+"""PolicyEngine: the serving-time owner of the host index, the compiled rule
+corpus (double-buffered, atomically swapped on reconcile) and the
+micro-batching queue that dispatches (requests × rules) kernels to the
+device.
+
+This is the TPU-era replacement for the reference's per-request goroutine
+evaluation (SURVEY.md §5 "communication backend"): the gRPC/HTTP frontend
+stays on host CPU; Check() contexts are encoded and batched here; one jitted
+kernel evaluates the batch against the whole corpus.  Reconcile-time
+compilation is the analog of the reference's OPA precompile
+(ref: pkg/evaluators/authorization/opa.go:141); the swap is the analog of
+index Set (ref: controllers/auth_config_controller.go:605-636)."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..authjson.wellknown import CheckRequestModel
+from ..compiler.compile import CompiledPolicy, ConfigRules, compile_corpus
+from ..compiler.encode import encode_batch
+from ..evaluators.base import RuntimeAuthConfig
+from ..index import HostIndex
+from ..pipeline.pipeline import AuthPipeline, AuthResult
+from ..utils.rpc import NOT_FOUND
+
+__all__ = ["PolicyEngine", "EngineEntry"]
+
+
+@dataclass
+class EngineEntry:
+    """One AuthConfig as the control plane hands it to the engine."""
+
+    id: str                       # e.g. "namespace/name"
+    hosts: List[str]
+    runtime: RuntimeAuthConfig
+    rules: Optional[ConfigRules] = None  # compilable pattern surface (may be None)
+
+
+class _Snapshot:
+    """Immutable compiled corpus + device params (double-buffered)."""
+
+    def __init__(self, entries: Sequence[EngineEntry], members_k: int = 16):
+        from ..ops.pattern_eval import to_device
+
+        self.by_id: Dict[str, EngineEntry] = {e.id: e for e in entries}
+        rules = [e.rules for e in entries if e.rules is not None]
+        self.policy: Optional[CompiledPolicy] = None
+        self.params = None
+        if rules:
+            self.policy = compile_corpus(rules, members_k=members_k)
+            self.params = to_device(self.policy)
+
+
+@dataclass
+class _Pending:
+    doc: Any
+    config_name: str
+    future: asyncio.Future
+
+
+class PolicyEngine:
+    def __init__(
+        self,
+        max_batch: int = 256,
+        max_delay_s: float = 0.0005,
+        timeout_s: Optional[float] = None,
+        members_k: int = 16,
+    ):
+        self.index: HostIndex[EngineEntry] = HostIndex()
+        self.max_batch = max_batch
+        self.max_delay_s = max_delay_s
+        self.timeout_s = timeout_s
+        self.members_k = members_k
+        self._snapshot: Optional[_Snapshot] = None
+        self._swap_lock = threading.Lock()
+        self._pending: List[_Pending] = []
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    # ---- control plane ---------------------------------------------------
+
+    def apply_snapshot(self, entries: Sequence[EngineEntry], override: bool = True) -> None:
+        """Compile the new corpus off the serving path, then atomically swap
+        snapshot + index (double buffering: in-flight batches keep the old
+        params alive until their futures resolve)."""
+        snap = _Snapshot(entries, members_k=self.members_k)
+        new_index: HostIndex[EngineEntry] = HostIndex()
+        for e in entries:
+            for host in e.hosts:
+                new_index.set(e.id, host, e, override=override)
+        with self._swap_lock:
+            self._snapshot = snap
+            self.index = new_index
+
+    def snapshot_policy(self) -> Optional[CompiledPolicy]:
+        snap = self._snapshot
+        return snap.policy if snap else None
+
+    # ---- request path ----------------------------------------------------
+
+    def lookup(self, host: str) -> Optional[EngineEntry]:
+        """Host lookup with :port-stripping retry
+        (ref: pkg/service/auth.go:270-289)."""
+        entry = self.index.get(host)
+        if entry is None and ":" in host:
+            entry = self.index.get(host.rsplit(":", 1)[0])
+        return entry
+
+    async def check(self, request: CheckRequestModel) -> AuthResult:
+        """Full request-time flow (ref: pkg/service/auth.go:239-310)."""
+        entry = self.lookup(request.host())
+        if entry is None:
+            return AuthResult(code=NOT_FOUND, message="Service not found")
+        pipeline = AuthPipeline(request, entry.runtime, timeout=self.timeout_s)
+        return await pipeline.evaluate()
+
+    # ---- micro-batching verdicts ----------------------------------------
+
+    def provider_for(self, config_name: str):
+        """BatchedVerdictProvider bound to one compiled config — handed to
+        PatternMatching evaluators at translate time."""
+
+        async def provider(pipeline, evaluator_slot: int) -> Tuple[bool, bool]:
+            rule, skipped = await self.submit(pipeline.authorization_json(), config_name)
+            e = evaluator_slot
+            return bool(rule[e]), bool(skipped[e])
+
+        return provider
+
+    async def submit(self, doc: Any, config_name: str) -> Tuple[np.ndarray, np.ndarray]:
+        """Queue one request for the next micro-batch; resolves to that
+        request's per-evaluator (rule_results [E], skipped [E])."""
+        loop = asyncio.get_running_loop()
+        self._loop = loop
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append(_Pending(doc, config_name, fut))
+        if len(self._pending) >= self.max_batch:
+            self._schedule_flush(immediate=True)
+        elif self._flush_handle is None:
+            self._flush_handle = loop.call_later(
+                self.max_delay_s, lambda: self._schedule_flush(immediate=True)
+            )
+        return await fut
+
+    def _schedule_flush(self, immediate: bool = False) -> None:
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        batch = self._pending
+        if not batch:
+            return
+        self._pending = []
+        asyncio.ensure_future(self._flush(batch))
+
+    async def _flush(self, batch: List[_Pending]) -> None:
+        snap = self._snapshot
+        if snap is None or snap.policy is None:
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(RuntimeError("no compiled policy snapshot"))
+            return
+        try:
+            own_rule, own_skipped = await asyncio.to_thread(self._run_batch, snap, batch)
+        except Exception as e:
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(e)
+            return
+        for i, p in enumerate(batch):
+            if not p.future.done():
+                p.future.set_result((own_rule[i], own_skipped[i]))
+
+    def _run_batch(self, snap: _Snapshot, batch: List[_Pending]):
+        from ..ops.pattern_eval import eval_full_jit
+        import jax.numpy as jnp
+
+        policy = snap.policy
+        rows = [policy.config_ids[p.config_name] for p in batch]
+        enc = encode_batch(policy, [p.doc for p in batch], rows, batch_pad=_bucket(len(batch)))
+        own, own_rule, own_skipped = eval_full_jit(
+            snap.params,
+            jnp.asarray(enc.attrs_val),
+            jnp.asarray(enc.attrs_members),
+            jnp.asarray(enc.overflow),
+            jnp.asarray(enc.cpu_lane),
+            jnp.asarray(enc.config_id),
+        )
+        return np.asarray(own_rule), np.asarray(own_skipped)
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b

@@ -1,0 +1,1 @@
+"""Service layer: raw-HTTP /check, gRPC ext_authz, OIDC discovery, health."""

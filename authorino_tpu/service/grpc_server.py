@@ -1,0 +1,193 @@
+"""gRPC ext_authz v3 frontend (semantics: ref pkg/service/auth.go:239-357,
+main.go:437-488) over grpc.aio with hand-wired generic method handlers
+(grpc_tools isn't in the image; the pb2 messages are protoc-generated,
+see protos/).
+
+The Envoy CheckRequest is converted to the transport-independent
+CheckRequestModel and runs through the same PolicyEngine/AuthPipeline as the
+raw-HTTP adapter."""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Dict, Optional
+
+import grpc
+from google.protobuf import struct_pb2
+
+from .. import protos
+from ..authjson.wellknown import (
+    CheckRequestModel,
+    HttpRequestAttributes,
+    PeerAttributes,
+)
+from ..pipeline.pipeline import AuthResult
+from ..runtime.engine import PolicyEngine
+from ..utils.rpc import INVALID_ARGUMENT, OK, http_status_for
+
+__all__ = ["build_server", "request_model_from_proto", "check_response_from_result"]
+
+external_auth_pb2 = protos.external_auth_pb2
+health_pb2 = protos.health_pb2
+
+AUTHORIZATION_SERVICE = "envoy.service.auth.v3.Authorization"
+HEALTH_SERVICE = "grpc.health.v1.Health"
+
+# 10k concurrent streams like the reference (ref main.go:68-69)
+DEFAULT_MAX_CONCURRENT_STREAMS = 10000
+
+
+def _peer_from_proto(peer) -> PeerAttributes:
+    sock = peer.address.socket_address
+    return PeerAttributes(
+        address=sock.address,
+        port=int(sock.port_value),
+        service=peer.service,
+        labels=dict(peer.labels),
+        principal=peer.principal,
+        certificate=peer.certificate,
+    )
+
+
+def _metadata_context_dict(metadata) -> Dict[str, dict]:
+    from google.protobuf import json_format
+
+    out: Dict[str, dict] = {}
+    for key, struct in metadata.filter_metadata.items():
+        out[key] = json_format.MessageToDict(struct)
+    return {"filter_metadata": out} if out else {}
+
+
+def request_model_from_proto(req) -> Optional[CheckRequestModel]:
+    """CheckRequest proto → CheckRequestModel; None when http attributes are
+    missing (→ INVALID_ARGUMENT, ref auth.go:242-255)."""
+    if not req.HasField("attributes") or not req.attributes.HasField("request") or not req.attributes.request.HasField("http"):
+        return None
+    attrs = req.attributes
+    http = attrs.request.http
+    time_str = None
+    if attrs.request.HasField("time"):
+        time_str = attrs.request.time.ToJsonString()
+    return CheckRequestModel(
+        http=HttpRequestAttributes(
+            id=http.id or str(uuid.uuid4()),
+            method=http.method,
+            headers=dict(http.headers),
+            path=http.path,
+            host=http.host,
+            scheme=http.scheme,
+            query=http.query,
+            fragment=http.fragment,
+            size=http.size,
+            protocol=http.protocol,
+            body=http.body,
+            raw_body=bytes(http.raw_body),
+        ),
+        source=_peer_from_proto(attrs.source),
+        destination=_peer_from_proto(attrs.destination),
+        context_extensions=dict(attrs.context_extensions),
+        metadata_context=_metadata_context_dict(attrs.metadata_context),
+        time=time_str,
+    )
+
+
+def _headers_to_options(headers):
+    out = []
+    for hs in headers:
+        for k, v in hs.items():
+            out.append(
+                protos.base_pb2.HeaderValueOption(
+                    header=protos.base_pb2.HeaderValue(key=k, value=v)
+                )
+            )
+    return out
+
+
+def check_response_from_result(result: AuthResult):
+    """AuthResult → CheckResponse (ref auth.go:315-357)."""
+    if result.success():
+        dynamic_metadata = None
+        if result.metadata:
+            dynamic_metadata = struct_pb2.Struct()
+            try:
+                dynamic_metadata.update(result.metadata)
+            except Exception:
+                dynamic_metadata = None
+        resp = external_auth_pb2.CheckResponse(
+            status=protos.status_pb2.Status(code=OK),
+            ok_response=external_auth_pb2.OkHttpResponse(
+                headers=_headers_to_options(result.headers)
+            ),
+        )
+        if dynamic_metadata is not None:
+            resp.dynamic_metadata.CopyFrom(dynamic_metadata)
+        return resp
+
+    headers = list(result.headers)
+    if result.message:
+        headers = headers + [{"X-Ext-Auth-Reason": result.message}]
+    return external_auth_pb2.CheckResponse(
+        status=protos.status_pb2.Status(code=result.code),
+        denied_response=external_auth_pb2.DeniedHttpResponse(
+            status=protos.http_status_pb2.HttpStatus(
+                code=http_status_for(result.code, result.status)
+            ),
+            headers=_headers_to_options(headers),
+            body=result.body,
+        ),
+    )
+
+
+def build_server(
+    engine: PolicyEngine,
+    address: str = "0.0.0.0:50051",
+    tls_credentials: Optional[grpc.ServerCredentials] = None,
+    max_concurrent_streams: int = DEFAULT_MAX_CONCURRENT_STREAMS,
+) -> grpc.aio.Server:
+    async def check(request, context) -> external_auth_pb2.CheckResponse:
+        model = request_model_from_proto(request)
+        if model is None:
+            return check_response_from_result(
+                AuthResult(code=INVALID_ARGUMENT, message="Invalid request")
+            )
+        result = await engine.check(model)
+        return check_response_from_result(result)
+
+    async def health_check(request, context):
+        return health_pb2.HealthCheckResponse(
+            status=health_pb2.HealthCheckResponse.SERVING
+        )
+
+    server = grpc.aio.server(
+        options=[("grpc.max_concurrent_streams", max_concurrent_streams)]
+    )
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                AUTHORIZATION_SERVICE,
+                {
+                    "Check": grpc.unary_unary_rpc_method_handler(
+                        check,
+                        request_deserializer=external_auth_pb2.CheckRequest.FromString,
+                        response_serializer=external_auth_pb2.CheckResponse.SerializeToString,
+                    )
+                },
+            ),
+            grpc.method_handlers_generic_handler(
+                HEALTH_SERVICE,
+                {
+                    "Check": grpc.unary_unary_rpc_method_handler(
+                        health_check,
+                        request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                        response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+                    )
+                },
+            ),
+        )
+    )
+    if tls_credentials is not None:
+        server.add_secure_port(address, tls_credentials)
+    else:
+        server.add_insecure_port(address)
+    return server

@@ -1,0 +1,146 @@
+"""Raw-HTTP ext_authz adapter: POST/GET /check, K8s ValidatingWebhook
+(AdmissionReview) support, health and metrics endpoints
+(semantics: ref pkg/service/auth.go:89-235, main.go:490-492,419-432).
+
+An incoming HTTP request is synthesized into the same CheckRequestModel the
+gRPC path produces (headers lower-cased, body captured, TLS peer cert →
+source.certificate) and runs through the identical engine/pipeline."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from ..authjson.wellknown import (
+    CheckRequestModel,
+    HttpRequestAttributes,
+    PeerAttributes,
+)
+from ..runtime.engine import PolicyEngine
+from ..utils import metrics as metrics_mod
+from ..utils.rpc import NOT_FOUND, OK, http_status_for
+
+__all__ = ["build_app", "make_check_handler"]
+
+DEFAULT_MAX_BODY = 1024 * 1024  # --max-http-request-body-size analog
+
+
+def synthesize_check_request(request: web.Request, body: bytes) -> CheckRequestModel:
+    """(ref: pkg/service/auth.go:140-177)"""
+    headers = {k.lower(): v for k, v in request.headers.items()}
+    peer = request.transport.get_extra_info("peername") if request.transport else None
+    source = PeerAttributes(
+        address=peer[0] if peer else "", port=peer[1] if peer and len(peer) > 1 else 0
+    )
+    # TLS peer certificate → Attributes.Source.Certificate (ref :166-172)
+    ssl_obj = request.transport.get_extra_info("ssl_object") if request.transport else None
+    if ssl_obj is not None:
+        try:
+            import ssl as _ssl
+
+            der = ssl_obj.getpeercert(binary_form=True)
+            if der:
+                source.certificate = _ssl.DER_cert_to_PEM_cert(der)
+        except Exception:
+            pass
+    path = request.path_qs
+    return CheckRequestModel(
+        http=HttpRequestAttributes(
+            id=headers.get("x-request-id", ""),
+            method=request.method,
+            headers=headers,
+            path=path,
+            host=headers.get("host", request.host or ""),
+            scheme=request.scheme,
+            protocol="HTTP/1.1",
+            body=body.decode("utf-8", "replace") if body else "",
+            raw_body=body,
+            size=len(body) if body else -1,
+        ),
+        source=source,
+    )
+
+
+def _admission_review(body: bytes) -> Optional[dict]:
+    """Detect a v1 AdmissionReview payload (ref: pkg/service/auth.go:191-234)."""
+    if not body:
+        return None
+    try:
+        payload = json.loads(body)
+    except Exception:
+        return None
+    if isinstance(payload, dict) and payload.get("kind") == "AdmissionReview":
+        return payload
+    return None
+
+
+def make_check_handler(engine: PolicyEngine, max_body: int = DEFAULT_MAX_BODY):
+    async def check(request: web.Request) -> web.StreamResponse:
+        # request.read() buffers the complete (possibly chunked) body;
+        # content.read(n) would return only what's already streamed in
+        try:
+            body = await request.read()
+        except web.HTTPRequestEntityTooLarge:
+            return web.Response(status=413, text="request body too large")
+        if len(body) > max_body:
+            return web.Response(status=413, text="request body too large")
+
+        check_request = synthesize_check_request(request, body)
+        result = await engine.check(check_request)
+
+        status = http_status_for(result.code, result.status)
+        metrics_mod.response_status.labels(str(status)).inc()
+
+        admission = _admission_review(body)
+        if admission is not None:
+            review = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "response": {
+                    "uid": (admission.get("request") or {}).get("uid", ""),
+                    "allowed": result.code == OK,
+                },
+            }
+            if result.code != OK and result.message:
+                review["response"]["status"] = {"message": result.message}
+            return web.json_response(review)
+
+        headers = {}
+        for hs in result.headers:
+            headers.update(hs)
+        if result.code != OK and result.message:
+            # reason travels in the X-Ext-Auth-Reason header (ref :470-480)
+            headers["X-Ext-Auth-Reason"] = result.message
+        return web.Response(status=status, headers=headers, text=result.body or "")
+
+    return check
+
+
+def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_BODY) -> web.Application:
+    app = web.Application(client_max_size=max_body + 1024)
+    app.router.add_route("*", "/check", make_check_handler(engine, max_body))
+
+    async def healthz(_):
+        return web.Response(text="ok")  # liveness (ref main.go:428-432)
+
+    async def readyz(request: web.Request):
+        # readiness aggregates reconciler state (ref pkg/health/health.go:48-71)
+        if readiness is None or readiness():
+            return web.Response(text="ok")
+        return web.Response(status=503, text="not ready")
+
+    async def server_metrics(_):
+        try:
+            from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
+
+            return web.Response(body=generate_latest(), content_type="text/plain")
+        except Exception:
+            return web.Response(status=501, text="prometheus_client unavailable")
+
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/readyz", readyz)
+    app.router.add_get("/metrics", server_metrics)
+    app.router.add_get("/server-metrics", server_metrics)
+    return app

@@ -1,0 +1,1 @@
+"""Infra utilities: rpc codes, metrics, logging, workers, env."""

@@ -1,0 +1,102 @@
+"""Prometheus metrics — same metric names/labels as the reference
+(ref: pkg/service/auth_pipeline.go:26-36, pkg/metrics/metrics.go).
+
+Per-evaluator (deep) metrics are gated by the evaluator's ``metrics: true``
+flag or the global DEEP_METRICS_ENABLED (ref: pkg/metrics/metrics.go:86-96,
+main.go:182) — the gate is applied by callers via the ``labels()`` helpers
+always being cheap; recording is unconditional on the aggregate metrics."""
+
+from __future__ import annotations
+
+try:
+    from prometheus_client import Counter, Histogram, REGISTRY
+
+    _PROM = True
+except Exception:  # pragma: no cover - prometheus is baked in, but stay safe
+    _PROM = False
+
+DEEP_METRICS_ENABLED = False
+
+_EVAL_LABELS = ("namespace", "authconfig", "evaluator_type", "evaluator_name")
+_CONF_LABELS = ("namespace", "authconfig")
+
+
+class _NoopMetric:
+    def labels(self, *a, **k):
+        return self
+
+    def inc(self, *a):
+        pass
+
+    def observe(self, *a):
+        pass
+
+    def time(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+def _counter(name, doc, labels):
+    if not _PROM:
+        return _NoopMetric()
+    try:
+        return Counter(name, doc, labels)
+    except ValueError:  # already registered (module re-import in tests)
+        return _NoopMetric()
+
+
+def _histogram(name, doc, labels):
+    if not _PROM:
+        return _NoopMetric()
+    try:
+        return Histogram(name, doc, labels)
+    except ValueError:
+        return _NoopMetric()
+
+
+evaluator_total = _counter(
+    "auth_server_evaluator_total",
+    "Total number of evaluations of individual authconfig rule performed by the auth server.",
+    _EVAL_LABELS,
+)
+evaluator_cancelled = _counter(
+    "auth_server_evaluator_cancelled",
+    "Number of evaluations of individual authconfig rule cancelled by the auth server.",
+    _EVAL_LABELS,
+)
+evaluator_ignored = _counter(
+    "auth_server_evaluator_ignored",
+    "Number of evaluations of individual authconfig rule ignored by the auth server.",
+    _EVAL_LABELS,
+)
+evaluator_denied = _counter(
+    "auth_server_evaluator_denied",
+    "Number of denials from individual authconfig rule evaluated by the auth server.",
+    _EVAL_LABELS,
+)
+evaluator_duration = _histogram(
+    "auth_server_evaluator_duration_seconds",
+    "Response latency of individual authconfig rule evaluated by the auth server (in seconds).",
+    _EVAL_LABELS,
+)
+authconfig_total = _counter(
+    "auth_server_authconfig_total",
+    "Total number of authconfigs enforced by the auth server, partitioned by authconfig.",
+    _CONF_LABELS,
+)
+authconfig_response_status = _counter(
+    "auth_server_authconfig_response_status",
+    "Response status of authconfigs sent by the auth server, partitioned by authconfig.",
+    _CONF_LABELS + ("status",),
+)
+authconfig_duration = _histogram(
+    "auth_server_authconfig_duration_seconds",
+    "Response latency of authconfig enforced by the auth server (in seconds).",
+    _CONF_LABELS,
+)
+response_status = _counter(
+    "auth_server_response_status",
+    "Status of HTTP response sent by the auth server.",
+    ("status",),
+)

@@ -116,6 +116,8 @@ def main():
         f"(build {t_build:.2f}s, compile+upload {t_compile:.2f}s)"
     )
 
+    if args.docs < args.batch:
+        args.docs = args.batch  # the measured loop slices full batches
     docs = build_docs(args.docs)
     rng = random.Random(3)
     rows = [rng.randrange(args.configs) for _ in range(args.docs)]

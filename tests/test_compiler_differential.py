@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 
 from authorino_tpu.compiler import ConfigRules, compile_corpus, encode_batch
+from authorino_tpu.expressions import FALSE as FALSE_RULE
 from authorino_tpu.expressions import All, Any_, Operator, Pattern
 from authorino_tpu.ops import eval_batch_jit, to_device
 
@@ -34,7 +35,8 @@ def random_pattern(rng):
     op = rng.choice([Operator.EQ, Operator.NEQ, Operator.INCL, Operator.EXCL, Operator.MATCHES])
     sel = rng.choice(SELECTORS)
     if op is Operator.MATCHES:
-        val = rng.choice([r"^/a", r"\d+", r"^(GET|POST)$", r"adm.n", r"^$"])
+        # includes an invalid regex: error-propagation must match the oracle
+        val = rng.choice([r"^/a", r"\d+", r"^(GET|POST)$", r"adm.n", r"^$", r"(["])
     else:
         val = rng.choice(VALUES)
     return Pattern(sel, op, val)
@@ -200,3 +202,36 @@ def test_regex_lane():
     own, _ = eval_batch_jit(params, encoded)
     # invalid regex → evaluation error → deny (ref: error return denies)
     assert list(own) == [True, False, False]
+
+
+def test_invalid_regex_error_propagation_matches_oracle():
+    """Error propagation follows the reference's left-to-right short-circuit:
+    Or(bad, true) errors (deny) but Or(true, bad) short-circuits (allow).
+    Such trees ride a whole-tree CPU-fallback leaf — kernel must agree with
+    the oracle in both directions (a naive constant-False leaf fails open)."""
+    bad = Pattern("path", Operator.MATCHES, "([")
+    true_leaf = Pattern("m", Operator.EQ, "GET")
+    configs = [
+        ConfigRules("or-bad-first", evaluators=[(None, Any_(bad, true_leaf))]),
+        ConfigRules("or-bad-second", evaluators=[(None, Any_(true_leaf, bad))]),
+        ConfigRules("and-bad", evaluators=[(None, All(true_leaf, bad))]),
+        ConfigRules("cond-bad", evaluators=[(Any_(bad, true_leaf), FALSE_RULE)]),
+    ]
+    policy = compile_corpus(configs)
+    params = to_device(policy)
+    doc = {"path": "/x", "m": "GET"}
+    encoded = encode_batch(policy, [doc] * 4, [0, 1, 2, 3])
+    own, _ = eval_batch_jit(params, encoded)
+    expected = [oracle_verdict(c, doc) for c in configs]
+    assert [bool(b) for b in own] == expected
+    # pin the concrete semantics too
+    assert expected == [False, True, False, True]  # cond errors → skip → allow
+
+
+def test_fast_resolver_negative_index_matches_selector():
+    """items.-1 must resolve MISSING like selector.get, not Python-negative."""
+    configs = [ConfigRules("c", evaluators=[(None, Pattern("items.-1", Operator.EQ, "b"))])]
+    policy = compile_corpus(configs)
+    encoded = encode_batch(policy, [{"items": ["a", "b"]}], [0])
+    own, _ = eval_batch_jit(to_device(policy), encoded)
+    assert not own[0]

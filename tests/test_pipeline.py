@@ -1,0 +1,293 @@
+"""5-phase pipeline semantics tests — the contract defined by the
+reference's pkg/service/auth_pipeline_test.go (short-circuits, priorities,
+conditions, denyWith, challenge headers)."""
+
+import asyncio
+import json
+
+import pytest
+
+from authorino_tpu.authjson import CheckRequestModel, HttpRequestAttributes, JSONValue, JSONProperty
+from authorino_tpu.evaluators import (
+    AuthorizationConfig,
+    AuthCredentials,
+    DenyWith,
+    DenyWithValues,
+    EvaluationError,
+    IdentityConfig,
+    IdentityExtension,
+    MetadataConfig,
+    ResponseConfig,
+    RuntimeAuthConfig,
+)
+from authorino_tpu.evaluators.authorization import PatternMatching
+from authorino_tpu.evaluators.identity import Noop, Plain
+from authorino_tpu.evaluators.response import DynamicJSON
+from authorino_tpu.expressions import All, Operator, Pattern
+from authorino_tpu.pipeline import AuthPipeline
+from authorino_tpu.utils.rpc import OK, PERMISSION_DENIED, UNAUTHENTICATED
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+def request(headers=None, method="GET", path="/"):
+    return CheckRequestModel(
+        http=HttpRequestAttributes(
+            method=method, path=path, host="svc.example.com", headers=headers or {}
+        )
+    )
+
+
+class _StubEval:
+    """Configurable leaf evaluator for pipeline contract tests."""
+
+    def __init__(self, result=None, error=None, delay=0.0):
+        self.result = result
+        self.error = error
+        self.delay = delay
+        self.called = 0
+        self.cancelled = 0
+
+    async def call(self, pipeline):
+        self.called += 1
+        try:
+            if self.delay:
+                await asyncio.sleep(self.delay)
+        except asyncio.CancelledError:
+            self.cancelled += 1
+            raise
+        if self.error:
+            raise EvaluationError(self.error)
+        return self.result
+
+
+class TestIdentityPhase:
+    def test_anonymous_success(self):
+        cfg = RuntimeAuthConfig(identity=[IdentityConfig("anon", Noop())])
+        result = run(AuthPipeline(request(), cfg).evaluate())
+        assert result.success()
+
+    def test_single_failure_returns_raw_message(self):
+        cfg = RuntimeAuthConfig(identity=[IdentityConfig("x", _StubEval(error="bad token"))])
+        result = run(AuthPipeline(request(), cfg).evaluate())
+        assert result.code == UNAUTHENTICATED
+        assert result.message == "bad token"
+        # challenge headers (ref config.go:29-40)
+        assert result.headers == [{"WWW-Authenticate": 'Bearer realm="x"'}]
+
+    def test_multi_failure_aggregates_errors_json(self):
+        cfg = RuntimeAuthConfig(
+            identity=[
+                IdentityConfig("a", _StubEval(error="err-a")),
+                IdentityConfig("b", _StubEval(error="err-b")),
+            ]
+        )
+        result = run(AuthPipeline(request(), cfg).evaluate())
+        assert result.code == UNAUTHENTICATED
+        assert json.loads(result.message) == {"a": "err-a", "b": "err-b"}
+
+    def test_first_success_cancels_slower_peers(self):
+        slow = _StubEval(result={"u": "slow"}, delay=5.0)
+        fast = _StubEval(result={"u": "fast"}, delay=0.0)
+        cfg = RuntimeAuthConfig(
+            identity=[IdentityConfig("slow", slow), IdentityConfig("fast", fast)]
+        )
+        pipeline = AuthPipeline(request(), cfg)
+        result = run(pipeline.evaluate())
+        assert result.success()
+        assert pipeline.authorization_json()["auth"]["identity"] == {"u": "fast"}
+
+    def test_priority_buckets_sequential(self):
+        order = []
+
+        class Tracker(_StubEval):
+            def __init__(self, tag, **kw):
+                super().__init__(**kw)
+                self.tag = tag
+
+            async def call(self, pipeline):
+                order.append(self.tag)
+                return await super().call(pipeline)
+
+        # priority 0 fails, priority 1 succeeds → evaluated in order
+        cfg = RuntimeAuthConfig(
+            identity=[
+                IdentityConfig("p1", Tracker("p1", result={"u": 1}), priority=1),
+                IdentityConfig("p0", Tracker("p0", error="nope"), priority=0),
+            ]
+        )
+        result = run(AuthPipeline(request(), cfg).evaluate())
+        assert result.success()
+        assert order == ["p0", "p1"]
+
+    def test_extended_properties(self):
+        cfg = RuntimeAuthConfig(
+            identity=[
+                IdentityConfig(
+                    "plain",
+                    Plain("request.headers.x-user|@fromstr"),
+                    extended_properties=[
+                        IdentityExtension("tier", JSONValue(static="gold")),
+                        IdentityExtension("name", JSONValue(static="overwritten"), overwrite=False),
+                    ],
+                )
+            ]
+        )
+        pipeline = AuthPipeline(request(headers={"x-user": '{"name":"john"}'}), cfg)
+        result = run(pipeline.evaluate())
+        assert result.success()
+        ident = pipeline.authorization_json()["auth"]["identity"]
+        assert ident == {"name": "john", "tier": "gold"}  # no overwrite of name
+
+    def test_conditions_skip_identity(self):
+        gated = IdentityConfig(
+            "gated",
+            _StubEval(result={"u": 1}),
+            conditions=Pattern("request.method", Operator.EQ, "POST"),
+        )
+        anon = IdentityConfig("anon", Noop())
+        cfg = RuntimeAuthConfig(identity=[gated, anon])
+        pipeline = AuthPipeline(request(method="GET"), cfg)
+        result = run(pipeline.evaluate())
+        assert result.success()
+        assert pipeline.authorization_json()["auth"]["identity"] == {"anonymous": True}
+
+
+class TestAuthorizationPhase:
+    def _cfg(self, *authz):
+        return RuntimeAuthConfig(
+            identity=[IdentityConfig("anon", Noop())],
+            authorization=list(authz),
+        )
+
+    def test_pattern_matching_allow_deny(self):
+        allow = AuthorizationConfig(
+            "rbac",
+            PatternMatching(All(Pattern("request.headers.x-org", Operator.EQ, "acme"))),
+        )
+        result = run(AuthPipeline(request(headers={"x-org": "acme"}), self._cfg(allow)).evaluate())
+        assert result.success()
+
+        result = run(AuthPipeline(request(headers={"x-org": "evil"}), self._cfg(allow)).evaluate())
+        assert result.code == PERMISSION_DENIED
+        assert result.message == "Unauthorized"
+
+    def test_all_must_pass(self):
+        ok = AuthorizationConfig("ok", _StubEval(result=True))
+        bad = AuthorizationConfig("bad", _StubEval(error="denied by policy"))
+        result = run(AuthPipeline(request(), self._cfg(ok, bad)).evaluate())
+        assert result.code == PERMISSION_DENIED
+        assert result.message == "denied by policy"
+
+    def test_conditions_skip_authorization(self):
+        gated = AuthorizationConfig(
+            "gated",
+            _StubEval(error="would deny"),
+            conditions=Pattern("request.method", Operator.EQ, "DELETE"),
+        )
+        result = run(AuthPipeline(request(method="GET"), self._cfg(gated)).evaluate())
+        assert result.success()
+
+    def test_authz_results_in_auth_json(self):
+        ok = AuthorizationConfig("policy-x", _StubEval(result={"score": 9}))
+        pipeline = AuthPipeline(request(), self._cfg(ok))
+        result = run(pipeline.evaluate())
+        assert result.success()
+        assert pipeline.authorization_json()["auth"]["authorization"]["policy-x"] == {"score": 9}
+
+
+class TestMetadataResponsePhases:
+    def test_metadata_failures_tolerated(self):
+        cfg = RuntimeAuthConfig(
+            identity=[IdentityConfig("anon", Noop())],
+            metadata=[
+                MetadataConfig("good", _StubEval(result={"m": 1})),
+                MetadataConfig("bad", _StubEval(error="boom")),
+            ],
+        )
+        pipeline = AuthPipeline(request(), cfg)
+        result = run(pipeline.evaluate())
+        assert result.success()
+        assert pipeline.authorization_json()["auth"]["metadata"] == {"good": {"m": 1}}
+
+    def test_response_headers_and_dynamic_metadata(self):
+        cfg = RuntimeAuthConfig(
+            identity=[IdentityConfig("anon", Noop())],
+            response=[
+                ResponseConfig(
+                    "x-ext-auth-data",
+                    DynamicJSON([JSONProperty("user", JSONValue(pattern="auth.identity.anonymous"))]),
+                ),
+                ResponseConfig(
+                    "rate-limit-data",
+                    DynamicJSON([JSONProperty("level", JSONValue(static=3))]),
+                    wrapper="envoyDynamicMetadata",
+                    wrapper_key="ext_auth_data",
+                ),
+            ],
+        )
+        result = run(AuthPipeline(request(), cfg).evaluate())
+        assert result.success()
+        assert result.headers == [{"x-ext-auth-data": '{"user":true}'}]
+        assert result.metadata == {"ext_auth_data": {"level": 3}}
+
+
+class TestTopLevel:
+    def test_top_level_conditions_skip_pipeline(self):
+        cfg = RuntimeAuthConfig(
+            conditions=Pattern("request.path", Operator.EQ, "/admin"),
+            identity=[IdentityConfig("x", _StubEval(error="should not run"))],
+        )
+        result = run(AuthPipeline(request(path="/public"), cfg).evaluate())
+        assert result.success()
+
+    def test_deny_with_unauthorized(self):
+        cfg = RuntimeAuthConfig(
+            identity=[IdentityConfig("anon", Noop())],
+            authorization=[AuthorizationConfig("deny", _StubEval(error="nope"))],
+            deny_with=DenyWith(
+                unauthorized=DenyWithValues(
+                    code=302,
+                    message=JSONValue(static="redirecting"),
+                    headers=[JSONProperty("Location", JSONValue(pattern="http://login{request.path}"))],
+                )
+            ),
+        )
+        result = run(AuthPipeline(request(path="/x"), cfg).evaluate())
+        assert result.code == PERMISSION_DENIED
+        assert result.status == 302
+        assert result.message == "redirecting"
+        assert result.headers == [{"Location": "http://login/x"}]
+
+    def test_timeout(self):
+        cfg = RuntimeAuthConfig(
+            identity=[IdentityConfig("slow", _StubEval(result={"u": 1}, delay=2.0))]
+        )
+        result = run(AuthPipeline(request(), cfg, timeout=0.05).evaluate())
+        assert not result.success()
+
+
+class TestHostIndex:
+    def test_radix_wildcards(self):
+        from authorino_tpu.index import HostIndex, IndexError_
+
+        idx = HostIndex()
+        idx.set("cfg-1", "talker-api.example.com", "A")
+        idx.set("cfg-2", "*.example.org", "B")
+        idx.set("cfg-3", "example.org", "C")
+        assert idx.get("talker-api.example.com") == "A"
+        assert idx.get("anything.example.org") == "B"
+        assert idx.get("deep.nested.example.org") == "B"
+        assert idx.get("example.org") == "C"
+        assert idx.get("unknown.example.com") is None
+        # collision policy (ref :176-186)
+        with pytest.raises(IndexError_):
+            idx.set("cfg-9", "talker-api.example.com", "Z")
+        idx.set("cfg-9", "talker-api.example.com", "Z", override=True)
+        assert idx.get("talker-api.example.com") == "Z"
+        # delete by id
+        idx.delete("cfg-2")
+        assert idx.get("anything.example.org") is None
+        assert idx.find_keys("cfg-3") == ["example.org"]

@@ -1,0 +1,112 @@
+"""gRPC ext_authz server tests: real grpc.aio client/server over localhost,
+asserting wire-level CheckRequest/CheckResponse behavior
+(contract: ref pkg/service/auth.go:239-357)."""
+
+import asyncio
+
+import grpc
+import pytest
+
+from authorino_tpu import protos
+from authorino_tpu.compiler import ConfigRules
+from authorino_tpu.evaluators import (
+    AuthorizationConfig,
+    IdentityConfig,
+    RuntimeAuthConfig,
+)
+from authorino_tpu.evaluators.authorization import PatternMatching
+from authorino_tpu.evaluators.identity import Noop
+from authorino_tpu.expressions import All, Operator, Pattern
+from authorino_tpu.runtime import EngineEntry, PolicyEngine
+from authorino_tpu.service.grpc_server import build_server
+
+external_auth_pb2 = protos.external_auth_pb2
+
+
+def make_engine():
+    engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+    rules = All(Pattern("request.headers.x-org", Operator.EQ, "acme"))
+    runtime = RuntimeAuthConfig(
+        identity=[IdentityConfig("anon", Noop())],
+        authorization=[
+            AuthorizationConfig(
+                "org", PatternMatching(rules, batched_provider=engine.provider_for("ns/cfg"))
+            )
+        ],
+    )
+    engine.apply_snapshot(
+        [
+            EngineEntry(
+                id="ns/cfg",
+                hosts=["svc.example.com"],
+                runtime=runtime,
+                rules=ConfigRules(name="ns/cfg", evaluators=[(None, rules)]),
+            )
+        ]
+    )
+    return engine
+
+
+def check_request(host="svc.example.com", org="acme", ctx_host=None):
+    req = external_auth_pb2.CheckRequest()
+    http = req.attributes.request.http
+    http.method = "GET"
+    http.path = "/hello"
+    http.host = host
+    http.headers["x-org"] = org
+    http.headers["host"] = host
+    if ctx_host:
+        req.attributes.context_extensions["host"] = ctx_host
+    return req
+
+
+def test_grpc_check_allow_deny_notfound():
+    async def run_all():
+        engine = make_engine()
+        server = build_server(engine, address="127.0.0.1:0")
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+                call = channel.unary_unary(
+                    "/envoy.service.auth.v3.Authorization/Check",
+                    request_serializer=external_auth_pb2.CheckRequest.SerializeToString,
+                    response_deserializer=external_auth_pb2.CheckResponse.FromString,
+                )
+                # allow
+                resp = await call(check_request(org="acme"))
+                assert resp.status.code == 0
+                assert resp.WhichOneof("http_response") == "ok_response"
+                # deny → PERMISSION_DENIED(7), HTTP 403, reason header
+                resp = await call(check_request(org="evil"))
+                assert resp.status.code == 7
+                assert resp.denied_response.status.code == 403
+                reasons = {
+                    h.header.key: h.header.value for h in resp.denied_response.headers
+                }
+                assert reasons.get("X-Ext-Auth-Reason") == "Unauthorized"
+                # unknown host → NOT_FOUND(5), 404 (ref auth.go:287-289)
+                resp = await call(check_request(host="nope.example.com"))
+                assert resp.status.code == 5
+                assert resp.denied_response.status.code == 404
+                # context_extensions host override (ref auth.go:270-276)
+                resp = await call(
+                    check_request(host="nope.example.com", ctx_host="svc.example.com")
+                )
+                assert resp.status.code == 0
+                # missing http attributes → INVALID_ARGUMENT(3) (ref :242-255)
+                resp = await call(external_auth_pb2.CheckRequest())
+                assert resp.status.code == 3
+
+                # health service
+                health = channel.unary_unary(
+                    "/grpc.health.v1.Health/Check",
+                    request_serializer=protos.health_pb2.HealthCheckRequest.SerializeToString,
+                    response_deserializer=protos.health_pb2.HealthCheckResponse.FromString,
+                )
+                hr = await health(protos.health_pb2.HealthCheckRequest())
+                assert hr.status == protos.health_pb2.HealthCheckResponse.SERVING
+        finally:
+            await server.stop(None)
+
+    asyncio.new_event_loop().run_until_complete(run_all())

@@ -1,0 +1,239 @@
+"""End-to-end slice: HTTP /check → engine → pipeline → batched TPU verdict
+(the minimum end-to-end slice of SURVEY.md §7 step 4, matching baseline
+config #1: anonymous identity + one patternMatching rule)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from authorino_tpu.authjson import JSONProperty, JSONValue
+from authorino_tpu.compiler import ConfigRules
+from authorino_tpu.evaluators import (
+    AuthorizationConfig,
+    IdentityConfig,
+    ResponseConfig,
+    RuntimeAuthConfig,
+)
+from authorino_tpu.evaluators.authorization import PatternMatching
+from authorino_tpu.evaluators.identity import Noop
+from authorino_tpu.evaluators.response import DynamicJSON
+from authorino_tpu.expressions import All, Operator, Pattern
+from authorino_tpu.runtime import EngineEntry, PolicyEngine
+from authorino_tpu.service.http_server import build_app
+
+
+def build_engine(batched: bool) -> PolicyEngine:
+    engine = PolicyEngine(max_batch=8, max_delay_s=0.002)
+    rules = All(
+        Pattern("request.headers.x-api-tier", Operator.EQ, "gold"),
+        Pattern("request.method", Operator.NEQ, "DELETE"),
+    )
+    cond = Pattern("request.url_path", Operator.MATCHES, r"^/protected")
+    pm = PatternMatching(
+        rules,
+        batched_provider=engine.provider_for("tenant/talker-api") if batched else None,
+        evaluator_slot=0,
+    )
+    runtime = RuntimeAuthConfig(
+        labels={"namespace": "tenant", "name": "talker-api"},
+        identity=[IdentityConfig("anon", Noop())],
+        authorization=[AuthorizationConfig("tier-check", pm, conditions=None if batched else cond)],
+        response=[
+            ResponseConfig(
+                "x-auth-data",
+                DynamicJSON([JSONProperty("tier", JSONValue(pattern="request.headers.x-api-tier"))]),
+            )
+        ],
+    )
+    entry = EngineEntry(
+        id="tenant/talker-api",
+        hosts=["talker-api.example.com", "*.wild.example.com"],
+        runtime=runtime,
+        rules=ConfigRules(
+            name="tenant/talker-api",
+            evaluators=[(cond, rules)],
+        ),
+    )
+    engine.apply_snapshot([entry])
+    return engine
+
+
+@pytest.mark.parametrize("batched", [False, True])
+def test_check_endpoint_allow_deny(batched):
+    # the aiohttp test client always hits the /check route; the simulated
+    # original request travels via headers — build explicit scenarios:
+    async def call(client, host, tier=None, method="GET"):
+        headers = {"Host": host}
+        if tier:
+            headers["X-Api-Tier"] = tier
+        r = await client.request(method, "/check", headers=headers)
+        return r
+
+    async def run_all():
+        engine = build_engine(batched)
+        app = build_app(engine)
+        async with TestClient(TestServer(app)) as client:
+            # NOTE: the raw-HTTP adapter takes path from the incoming
+            # request (/check), so the condition (^/protected) won't match →
+            # evaluator skipped → allow. Exercise both gate outcomes via the
+            # wildcard host config below and header-only rules.
+            r = await call(client, "talker-api.example.com", tier="gold")
+            assert r.status == 200
+            # skipped condition → no authorization result recorded, allow
+            r = await call(client, "talker-api.example.com", tier="bronze")
+            assert r.status == 200
+
+            # unknown host → 404 "Service not found" (ref auth.go:270-289)
+            r = await call(client, "unknown.example.com", tier="gold")
+            assert r.status == 404
+            assert r.headers.get("X-Ext-Auth-Reason") == "Service not found"
+
+            # wildcard host match
+            r = await call(client, "deep.wild.example.com", tier="gold")
+            assert r.status == 200
+
+    asyncio.new_event_loop().run_until_complete(run_all())
+
+
+@pytest.mark.parametrize("batched", [False, True])
+def test_check_condition_matched_rules_enforced(batched):
+    """Host-based config where conditions always match: rules are enforced."""
+
+    async def run_all():
+        engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+        rules = All(Pattern("request.headers.x-api-tier", Operator.EQ, "gold"))
+        pm = PatternMatching(
+            rules,
+            batched_provider=engine.provider_for("ns/cfg") if batched else None,
+        )
+        runtime = RuntimeAuthConfig(
+            identity=[IdentityConfig("anon", Noop())],
+            authorization=[AuthorizationConfig("tier", pm)],
+        )
+        engine.apply_snapshot(
+            [
+                EngineEntry(
+                    id="ns/cfg",
+                    hosts=["svc.example.com"],
+                    runtime=runtime,
+                    rules=ConfigRules(name="ns/cfg", evaluators=[(None, rules)]),
+                )
+            ]
+        )
+        app = build_app(engine)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.get(
+                "/check", headers={"Host": "svc.example.com", "X-Api-Tier": "gold"}
+            )
+            assert r.status == 200
+            # response-phase header injection is exercised in the other test;
+            # here check deny + reason header
+            r = await client.get(
+                "/check", headers={"Host": "svc.example.com", "X-Api-Tier": "bronze"}
+            )
+            assert r.status == 403
+            assert r.headers.get("X-Ext-Auth-Reason") == "Unauthorized"
+
+            # micro-batching: concurrent requests coalesce into one kernel call
+            results = await asyncio.gather(
+                *[
+                    client.get(
+                        "/check",
+                        headers={
+                            "Host": "svc.example.com",
+                            "X-Api-Tier": "gold" if i % 2 == 0 else "bronze",
+                        },
+                    )
+                    for i in range(16)
+                ]
+            )
+            statuses = [r.status for r in results]
+            assert statuses == [200 if i % 2 == 0 else 403 for i in range(16)]
+
+    asyncio.new_event_loop().run_until_complete(run_all())
+
+
+def test_admission_review_mode():
+    async def run_all():
+        engine = PolicyEngine()
+        rules = All(Pattern("request.body.@fromstr.request.operation", Operator.NEQ, "DELETE"))
+        runtime = RuntimeAuthConfig(
+            identity=[IdentityConfig("anon", Noop())],
+            authorization=[AuthorizationConfig("no-delete", PatternMatching(rules))],
+        )
+        engine.apply_snapshot(
+            [EngineEntry(id="ns/w", hosts=["webhook.example.com"], runtime=runtime)]
+        )
+        app = build_app(engine)
+        async with TestClient(TestServer(app)) as client:
+            review = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {"uid": "abc-123", "operation": "CREATE"},
+            }
+            r = await client.post(
+                "/check", headers={"Host": "webhook.example.com"}, json=review
+            )
+            assert r.status == 200
+            payload = await r.json()
+            assert payload["kind"] == "AdmissionReview"
+            assert payload["response"] == {"uid": "abc-123", "allowed": True}
+
+            review["request"]["operation"] = "DELETE"
+            r = await client.post(
+                "/check", headers={"Host": "webhook.example.com"}, json=review
+            )
+            payload = await r.json()
+            assert payload["response"]["allowed"] is False
+            assert "status" in payload["response"]
+
+    asyncio.new_event_loop().run_until_complete(run_all())
+
+
+def test_engine_snapshot_swap_under_load():
+    """Reconcile-time swap must not break in-flight serving."""
+
+    async def run_all():
+        engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+
+        def snapshot(tier):
+            rules = All(Pattern("request.headers.x-api-tier", Operator.EQ, tier))
+            runtime = RuntimeAuthConfig(
+                identity=[IdentityConfig("anon", Noop())],
+                authorization=[
+                    AuthorizationConfig(
+                        "tier", PatternMatching(rules, batched_provider=engine.provider_for("ns/cfg"))
+                    )
+                ],
+            )
+            return [
+                EngineEntry(
+                    id="ns/cfg",
+                    hosts=["svc.example.com"],
+                    runtime=runtime,
+                    rules=ConfigRules(name="ns/cfg", evaluators=[(None, rules)]),
+                )
+            ]
+
+        engine.apply_snapshot(snapshot("gold"))
+        app = build_app(engine)
+        async with TestClient(TestServer(app)) as client:
+
+            async def hammer(n):
+                out = []
+                for _ in range(n):
+                    r = await client.get(
+                        "/check", headers={"Host": "svc.example.com", "X-Api-Tier": "silver"}
+                    )
+                    out.append(r.status)
+                return out
+
+            first = await hammer(3)
+            assert first == [403, 403, 403]
+            engine.apply_snapshot(snapshot("silver"))  # rule flip mid-serving
+            second = await hammer(3)
+            assert second == [200, 200, 200]
+
+    asyncio.new_event_loop().run_until_complete(run_all())
