@@ -1,0 +1,172 @@
+"""CLI bootstrap (the analog of main.go: `authorino server|webhooks|version`,
+ref main.go:134-220).  One process boots the gRPC ext_authz server, the
+raw-HTTP /check server, the wristband OIDC discovery server and the control
+plane (YAML-dir source standalone, or in-cluster watch when running in
+Kubernetes).
+
+Flags fall back to env vars through a typed helper
+(ref: pkg/utils/envvar.go:13-33)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+import sys
+from typing import Any, Optional
+
+
+def env_var(name: str, default: Any) -> Any:
+    """(ref: pkg/utils/envvar.go)"""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        try:
+            return int(raw)
+        except ValueError:
+            return default
+    if isinstance(default, float):
+        try:
+            return float(raw)
+        except ValueError:
+            return default
+    return raw
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="authorino-tpu")
+    sub = p.add_subparsers(dest="command")
+
+    s = sub.add_parser("server", help="Run the authorization server")
+    s.add_argument("--watch-dir", default=env_var("WATCH_DIR", ""), help="Directory of AuthConfig/Secret manifests (standalone mode)")
+    s.add_argument("--in-cluster", action="store_true", default=env_var("IN_CLUSTER", False), help="Watch AuthConfigs via the Kubernetes API")
+    s.add_argument("--ext-auth-grpc-port", type=int, default=env_var("EXT_AUTH_GRPC_PORT", 50051))
+    s.add_argument("--ext-auth-http-port", type=int, default=env_var("EXT_AUTH_HTTP_PORT", 5001))
+    s.add_argument("--oidc-http-port", type=int, default=env_var("OIDC_HTTP_PORT", 8083))
+    s.add_argument("--metrics-addr-port", type=int, default=env_var("METRICS_PORT", 8080))
+    s.add_argument("--timeout", type=int, default=env_var("TIMEOUT", 0), help="Per-request timeout in ms (0 = none)")
+    s.add_argument("--max-http-request-body-size", type=int, default=env_var("MAX_HTTP_REQUEST_BODY_SIZE", 1024 * 1024))
+    s.add_argument("--batch-size", type=int, default=env_var("BATCH_SIZE", 256), help="Max micro-batch size for TPU dispatch")
+    s.add_argument("--batch-window-us", type=int, default=env_var("BATCH_WINDOW_US", 500), help="Micro-batch window in microseconds")
+    s.add_argument("--evaluator-cache-size", type=int, default=env_var("EVALUATOR_CACHE_SIZE", 4096))
+    s.add_argument("--deep-metrics-enabled", action="store_true", default=env_var("DEEP_METRICS_ENABLED", False))
+    s.add_argument("--auth-config-label-selector", default=env_var("AUTH_CONFIG_LABEL_SELECTOR", ""))
+    s.add_argument("--secret-label-selector", default=env_var("SECRET_LABEL_SELECTOR", "authorino.kuadrant.io/managed-by=authorino"))
+    s.add_argument("--allow-superseding-host-subsets", action="store_true", default=env_var("ALLOW_SUPERSEDING_HOST_SUBSETS", False))
+    s.add_argument("--log-level", default=env_var("LOG_LEVEL", "info"))
+    s.add_argument("--jax-platform", default=env_var("JAX_PLATFORM", ""), help="Force a jax platform (e.g. cpu) — useful without TPU access")
+
+    sub.add_parser("version", help="Print version")
+    return p
+
+
+async def run_server(args) -> None:
+    from aiohttp import web
+
+    if args.jax_platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.jax_platform)
+
+    from .controllers.reconciler import AuthConfigReconciler, SecretReconciler
+    from .controllers.sources import YamlDirSource
+    from .evaluators import cache as cache_mod
+    from .k8s.client import InMemoryCluster, LabelSelector, RestCluster
+    from .runtime.engine import PolicyEngine
+    from .service.grpc_server import build_server
+    from .service.http_server import build_app
+    from .service.oidc_server import build_oidc_app
+    from .utils import metrics as metrics_mod
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    log = logging.getLogger("authorino_tpu")
+
+    cache_mod.EVALUATOR_CACHE_MAX_ENTRIES = args.evaluator_cache_size
+    metrics_mod.DEEP_METRICS_ENABLED = args.deep_metrics_enabled
+
+    engine = PolicyEngine(
+        max_batch=args.batch_size,
+        max_delay_s=args.batch_window_us / 1e6,
+        timeout_s=(args.timeout / 1000.0) if args.timeout else None,
+    )
+
+    selector = LabelSelector.parse(args.auth_config_label_selector) if args.auth_config_label_selector else None
+    secret_selector = LabelSelector.parse(args.secret_label_selector) if args.secret_label_selector else None
+
+    source = None
+    if args.in_cluster:
+        raise SystemExit("--in-cluster watch mode requires running inside Kubernetes (round 2)")
+    cluster = InMemoryCluster()
+    reconciler = AuthConfigReconciler(
+        engine,
+        cluster=cluster,
+        label_selector=selector,
+        allow_superseding_host_subsets=args.allow_superseding_host_subsets,
+    )
+    secret_reconciler = SecretReconciler(engine, secret_label_selector=secret_selector)
+    if args.watch_dir:
+        source = YamlDirSource(args.watch_dir, reconciler, cluster, secret_reconciler)
+        await source.sync()
+        source.start()
+        log.info("watching manifests under %s", args.watch_dir)
+    else:
+        log.warning("no --watch-dir and not --in-cluster: serving an empty index")
+
+    # HTTP /check
+    app = build_app(engine, readiness=reconciler.ready, max_body=args.max_http_request_body_size)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    await web.TCPSite(runner, "0.0.0.0", args.ext_auth_http_port).start()
+    log.info("http /check listening on :%d", args.ext_auth_http_port)
+
+    # OIDC discovery (wristbands)
+    oidc_runner = web.AppRunner(build_oidc_app(engine))
+    await oidc_runner.setup()
+    await web.TCPSite(oidc_runner, "0.0.0.0", args.oidc_http_port).start()
+    log.info("oidc discovery listening on :%d", args.oidc_http_port)
+
+    # gRPC ext_authz
+    grpc_server = build_server(engine, address=f"0.0.0.0:{args.ext_auth_grpc_port}")
+    await grpc_server.start()
+    log.info("grpc ext_authz listening on :%d", args.ext_auth_grpc_port)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
+    log.info("shutting down")
+    if source is not None:
+        await source.stop()
+    await grpc_server.stop(2)
+    await runner.cleanup()
+    await oidc_runner.cleanup()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        from . import __version__
+
+        print(__version__)
+        return 0
+    if args.command == "server":
+        asyncio.run(run_server(args))
+        return 0
+    build_parser().print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,143 @@
+"""AuthConfig/Secret resource sources.
+
+The reference's control plane is Kubernetes watch streams via
+controller-runtime (ref main.go:241-306).  Here sources are pluggable:
+
+  - YamlDirSource: standalone/gitops mode — AuthConfig (v1beta1 or v1beta2)
+    and Secret manifests in a directory, mtime-polled
+  - K8sWatchSource: real cluster via the REST client's watch endpoints
+    (RestCluster); resyncs on connection loss
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import yaml
+
+from ..apis.convert import to_v1beta2
+from ..k8s.client import InMemoryCluster, LabelSelector, Secret
+from .reconciler import AuthConfigReconciler, SecretReconciler
+
+__all__ = ["YamlDirSource", "load_manifests"]
+
+log = logging.getLogger("authorino_tpu.sources")
+
+
+def load_manifests(path: str) -> Tuple[List[dict], List[Secret]]:
+    """Parse all YAML docs under a file/dir into (authconfigs, secrets)."""
+    import base64
+
+    files: List[str] = []
+    if os.path.isdir(path):
+        for root, _, names in os.walk(path):
+            files.extend(
+                os.path.join(root, n) for n in names if n.endswith((".yaml", ".yml", ".json"))
+            )
+    else:
+        files = [path]
+    authconfigs: List[dict] = []
+    secrets: List[Secret] = []
+    for f in sorted(files):
+        try:
+            with open(f) as fh:
+                docs = list(yaml.safe_load_all(fh))
+        except Exception as e:
+            log.warning("skipping unparseable manifest %s: %s", f, e)
+            continue
+        for doc in docs:
+            if not isinstance(doc, dict):
+                continue
+            kind = doc.get("kind")
+            if kind == "AuthConfig":
+                authconfigs.append(to_v1beta2(doc))
+            elif kind == "Secret":
+                meta = doc.get("metadata") or {}
+                data = {
+                    k: base64.b64decode(v) for k, v in (doc.get("data") or {}).items()
+                }
+                for k, v in (doc.get("stringData") or {}).items():
+                    data[k] = v.encode()
+                secrets.append(
+                    Secret(
+                        name=meta.get("name", ""),
+                        namespace=meta.get("namespace", "default"),
+                        labels=meta.get("labels") or {},
+                        annotations=meta.get("annotations") or {},
+                        data=data,
+                    )
+                )
+    return authconfigs, secrets
+
+
+class YamlDirSource:
+    """Standalone control plane: manifests from disk, polled for changes."""
+
+    def __init__(
+        self,
+        path: str,
+        reconciler: AuthConfigReconciler,
+        cluster: InMemoryCluster,
+        secret_reconciler: Optional[SecretReconciler] = None,
+        poll_interval_s: float = 2.0,
+    ):
+        self.path = path
+        self.reconciler = reconciler
+        self.cluster = cluster
+        self.secret_reconciler = secret_reconciler
+        self.poll_interval_s = poll_interval_s
+        self._snapshot_sig: Optional[tuple] = None
+        self._task: Optional[asyncio.Task] = None
+        if secret_reconciler is not None:
+            cluster.on_secret_event(secret_reconciler.on_event)
+
+    def _signature(self) -> tuple:
+        sig = []
+        if os.path.isdir(self.path):
+            for root, _, names in os.walk(self.path):
+                for n in sorted(names):
+                    p = os.path.join(root, n)
+                    try:
+                        sig.append((p, os.path.getmtime(p), os.path.getsize(p)))
+                    except OSError:
+                        pass
+        elif os.path.exists(self.path):
+            sig.append((self.path, os.path.getmtime(self.path), os.path.getsize(self.path)))
+        return tuple(sig)
+
+    async def sync(self) -> None:
+        authconfigs, secrets = load_manifests(self.path)
+        current = {s.key for s in secrets}
+        for existing in await self.cluster.list_secrets(LabelSelector()):
+            if existing.key not in current:
+                self.cluster.remove_secret(*existing.key)
+        for s in secrets:
+            self.cluster.put_secret(s)
+        await self.reconciler.reconcile_all(authconfigs)
+
+    async def run(self) -> None:
+        while True:
+            sig = self._signature()
+            if sig != self._snapshot_sig:
+                self._snapshot_sig = sig
+                try:
+                    await self.sync()
+                except Exception as e:
+                    log.error("sync failed: %s", e)
+            await asyncio.sleep(self.poll_interval_s)
+
+    def start(self) -> "YamlDirSource":
+        self._task = asyncio.ensure_future(self.run())
+        return self
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass

@@ -1,0 +1,499 @@
+"""AuthConfig translation: v1beta2-shaped spec (dict) → runtime evaluator
+graph + compilable rule corpus (semantics: ref
+controllers/auth_config_controller.go:159-603 translateAuthConfig +
+buildJSONExpression :805 + buildGenericHttpEvaluator :721).
+
+This is where the TPU design departs from the reference: every
+pattern-matching authorization evaluator (and its `when` conditions) is ALSO
+lowered into the config's ConfigRules so the reconcile step compiles it into
+the device corpus; the wrapper keeps an inline CPU fallback for standalone
+use.  Secret reads happen here (OAuth2 creds, shared secrets, wristband
+signing keys) exactly like the reference reads Secrets at reconcile time."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..authjson.value import JSONProperty, JSONValue
+from ..compiler.compile import ConfigRules
+from ..evaluators import cache as cache_mod
+from ..evaluators.base import (
+    AuthorizationConfig,
+    CallbackConfig,
+    DenyWith,
+    DenyWithValues,
+    IdentityConfig,
+    IdentityExtension,
+    MetadataConfig,
+    ResponseConfig,
+    RuntimeAuthConfig,
+)
+from ..evaluators.authorization import OPA, Authzed, KubernetesAuthz, OPAExternalSource, PatternMatching
+from ..evaluators.credentials import AuthCredentials
+from ..evaluators.identity import APIKey, HMAC, KubernetesAuth, MTLS, Noop, OAuth2, OIDC, Plain
+from ..evaluators.metadata import UMA, GenericHttp, UserInfo
+from ..evaluators.response import DynamicJSON, SigningKey, Wristband
+from ..evaluators.response import Plain as PlainResponse
+from ..expressions.ast import All, Any_, Expression, Operator, Pattern
+from ..k8s.client import ClusterReader, LabelSelector
+from ..runtime.engine import EngineEntry, PolicyEngine
+from ..utils.oauth2cc import ClientCredentials
+
+__all__ = ["TranslationError", "translate_auth_config", "build_expression"]
+
+
+class TranslationError(Exception):
+    """Invalid AuthConfig spec — the analog of the reference's reconcile
+    failure → CachingError status."""
+
+
+# ---------------------------------------------------------------------------
+# pattern expressions (ref :805 buildJSONExpression)
+# ---------------------------------------------------------------------------
+
+def _one_pattern(item: Dict[str, Any], named: Dict[str, List[dict]]) -> Expression:
+    if "patternRef" in item and item["patternRef"]:
+        ref = item["patternRef"]
+        patterns = named.get(ref)
+        if patterns is None:
+            raise TranslationError(f"referenced pattern not found: {ref!r}")
+        return All(*[_one_pattern(p, named) for p in patterns])
+    if item.get("all") is not None:
+        return All(*[_one_pattern(p, named) for p in item["all"]])
+    if item.get("any") is not None:
+        return Any_(*[_one_pattern(p, named) for p in item["any"]])
+    selector = item.get("selector", "")
+    operator = item.get("operator", "")
+    value = item.get("value", "")
+    if not operator:
+        raise TranslationError(f"invalid pattern expression: {item!r}")
+    return Pattern(selector, Operator.from_string(operator), str(value))
+
+
+def build_expression(
+    items: Optional[List[dict]], named: Optional[Dict[str, List[dict]]] = None
+) -> Optional[Expression]:
+    """A `when`/patterns list is a logical AND of its items."""
+    if not items:
+        return None
+    named = named or {}
+    return All(*[_one_pattern(i, named) for i in items])
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _value_or_selector(spec: Optional[dict]) -> Optional[JSONValue]:
+    if spec is None:
+        return None
+    if "selector" in spec and spec["selector"]:
+        return JSONValue(pattern=spec["selector"])
+    return JSONValue(static=spec.get("value"))
+
+
+def _named_values(spec: Optional[Dict[str, dict]]) -> List[JSONProperty]:
+    if not spec:
+        return []
+    return [JSONProperty(name, _value_or_selector(v) or JSONValue()) for name, v in spec.items()]
+
+
+def _credentials(spec: Optional[dict]) -> AuthCredentials:
+    """(ref v1beta2 Credentials → in/keySelector)"""
+    if not spec:
+        return AuthCredentials()
+    if spec.get("authorizationHeader") is not None:
+        return AuthCredentials(
+            key_selector=spec["authorizationHeader"].get("prefix", "Bearer") or "Bearer",
+            location="authorization_header",
+        )
+    if spec.get("customHeader") is not None:
+        return AuthCredentials(
+            key_selector=spec["customHeader"].get("name", ""), location="custom_header"
+        )
+    if spec.get("queryString") is not None:
+        return AuthCredentials(key_selector=spec["queryString"].get("name", ""), location="query")
+    if spec.get("cookie") is not None:
+        return AuthCredentials(key_selector=spec["cookie"].get("name", ""), location="cookie")
+    return AuthCredentials()
+
+
+async def _secret_value(cluster: Optional[ClusterReader], namespace: str, ref: Optional[dict], default_key: str = "") -> str:
+    """SecretKeyReference / LocalObjectReference resolution."""
+    if not ref:
+        return ""
+    if cluster is None:
+        raise TranslationError("spec references a Secret but no cluster access is configured")
+    name = ref.get("name", "")
+    key = ref.get("key", default_key)
+    secret = await cluster.get_secret(namespace, name)
+    if secret is None:
+        raise TranslationError(f"secret not found: {namespace}/{name}")
+    if key:
+        if key not in secret.data:
+            raise TranslationError(f"key {key!r} not found in secret {namespace}/{name}")
+        return secret.data[key].decode()
+    return ""
+
+
+def _cache(spec: Optional[dict]) -> Optional[cache_mod.EvaluatorCache]:
+    if not spec:
+        return None
+    key = _value_or_selector(spec.get("key")) or JSONValue()
+    return cache_mod.EvaluatorCache(key, int(spec.get("ttl", 60) or 60))
+
+
+def _common(spec: dict, named: Dict[str, List[dict]]) -> dict:
+    return {
+        "priority": int(spec.get("priority", 0) or 0),
+        "conditions": build_expression(spec.get("when"), named),
+        "cache": _cache(spec.get("cache")),
+        "metrics": bool(spec.get("metrics", False)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# main translation
+# ---------------------------------------------------------------------------
+
+async def _build_generic_http(
+    spec: dict, namespace: str, cluster: Optional[ClusterReader]
+) -> GenericHttp:
+    """(ref :721 buildGenericHttpEvaluator)"""
+    oauth2 = None
+    o = spec.get("oauth2")
+    if o:
+        client_secret = await _secret_value(
+            cluster, namespace, o.get("clientSecretRef"), default_key="clientSecret"
+        )
+        oauth2 = ClientCredentials(
+            o.get("tokenUrl", ""), o.get("clientId", ""), client_secret, o.get("scopes")
+        )
+    shared_secret = ""
+    if spec.get("sharedSecretRef"):
+        shared_secret = await _secret_value(cluster, namespace, spec["sharedSecretRef"])
+    url = spec.get("url", "") or spec.get("endpoint", "")
+    return GenericHttp(
+        endpoint=JSONValue(pattern=url) if "{" in url else JSONValue(static=url),
+        method=spec.get("method", "GET") or "GET",
+        body=_value_or_selector(spec.get("body")),
+        parameters=_named_values(spec.get("bodyParameters")),
+        headers=_named_values(spec.get("headers")),
+        content_type=spec.get("contentType", "") or "application/json",
+        shared_secret=shared_secret,
+        credentials=_credentials(spec.get("credentials")),
+        oauth2=oauth2,
+    )
+
+
+async def translate_auth_config(
+    name: str,
+    namespace: str,
+    spec: Dict[str, Any],
+    labels: Optional[Dict[str, str]] = None,
+    cluster: Optional[ClusterReader] = None,
+    engine: Optional[PolicyEngine] = None,
+) -> EngineEntry:
+    """Returns the EngineEntry (runtime graph + compilable rules)."""
+    cfg_id = f"{namespace}/{name}"
+    named: Dict[str, List[dict]] = spec.get("patterns") or {}
+    runtime = RuntimeAuthConfig(
+        labels={"namespace": namespace, "name": name, **(labels or {})},
+        conditions=build_expression(spec.get("when"), named),
+    )
+
+    oidc_by_name: Dict[str, OIDC] = {}
+
+    # ---- authentication (ref :228-320) ----
+    for auth_name, aspec in (spec.get("authentication") or {}).items():
+        creds = _credentials(aspec.get("credentials"))
+        if aspec.get("apiKey") is not None:
+            sel = LabelSelector.from_spec(aspec["apiKey"].get("selector"))
+            ev = APIKey(
+                auth_name,
+                sel,
+                namespace="" if aspec["apiKey"].get("allNamespaces") else namespace,
+                credentials=creds,
+                cluster=cluster,
+            )
+            await ev.load_secrets()
+            etype = "API_KEY"
+        elif aspec.get("jwt") is not None:
+            ev = OIDC(
+                auth_name,
+                aspec["jwt"].get("issuerUrl", ""),
+                ttl_s=int(aspec["jwt"].get("ttl", 0) or 0),
+                credentials=creds,
+            )
+            try:
+                await ev.refresh()
+            except Exception as e:
+                raise TranslationError(f"failed OIDC discovery for {auth_name!r}: {e}")
+            oidc_by_name[auth_name] = ev
+            etype = "JWT"
+        elif aspec.get("oauth2Introspection") is not None:
+            o = aspec["oauth2Introspection"]
+            secret_name = (o.get("credentialsRef") or {}).get("name", "")
+            client_id = client_secret = ""
+            if secret_name and cluster is not None:
+                secret = await cluster.get_secret(namespace, secret_name)
+                if secret is None:
+                    raise TranslationError(f"secret not found: {namespace}/{secret_name}")
+                client_id = secret.data.get("clientID", b"").decode()
+                client_secret = secret.data.get("clientSecret", b"").decode()
+            ev = OAuth2(
+                auth_name,
+                o.get("endpoint", ""),
+                client_id,
+                client_secret,
+                token_type_hint=o.get("tokenTypeHint", ""),
+                credentials=creds,
+            )
+            etype = "OAUTH2_INTROSPECTION"
+        elif aspec.get("x509") is not None:
+            sel = LabelSelector.from_spec(aspec["x509"].get("selector"))
+            ev = MTLS(
+                auth_name,
+                sel,
+                namespace="" if aspec["x509"].get("allNamespaces") else namespace,
+                credentials=creds,
+                cluster=cluster,
+            )
+            await ev.load_secrets()
+            etype = "X509"
+        elif aspec.get("kubernetesTokenReview") is not None:
+            ev = KubernetesAuth(
+                auth_name,
+                audiences=aspec["kubernetesTokenReview"].get("audiences"),
+                credentials=creds,
+                cluster=cluster,
+            )
+            etype = "KUBERNETES_TOKEN_REVIEW"
+        elif aspec.get("plain") is not None:
+            ev = Plain(aspec["plain"].get("selector", ""))
+            etype = "PLAIN"
+        elif aspec.get("anonymous") is not None:
+            ev = Noop(creds)
+            etype = "ANONYMOUS"
+        else:
+            raise TranslationError(f"unknown authentication method for {auth_name!r}")
+
+        extensions: List[IdentityExtension] = []
+        for prop_name, v in (aspec.get("defaults") or {}).items():
+            extensions.append(IdentityExtension(prop_name, _value_or_selector(v) or JSONValue(), overwrite=False))
+        for prop_name, v in (aspec.get("overrides") or {}).items():
+            extensions.append(IdentityExtension(prop_name, _value_or_selector(v) or JSONValue(), overwrite=True))
+
+        runtime.identity.append(
+            IdentityConfig(
+                auth_name,
+                ev,
+                type=etype,
+                credentials=creds,
+                extended_properties=extensions,
+                **_common(aspec, named),
+            )
+        )
+
+    # ---- metadata (ref :322-365) ----
+    for md_name, mspec in (spec.get("metadata") or {}).items():
+        if mspec.get("http") is not None:
+            ev = await _build_generic_http(mspec["http"], namespace, cluster)
+            etype = "METADATA_GENERIC_HTTP"
+        elif mspec.get("userInfo") is not None:
+            source = mspec["userInfo"].get("identitySource", "")
+            oidc = oidc_by_name.get(source)
+            if oidc is None:
+                raise TranslationError(
+                    f"missing OIDC identity source {source!r} for userInfo metadata {md_name!r}"
+                )
+            ev = UserInfo(oidc)
+            etype = "METADATA_USERINFO"
+        elif mspec.get("uma") is not None:
+            u = mspec["uma"]
+            secret_name = (u.get("credentialsRef") or {}).get("name", "")
+            client_id = client_secret = ""
+            if secret_name and cluster is not None:
+                secret = await cluster.get_secret(namespace, secret_name)
+                if secret is None:
+                    raise TranslationError(f"secret not found: {namespace}/{secret_name}")
+                client_id = secret.data.get("clientID", b"").decode()
+                client_secret = secret.data.get("clientSecret", b"").decode()
+            ev = UMA(u.get("endpoint", ""), client_id, client_secret)
+            etype = "METADATA_UMA"
+        else:
+            raise TranslationError(f"unknown metadata method for {md_name!r}")
+        runtime.metadata.append(MetadataConfig(md_name, ev, type=etype, **_common(mspec, named)))
+
+    # ---- authorization (ref :367-455) ----
+    pattern_slots: List[Tuple[Optional[Expression], Expression]] = []
+    for az_name, azspec in (spec.get("authorization") or {}).items():
+        common = _common(azspec, named)
+        if azspec.get("patternMatching") is not None:
+            rules = build_expression(azspec["patternMatching"].get("patterns"), named)
+            if rules is None:
+                rules = All()
+            slot = len(pattern_slots)
+            pattern_slots.append((common["conditions"], rules))
+            ev = PatternMatching(
+                rules,
+                batched_provider=engine.provider_for(cfg_id) if engine is not None else None,
+                evaluator_slot=slot,
+            )
+            if engine is not None:
+                # conditions are compiled into the kernel; avoid double gating
+                common = {**common, "conditions": None}
+            etype = "PATTERN_MATCHING"
+        elif azspec.get("opa") is not None:
+            o = azspec["opa"]
+            external = None
+            if o.get("externalPolicy"):
+                ext = o["externalPolicy"]
+                shared = ""
+                if ext.get("sharedSecretRef"):
+                    shared = await _secret_value(cluster, namespace, ext["sharedSecretRef"])
+                external = OPAExternalSource(
+                    ext.get("url", "") or ext.get("endpoint", ""),
+                    shared_secret=shared,
+                    ttl_s=int(ext.get("ttl", 0) or 0),
+                )
+            try:
+                ev = OPA(
+                    f"{cfg_id}/{az_name}",
+                    inline_rego=o.get("rego", ""),
+                    external_source=external,
+                    all_values=bool(o.get("allValues", False)),
+                )
+            except ValueError as e:
+                raise TranslationError(str(e))
+            if external is not None:
+                try:
+                    await ev.load_external()
+                except Exception as e:
+                    raise TranslationError(f"failed to fetch external rego policy: {e}")
+            etype = "OPA"
+        elif azspec.get("kubernetesSubjectAccessReview") is not None:
+            k = azspec["kubernetesSubjectAccessReview"]
+            ra = k.get("resourceAttributes") or {}
+            ev = KubernetesAuthz(
+                az_name,
+                user=_value_or_selector(k.get("user")) or JSONValue(),
+                groups=k.get("groups"),
+                resource_attributes={
+                    key: _value_or_selector(ra.get(key)) or JSONValue()
+                    for key in ("namespace", "group", "resource", "name", "subresource", "verb")
+                    if ra.get(key) is not None
+                }
+                if ra
+                else None,
+                cluster=cluster,
+            )
+            etype = "KUBERNETES_SUBJECT_ACCESS_REVIEW"
+        elif azspec.get("spicedb") is not None:
+            s = azspec["spicedb"]
+            shared = ""
+            if s.get("sharedSecretRef"):
+                shared = await _secret_value(cluster, namespace, s["sharedSecretRef"])
+            subj = s.get("subject") or {}
+            res = s.get("resource") or {}
+            ev = Authzed(
+                az_name,
+                endpoint=s.get("endpoint", ""),
+                insecure=bool(s.get("insecure", False)),
+                shared_secret=shared,
+                subject_kind=_value_or_selector(subj.get("kind")),
+                subject_name=_value_or_selector(subj.get("name")),
+                resource_kind=_value_or_selector(res.get("kind")),
+                resource_name=_value_or_selector(res.get("name")),
+                permission=_value_or_selector(s.get("permission")),
+            )
+            etype = "SPICEDB"
+        else:
+            raise TranslationError(f"unknown authorization method for {az_name!r}")
+        runtime.authorization.append(AuthorizationConfig(az_name, ev, type=etype, **common))
+
+    # ---- response (ref :457-560) ----
+    response = spec.get("response") or {}
+    deny_with = DenyWith()
+    for phase, key in (("unauthenticated", "unauthenticated"), ("unauthorized", "unauthorized")):
+        d = response.get(key)
+        if d:
+            setattr(
+                deny_with,
+                phase,
+                DenyWithValues(
+                    code=int(d.get("code", 0) or 0),
+                    message=_value_or_selector(d.get("message")),
+                    headers=_named_values(d.get("headers")),
+                    body=_value_or_selector(d.get("body")),
+                ),
+            )
+    runtime.deny_with = deny_with
+
+    async def build_success(resp_name: str, rspec: dict, wrapper: str) -> ResponseConfig:
+        common = _common(rspec, named)
+        if rspec.get("wristband") is not None:
+            w = rspec["wristband"]
+            signing_keys: List[SigningKey] = []
+            for ref in w.get("signingKeyRefs") or []:
+                pem = await _secret_value(
+                    cluster, namespace, {"name": ref.get("name", ""), "key": "key.pem"}
+                )
+                try:
+                    signing_keys.append(
+                        SigningKey.from_pem(ref.get("name", ""), ref.get("algorithm", "ES256"), pem.encode())
+                    )
+                except ValueError as e:
+                    raise TranslationError(str(e))
+            try:
+                ev = Wristband(
+                    issuer=w.get("issuer", ""),
+                    custom_claims=_named_values(w.get("customClaims")),
+                    token_duration=w.get("tokenDuration"),
+                    signing_keys=signing_keys,
+                )
+            except ValueError as e:
+                raise TranslationError(str(e))
+            etype = "RESPONSE_WRISTBAND"
+        elif rspec.get("json") is not None:
+            ev = DynamicJSON(_named_values(rspec["json"].get("properties")))
+            etype = "RESPONSE_JSON"
+        elif rspec.get("plain") is not None:
+            ev = PlainResponse(_value_or_selector(rspec["plain"]) or JSONValue())
+            etype = "RESPONSE_PLAIN"
+        else:
+            raise TranslationError(f"unknown response method for {resp_name!r}")
+        return ResponseConfig(
+            resp_name,
+            ev,
+            type=etype,
+            wrapper=wrapper,
+            wrapper_key=rspec.get("key", ""),
+            **common,
+        )
+
+    success = response.get("success") or {}
+    for resp_name, rspec in (success.get("headers") or {}).items():
+        runtime.response.append(await build_success(resp_name, rspec, "httpHeader"))
+    for resp_name, rspec in (success.get("dynamicMetadata") or {}).items():
+        runtime.response.append(await build_success(resp_name, rspec, "envoyDynamicMetadata"))
+
+    # ---- callbacks (ref :562-583) ----
+    for cb_name, cbspec in (spec.get("callbacks") or {}).items():
+        if cbspec.get("http") is None:
+            raise TranslationError(f"unknown callback method for {cb_name!r}")
+        ev = await _build_generic_http(cbspec["http"], namespace, cluster)
+        runtime.callbacks.append(
+            CallbackConfig(cb_name, ev, type="CALLBACK_HTTP", **_common(cbspec, named))
+        )
+
+    hosts = list(spec.get("hosts") or [])
+    if not hosts:
+        raise TranslationError("missing hosts")
+
+    return EngineEntry(
+        id=cfg_id,
+        hosts=hosts,
+        runtime=runtime,
+        rules=ConfigRules(name=cfg_id, evaluators=pattern_slots) if pattern_slots else None,
+    )

@@ -1,0 +1,142 @@
+"""Authzed/SpiceDB authorization (semantics: ref
+pkg/evaluators/authorization/authzed.go:25-88): gRPC CheckPermission with
+subject/resource/permission resolved from the Authorization JSON.
+
+The wire call is made with a minimal hand-built method descriptor (the
+public authzed.api.v1 CheckPermission shapes, same field numbers) — no
+authzed client library needed."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import grpc
+from google.protobuf import descriptor_pb2  # noqa: F401  (ensures protobuf runtime)
+
+from ...authjson.value import JSONValue, stringify_json
+from ..base import EvaluationError
+
+CHECK_METHOD = "/authzed.api.v1.PermissionsService/CheckPermission"
+PERMISSIONSHIP_HAS_PERMISSION = 2
+
+
+def _encode_check_request(
+    resource_type: str, resource_id: str, permission: str, subject_type: str, subject_id: str
+) -> bytes:
+    """Hand-encode authzed.api.v1.CheckPermissionRequest:
+      resource(2){object_type(1), object_id(2)}, permission(3),
+      subject(4){object(1){object_type(1), object_id(2)}}"""
+
+    def tag(field: int, wire: int) -> bytes:
+        return bytes([(field << 3) | wire])
+
+    def ld(field: int, payload: bytes) -> bytes:
+        return tag(field, 2) + _varint(len(payload)) + payload
+
+    def _varint(n: int) -> bytes:
+        out = bytearray()
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            out.append(b | (0x80 if n else 0))
+            if not n:
+                return bytes(out)
+
+    def obj_ref(t: str, i: str) -> bytes:
+        return ld(1, t.encode()) + ld(2, i.encode())
+
+    resource = obj_ref(resource_type, resource_id)
+    subject = ld(1, obj_ref(subject_type, subject_id))
+    return ld(2, resource) + ld(3, permission.encode()) + ld(4, subject)
+
+
+def _decode_check_response(data: bytes) -> int:
+    """Extract permissionship (field 2, varint) from CheckPermissionResponse."""
+    i = 0
+    while i < len(data):
+        key = data[i]
+        field, wire = key >> 3, key & 7
+        i += 1
+        if wire == 0:
+            val = 0
+            shift = 0
+            while True:
+                b = data[i]
+                i += 1
+                val |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            if field == 2:
+                return val
+        elif wire == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = data[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            i += ln
+        else:
+            break
+    return 0
+
+
+class Authzed:
+    def __init__(
+        self,
+        name: str,
+        endpoint: str,
+        insecure: bool = False,
+        shared_secret: str = "",
+        subject_kind: Optional[JSONValue] = None,
+        subject_name: Optional[JSONValue] = None,
+        resource_kind: Optional[JSONValue] = None,
+        resource_name: Optional[JSONValue] = None,
+        permission: Optional[JSONValue] = None,
+    ):
+        self.name = name
+        self.endpoint = endpoint
+        self.insecure = insecure
+        self.shared_secret = shared_secret
+        self.subject_kind = subject_kind or JSONValue(static="")
+        self.subject_name = subject_name or JSONValue(static="")
+        self.resource_kind = resource_kind or JSONValue(static="")
+        self.resource_name = resource_name or JSONValue(static="")
+        self.permission = permission or JSONValue(static="")
+
+    async def call(self, pipeline) -> Any:
+        doc = pipeline.authorization_json()
+        payload = _encode_check_request(
+            stringify_json(self.resource_kind.resolve_for(doc)),
+            stringify_json(self.resource_name.resolve_for(doc)),
+            stringify_json(self.permission.resolve_for(doc)),
+            stringify_json(self.subject_kind.resolve_for(doc)),
+            stringify_json(self.subject_name.resolve_for(doc)),
+        )
+        metadata = []
+        if self.shared_secret:
+            metadata.append(("authorization", f"Bearer {self.shared_secret}"))
+        try:
+            if self.insecure:
+                channel = grpc.aio.insecure_channel(self.endpoint)
+            else:
+                channel = grpc.aio.secure_channel(
+                    self.endpoint, grpc.ssl_channel_credentials()
+                )
+            async with channel:
+                call = channel.unary_unary(
+                    CHECK_METHOD,
+                    request_serializer=lambda b: b,
+                    response_deserializer=lambda b: b,
+                )
+                raw = await call(payload, metadata=metadata)
+        except grpc.RpcError as e:
+            raise EvaluationError(f"spicedb check failed: {e}")
+        permissionship = _decode_check_response(raw)
+        if permissionship != PERMISSIONSHIP_HAS_PERMISSION:
+            raise EvaluationError("PERMISSIONSHIP_NO_PERMISSION")
+        return {"permissionship": permissionship}

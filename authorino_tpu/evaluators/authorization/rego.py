@@ -1,0 +1,676 @@
+"""Embedded mini-Rego interpreter — the evaluation core of the OPA
+authorization evaluator (ref: pkg/evaluators/authorization/opa.go uses the
+Go OPA library; no OPA runtime exists for this image, so a focused subset
+interpreter runs the same policies on the CPU path behind the identical
+evaluator seam).
+
+Supported subset (policies outside it are rejected at reconcile time, which
+surfaces as a translate error — fail closed):
+
+  - ``package``/``import`` headers (imports of ``input`` aliases only)
+  - ``default <name> = <term>``
+  - rules: ``name { body }``, ``name = term { body }``, ``name := term``,
+    ``name if { body }`` (v1 sugar), multiple definitions (logical OR)
+  - body expressions (newline/``;`` separated, logical AND):
+    comparisons ``== != < <= > >=``, assignment ``:=``, unification ``=``
+    (simple var binding), negation ``not``, membership ``x in xs``,
+    existential iteration over ``ref[_]`` / ``ref[i]`` variables
+  - references over ``input`` and rule results; array/object indexing
+  - built-ins: count, contains, startswith, endswith, lower, upper, split,
+    concat, trim, trim_prefix, trim_suffix, replace, sprintf, to_number,
+    abs, max, min, sum, object.get, array.concat, json.unmarshal
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+__all__ = ["RegoError", "RegoModule", "compile_module"]
+
+
+class RegoError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>[ \t\r]+)
+  | (?P<comment>\#[^\n]*)
+  | (?P<newline>\n)
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<rawstring>`[^`]*`)
+  | (?P<number>-?\d+(?:\.\d+)?)
+  | (?P<op>:=|==|!=|<=|>=|\[|\]|\{|\}|\(|\)|,|;|:|\.|<|>|=|\|)
+  | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
+""",
+    re.X,
+)
+
+_KEYWORDS = {"package", "import", "default", "not", "in", "if", "true", "false", "null",
+             "else", "some", "every", "as", "contains", "with"}
+
+
+@dataclass
+class _Tok:
+    kind: str  # "name" | "string" | "number" | "op" | "newline" | "eof"
+    value: Any
+    line: int
+
+
+def _lex(src: str) -> List[_Tok]:
+    toks: List[_Tok] = []
+    line = 1
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if not m:
+            raise RegoError(f"rego: unexpected character {src[pos]!r} at line {line}")
+        pos = m.end()
+        kind = m.lastgroup
+        text = m.group()
+        if kind in ("ws", "comment"):
+            continue
+        if kind == "newline":
+            line += 1
+            toks.append(_Tok("newline", "\n", line))
+        elif kind == "string":
+            toks.append(_Tok("string", json.loads(text), line))
+        elif kind == "rawstring":
+            toks.append(_Tok("string", text[1:-1], line))
+        elif kind == "number":
+            toks.append(_Tok("number", float(text) if "." in text else int(text), line))
+        elif kind == "op":
+            toks.append(_Tok("op", text, line))
+        else:
+            toks.append(_Tok("name", text, line))
+    toks.append(_Tok("eof", None, line))
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Ref:
+    base: str                      # "input" | var | rule name
+    path: List[Any] = field(default_factory=list)  # str keys, Const, Var("_"), Var(name)
+
+
+@dataclass
+class Var:
+    name: str
+
+
+@dataclass
+class Const:
+    value: Any
+
+
+@dataclass
+class ArrayLit:
+    items: List[Any]
+
+
+@dataclass
+class ObjectLit:
+    items: List[Tuple[Any, Any]]
+
+
+@dataclass
+class CallExpr:
+    fn: str
+    args: List[Any]
+
+
+@dataclass
+class BinExpr:
+    op: str
+    left: Any
+    right: Any
+
+
+@dataclass
+class NotExpr:
+    expr: Any
+
+
+@dataclass
+class InExpr:
+    needle: Any
+    haystack: Any
+
+
+@dataclass
+class SomeDecl:
+    names: List[str]
+
+
+@dataclass
+class Rule:
+    name: str
+    value: Any          # term producing the rule value (Const(True) default)
+    body: List[Any]     # expressions (AND)
+    is_default: bool = False
+
+
+@dataclass
+class RegoModule:
+    package: str
+    rules: Dict[str, List[Rule]]
+    defaults: Dict[str, Any]
+
+    def evaluate(self, input_doc: Any) -> Dict[str, Any]:
+        """Evaluate every rule in the package against ``input`` and return
+        the package document (rule name → value)."""
+        ev = _Evaluator(self, input_doc)
+        out: Dict[str, Any] = {}
+        for name in self.rules:
+            v = ev.rule_value(name)
+            if v is not _UNDEFINED:
+                out[name] = v
+        for name, default in self.defaults.items():
+            if name not in out:
+                out[name] = _const_value(default)
+        return out
+
+
+_UNDEFINED = object()
+
+
+def _const_value(term) -> Any:
+    if isinstance(term, Const):
+        return term.value
+    raise RegoError("default value must be a constant")
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+class _Parser:
+    def __init__(self, toks: List[_Tok]):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self, offset: int = 0) -> _Tok:
+        return self.toks[min(self.i + offset, len(self.toks) - 1)]
+
+    def next(self) -> _Tok:
+        t = self.toks[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def skip_newlines(self):
+        while self.peek().kind == "newline":
+            self.next()
+
+    def expect(self, kind: str, value: Any = None) -> _Tok:
+        t = self.next()
+        if t.kind != kind or (value is not None and t.value != value):
+            raise RegoError(f"rego parse error at line {t.line}: expected {value or kind}, got {t.value!r}")
+        return t
+
+    # ---- module ----
+
+    def parse_module(self) -> RegoModule:
+        self.skip_newlines()
+        package = "policy"
+        if self.peek().kind == "name" and self.peek().value == "package":
+            self.next()
+            package = self._parse_dotted_name()
+        self.skip_newlines()
+        while self.peek().kind == "name" and self.peek().value == "import":
+            while self.peek().kind not in ("newline", "eof"):
+                self.next()
+            self.skip_newlines()
+        rules: Dict[str, List[Rule]] = {}
+        defaults: Dict[str, Any] = {}
+        while self.peek().kind != "eof":
+            self.skip_newlines()
+            if self.peek().kind == "eof":
+                break
+            rule = self._parse_rule()
+            if rule.is_default:
+                defaults[rule.name] = rule.value
+            else:
+                rules.setdefault(rule.name, []).append(rule)
+        return RegoModule(package=package, rules=rules, defaults=defaults)
+
+    def _parse_dotted_name(self) -> str:
+        parts = [self.expect("name").value]
+        while self.peek().kind == "op" and self.peek().value == ".":
+            self.next()
+            parts.append(self.expect("name").value)
+        return ".".join(parts)
+
+    # ---- rules ----
+
+    def _parse_rule(self) -> Rule:
+        t = self.peek()
+        if t.kind == "name" and t.value == "default":
+            self.next()
+            name = self.expect("name").value
+            op = self.next()
+            if not (op.kind == "op" and op.value in ("=", ":=")):
+                raise RegoError(f"rego parse error at line {op.line}: expected = after default")
+            value = self._parse_term()
+            return Rule(name=name, value=value, body=[], is_default=True)
+
+        name = self.expect("name").value
+        value: Any = Const(True)
+        body: List[Any] = []
+
+        t = self.peek()
+        # name = term / name := term
+        if t.kind == "op" and t.value in ("=", ":="):
+            self.next()
+            value = self._parse_term()
+            t = self.peek()
+        # optional `if` (v1)
+        if t.kind == "name" and t.value == "if":
+            self.next()
+            t = self.peek()
+        if t.kind == "op" and t.value == "{":
+            self.next()
+            body = self._parse_body()
+            self.expect("op", "}")
+        elif not body and isinstance(value, Const) and value.value is True and not (
+            t.kind in ("newline", "eof")
+        ):
+            # bare `name expr`? not supported
+            raise RegoError(f"rego parse error at line {t.line}: expected rule body")
+        return Rule(name=name, value=value, body=body)
+
+    def _parse_body(self) -> List[Any]:
+        exprs: List[Any] = []
+        while True:
+            self.skip_newlines()
+            t = self.peek()
+            if t.kind == "op" and t.value == "}":
+                return exprs
+            if t.kind == "eof":
+                raise RegoError("rego parse error: unexpected EOF in rule body")
+            exprs.append(self._parse_expr())
+            t = self.peek()
+            if t.kind == "op" and t.value == ";":
+                self.next()
+
+    # ---- expressions ----
+
+    def _parse_expr(self) -> Any:
+        t = self.peek()
+        if t.kind == "name" and t.value == "not":
+            self.next()
+            return NotExpr(self._parse_expr())
+        if t.kind == "name" and t.value == "some":
+            self.next()
+            names = [self.expect("name").value]
+            while self.peek().kind == "op" and self.peek().value == ",":
+                self.next()
+                names.append(self.expect("name").value)
+            # `some x in xs` sugar
+            if self.peek().kind == "name" and self.peek().value == "in":
+                self.next()
+                haystack = self._parse_term()
+                return InExpr(Var(names[0]), haystack)
+            return SomeDecl(names)
+        left = self._parse_term()
+        t = self.peek()
+        if t.kind == "name" and t.value == "in":
+            self.next()
+            return InExpr(left, self._parse_term())
+        if t.kind == "op" and t.value in ("==", "!=", "<", "<=", ">", ">=", "=", ":="):
+            op = self.next().value
+            right = self._parse_term()
+            return BinExpr(op, left, right)
+        return left
+
+    def _parse_term(self) -> Any:
+        t = self.peek()
+        if t.kind == "string":
+            self.next()
+            return Const(t.value)
+        if t.kind == "number":
+            self.next()
+            return Const(t.value)
+        if t.kind == "op" and t.value == "[":
+            self.next()
+            items = []
+            while not (self.peek().kind == "op" and self.peek().value == "]"):
+                self.skip_newlines()
+                items.append(self._parse_term())
+                self.skip_newlines()
+                if self.peek().kind == "op" and self.peek().value == ",":
+                    self.next()
+            self.expect("op", "]")
+            return ArrayLit(items)
+        if t.kind == "op" and t.value == "{":
+            self.next()
+            items: List[Tuple[Any, Any]] = []
+            while not (self.peek().kind == "op" and self.peek().value == "}"):
+                self.skip_newlines()
+                key = self._parse_term()
+                self.expect("op", ":")
+                val = self._parse_term()
+                items.append((key, val))
+                self.skip_newlines()
+                if self.peek().kind == "op" and self.peek().value == ",":
+                    self.next()
+            self.expect("op", "}")
+            return ObjectLit(items)
+        if t.kind == "name":
+            if t.value == "true":
+                self.next()
+                return Const(True)
+            if t.value == "false":
+                self.next()
+                return Const(False)
+            if t.value == "null":
+                self.next()
+                return Const(None)
+            name = self._parse_dotted_call_or_ref()
+            return name
+        raise RegoError(f"rego parse error at line {t.line}: unexpected token {t.value!r}")
+
+    def _parse_dotted_call_or_ref(self) -> Any:
+        base = self.expect("name").value
+        path: List[Any] = []
+        fn_parts = [base]
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value == ".":
+                self.next()
+                nxt = self.expect("name")
+                path.append(nxt.value)
+                fn_parts.append(nxt.value)
+            elif t.kind == "op" and t.value == "[":
+                self.next()
+                inner = self._parse_term()
+                self.expect("op", "]")
+                path.append(inner)
+                fn_parts = []  # indexed refs are never function names
+            elif t.kind == "op" and t.value == "(":
+                self.next()
+                args = []
+                while not (self.peek().kind == "op" and self.peek().value == ")"):
+                    args.append(self._parse_term())
+                    if self.peek().kind == "op" and self.peek().value == ",":
+                        self.next()
+                self.expect("op", ")")
+                fn = ".".join(fn_parts) if fn_parts else base
+                return CallExpr(fn, args)
+            else:
+                break
+        if not path:
+            return Var(base)
+        return Ref(base, path)
+
+
+# ---------------------------------------------------------------------------
+# Evaluator
+# ---------------------------------------------------------------------------
+
+def _builtin(fn: str, args: List[Any]) -> Any:
+    try:
+        if fn == "count":
+            return len(args[0])
+        if fn == "contains":
+            return args[1] in args[0]
+        if fn == "startswith":
+            return str(args[0]).startswith(str(args[1]))
+        if fn == "endswith":
+            return str(args[0]).endswith(str(args[1]))
+        if fn == "lower":
+            return str(args[0]).lower()
+        if fn == "upper":
+            return str(args[0]).upper()
+        if fn == "split":
+            return str(args[0]).split(str(args[1]))
+        if fn == "concat":
+            return str(args[0]).join(str(x) for x in args[1])
+        if fn == "trim":
+            return str(args[0]).strip(str(args[1]))
+        if fn == "trim_prefix":
+            s, p = str(args[0]), str(args[1])
+            return s[len(p):] if s.startswith(p) else s
+        if fn == "trim_suffix":
+            s, p = str(args[0]), str(args[1])
+            return s[: -len(p)] if p and s.endswith(p) else s
+        if fn == "replace":
+            return str(args[0]).replace(str(args[1]), str(args[2]))
+        if fn == "sprintf":
+            return str(args[0]) % tuple(args[1])
+        if fn == "to_number":
+            v = args[0]
+            return float(v) if "." in str(v) else int(v)
+        if fn == "abs":
+            return abs(args[0])
+        if fn == "max":
+            return max(args[0])
+        if fn == "min":
+            return min(args[0])
+        if fn == "sum":
+            return sum(args[0])
+        if fn == "object.get":
+            return args[0].get(args[1], args[2]) if isinstance(args[0], dict) else args[2]
+        if fn == "array.concat":
+            return list(args[0]) + list(args[1])
+        if fn == "json.unmarshal":
+            return json.loads(args[0])
+    except RegoError:
+        raise
+    except Exception as e:
+        raise RegoError(f"rego builtin {fn} failed: {e}")
+    raise RegoError(f"rego: unsupported builtin {fn!r}")
+
+
+class _Evaluator:
+    def __init__(self, module: RegoModule, input_doc: Any):
+        self.module = module
+        self.input = input_doc
+        self._cache: Dict[str, Any] = {}
+        self._in_progress: set = set()
+
+    def rule_value(self, name: str) -> Any:
+        if name in self._cache:
+            return self._cache[name]
+        if name in self._in_progress:
+            raise RegoError(f"rego: recursive rule {name!r}")
+        self._in_progress.add(name)
+        try:
+            result = _UNDEFINED
+            for rule in self.module.rules.get(name, []):
+                for bindings in self._eval_body(rule.body, {}):
+                    vals = list(self._term_values(rule.value, bindings))
+                    if vals:
+                        result = vals[0]
+                        break
+                if result is not _UNDEFINED:
+                    break
+            if result is _UNDEFINED and name in self.module.defaults:
+                result = _const_value(self.module.defaults[name])
+            self._cache[name] = result
+            return result
+        finally:
+            self._in_progress.discard(name)
+
+    # --- body evaluation: yields satisfying binding dicts (existential) ---
+
+    def _eval_body(self, body: List[Any], bindings: Dict[str, Any]) -> Iterator[Dict[str, Any]]:
+        if not body:
+            yield bindings
+            return
+        head, rest = body[0], body[1:]
+        for b in self._eval_expr(head, bindings):
+            yield from self._eval_body(rest, b)
+
+    def _eval_expr(self, expr: Any, bindings: Dict[str, Any]) -> Iterator[Dict[str, Any]]:
+        if isinstance(expr, SomeDecl):
+            yield bindings  # declaration only
+            return
+        if isinstance(expr, NotExpr):
+            # negation as failure: succeeds iff inner has no satisfying binding
+            for _ in self._eval_expr(expr.expr, dict(bindings)):
+                return
+            yield bindings
+            return
+        if isinstance(expr, BinExpr):
+            if expr.op in (":=", "="):
+                # bind-if-var, else compare
+                if isinstance(expr.left, Var) and expr.left.name not in bindings and expr.left.name != "_":
+                    for v in self._term_values(expr.right, bindings):
+                        nb = dict(bindings)
+                        nb[expr.left.name] = v
+                        yield nb
+                    return
+                for lv in self._term_values(expr.left, bindings):
+                    for rv in self._term_values(expr.right, bindings):
+                        if lv == rv:
+                            yield bindings
+                            return
+                return
+            for lv in self._term_values(expr.left, bindings):
+                for rv in self._term_values(expr.right, bindings):
+                    if self._compare(expr.op, lv, rv):
+                        yield bindings
+                        return
+            return
+        if isinstance(expr, InExpr):
+            for hay in self._term_values(expr.haystack, bindings):
+                items = hay if isinstance(hay, list) else (
+                    list(hay.values()) if isinstance(hay, dict) else []
+                )
+                if isinstance(expr.needle, Var) and expr.needle.name not in bindings and expr.needle.name != "_":
+                    for item in items:
+                        nb = dict(bindings)
+                        nb[expr.needle.name] = item
+                        yield nb
+                    return
+                for nv in self._term_values(expr.needle, bindings):
+                    if nv in items:
+                        yield bindings
+                        return
+            return
+        # bare term: truthy & defined
+        for v in self._term_values(expr, bindings):
+            if v is not _UNDEFINED and v is not False and v is not None:
+                yield bindings
+                return
+        return
+
+    @staticmethod
+    def _compare(op: str, a: Any, b: Any) -> bool:
+        try:
+            if op == "==":
+                return a == b
+            if op == "!=":
+                return a != b
+            if op == "<":
+                return a < b
+            if op == "<=":
+                return a <= b
+            if op == ">":
+                return a > b
+            if op == ">=":
+                return a >= b
+        except TypeError:
+            return False
+        raise RegoError(f"rego: unsupported operator {op!r}")
+
+    # --- term evaluation: yields possible values (iteration over [_]) ---
+
+    def _term_values(self, term: Any, bindings: Dict[str, Any]) -> Iterator[Any]:
+        if isinstance(term, Const):
+            yield term.value
+        elif isinstance(term, Var):
+            if term.name in bindings:
+                yield bindings[term.name]
+            elif term.name == "input":
+                yield self.input
+            elif term.name in self.module.rules or term.name in self.module.defaults:
+                v = self.rule_value(term.name)
+                if v is not _UNDEFINED:
+                    yield v
+            else:
+                raise RegoError(f"rego: unsafe variable {term.name!r}")
+        elif isinstance(term, ArrayLit):
+            yield [next(self._term_values(i, bindings), _UNDEFINED) for i in term.items]
+        elif isinstance(term, ObjectLit):
+            yield {
+                next(self._term_values(k, bindings), None): next(
+                    self._term_values(v, bindings), None
+                )
+                for k, v in term.items
+            }
+        elif isinstance(term, CallExpr):
+            arg_vals = [next(self._term_values(a, bindings), _UNDEFINED) for a in term.args]
+            if _UNDEFINED in arg_vals:
+                return
+            yield _builtin(term.fn, arg_vals)
+        elif isinstance(term, Ref):
+            yield from self._ref_values(term, bindings)
+        elif isinstance(term, (BinExpr, NotExpr, InExpr)):
+            # expression used as a term: true iff satisfiable
+            sat = next(self._eval_expr(term, dict(bindings)), None)
+            yield sat is not None
+        else:
+            raise RegoError(f"rego: cannot evaluate term {term!r}")
+
+    def _ref_values(self, ref: Ref, bindings: Dict[str, Any]) -> Iterator[Any]:
+        if ref.base == "input":
+            roots = [self.input]
+        elif ref.base in bindings:
+            roots = [bindings[ref.base]]
+        elif ref.base in self.module.rules or ref.base in self.module.defaults:
+            v = self.rule_value(ref.base)
+            roots = [] if v is _UNDEFINED else [v]
+        elif ref.base == "data":
+            roots = [{}]
+        else:
+            raise RegoError(f"rego: unsafe variable {ref.base!r}")
+
+        def walk(values: List[Any], path: List[Any]) -> Iterator[Any]:
+            if not path:
+                yield from values
+                return
+            seg, rest = path[0], path[1:]
+            out: List[Any] = []
+            for v in values:
+                if isinstance(seg, str):
+                    if isinstance(v, dict) and seg in v:
+                        yield from walk([v[seg]], rest)
+                elif isinstance(seg, Var) and seg.name == "_":
+                    items = v if isinstance(v, list) else (
+                        list(v.values()) if isinstance(v, dict) else []
+                    )
+                    for item in items:
+                        yield from walk([item], rest)
+                else:
+                    for key in self._term_values(seg, bindings):
+                        if isinstance(v, list) and isinstance(key, (int, float)):
+                            i = int(key)
+                            if 0 <= i < len(v):
+                                yield from walk([v[i]], rest)
+                        elif isinstance(v, dict) and key in v:
+                            yield from walk([v[key]], rest)
+            return
+
+        yield from walk(roots, ref.path)
+
+
+def compile_module(rego_src: str, package: str = "policy") -> RegoModule:
+    """Parse + validate a policy (the reconcile-time analog of OPA's
+    PrepareForEval, ref: pkg/evaluators/authorization/opa.go:141)."""
+    module = _Parser(_lex(rego_src)).parse_module()
+    if package and module.package == "policy":
+        module.package = package
+    return module

@@ -2,3 +2,4 @@
 
 from .dynamic_json import DynamicJSON  # noqa: F401
 from .plain import Plain  # noqa: F401
+from .wristband import SigningKey, Wristband  # noqa: F401

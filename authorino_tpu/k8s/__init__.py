@@ -1,0 +1,3 @@
+"""Kubernetes access seam: cluster reader protocol + in-memory/REST impls."""
+
+from .client import ClusterReader, InMemoryCluster, LabelSelector, RestCluster, Secret  # noqa: F401

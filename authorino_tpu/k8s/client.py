@@ -1,0 +1,306 @@
+"""Cluster abstraction: the narrow seam every Kubernetes-touching evaluator
+and controller goes through (the analog of the reference's injected
+controller-runtime client / typed clientsets — SURVEY.md §4 notes the
+narrow-interface style is what makes its fakes easy).
+
+Implementations:
+  - InMemoryCluster — tests and standalone mode (secrets loaded from YAML)
+  - RestCluster    — real Kubernetes over its REST API with aiohttp
+    (in-cluster service account or kubeconfig token); built without the
+    `kubernetes` pip package, which is not in the image
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import os
+import ssl
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Protocol, Tuple
+
+__all__ = ["Secret", "LabelSelector", "ClusterReader", "InMemoryCluster", "RestCluster"]
+
+
+@dataclass
+class Secret:
+    name: str
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    data: Dict[str, bytes] = field(default_factory=dict)
+    uid: str = ""
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.namespace, self.name)
+
+    def to_identity_object(self) -> Dict[str, Any]:
+        """K8s-Secret-shaped JSON: what the API-key evaluator resolves as the
+        identity object (ref: pkg/evaluators/identity/api_key.go:79-82 returns
+        the Secret resource)."""
+        return {
+            "apiVersion": "v1",
+            "kind": "Secret",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "labels": dict(self.labels),
+                "annotations": dict(self.annotations),
+                "uid": self.uid,
+            },
+            "data": {k: base64.b64encode(v).decode() for k, v in self.data.items()},
+        }
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    """matchLabels + a subset of string-form expressions ("k=v,k2 in (a,b),!k3")."""
+
+    match_labels: Tuple[Tuple[str, str], ...] = ()
+    expressions: Tuple[Tuple[str, str, Tuple[str, ...]], ...] = ()  # (key, op, values)
+
+    @classmethod
+    def parse(cls, selector: str) -> "LabelSelector":
+        match_labels: List[Tuple[str, str]] = []
+        expressions: List[Tuple[str, str, Tuple[str, ...]]] = []
+        s = selector.strip()
+        i = 0
+        parts: List[str] = []
+        depth = 0
+        buf = []
+        for ch in s:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            if ch == "," and depth == 0:
+                parts.append("".join(buf))
+                buf = []
+            else:
+                buf.append(ch)
+        if buf:
+            parts.append("".join(buf))
+        for part in parts:
+            part = part.strip()
+            if not part:
+                continue
+            if " in " in part or " notin " in part:
+                op = "in" if " in " in part else "notin"
+                key, _, rest = part.partition(f" {op} ")
+                vals = tuple(v.strip() for v in rest.strip().strip("()").split(","))
+                expressions.append((key.strip(), op, vals))
+            elif part.startswith("!"):
+                expressions.append((part[1:].strip(), "!", ()))
+            elif "!=" in part:
+                k, _, v = part.partition("!=")
+                expressions.append((k.strip(), "!=", (v.strip(),)))
+            elif "=" in part:
+                k, _, v = part.partition("==") if "==" in part else part.partition("=")
+                match_labels.append((k.strip(), v.strip()))
+            else:
+                expressions.append((part, "exists", ()))
+        return cls(tuple(match_labels), tuple(expressions))
+
+    @classmethod
+    def from_spec(cls, spec: Optional[dict]) -> "LabelSelector":
+        """From a K8s LabelSelector object ({matchLabels, matchExpressions})."""
+        if not spec:
+            return cls()
+        ml = tuple(sorted((spec.get("matchLabels") or {}).items()))
+        exprs = []
+        for e in spec.get("matchExpressions") or []:
+            op = {"In": "in", "NotIn": "notin", "Exists": "exists", "DoesNotExist": "!"}.get(
+                e.get("operator", ""), "exists"
+            )
+            exprs.append((e.get("key", ""), op, tuple(e.get("values") or ())))
+        return cls(ml, tuple(exprs))
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        for k, v in self.match_labels:
+            if labels.get(k) != v:
+                return False
+        for key, op, values in self.expressions:
+            if op == "in" and labels.get(key) not in values:
+                return False
+            if op == "notin" and labels.get(key) in values:
+                return False
+            if op == "exists" and key not in labels:
+                return False
+            if op == "!" and key in labels:
+                return False
+            if op == "!=" and labels.get(key) == values[0]:
+                return False
+        return True
+
+    def to_string(self) -> str:
+        out = [f"{k}={v}" for k, v in self.match_labels]
+        for key, op, values in self.expressions:
+            if op == "in":
+                out.append(f"{key} in ({','.join(values)})")
+            elif op == "notin":
+                out.append(f"{key} notin ({','.join(values)})")
+            elif op == "exists":
+                out.append(key)
+            elif op == "!":
+                out.append(f"!{key}")
+            elif op == "!=":
+                out.append(f"{key}!={values[0]}")
+        return ",".join(out)
+
+    def empty(self) -> bool:
+        return not self.match_labels and not self.expressions
+
+
+class ClusterReader(Protocol):
+    async def list_secrets(self, selector: LabelSelector, namespace: Optional[str] = None) -> List[Secret]: ...
+    async def get_secret(self, namespace: str, name: str) -> Optional[Secret]: ...
+    async def token_review(self, token: str, audiences: List[str]) -> Dict[str, Any]: ...
+    async def subject_access_review(self, spec: Dict[str, Any]) -> Dict[str, Any]: ...
+
+
+class InMemoryCluster:
+    """Fake cluster for tests/standalone mode; secret mutations notify
+    subscribers (drives the secret reconciler like a watch stream)."""
+
+    def __init__(self):
+        self._secrets: Dict[Tuple[str, str], Secret] = {}
+        self._secret_listeners: List[Callable[[str, Secret], None]] = []
+        self.token_reviews: Dict[str, Dict[str, Any]] = {}
+        self.access_reviews: Callable[[Dict[str, Any]], Dict[str, Any]] = lambda spec: {
+            "status": {"allowed": False}
+        }
+
+    # --- secrets ---
+    def put_secret(self, secret: Secret) -> None:
+        self._secrets[secret.key] = secret
+        for fn in self._secret_listeners:
+            fn("upsert", secret)
+
+    def remove_secret(self, namespace: str, name: str) -> None:
+        secret = self._secrets.pop((namespace, name), None)
+        if secret is not None:
+            for fn in self._secret_listeners:
+                fn("delete", secret)
+
+    def on_secret_event(self, fn: Callable[[str, Secret], None]) -> None:
+        self._secret_listeners.append(fn)
+
+    async def list_secrets(self, selector: LabelSelector, namespace: Optional[str] = None) -> List[Secret]:
+        return [
+            s
+            for s in self._secrets.values()
+            if (namespace is None or s.namespace == namespace) and selector.matches(s.labels)
+        ]
+
+    async def get_secret(self, namespace: str, name: str) -> Optional[Secret]:
+        return self._secrets.get((namespace, name))
+
+    # --- reviews ---
+    async def token_review(self, token: str, audiences: List[str]) -> Dict[str, Any]:
+        hit = self.token_reviews.get(token)
+        if hit is None:
+            return {"status": {"authenticated": False, "error": "invalid token"}}
+        return hit
+
+    async def subject_access_review(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        return self.access_reviews(spec)
+
+
+class RestCluster:
+    """Kubernetes REST client over aiohttp (no `kubernetes` pip dependency).
+
+    In-cluster: reads the service-account token + CA from
+    /var/run/secrets/kubernetes.io/serviceaccount (like client-go's
+    InClusterConfig the reference relies on through controller-runtime)."""
+
+    SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    def __init__(self, base_url: Optional[str] = None, token: Optional[str] = None, ca_file: Optional[str] = None):
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError("not running in-cluster and no base_url given")
+            base_url = f"https://{host}:{port}"
+        self.base_url = base_url.rstrip("/")
+        self._token = token
+        self._ca_file = ca_file or os.path.join(self.SA_DIR, "ca.crt")
+        self._ssl: Optional[ssl.SSLContext] = None
+
+    def _auth_headers(self) -> Dict[str, str]:
+        token = self._token
+        if token is None:
+            try:
+                with open(os.path.join(self.SA_DIR, "token")) as f:
+                    token = f.read().strip()
+            except OSError:
+                token = ""
+        return {"Authorization": f"Bearer {token}"} if token else {}
+
+    def _ssl_ctx(self):
+        if self._ssl is None and os.path.exists(self._ca_file):
+            self._ssl = ssl.create_default_context(cafile=self._ca_file)
+        return self._ssl
+
+    async def _request(self, method: str, path: str, **kw) -> Any:
+        from ..utils import http as http_util
+
+        sess = http_util.get_session()
+        headers = {**self._auth_headers(), **kw.pop("headers", {})}
+        async with sess.request(
+            method, f"{self.base_url}{path}", headers=headers, ssl=self._ssl_ctx(), **kw
+        ) as resp:
+            body = await resp.text()
+            if resp.status >= 300:
+                raise RuntimeError(f"k8s api {method} {path}: {resp.status} {body[:200]}")
+            return json.loads(body) if body else {}
+
+    @staticmethod
+    def _secret_from_obj(obj: Dict[str, Any]) -> Secret:
+        meta = obj.get("metadata", {})
+        return Secret(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            labels=meta.get("labels", {}) or {},
+            annotations=meta.get("annotations", {}) or {},
+            uid=meta.get("uid", ""),
+            data={k: base64.b64decode(v) for k, v in (obj.get("data") or {}).items()},
+        )
+
+    async def list_secrets(self, selector: LabelSelector, namespace: Optional[str] = None) -> List[Secret]:
+        path = f"/api/v1/namespaces/{namespace}/secrets" if namespace else "/api/v1/secrets"
+        params = {}
+        sel = selector.to_string()
+        if sel:
+            params["labelSelector"] = sel
+        payload = await self._request("GET", path, params=params)
+        return [self._secret_from_obj(o) for o in payload.get("items", [])]
+
+    async def get_secret(self, namespace: str, name: str) -> Optional[Secret]:
+        try:
+            obj = await self._request("GET", f"/api/v1/namespaces/{namespace}/secrets/{name}")
+        except RuntimeError:
+            return None
+        return self._secret_from_obj(obj)
+
+    async def token_review(self, token: str, audiences: List[str]) -> Dict[str, Any]:
+        body = {
+            "apiVersion": "authentication.k8s.io/v1",
+            "kind": "TokenReview",
+            "spec": {"token": token, "audiences": audiences},
+        }
+        return await self._request(
+            "POST", "/apis/authentication.k8s.io/v1/tokenreviews", json=body
+        )
+
+    async def subject_access_review(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        body = {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": spec,
+        }
+        return await self._request(
+            "POST", "/apis/authorization.k8s.io/v1/subjectaccessreviews", json=body
+        )

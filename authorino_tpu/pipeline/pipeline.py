@@ -18,6 +18,7 @@ evaluator read (ref :542-579), which is its dominant pipeline cost."""
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json as _json
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -99,30 +100,37 @@ class AuthPipeline:
     # ---- evaluator invocation -------------------------------------------
 
     async def _call_one(self, conf: PhaseConfig) -> Any:
+        # per-evaluator (deep) metrics are gated by the evaluator's
+        # `metrics: true` or the global flag (ref: pkg/metrics/metrics.go:86-96)
+        deep = conf.metrics or metrics_mod.DEEP_METRICS_ENABLED
         labels = self.config.labels
-        mlabels = (labels.get("namespace", ""), labels.get("name", ""), conf.type, conf.name)
-        metrics_mod.evaluator_total.labels(*mlabels).inc()
+        if deep:
+            mlabels = (labels.get("namespace", ""), labels.get("name", ""), conf.type, conf.name)
+            metrics_mod.evaluator_total.labels(*mlabels).inc()
         if conf.conditions is not None:
             try:
-                if not conf.conditions.matches(self._doc):
-                    metrics_mod.evaluator_ignored.labels(*mlabels).inc()
-                    raise _Skip()
-            except _Skip:
-                raise
+                matched = conf.conditions.matches(self._doc)
             except Exception:
-                metrics_mod.evaluator_ignored.labels(*mlabels).inc()
+                matched = False
+            if not matched:
+                if deep:
+                    metrics_mod.evaluator_ignored.labels(*mlabels).inc()
                 raise _Skip()
-        with metrics_mod.evaluator_duration.labels(*mlabels).time():
+        timer = metrics_mod.evaluator_duration.labels(*mlabels).time() if deep else contextlib.nullcontext()
+        with timer:
             try:
                 return await conf.call(self)
             except SkippedError:
-                metrics_mod.evaluator_ignored.labels(*mlabels).inc()
+                if deep:
+                    metrics_mod.evaluator_ignored.labels(*mlabels).inc()
                 raise _Skip()
             except EvaluationError:
-                metrics_mod.evaluator_denied.labels(*mlabels).inc()
+                if deep:
+                    metrics_mod.evaluator_denied.labels(*mlabels).inc()
                 raise
             except asyncio.CancelledError:
-                metrics_mod.evaluator_cancelled.labels(*mlabels).inc()
+                if deep:
+                    metrics_mod.evaluator_cancelled.labels(*mlabels).inc()
                 raise
 
     @staticmethod

@@ -81,7 +81,6 @@ class PolicyEngine:
         self._swap_lock = threading.Lock()
         self._pending: List[_Pending] = []
         self._flush_handle: Optional[asyncio.TimerHandle] = None
-        self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     # ---- control plane ---------------------------------------------------
 
@@ -137,18 +136,15 @@ class PolicyEngine:
         """Queue one request for the next micro-batch; resolves to that
         request's per-evaluator (rule_results [E], skipped [E])."""
         loop = asyncio.get_running_loop()
-        self._loop = loop
         fut: asyncio.Future = loop.create_future()
         self._pending.append(_Pending(doc, config_name, fut))
         if len(self._pending) >= self.max_batch:
-            self._schedule_flush(immediate=True)
+            self._schedule_flush()
         elif self._flush_handle is None:
-            self._flush_handle = loop.call_later(
-                self.max_delay_s, lambda: self._schedule_flush(immediate=True)
-            )
+            self._flush_handle = loop.call_later(self.max_delay_s, self._schedule_flush)
         return await fut
 
-    def _schedule_flush(self, immediate: bool = False) -> None:
+    def _schedule_flush(self) -> None:
         if self._flush_handle is not None:
             self._flush_handle.cancel()
             self._flush_handle = None

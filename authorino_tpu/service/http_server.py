@@ -107,9 +107,14 @@ def make_check_handler(engine: PolicyEngine, max_body: int = DEFAULT_MAX_BODY):
                 review["response"]["status"] = {"message": result.message}
             return web.json_response(review)
 
-        headers = {}
+        from multidict import CIMultiDict
+
+        # multidict: repeated header names must survive (e.g. one
+        # WWW-Authenticate challenge per identity config — ref config.go:29-40)
+        headers: CIMultiDict = CIMultiDict()
         for hs in result.headers:
-            headers.update(hs)
+            for k, v in hs.items():
+                headers.add(k, v)
         if result.code != OK and result.message:
             # reason travels in the X-Ext-Auth-Reason header (ref :470-480)
             headers["X-Ext-Auth-Reason"] = result.message

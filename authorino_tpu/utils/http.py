@@ -1,0 +1,57 @@
+"""Shared outbound HTTP: one aiohttp session per loop, JSON-or-text response
+parsing with the reference's tolerance (ref: pkg/json/json.go:63-94
+UnmashalJSONResponse), W3C trace-context header injection hook."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, Optional, Tuple
+
+import aiohttp
+
+__all__ = ["get_session", "parse_response", "HttpError", "close_sessions"]
+
+_sessions: Dict[int, aiohttp.ClientSession] = {}
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, body: str):
+        self.status = status
+        self.body = body
+        super().__init__(f"{status}: {body[:200]}")
+
+
+def get_session() -> aiohttp.ClientSession:
+    loop = asyncio.get_running_loop()
+    sess = _sessions.get(id(loop))
+    if sess is None or sess.closed:
+        sess = aiohttp.ClientSession()
+        _sessions[id(loop)] = sess
+    return sess
+
+
+async def close_sessions() -> None:
+    for sess in list(_sessions.values()):
+        if not sess.closed:
+            await sess.close()
+    _sessions.clear()
+
+
+async def parse_response(resp: aiohttp.ClientResponse) -> Any:
+    """Status must be 200; body decodes as JSON when possible, else returns
+    the raw text (ref: pkg/evaluators/metadata/generic_http.go:82-87 parses
+    JSON content-type, other content types resolve as plain text)."""
+    body = await resp.text()
+    if resp.status != 200:
+        raise HttpError(resp.status, body)
+    ctype = resp.headers.get("Content-Type", "")
+    if "application/json" in ctype:
+        try:
+            return json.loads(body)
+        except Exception as e:
+            raise HttpError(resp.status, f"got Content-Type = application/json, but could not unmarshal as JSON: {e}")
+    try:
+        return json.loads(body)
+    except Exception:
+        return body

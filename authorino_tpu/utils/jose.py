@@ -1,0 +1,219 @@
+"""Minimal JOSE (JWS/JWT/JWK) on top of `cryptography` — the image has no
+python-jose/pyjwt.  Covers what the framework needs: RS256/384/512,
+PS256/384/512, ES256/384/512, HS256/384/512 verification and signing, JWK
+parse/export, and JWT claim validation mirroring go-oidc's verifier behavior
+(iss, exp, nbf; audience check optional — the reference skips client-id
+checks, ref pkg/evaluators/identity/oidc.go)."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac as hmac_mod
+import json
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec, padding, rsa, utils as asym_utils
+
+__all__ = [
+    "JoseError", "b64url_encode", "b64url_decode", "jwk_from_public_key",
+    "public_key_from_jwk", "sign_jwt", "verify_jws", "verify_jwt_claims",
+    "decode_unverified",
+]
+
+
+class JoseError(Exception):
+    pass
+
+
+def b64url_encode(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+_HASHES = {"256": hashes.SHA256, "384": hashes.SHA384, "512": hashes.SHA512}
+_CURVES = {"ES256": ec.SECP256R1, "ES384": ec.SECP384R1, "ES512": ec.SECP521R1}
+_CRV_NAMES = {"ES256": "P-256", "ES384": "P-384", "ES512": "P-521"}
+_EC_SIZES = {"ES256": 32, "ES384": 48, "ES512": 66}
+
+
+def _int_to_b64(n: int, size: Optional[int] = None) -> str:
+    length = size or (n.bit_length() + 7) // 8
+    return b64url_encode(n.to_bytes(length, "big"))
+
+
+def jwk_from_public_key(key, kid: str = "", alg: str = "") -> Dict[str, Any]:
+    """Public JWK dict for an RSA or EC public key."""
+    if isinstance(key, rsa.RSAPublicKey):
+        nums = key.public_numbers()
+        jwk = {"kty": "RSA", "n": _int_to_b64(nums.n), "e": _int_to_b64(nums.e)}
+        jwk["alg"] = alg or "RS256"
+    elif isinstance(key, ec.EllipticCurvePublicKey):
+        nums = key.public_numbers()
+        size = (key.curve.key_size + 7) // 8
+        crv = {256: "P-256", 384: "P-384", 521: "P-521"}[key.curve.key_size]
+        jwk = {
+            "kty": "EC",
+            "crv": crv,
+            "x": _int_to_b64(nums.x, size),
+            "y": _int_to_b64(nums.y, size),
+        }
+        jwk["alg"] = alg or {"P-256": "ES256", "P-384": "ES384", "P-521": "ES512"}[crv]
+    else:
+        raise JoseError(f"unsupported key type: {type(key)}")
+    jwk["use"] = "sig"
+    if kid:
+        jwk["kid"] = kid
+    return jwk
+
+
+def public_key_from_jwk(jwk: Dict[str, Any]):
+    kty = jwk.get("kty")
+    if kty == "RSA":
+        n = int.from_bytes(b64url_decode(jwk["n"]), "big")
+        e = int.from_bytes(b64url_decode(jwk["e"]), "big")
+        return rsa.RSAPublicNumbers(e, n).public_key()
+    if kty == "EC":
+        crv = {"P-256": ec.SECP256R1(), "P-384": ec.SECP384R1(), "P-521": ec.SECP521R1()}[
+            jwk["crv"]
+        ]
+        x = int.from_bytes(b64url_decode(jwk["x"]), "big")
+        y = int.from_bytes(b64url_decode(jwk["y"]), "big")
+        return ec.EllipticCurvePublicNumbers(x, y, crv).public_key()
+    if kty == "oct":
+        return b64url_decode(jwk["k"])
+    raise JoseError(f"unsupported kty: {kty}")
+
+
+def _sign_raw(alg: str, key, signing_input: bytes) -> bytes:
+    fam, bits = alg[:2], alg[2:]
+    h = _HASHES[bits]()
+    if fam == "HS":
+        if not isinstance(key, (bytes, bytearray)):
+            raise JoseError("HS* needs a bytes key")
+        return hmac_mod.new(key, signing_input, getattr(hashlib, f"sha{bits}")).digest()
+    if fam == "RS":
+        return key.sign(signing_input, padding.PKCS1v15(), h)
+    if fam == "PS":
+        return key.sign(
+            signing_input,
+            padding.PSS(mgf=padding.MGF1(h), salt_length=h.digest_size),
+            h,
+        )
+    if fam == "ES":
+        der = key.sign(signing_input, ec.ECDSA(h))
+        r, s = asym_utils.decode_dss_signature(der)
+        size = _EC_SIZES[alg]
+        return r.to_bytes(size, "big") + s.to_bytes(size, "big")
+    raise JoseError(f"unsupported alg: {alg}")
+
+
+def _verify_raw(alg: str, key, signing_input: bytes, sig: bytes) -> bool:
+    fam, bits = alg[:2], alg[2:]
+    h = _HASHES[bits]()
+    try:
+        if fam == "HS":
+            expected = hmac_mod.new(
+                key, signing_input, getattr(hashlib, f"sha{bits}")
+            ).digest()
+            return hmac_mod.compare_digest(expected, sig)
+        if fam == "RS":
+            key.verify(sig, signing_input, padding.PKCS1v15(), h)
+            return True
+        if fam == "PS":
+            key.verify(
+                sig,
+                signing_input,
+                padding.PSS(mgf=padding.MGF1(h), salt_length=h.digest_size),
+                h,
+            )
+            return True
+        if fam == "ES":
+            size = _EC_SIZES[alg]
+            if len(sig) != 2 * size:
+                return False
+            r = int.from_bytes(sig[:size], "big")
+            s = int.from_bytes(sig[size:], "big")
+            der = asym_utils.encode_dss_signature(r, s)
+            key.verify(der, signing_input, ec.ECDSA(h))
+            return True
+    except Exception:
+        return False
+    raise JoseError(f"unsupported alg: {alg}")
+
+
+def sign_jwt(claims: Dict[str, Any], key, alg: str, kid: str = "", extra_header: Optional[dict] = None) -> str:
+    header: Dict[str, Any] = {"alg": alg, "typ": "JWT"}
+    if kid:
+        header["kid"] = kid
+    if extra_header:
+        header.update(extra_header)
+    h = b64url_encode(json.dumps(header, separators=(",", ":")).encode())
+    p = b64url_encode(json.dumps(claims, separators=(",", ":")).encode())
+    signing_input = f"{h}.{p}".encode()
+    sig = _sign_raw(alg, key, signing_input)
+    return f"{h}.{p}.{b64url_encode(sig)}"
+
+
+def decode_unverified(token: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    try:
+        h, p, _ = token.split(".")
+        return json.loads(b64url_decode(h)), json.loads(b64url_decode(p))
+    except Exception as e:
+        raise JoseError(f"malformed JWT: {e}")
+
+
+def verify_jws(token: str, keys: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Verify signature against a JWKS key list; returns the claims."""
+    try:
+        h_b64, p_b64, s_b64 = token.split(".")
+    except ValueError:
+        raise JoseError("malformed JWT")
+    header = json.loads(b64url_decode(h_b64))
+    alg = header.get("alg", "")
+    if alg in ("", "none"):
+        raise JoseError("unsigned JWTs are rejected")
+    kid = header.get("kid")
+    signing_input = f"{h_b64}.{p_b64}".encode()
+    sig = b64url_decode(s_b64)
+    candidates = [k for k in keys if not kid or k.get("kid") in (None, kid)]
+    if kid and not candidates:
+        candidates = keys  # kid not found: try all (JWKS may have rotated)
+    for jwk in candidates:
+        if jwk.get("alg") and jwk["alg"] != alg:
+            continue
+        try:
+            key = public_key_from_jwk(jwk)
+        except Exception:
+            continue
+        if _verify_raw(alg, key, signing_input, sig):
+            return json.loads(b64url_decode(p_b64))
+    raise JoseError("failed to verify signature against any key")
+
+
+def verify_jwt_claims(
+    claims: Dict[str, Any],
+    issuer: Optional[str] = None,
+    audience: Optional[str] = None,
+    leeway_s: int = 30,
+) -> None:
+    now = time.time()
+    if issuer is not None and claims.get("iss") != issuer:
+        raise JoseError(f"id token issued by a different provider: {claims.get('iss')!r}")
+    exp = claims.get("exp")
+    if exp is not None and now > float(exp) + leeway_s:
+        raise JoseError("token is expired")
+    nbf = claims.get("nbf")
+    if nbf is not None and now < float(nbf) - leeway_s:
+        raise JoseError("token not valid yet")
+    if audience is not None:
+        aud = claims.get("aud")
+        auds = aud if isinstance(aud, list) else [aud]
+        if audience not in auds:
+            raise JoseError("audience mismatch")

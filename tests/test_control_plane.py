@@ -1,0 +1,337 @@
+"""Control-plane tests: translation, v1beta1↔v1beta2 conversion, reconciler
+status/bootstrap/collision, secret reconciler live rotation, YAML source."""
+
+import asyncio
+import base64
+import json
+import os
+
+import pytest
+
+from authorino_tpu.apis import to_v1beta1, to_v1beta2
+from authorino_tpu.controllers import (
+    AuthConfigReconciler,
+    SecretReconciler,
+    TranslationError,
+    translate_auth_config,
+)
+from authorino_tpu.controllers.reconciler import (
+    STATUS_CACHING_ERROR,
+    STATUS_HOSTS_NOT_LINKED,
+    STATUS_RECONCILED,
+)
+from authorino_tpu.k8s import InMemoryCluster, LabelSelector, Secret
+from authorino_tpu.runtime import PolicyEngine
+from authorino_tpu.authjson import CheckRequestModel, HttpRequestAttributes
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+V2_SPEC = {
+    "hosts": ["talker-api.example.com"],
+    "patterns": {
+        "admin-path": [{"selector": "request.url_path", "operator": "matches", "value": "^/admin"}]
+    },
+    "when": [{"selector": "request.method", "operator": "neq", "value": "OPTIONS"}],
+    "authentication": {
+        "api-clients": {
+            "apiKey": {"selector": {"matchLabels": {"audience": "talker-api"}}},
+            "credentials": {"authorizationHeader": {"prefix": "APIKEY"}},
+        },
+        "anon": {"anonymous": {}, "priority": 1},
+    },
+    "authorization": {
+        "admin-only": {
+            "patternMatching": {
+                "patterns": [
+                    {
+                        "any": [
+                            {"selector": "auth.identity.metadata.labels.role", "operator": "eq", "value": "admin"},
+                            {"selector": "auth.identity.anonymous", "operator": "neq", "value": "true"},
+                        ]
+                    }
+                ]
+            },
+            "when": [{"patternRef": "admin-path"}],
+        }
+    },
+    "response": {
+        "unauthorized": {"code": 302, "message": {"value": "redirect"}},
+        "success": {
+            "headers": {"x-auth": {"json": {"properties": {"user": {"selector": "auth.identity.anonymous"}}}}}
+        },
+    },
+}
+
+
+def make_cluster():
+    cluster = InMemoryCluster()
+    cluster.put_secret(
+        Secret(
+            name="client-1",
+            namespace="tenant",
+            labels={"audience": "talker-api", "role": "admin",
+                    "authorino.kuadrant.io/managed-by": "authorino"},
+            data={"api_key": b"secret-key-1"},
+        )
+    )
+    return cluster
+
+
+class TestTranslate:
+    def test_full_translate(self):
+        engine = PolicyEngine()
+        entry = run(
+            translate_auth_config("ac", "tenant", V2_SPEC, cluster=make_cluster(), engine=engine)
+        )
+        assert entry.id == "tenant/ac"
+        assert entry.hosts == ["talker-api.example.com"]
+        assert [c.name for c in entry.runtime.identity] == ["api-clients", "anon"]
+        assert entry.runtime.identity[0].credentials.key_selector == "APIKEY"
+        assert entry.runtime.conditions is not None
+        assert entry.rules is not None and len(entry.rules.evaluators) == 1
+        cond, rules = entry.rules.evaluators[0]
+        assert cond is not None  # when: [patternRef admin-path]
+
+    def test_translate_errors(self):
+        with pytest.raises(TranslationError, match="missing hosts"):
+            run(translate_auth_config("x", "ns", {"authentication": {"a": {"anonymous": {}}}}))
+        with pytest.raises(TranslationError, match="pattern not found"):
+            run(
+                translate_auth_config(
+                    "x",
+                    "ns",
+                    {
+                        "hosts": ["h"],
+                        "authorization": {
+                            "z": {"patternMatching": {"patterns": [{"patternRef": "nope"}]}}
+                        },
+                    },
+                )
+            )
+        with pytest.raises(TranslationError, match="invalid rego"):
+            run(
+                translate_auth_config(
+                    "x",
+                    "ns",
+                    {"hosts": ["h"], "authorization": {"z": {"opa": {"rego": "allow { every x in y { x } }"}}}},
+                )
+            )
+
+
+class TestConversion:
+    def test_v1beta1_roundtrip(self):
+        v2 = {
+            "apiVersion": "authorino.kuadrant.io/v1beta2",
+            "kind": "AuthConfig",
+            "metadata": {"name": "ac", "namespace": "ns"},
+            "spec": V2_SPEC,
+        }
+        v1 = to_v1beta1(v2)
+        assert v1["apiVersion"].endswith("v1beta1")
+        spec1 = v1["spec"]
+        assert {i["name"] for i in spec1["identity"]} == {"api-clients", "anon"}
+        assert spec1["authorization"][0]["json"]["rules"]
+        assert spec1["denyWith"]["unauthorized"]["code"] == 302
+        back = to_v1beta2(v1)
+        spec2 = back["spec"]
+        assert set(spec2["authentication"]) == {"api-clients", "anon"}
+        assert spec2["authentication"]["api-clients"]["credentials"] == {
+            "authorizationHeader": {"prefix": "APIKEY"}
+        }
+        assert spec2["authorization"]["admin-only"]["patternMatching"]["patterns"]
+        assert spec2["response"]["unauthorized"]["code"] == 302
+        assert spec2["response"]["success"]["headers"]["x-auth"]["json"]["properties"]["user"] == {
+            "selector": "auth.identity.anonymous"
+        }
+
+
+def resource(name="ac", namespace="tenant", spec=None, labels=None):
+    return {
+        "apiVersion": "authorino.kuadrant.io/v1beta2",
+        "kind": "AuthConfig",
+        "metadata": {"name": name, "namespace": namespace, "labels": labels or {}},
+        "spec": spec or dict(V2_SPEC),
+    }
+
+
+class TestReconciler:
+    def test_reconcile_status_and_serving(self):
+        async def body():
+            engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+            cluster = make_cluster()
+            rec = AuthConfigReconciler(engine, cluster=cluster)
+            await rec.reconcile_all([resource()])
+            assert rec.status.get("tenant/ac").reason == STATUS_RECONCILED
+            assert rec.ready()
+            status = rec.status.status_object("tenant/ac")
+            assert status["summary"]["hostsReady"] == ["talker-api.example.com"]
+
+            # serving end-to-end through the engine: API key + admin role
+            req = CheckRequestModel(
+                http=HttpRequestAttributes(
+                    method="GET",
+                    path="/admin/x",
+                    host="talker-api.example.com",
+                    headers={"authorization": "APIKEY secret-key-1"},
+                )
+            )
+            result = await engine.check(req)
+            assert result.success(), result.message
+
+            # wrong api key → anonymous matches instead (priority 1) and the
+            # admin-only pattern denies under /admin
+            req2 = CheckRequestModel(
+                http=HttpRequestAttributes(
+                    method="GET", path="/admin/x", host="talker-api.example.com",
+                    headers={"authorization": "APIKEY wrong"},
+                )
+            )
+            result2 = await engine.check(req2)
+            assert not result2.success()
+            assert result2.status == 302  # denyWith
+
+            # outside /admin → authz condition unmatched → allow
+            req3 = CheckRequestModel(
+                http=HttpRequestAttributes(
+                    method="GET", path="/public", host="talker-api.example.com",
+                    headers={"authorization": "APIKEY wrong"},
+                )
+            )
+            result3 = await engine.check(req3)
+            assert result3.success()
+
+        run(body())
+
+    def test_translate_error_status(self):
+        async def body():
+            engine = PolicyEngine()
+            rec = AuthConfigReconciler(engine)
+            bad = resource(spec={"hosts": ["h.example.com"], "authorization": {"z": {"opa": {"rego": "allow { every x in y { x } }"}}}})
+            await rec.reconcile_all([bad])
+            assert rec.status.get("tenant/ac").reason == STATUS_CACHING_ERROR
+            assert not rec.ready()
+
+        run(body())
+
+    def test_host_collision(self):
+        async def body():
+            engine = PolicyEngine()
+            spec = {"hosts": ["shared.example.com"], "authentication": {"anon": {"anonymous": {}}}}
+            r1 = resource(name="first", spec=dict(spec))
+            r2 = resource(name="second", spec=dict(spec))
+            rec = AuthConfigReconciler(engine)
+            await rec.reconcile_all([r1, r2])
+            reasons = {id_: rep.reason for id_, rep in rec.status.all().items()}
+            assert reasons["tenant/first"] == STATUS_RECONCILED
+            assert reasons["tenant/second"] == STATUS_HOSTS_NOT_LINKED
+
+        run(body())
+
+    def test_label_selector_sharding(self):
+        async def body():
+            engine = PolicyEngine()
+            rec = AuthConfigReconciler(engine, label_selector=LabelSelector.parse("group=a"))
+            spec = {"hosts": ["a.example.com"], "authentication": {"anon": {"anonymous": {}}}}
+            watched = resource(name="mine", spec=dict(spec), labels={"group": "a"})
+            unwatched = resource(
+                name="other",
+                spec={"hosts": ["b.example.com"], "authentication": {"anon": {"anonymous": {}}}},
+                labels={"group": "b"},
+            )
+            await rec.reconcile_all([watched, unwatched])
+            assert engine.lookup("a.example.com") is not None
+            assert engine.lookup("b.example.com") is None
+
+        run(body())
+
+
+class TestSecretReconciler:
+    def test_live_rotation_through_cluster_events(self):
+        async def body():
+            engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+            cluster = make_cluster()
+            rec = AuthConfigReconciler(engine, cluster=cluster)
+            sec_rec = SecretReconciler(
+                engine,
+                secret_label_selector=LabelSelector.parse("authorino.kuadrant.io/managed-by=authorino"),
+            )
+            cluster.on_secret_event(sec_rec.on_event)
+            await rec.reconcile_all([resource()])
+
+            def check(key):
+                # /admin path: valid API key → allow; anonymous fallback → deny
+                req = CheckRequestModel(
+                    http=HttpRequestAttributes(
+                        method="GET", path="/admin/x", host="talker-api.example.com",
+                        headers={"authorization": f"APIKEY {key}"},
+                    )
+                )
+                return engine.check(req)
+
+            assert (await check("secret-key-1")).success()
+            # rotate the key → old revoked, new works (ref secret_controller.go)
+            cluster.put_secret(
+                Secret(
+                    name="client-1",
+                    namespace="tenant",
+                    labels={"audience": "talker-api", "authorino.kuadrant.io/managed-by": "authorino"},
+                    data={"api_key": b"rotated-key"},
+                )
+            )
+            r = await check("secret-key-1")
+            assert not r.success()
+            assert (await check("rotated-key")).success()
+            # delete the secret → revoked (falls back to deny since the
+            # admin-only rule's 'anonymous neq true' fails for anonymous)
+            cluster.remove_secret("tenant", "client-1")
+            r = await check("rotated-key")
+            assert not r.success()
+
+        run(body())
+
+
+class TestYamlSource:
+    def test_load_and_serve_from_dir(self, tmp_path):
+        async def body():
+            import yaml as yaml_mod
+
+            from authorino_tpu.controllers.sources import YamlDirSource
+
+            secret = {
+                "apiVersion": "v1",
+                "kind": "Secret",
+                "metadata": {
+                    "name": "client-1",
+                    "namespace": "tenant",
+                    "labels": {"audience": "talker-api", "authorino.kuadrant.io/managed-by": "authorino"},
+                },
+                "data": {"api_key": base64.b64encode(b"from-yaml").decode()},
+            }
+            (tmp_path / "manifests.yaml").write_text(
+                yaml_mod.dump_all([resource(), secret], default_flow_style=False)
+            )
+            engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+            cluster = InMemoryCluster()
+            rec = AuthConfigReconciler(engine, cluster=cluster)
+            sec_rec = SecretReconciler(
+                engine,
+                secret_label_selector=LabelSelector.parse("authorino.kuadrant.io/managed-by=authorino"),
+            )
+            source = YamlDirSource(str(tmp_path), rec, cluster, sec_rec)
+            await source.sync()
+            req = CheckRequestModel(
+                http=HttpRequestAttributes(
+                    method="GET", path="/x", host="talker-api.example.com",
+                    headers={"authorization": "APIKEY from-yaml"},
+                )
+            )
+            assert (await engine.check(req)).success()
+
+        run(body())

@@ -1,0 +1,447 @@
+"""Leaf evaluator tests with fake in-process backends (the reference's
+pkg/httptest style: local HTTP servers faking Keycloak/UMA/registries;
+SURVEY.md §4)."""
+
+import asyncio
+import base64
+import json
+
+import pytest
+from aiohttp import web
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import ec, rsa
+
+from authorino_tpu.authjson import (
+    CheckRequestModel,
+    HttpRequestAttributes,
+    JSONProperty,
+    JSONValue,
+    PeerAttributes,
+)
+from authorino_tpu.evaluators import EvaluationError, IdentityConfig, RuntimeAuthConfig
+from authorino_tpu.evaluators.authorization import OPA
+from authorino_tpu.evaluators.authorization.rego import RegoError, compile_module
+from authorino_tpu.evaluators.identity import APIKey, KubernetesAuth, MTLS, Noop, OAuth2, OIDC
+from authorino_tpu.evaluators.metadata import GenericHttp, UserInfo
+from authorino_tpu.evaluators.response import SigningKey, Wristband
+from authorino_tpu.evaluators.credentials import AuthCredentials
+from authorino_tpu.k8s import InMemoryCluster, LabelSelector, Secret
+from authorino_tpu.pipeline import AuthPipeline
+from authorino_tpu.utils import jose
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def make_pipeline(headers=None, source_cert="", identity=None):
+    req = CheckRequestModel(
+        http=HttpRequestAttributes(
+            method="GET", path="/x", host="svc.example.com", headers=headers or {}
+        ),
+        source=PeerAttributes(certificate=source_cert),
+    )
+    p = AuthPipeline(req, RuntimeAuthConfig())
+    if identity is not None:
+        conf = IdentityConfig("test", Noop())
+        p.identity_results[conf] = identity
+        p._sync_auth()
+    return p
+
+
+class TestAPIKey:
+    def _cluster(self):
+        cluster = InMemoryCluster()
+        cluster.put_secret(
+            Secret(
+                name="app-1-key",
+                namespace="ns",
+                labels={"audience": "app"},
+                data={"api_key": b"ndyBzreUzF4zqDQsqSPMHkRhriEOtcRx"},
+            )
+        )
+        return cluster
+
+    def test_valid_and_invalid_key(self):
+        ak = APIKey("api-key", LabelSelector.parse("audience=app"), cluster=self._cluster())
+        run(ak.load_secrets())
+        p = make_pipeline(headers={"authorization": "APIKEY ndyBzreUzF4zqDQsqSPMHkRhriEOtcRx"})
+        ak.credentials = AuthCredentials(key_selector="APIKEY")
+        obj = run(ak.call(p))
+        assert obj["metadata"]["name"] == "app-1-key"
+        p2 = make_pipeline(headers={"authorization": "APIKEY wrong"})
+        with pytest.raises(EvaluationError, match="invalid"):
+            run(ak.call(p2))
+
+    def test_live_rotation(self):
+        cluster = self._cluster()
+        ak = APIKey("api-key", LabelSelector.parse("audience=app"), cluster=cluster)
+        run(ak.load_secrets())
+        ak.credentials = AuthCredentials(key_selector="APIKEY")
+        # revoke (ref secret_controller.go:100-106)
+        ak.revoke_k8s_secret_based_identity("ns", "app-1-key")
+        with pytest.raises(EvaluationError):
+            run(ak.call(make_pipeline(headers={"authorization": "APIKEY ndyBzreUzF4zqDQsqSPMHkRhriEOtcRx"})))
+        # add a rotated key
+        ak.add_k8s_secret_based_identity(
+            Secret(name="app-1-key", namespace="ns", labels={"audience": "app"}, data={"api_key": b"new-key"})
+        )
+        obj = run(ak.call(make_pipeline(headers={"authorization": "APIKEY new-key"})))
+        assert obj["metadata"]["name"] == "app-1-key"
+
+
+class TestMTLS:
+    def _make_ca_and_cert(self, valid=True):
+        from datetime import datetime, timedelta, timezone
+
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.x509.oid import NameOID
+
+        ca_key = ec.generate_private_key(ec.SECP256R1())
+        ca_name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "test-ca")])
+        now = datetime.now(timezone.utc)
+        ca_cert = (
+            x509.CertificateBuilder()
+            .subject_name(ca_name)
+            .issuer_name(ca_name)
+            .public_key(ca_key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - timedelta(days=1))
+            .not_valid_after(now + timedelta(days=30))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+            .sign(ca_key, hashes.SHA256())
+        )
+        signer = ca_key if valid else ec.generate_private_key(ec.SECP256R1())
+        leaf_key = ec.generate_private_key(ec.SECP256R1())
+        leaf = (
+            x509.CertificateBuilder()
+            .subject_name(
+                x509.Name(
+                    [
+                        x509.NameAttribute(NameOID.COMMON_NAME, "john"),
+                        x509.NameAttribute(NameOID.ORGANIZATION_NAME, "acme"),
+                    ]
+                )
+            )
+            .issuer_name(ca_name)
+            .public_key(leaf_key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - timedelta(hours=1))
+            .not_valid_after(now + timedelta(days=1))
+            .sign(signer, hashes.SHA256())
+        )
+        ca_pem = ca_cert.public_bytes(serialization.Encoding.PEM)
+        leaf_pem = leaf.public_bytes(serialization.Encoding.PEM).decode()
+        return ca_pem, leaf_pem
+
+    def test_verify_subject(self):
+        ca_pem, leaf_pem = self._make_ca_and_cert(valid=True)
+        cluster = InMemoryCluster()
+        cluster.put_secret(Secret(name="ca", namespace="ns", labels={"app": "mtls"}, data={"ca.crt": ca_pem}))
+        m = MTLS("mtls", LabelSelector.parse("app=mtls"), cluster=cluster)
+        run(m.load_secrets())
+        obj = run(m.call(make_pipeline(source_cert=leaf_pem)))
+        assert obj["CommonName"] == "john"
+        assert obj["Organization"] == "acme"
+
+    def test_unknown_authority(self):
+        ca_pem, _ = self._make_ca_and_cert(valid=True)
+        _, rogue_pem = self._make_ca_and_cert(valid=False)
+        cluster = InMemoryCluster()
+        cluster.put_secret(Secret(name="ca", namespace="ns", labels={"app": "mtls"}, data={"ca.crt": ca_pem}))
+        m = MTLS("mtls", LabelSelector.parse("app=mtls"), cluster=cluster)
+        run(m.load_secrets())
+        with pytest.raises(EvaluationError, match="unknown authority"):
+            run(m.call(make_pipeline(source_cert=rogue_pem)))
+        with pytest.raises(EvaluationError, match="missing"):
+            run(m.call(make_pipeline()))
+
+
+class FakeIdP:
+    """Fake Keycloak-ish IdP: discovery, JWKS, userinfo, introspection."""
+
+    def __init__(self):
+        self.key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        self.issuer = None
+        self.userinfo = {"sub": "john", "email": "john@acme.com"}
+        self.active_tokens = {"opaque-token-1": {"active": True, "username": "john"}}
+
+    def token(self, claims=None):
+        iat = int(__import__("time").time())
+        payload = {"iss": self.issuer, "sub": "john", "iat": iat, "exp": iat + 300,
+                   "realm_access": {"roles": ["admin"]}}
+        payload.update(claims or {})
+        return jose.sign_jwt(payload, self.key, "RS256", kid="k1")
+
+    def app(self):
+        app = web.Application()
+
+        async def well_known(request):
+            return web.json_response(
+                {
+                    "issuer": self.issuer,
+                    "jwks_uri": f"{self.issuer}/jwks",
+                    "userinfo_endpoint": f"{self.issuer}/userinfo",
+                    "token_endpoint": f"{self.issuer}/token",
+                }
+            )
+
+        async def jwks(request):
+            return web.json_response({"keys": [jose.jwk_from_public_key(self.key.public_key(), kid="k1")]})
+
+        async def userinfo(request):
+            return web.json_response(self.userinfo)
+
+        async def introspect(request):
+            form = await request.post()
+            return web.json_response(self.active_tokens.get(form.get("token"), {"active": False}))
+
+        app.router.add_get("/.well-known/openid-configuration", well_known)
+        app.router.add_get("/jwks", jwks)
+        app.router.add_get("/userinfo", userinfo)
+        app.router.add_post("/introspect", introspect)
+        return app
+
+
+def with_fake_idp(test_body):
+    async def scenario():
+        from aiohttp.test_utils import TestServer
+
+        idp = FakeIdP()
+        server = TestServer(idp.app())
+        await server.start_server()
+        idp.issuer = str(server.make_url("")).rstrip("/")
+        try:
+            await test_body(idp)
+        finally:
+            await server.close()
+            from authorino_tpu.utils.http import close_sessions
+
+            await close_sessions()
+
+    run(scenario())
+
+
+class TestOIDC:
+    def test_jwt_verify_and_claims(self):
+        async def body(idp):
+            oidc = OIDC("keycloak", idp.issuer)
+            token = idp.token()
+            p = make_pipeline(headers={"authorization": f"Bearer {token}"})
+            claims = await oidc.call(p)
+            assert claims["sub"] == "john"
+            assert claims["realm_access"]["roles"] == ["admin"]
+            # tampered token denied
+            bad = token[:-4] + "AAAA"
+            with pytest.raises(EvaluationError):
+                await oidc.call(make_pipeline(headers={"authorization": f"Bearer {bad}"}))
+            # expired token denied
+            expired = idp.token({"exp": 1})
+            with pytest.raises(EvaluationError, match="expired"):
+                await oidc.call(make_pipeline(headers={"authorization": f"Bearer {expired}"}))
+            await oidc.clean()
+
+        with_fake_idp(body)
+
+    def test_userinfo_bound_to_same_issuer(self):
+        async def body(idp):
+            oidc = OIDC("keycloak", idp.issuer)
+            ui = UserInfo(oidc)
+            token = idp.token()
+            p = make_pipeline(headers={"authorization": f"Bearer {token}"})
+            conf = IdentityConfig("keycloak", oidc)
+            p.identity_results[conf] = await oidc.call(p)
+            p._sync_auth()
+            data = await ui.call(p)
+            assert data["email"] == "john@acme.com"
+            # identity resolved by a different evaluator → skip error (ref user_info.go:22-44)
+            p2 = make_pipeline(identity={"anonymous": True})
+            with pytest.raises(EvaluationError, match="Missing identity"):
+                await ui.call(p2)
+            await oidc.clean()
+
+        with_fake_idp(body)
+
+
+class TestOAuth2Introspection:
+    def test_active_and_inactive(self):
+        async def body(idp):
+            ev = OAuth2("oauth2", f"{idp.issuer}/introspect", "client", "secret")
+            p = make_pipeline(headers={"authorization": "Bearer opaque-token-1"})
+            obj = await ev.call(p)
+            assert obj["username"] == "john"
+            with pytest.raises(EvaluationError, match="not active"):
+                await ev.call(make_pipeline(headers={"authorization": "Bearer nope"}))
+
+        with_fake_idp(body)
+
+
+class TestKubernetesTokenReview:
+    def test_token_review(self):
+        cluster = InMemoryCluster()
+        cluster.token_reviews["good-token"] = {
+            "status": {"authenticated": True, "user": {"username": "system:serviceaccount:ns:app"}}
+        }
+        ev = KubernetesAuth("k8s", cluster=cluster)
+        obj = run(ev.call(make_pipeline(headers={"authorization": "Bearer good-token"})))
+        assert obj["username"].startswith("system:serviceaccount")
+        with pytest.raises(EvaluationError, match="Not authenticated"):
+            run(ev.call(make_pipeline(headers={"authorization": "Bearer bad"})))
+
+
+class TestGenericHttp:
+    def test_get_and_post(self):
+        async def body(idp):
+            seen = {}
+
+            async def echo(request):
+                seen["headers"] = dict(request.headers)
+                seen["query"] = dict(request.query)
+                seen["body"] = await request.text()
+                return web.json_response({"ok": True})
+
+            from aiohttp.test_utils import TestServer
+
+            app = web.Application()
+            app.router.add_route("*", "/meta", echo)
+            server = TestServer(app)
+            await server.start_server()
+            base = str(server.make_url("")).rstrip("/")
+            try:
+                ev = GenericHttp(
+                    endpoint=JSONValue(pattern=base + "/meta?user={auth.identity.user}"),
+                    method="GET",
+                    shared_secret="s3cr3t",
+                    credentials=AuthCredentials(key_selector="Bearer"),
+                    headers=[JSONProperty("X-Tag", JSONValue(static="v1"))],
+                )
+                p = make_pipeline(identity={"user": "john"})
+                out = await ev.call(p)
+                assert out == {"ok": True}
+                assert seen["headers"]["Authorization"] == "Bearer s3cr3t"
+                assert seen["headers"]["X-Tag"] == "v1"
+                assert seen["query"] == {"user": "john"}
+
+                ev2 = GenericHttp(
+                    endpoint=JSONValue(static=base + "/meta"),
+                    method="POST",
+                    parameters=[JSONProperty("u", JSONValue(pattern="auth.identity.user"))],
+                )
+                out = await ev2.call(p)
+                assert json.loads(seen["body"]) == {"u": "john"}
+            finally:
+                await server.close()
+
+        with_fake_idp(body)
+
+
+class TestRego:
+    def test_basic_allow(self):
+        m = compile_module(
+            """
+            default allow = false
+            allow { input.auth.identity.role == "admin" }
+            allow { input.request.method == "GET"; input.request.path == "/public" }
+            """
+        )
+        assert m.evaluate({"auth": {"identity": {"role": "admin"}}, "request": {}})["allow"]
+        assert m.evaluate({"auth": {"identity": {}}, "request": {"method": "GET", "path": "/public"}})["allow"]
+        assert not m.evaluate({"auth": {"identity": {"role": "dev"}}, "request": {"method": "POST"}})["allow"]
+
+    def test_iteration_and_builtins(self):
+        m = compile_module(
+            """
+            default allow = false
+            allow { input.roles[_] == "admin" }
+            allow { startswith(input.path, "/public/") }
+            """
+        )
+        assert m.evaluate({"roles": ["dev", "admin"], "path": "/x"})["allow"]
+        assert m.evaluate({"roles": [], "path": "/public/a"})["allow"]
+        assert not m.evaluate({"roles": ["dev"], "path": "/private"})["allow"]
+
+    def test_bindings_and_value_rules(self):
+        m = compile_module(
+            """
+            default allow = false
+            user := input.identity.username
+            allow { user == "john" }
+            greeting = msg { msg := sprintf("hello %s", [user]) }
+            """
+        )
+        out = m.evaluate({"identity": {"username": "john"}})
+        assert out["allow"] and out["user"] == "john" and out["greeting"] == "hello john"
+
+    def test_not_and_in(self):
+        m = compile_module(
+            """
+            default allow = false
+            allow { not denied; "gold" in input.tiers }
+            denied { input.banned == true }
+            """
+        )
+        assert m.evaluate({"tiers": ["gold"], "banned": False})["allow"]
+        assert not m.evaluate({"tiers": ["gold"], "banned": True})["allow"]
+        assert not m.evaluate({"tiers": ["silver"], "banned": False})["allow"]
+
+    def test_unsupported_syntax_rejected(self):
+        with pytest.raises(RegoError):
+            compile_module("allow { every x in input.xs { x > 1 } }")
+
+
+class TestOPAEvaluator:
+    def test_opa_call(self):
+        opa = OPA("policy", inline_rego='allow { input.auth.identity.anonymous == true }')
+        p = make_pipeline(identity={"anonymous": True})
+        assert run(opa.call(p)) is True
+        p2 = make_pipeline(identity={"anonymous": False})
+        with pytest.raises(EvaluationError, match="Unauthorized"):
+            run(opa.call(p2))
+
+    def test_opa_all_values(self):
+        opa = OPA(
+            "policy",
+            inline_rego='allow { input.auth.identity.user == "john" }\nuser := input.auth.identity.user',
+            all_values=True,
+        )
+        out = run(opa.call(make_pipeline(identity={"user": "john"})))
+        assert out["allow"] is True and out["user"] == "john"
+
+    def test_invalid_rego_rejected_at_compile(self):
+        with pytest.raises(ValueError, match="invalid rego"):
+            OPA("policy", inline_rego="allow { every x in input { x } }")
+
+
+class TestWristband:
+    def _signing_key(self):
+        key = ec.generate_private_key(ec.SECP256R1())
+        pem = key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+        return SigningKey.from_pem("wristband-key", "ES256", pem)
+
+    def test_issue_and_verify(self):
+        sk = self._signing_key()
+        wb = Wristband(
+            issuer="https://authorino.example.com/ns/ac/wristband",
+            custom_claims=[JSONProperty("username", JSONValue(pattern="auth.identity.user"))],
+            token_duration=300,
+            signing_keys=[sk],
+        )
+        p = make_pipeline(identity={"user": "john"})
+        token = run(wb.call(p))
+        jwks = json.loads(wb.jwks())["keys"]
+        claims = jose.verify_jws(token, jwks)
+        assert claims["iss"] == wb.issuer
+        assert claims["username"] == "john"
+        assert claims["exp"] - claims["iat"] == 300
+        assert len(claims["sub"]) == 64  # sha256 hex
+        cfg = json.loads(wb.openid_config())
+        assert cfg["jwks_uri"].endswith("/openid-connect/certs")
