@@ -43,15 +43,23 @@ from .intern import PAD, StringInterner
 
 __all__ = [
     "OP_EQ", "OP_NEQ", "OP_INCL", "OP_EXCL", "OP_CPU", "OP_ERROR", "OP_TREE_CPU",
+    "OP_REGEX_DFA",
     "ConfigRules", "CompiledPolicy", "ShapeTargets", "compile_corpus",
-    "TRUE_SLOT", "FALSE_SLOT",
+    "TRUE_SLOT", "FALSE_SLOT", "DFA_VALUE_BYTES",
 ]
 
-OP_EQ, OP_NEQ, OP_INCL, OP_EXCL, OP_CPU, OP_ERROR, OP_TREE_CPU = 0, 1, 2, 3, 4, 5, 6
+OP_EQ, OP_NEQ, OP_INCL, OP_EXCL, OP_CPU, OP_ERROR, OP_TREE_CPU, OP_REGEX_DFA = (
+    0, 1, 2, 3, 4, 5, 6, 7,
+)
+
+# max value length evaluated on the device regex lane; longer values (or
+# values containing NUL) fall back to the CPU regex lane per request
+DFA_VALUE_BYTES = 128
 
 TRUE_SLOT = 0
 FALSE_SLOT = 1
 _LEAF_BASE = 2
+_DFA_MISS = object()
 
 
 @dataclass
@@ -119,6 +127,14 @@ class CompiledPolicy:
     eval_rule: np.ndarray      # [G, E] int32 buffer idx
     eval_has_cond: np.ndarray  # [G, E] bool
 
+    # --- device regex lane (empty arrays when no DFA-compilable regexes) ---
+    dfa_tables: np.ndarray     # [R, S, 256] uint8 — per-leaf transition tables
+    dfa_accept: np.ndarray     # [R, S] bool
+    dfa_leaf_attr: np.ndarray  # [R] int32 — attr idx of each dfa row
+    leaf_dfa_row: np.ndarray   # [L] int32 — leaf → dfa row (0 for others)
+    attr_byte_slot: np.ndarray  # [A] int32 — attr → byte-tensor slot (-1 none)
+    n_byte_attrs: int
+
     # --- CPU-side metadata ---
     interner: StringInterner
     attr_selectors: List[str]            # attr idx -> selector string
@@ -176,9 +192,10 @@ def _round_up(n: int, multiple: int = 8, minimum: int = 8) -> int:
 
 
 class _Lowerer:
-    def __init__(self, interner: StringInterner, members_k: int):
+    def __init__(self, interner: StringInterner, members_k: int, enable_dfa: bool = True):
         self.interner = interner
         self.members_k = members_k
+        self.enable_dfa = enable_dfa
         self.attrs: Dict[str, int] = {}
         self.leaves: List[_Leaf] = []
         self.leaf_dedupe: Dict[Tuple[int, int, int, Optional[str]], int] = {}
@@ -186,6 +203,17 @@ class _Lowerer:
         self.nodes: List[Tuple[int, bool, List[int]]] = []
         self.depth_of: Dict[int, int] = {TRUE_SLOT: 0, FALSE_SLOT: 0}
         self.tree_leaf_by_expr: Dict[int, int] = {}
+        self._dfa_cache: Dict[str, Optional["object"]] = {}
+
+    def _dfa_for(self, pattern: str):
+        hit = self._dfa_cache.get(pattern, _DFA_MISS)
+        if hit is not _DFA_MISS:
+            return hit
+        from .redfa import compile_regex_dfa
+
+        dfa = compile_regex_dfa(pattern)
+        self._dfa_cache[pattern] = dfa
+        return dfa
 
     def attr_idx(self, selector: str) -> int:
         i = self.attrs.get(selector)
@@ -202,6 +230,8 @@ class _Lowerer:
                 # invalid regex: evaluation errors deny in the reference
                 # (error return from Pattern.Matches → deny); constant-false
                 key = (OP_ERROR, attr, 0, p.value)
+            elif self.enable_dfa and self._dfa_for(p.value) is not None:
+                key = (OP_REGEX_DFA, attr, 0, p.value)
             else:
                 key = (OP_CPU, attr, 0, p.value)
         else:
@@ -262,13 +292,16 @@ def compile_corpus(
     pad: bool = True,
     targets: Optional[ShapeTargets] = None,
     interner: Optional[StringInterner] = None,
+    enable_dfa: bool = True,
 ) -> CompiledPolicy:
     """Compile all configs' pattern rules into one CompiledPolicy.
 
     ``targets`` forces final operand shapes (must dominate the natural ones);
-    ``interner`` lets tensor-parallel shards share one global string table."""
+    ``interner`` lets tensor-parallel shards share one global string table;
+    ``enable_dfa=False`` routes all regexes to the CPU lane (used by the
+    sharded model, whose stacking does not yet unify DFA table shapes)."""
     interner = interner if interner is not None else StringInterner()
-    lw = _Lowerer(interner, members_k)
+    lw = _Lowerer(interner, members_k, enable_dfa=enable_dfa)
 
     # 1. lower every expression; remember (cond_ref, rule_ref) per evaluator
     per_config: List[Tuple[str, List[Tuple[Optional[int], int]]]] = []
@@ -366,13 +399,18 @@ def compile_corpus(
     leaf_regex: List[Optional[re.Pattern]] = [None] * Lp
     leaf_tree: List[Optional[Expression]] = [None] * Lp
     leaf_is_membership = np.zeros((Lp,), dtype=bool)
+    leaf_dfa_row = np.zeros((Lp,), dtype=np.int32)
+    dfa_rows: List[Tuple[int, Any]] = []  # (attr, DFA) per device-regex leaf
     for i, leaf in enumerate(lw.leaves):
         leaf_op[i] = leaf.op
         leaf_attr[i] = leaf.attr
         leaf_const[i] = leaf.const
         leaf_is_membership[i] = leaf.op in (OP_INCL, OP_EXCL)
-        if leaf.op == OP_CPU and leaf.regex is not None:
-            leaf_regex[i] = re.compile(leaf.regex)
+        if leaf.op in (OP_CPU, OP_REGEX_DFA) and leaf.regex is not None:
+            leaf_regex[i] = re.compile(leaf.regex)  # CPU lane / overflow fallback
+        if leaf.op == OP_REGEX_DFA:
+            leaf_dfa_row[i] = len(dfa_rows)
+            dfa_rows.append((leaf.attr, lw._dfa_for(leaf.regex)))
         if leaf.op == OP_TREE_CPU:
             leaf_tree[i] = leaf.tree
 
@@ -381,6 +419,26 @@ def compile_corpus(
     if targets is not None:
         assert targets.n_attrs >= n_attrs, "targets.n_attrs too small"
         Ap = targets.n_attrs
+
+    # device regex lane tables (stacked per leaf, states padded to max)
+    R = len(dfa_rows)
+    S = max((d.n_states for _, d in dfa_rows), default=1)
+    dfa_tables = np.zeros((max(R, 1), S, 256), dtype=np.uint8)
+    dfa_accept = np.zeros((max(R, 1), S), dtype=bool)
+    dfa_leaf_attr = np.zeros((max(R, 1),), dtype=np.int32)
+    attr_byte_slot = np.full((Ap,), -1, dtype=np.int32)
+    n_byte_attrs = 0
+    for r_i, (attr, dfa) in enumerate(dfa_rows):
+        s = dfa.n_states
+        dfa_tables[r_i, :s] = dfa.trans
+        # padded states self-loop so they can never be reached anyway
+        for extra in range(s, S):
+            dfa_tables[r_i, extra] = extra
+        dfa_accept[r_i, :s] = dfa.accept
+        dfa_leaf_attr[r_i] = attr
+        if attr_byte_slot[attr] < 0:
+            attr_byte_slot[attr] = n_byte_attrs
+            n_byte_attrs += 1
     attr_selectors = [""] * Ap
     for sel, idx in lw.attrs.items():
         attr_selectors[idx] = sel
@@ -403,8 +461,11 @@ def compile_corpus(
             acc_attrs.add(attr)
             if expr.operator is Operator.MATCHES:
                 rx = getattr(expr, "_regex", None)
-                key = (OP_ERROR if rx is None else OP_CPU, attr, 0, expr.value)
-                acc_cpu.add(lw.leaf_dedupe[key])
+                for op in (OP_ERROR, OP_REGEX_DFA, OP_CPU):
+                    key = (op, attr, 0, expr.value)
+                    if key in lw.leaf_dedupe:
+                        acc_cpu.add(lw.leaf_dedupe[key])
+                        break
             elif expr.operator in (Operator.INCL, Operator.EXCL):
                 op = OP_INCL if expr.operator is Operator.INCL else OP_EXCL
                 key = (op, attr, interner.intern(expr.value), None)
@@ -431,6 +492,12 @@ def compile_corpus(
         eval_cond=eval_cond,
         eval_rule=eval_rule,
         eval_has_cond=eval_has_cond,
+        dfa_tables=dfa_tables,
+        dfa_accept=dfa_accept,
+        dfa_leaf_attr=dfa_leaf_attr,
+        leaf_dfa_row=leaf_dfa_row,
+        attr_byte_slot=attr_byte_slot,
+        n_byte_attrs=n_byte_attrs,
         interner=interner,
         attr_selectors=attr_selectors,
         config_ids=config_ids,

@@ -20,7 +20,16 @@ from typing import Any, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..authjson import selector as sel
-from .compile import OP_CPU, OP_ERROR, OP_EXCL, OP_INCL, OP_TREE_CPU, CompiledPolicy
+from .compile import (
+    DFA_VALUE_BYTES,
+    OP_CPU,
+    OP_ERROR,
+    OP_EXCL,
+    OP_INCL,
+    OP_REGEX_DFA,
+    OP_TREE_CPU,
+    CompiledPolicy,
+)
 from .intern import EMPTY_ID, PAD
 
 __all__ = ["EncodedBatch", "encode_batch"]
@@ -33,6 +42,8 @@ class EncodedBatch:
     overflow: np.ndarray       # [B, A] bool
     cpu_lane: np.ndarray       # [B, L] bool
     config_id: np.ndarray      # [B] int32
+    attr_bytes: np.ndarray     # [B, NB, DFA_VALUE_BYTES] uint8 (device regex lane)
+    byte_ovf: np.ndarray       # [B, NB] bool — value too long / has NUL → CPU lane
 
 
 _MISSING = object()
@@ -118,6 +129,10 @@ def encode_batch(
     overflow = np.zeros((B, A), dtype=bool)
     cpu_lane = np.zeros((B, L), dtype=bool)
     config_id = np.zeros((B,), dtype=np.int32)
+    NB = max(policy.n_byte_attrs, 1)
+    attr_bytes = np.zeros((B, NB, DFA_VALUE_BYTES), dtype=np.uint8)
+    byte_ovf = np.zeros((B, NB), dtype=bool)
+    attr_byte_slot = policy.attr_byte_slot
 
     lookup = policy.interner.lookup
     resolvers = _fast_resolvers(policy)
@@ -148,13 +163,25 @@ def encode_batch(
         # resolve each needed selector once; share across leaves on that attr
         res_by_attr = {}
         ovf_attrs = None
+        byte_ovf_attrs = None
         for attr in config_attrs[row]:
             v = resolvers[attr](doc)
             res_by_attr[attr] = v
-            vid = lookup(_render(v))
+            rendered = _render(v)
+            vid = lookup(rendered)
             v_r.append(r)
             v_a.append(attr)
             v_id.append(vid)
+            slot = attr_byte_slot[attr]
+            if slot >= 0:
+                raw = rendered.encode("utf-8")
+                if len(raw) > DFA_VALUE_BYTES or 0 in raw:
+                    byte_ovf[r, slot] = True
+                    if byte_ovf_attrs is None:
+                        byte_ovf_attrs = set()
+                    byte_ovf_attrs.add(attr)
+                elif raw:
+                    attr_bytes[r, slot, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
             # gjson Array(): list → elements; null/missing → []; scalar → [v]
             if isinstance(v, list):
                 for k, e in enumerate(v[:K]):
@@ -173,10 +200,19 @@ def encode_batch(
                 m_a.append(attr)
                 m_k.append(0)
                 m_id.append(vid)
-        # CPU lane: regex always; incl/excl only when overflowed
+        # CPU lane: non-DFA regex always; DFA regex and incl/excl only on
+        # their respective overflows
         for leaf in config_cpu_leaves[row]:
             op = leaf_op[leaf]
-            if op == OP_TREE_CPU:
+            if op == OP_REGEX_DFA:
+                attr = leaf_attr[leaf]
+                if byte_ovf_attrs is not None and attr in byte_ovf_attrs:
+                    rx = leaf_regex[leaf]
+                    v = res_by_attr.get(attr, _MISSING)
+                    c_r.append(r)
+                    c_l.append(leaf)
+                    c_v.append(rx.search(_render(v)) is not None if rx else False)
+            elif op == OP_TREE_CPU:
                 # whole-tree oracle fallback (invalid-regex trees): error ⇒
                 # False (deny for rules, skip for conditions — exact at root)
                 expr = policy.leaf_tree[leaf]
@@ -218,4 +254,6 @@ def encode_batch(
         overflow=overflow,
         cpu_lane=cpu_lane,
         config_id=config_id,
+        attr_bytes=attr_bytes,
+        byte_ovf=byte_ovf,
     )

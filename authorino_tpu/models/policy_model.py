@@ -45,6 +45,7 @@ class PolicyModel:
         return encode_batch(self.policy, docs, config_rows, batch_pad=batch_pad)
 
     def apply(self, encoded: EncodedBatch) -> Tuple[np.ndarray, np.ndarray]:
+        has_dfa = self.params["dfa_tables"] is not None
         own, verdict = self._apply(
             self.params,
             jnp.asarray(encoded.attrs_val),
@@ -52,6 +53,8 @@ class PolicyModel:
             jnp.asarray(encoded.overflow),
             jnp.asarray(encoded.cpu_lane),
             jnp.asarray(encoded.config_id),
+            jnp.asarray(encoded.attr_bytes) if has_dfa else None,
+            jnp.asarray(encoded.byte_ovf) if has_dfa else None,
         )
         return np.asarray(own), np.asarray(verdict)
 
@@ -65,6 +68,7 @@ class PolicyModel:
     def forward_fn_and_args(self, batch: int = 64):
         """A jittable forward fn + realistic example args (for compile checks)."""
         enc = encode_batch(self.policy, [], [], batch_pad=batch)
+        has_dfa = self.params["dfa_tables"] is not None
         args = (
             self.params,
             jnp.asarray(enc.attrs_val),
@@ -72,5 +76,7 @@ class PolicyModel:
             jnp.asarray(enc.overflow),
             jnp.asarray(enc.cpu_lane),
             jnp.asarray(enc.config_id),
+            jnp.asarray(enc.attr_bytes) if has_dfa else None,
+            jnp.asarray(enc.byte_ovf) if has_dfa else None,
         )
         return forward, args

@@ -13,7 +13,7 @@ equal-priority rules across all configs fuse into one kernel launch
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +27,7 @@ from ..compiler.compile import (
     OP_EXCL,
     OP_INCL,
     OP_NEQ,
+    OP_REGEX_DFA,
     OP_TREE_CPU,
     TRUE_SLOT,
     CompiledPolicy,
@@ -40,6 +41,8 @@ def to_device(policy: CompiledPolicy, device=None) -> dict:
     The engine double-buffers these and swaps atomically on reconcile
     (SURVEY.md §3.4: rule-tensor compile + device upload on index Set)."""
     put = partial(jax.device_put, device=device) if device is not None else jax.device_put
+    # per-dfa-row byte-tensor slot (attr → slot mapping folded in here)
+    dfa_byte_slot = np.maximum(policy.attr_byte_slot[policy.dfa_leaf_attr], 0)
     return {
         "leaf_op": put(jnp.asarray(policy.leaf_op)),
         "leaf_attr": put(jnp.asarray(policy.leaf_attr)),
@@ -51,6 +54,13 @@ def to_device(policy: CompiledPolicy, device=None) -> dict:
         "eval_cond": put(jnp.asarray(policy.eval_cond)),
         "eval_rule": put(jnp.asarray(policy.eval_rule)),
         "eval_has_cond": put(jnp.asarray(policy.eval_has_cond)),
+        # device regex lane; None (a static pytree node, not a traced leaf)
+        # when the corpus has no DFA-compilable regexes, so the kernel's
+        # python-level `is None` check specializes at trace time
+        "dfa_tables": put(jnp.asarray(policy.dfa_tables)) if policy.n_byte_attrs else None,
+        "dfa_accept": put(jnp.asarray(policy.dfa_accept)) if policy.n_byte_attrs else None,
+        "dfa_byte_slot": put(jnp.asarray(dfa_byte_slot.astype(np.int32))) if policy.n_byte_attrs else None,
+        "leaf_dfa_row": put(jnp.asarray(policy.leaf_dfa_row)) if policy.n_byte_attrs else None,
     }
 
 
@@ -63,6 +73,8 @@ def eval_verdicts(
     attrs_members: jnp.ndarray,  # [B, A, K] int32
     overflow: jnp.ndarray,       # [B, A] bool
     cpu_lane: jnp.ndarray,       # [B, L] bool
+    attr_bytes: Optional[jnp.ndarray] = None,  # [B, NB, LB] uint8
+    byte_ovf: Optional[jnp.ndarray] = None,    # [B, NB] bool
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """Returns (verdict [B, G] bool, (rule_results [B, G, E], skipped [B, G, E]))."""
     leaf_op = params["leaf_op"]          # [L]
@@ -78,6 +90,27 @@ def eval_verdicts(
     incl = jnp.any(memb == leaf_const[None, :, None], axis=-1)
     ovf = jnp.take(overflow, leaf_attr, axis=1)             # [B, L]
 
+    # ---- device regex lane: DFA scan over value bytes --------------------
+    if params["dfa_tables"] is not None and attr_bytes is not None:
+        tables = params["dfa_tables"]          # [R, S, 256] uint8
+        R = tables.shape[0]
+        row_idx = jnp.arange(R)[None, :]
+        row_bytes = jnp.take(attr_bytes, params["dfa_byte_slot"], axis=1)  # [B, R, LB]
+
+        def dfa_step(states, byte_col):  # states [B,R] i32, byte_col [B,R] u8
+            nxt = tables[row_idx, states, byte_col.astype(jnp.int32)]
+            return nxt.astype(jnp.int32), None
+
+        init = jnp.zeros((B, R), dtype=jnp.int32)
+        final, _ = jax.lax.scan(dfa_step, init, jnp.transpose(row_bytes, (2, 0, 1)))
+        dfa_row_res = params["dfa_accept"][row_idx, final]   # [B, R]
+        leaf_dfa = jnp.take(dfa_row_res, params["leaf_dfa_row"], axis=1)  # [B, L]
+        leaf_slot = jnp.take(params["dfa_byte_slot"], params["leaf_dfa_row"])
+        leaf_bovf = jnp.take(byte_ovf, leaf_slot, axis=1)    # [B, L]
+        dfa_leaf_val = jnp.where(leaf_bovf, cpu_lane, leaf_dfa)
+    else:
+        dfa_leaf_val = cpu_lane  # regexes ride the CPU lane entirely
+
     op = leaf_op[None, :]
     res = jnp.where(
         op == OP_EQ, eq,
@@ -87,8 +120,11 @@ def eval_verdicts(
                 op == OP_INCL, jnp.where(ovf, cpu_lane, incl),
                 jnp.where(
                     op == OP_EXCL, jnp.where(ovf, cpu_lane, ~incl),
-                    # OP_CPU (regex) and OP_TREE_CPU ride the lane; OP_ERROR → False
-                    jnp.where((op == OP_CPU) | (op == OP_TREE_CPU), cpu_lane, False),
+                    jnp.where(
+                        op == OP_REGEX_DFA, dfa_leaf_val,
+                        # OP_CPU (regex) and OP_TREE_CPU ride the lane; OP_ERROR → False
+                        jnp.where((op == OP_CPU) | (op == OP_TREE_CPU), cpu_lane, False),
+                    ),
                 ),
             ),
         ),
@@ -116,11 +152,14 @@ def eval_verdicts(
     return verdict, (rule, skipped)
 
 
-def forward(params, attrs_val, attrs_members, overflow, cpu_lane, config_id):
+def forward(params, attrs_val, attrs_members, overflow, cpu_lane, config_id,
+            attr_bytes=None, byte_ovf=None):
     """Canonical forward step: encoded micro-batch → (own verdicts [B],
     full verdict matrix [B, G]).  The single source of truth for
     verdict-selection logic (PolicyModel and the engine both use it)."""
-    verdict, _ = eval_verdicts(params, attrs_val, attrs_members, overflow, cpu_lane)
+    verdict, _ = eval_verdicts(
+        params, attrs_val, attrs_members, overflow, cpu_lane, attr_bytes, byte_ovf
+    )
     # select each request's own config column
     own = jnp.take_along_axis(verdict, config_id[:, None], axis=1)[:, 0]
     return own, verdict
@@ -130,11 +169,14 @@ _eval_jit = jax.jit(forward)
 
 
 @partial(jax.jit, static_argnames=())
-def eval_full_jit(params, attrs_val, attrs_members, overflow, cpu_lane, config_id):
+def eval_full_jit(params, attrs_val, attrs_members, overflow, cpu_lane, config_id,
+                  attr_bytes=None, byte_ovf=None):
     """Like _eval_jit but also returns each request's own per-evaluator rule
     results + skipped flags [B, E] — what the pipeline's batched
     pattern-matching evaluators consume (runtime/engine.py)."""
-    verdict, (rule, skipped) = eval_verdicts(params, attrs_val, attrs_members, overflow, cpu_lane)
+    verdict, (rule, skipped) = eval_verdicts(
+        params, attrs_val, attrs_members, overflow, cpu_lane, attr_bytes, byte_ovf
+    )
     own = jnp.take_along_axis(verdict, config_id[:, None], axis=1)[:, 0]
     idx = config_id[:, None, None]
     own_rule = jnp.take_along_axis(rule, idx, axis=1)[:, 0, :]
@@ -145,6 +187,7 @@ def eval_full_jit(params, attrs_val, attrs_members, overflow, cpu_lane, config_i
 def eval_batch_jit(params, encoded) -> Tuple[np.ndarray, np.ndarray]:
     """Convenience wrapper: encoded batch (numpy) → (own verdicts [B],
     full verdict matrix [B, G]) as numpy."""
+    has_dfa = params["dfa_tables"] is not None
     own, verdict = _eval_jit(
         params,
         jnp.asarray(encoded.attrs_val),
@@ -152,5 +195,7 @@ def eval_batch_jit(params, encoded) -> Tuple[np.ndarray, np.ndarray]:
         jnp.asarray(encoded.overflow),
         jnp.asarray(encoded.cpu_lane),
         jnp.asarray(encoded.config_id),
+        jnp.asarray(encoded.attr_bytes) if has_dfa else None,
+        jnp.asarray(encoded.byte_ovf) if has_dfa else None,
     )
     return np.asarray(own), np.asarray(verdict)

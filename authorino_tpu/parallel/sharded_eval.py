@@ -74,11 +74,16 @@ class ShardedPolicyModel:
             self.locator[cfg.name] = (shard, len(groups[shard]))
             groups[shard].append(cfg)
 
-        # two-pass compile: natural shapes → union targets → final compile
-        first = [compile_corpus(g, members_k=members_k, interner=interner) for g in groups]
+        # two-pass compile: natural shapes → union targets → final compile.
+        # enable_dfa=False: regexes ride the CPU lane here — DFA table shapes
+        # are not yet unified across shards (single-corpus serving uses them)
+        first = [
+            compile_corpus(g, members_k=members_k, interner=interner, enable_dfa=False)
+            for g in groups
+        ]
         targets = ShapeTargets.union([p.shape_targets() for p in first])
         self.shards: List[CompiledPolicy] = [
-            compile_corpus(g, members_k=members_k, interner=interner, targets=targets)
+            compile_corpus(g, members_k=members_k, interner=interner, targets=targets, enable_dfa=False)
             for g in groups
         ]
         # eval tables may still differ in row count (configs per shard): pad G
@@ -117,6 +122,11 @@ class ShardedPolicyModel:
             "eval_cond": jnp.asarray(eval_cond),
             "eval_rule": jnp.asarray(eval_rule),
             "eval_has_cond": jnp.asarray(eval_has),
+            # regexes ride the CPU lane in the sharded path (enable_dfa=False)
+            "dfa_tables": None,
+            "dfa_accept": None,
+            "dfa_byte_slot": None,
+            "leaf_dfa_row": None,
         }
         self._place_params()
         self._step = self._build_step()
@@ -133,6 +143,11 @@ class ShardedPolicyModel:
             "eval_cond": P("mp"),
             "eval_rule": P("mp"),
             "eval_has_cond": P("mp"),
+            # None params are empty pytree nodes; specs mirror the structure
+            "dfa_tables": None,
+            "dfa_accept": None,
+            "dfa_byte_slot": None,
+            "leaf_dfa_row": None,
         }
 
     def _place_params(self):
@@ -152,6 +167,10 @@ class ShardedPolicyModel:
             "eval_cond": place(p["eval_cond"], specs["eval_cond"]),
             "eval_rule": place(p["eval_rule"], specs["eval_rule"]),
             "eval_has_cond": place(p["eval_has_cond"], specs["eval_has_cond"]),
+            "dfa_tables": None,
+            "dfa_accept": None,
+            "dfa_byte_slot": None,
+            "leaf_dfa_row": None,
         }
 
     def _build_step(self):

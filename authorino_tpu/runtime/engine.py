@@ -179,6 +179,7 @@ class PolicyEngine:
         policy = snap.policy
         rows = [policy.config_ids[p.config_name] for p in batch]
         enc = encode_batch(policy, [p.doc for p in batch], rows, batch_pad=_bucket(len(batch)))
+        has_dfa = snap.params["dfa_tables"] is not None
         own, own_rule, own_skipped = eval_full_jit(
             snap.params,
             jnp.asarray(enc.attrs_val),
@@ -186,6 +187,8 @@ class PolicyEngine:
             jnp.asarray(enc.overflow),
             jnp.asarray(enc.cpu_lane),
             jnp.asarray(enc.config_id),
+            jnp.asarray(enc.attr_bytes) if has_dfa else None,
+            jnp.asarray(enc.byte_ovf) if has_dfa else None,
         )
         return np.asarray(own_rule), np.asarray(own_skipped)
 
