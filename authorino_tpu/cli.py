@@ -58,6 +58,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--auth-config-label-selector", default=env_var("AUTH_CONFIG_LABEL_SELECTOR", ""))
     s.add_argument("--secret-label-selector", default=env_var("SECRET_LABEL_SELECTOR", "authorino.kuadrant.io/managed-by=authorino"))
     s.add_argument("--allow-superseding-host-subsets", action="store_true", default=env_var("ALLOW_SUPERSEDING_HOST_SUBSETS", False))
+    s.add_argument("--tracing-service-endpoint", default=env_var("TRACING_SERVICE_ENDPOINT", ""), help="OTLP endpoint (rpc://host:port or http(s)://...)")
+    s.add_argument("--tracing-service-insecure", action="store_true", default=env_var("TRACING_SERVICE_INSECURE", False))
     s.add_argument("--log-level", default=env_var("LOG_LEVEL", "info"))
     s.add_argument("--jax-platform", default=env_var("JAX_PLATFORM", ""), help="Force a jax platform (e.g. cpu) — useful without TPU access")
 
@@ -91,6 +93,11 @@ async def run_server(args) -> None:
 
     cache_mod.EVALUATOR_CACHE_MAX_ENTRIES = args.evaluator_cache_size
     metrics_mod.DEEP_METRICS_ENABLED = args.deep_metrics_enabled
+
+    if args.tracing_service_endpoint:
+        from .utils.tracing import setup_tracing
+
+        setup_tracing(args.tracing_service_endpoint, insecure=args.tracing_service_insecure)
 
     engine = PolicyEngine(
         max_batch=args.batch_size,

@@ -71,6 +71,12 @@ class GenericHttp:
         for h in self.headers:
             headers[h.name] = stringify_json(h.value.resolve_for(doc))
 
+        # W3C trace propagation into every outbound evaluator call
+        # (ref: pkg/evaluators/metadata/generic_http.go:135 otelhttp injection)
+        span = getattr(pipeline, "span", None)
+        if span is not None:
+            span.inject(headers)
+
         sess = http_util.get_session()
         try:
             async with sess.request(self.method, url, headers=headers, data=data) as resp:

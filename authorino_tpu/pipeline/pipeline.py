@@ -65,10 +65,12 @@ class AuthPipeline:
         request: CheckRequestModel,
         config: RuntimeAuthConfig,
         timeout: Optional[float] = None,
+        span=None,
     ):
         self.request = request
         self.config = config
         self.timeout = timeout
+        self.span = span  # RequestSpan for outbound W3C propagation
         self.identity_results: Dict[Any, Any] = {}
         self.metadata_results: Dict[Any, Any] = {}
         self.authorization_results: Dict[Any, Any] = {}

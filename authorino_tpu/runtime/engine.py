@@ -111,12 +111,12 @@ class PolicyEngine:
             entry = self.index.get(host.rsplit(":", 1)[0])
         return entry
 
-    async def check(self, request: CheckRequestModel) -> AuthResult:
+    async def check(self, request: CheckRequestModel, span=None) -> AuthResult:
         """Full request-time flow (ref: pkg/service/auth.go:239-310)."""
         entry = self.lookup(request.host())
         if entry is None:
             return AuthResult(code=NOT_FOUND, message="Service not found")
-        pipeline = AuthPipeline(request, entry.runtime, timeout=self.timeout_s)
+        pipeline = AuthPipeline(request, entry.runtime, timeout=self.timeout_s, span=span)
         return await pipeline.evaluate()
 
     # ---- micro-batching verdicts ----------------------------------------

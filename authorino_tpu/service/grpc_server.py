@@ -151,7 +151,13 @@ def build_server(
             return check_response_from_result(
                 AuthResult(code=INVALID_ARGUMENT, message="Invalid request")
             )
-        result = await engine.check(model)
+        from ..utils.tracing import RequestSpan
+
+        span = RequestSpan.from_headers(model.http.headers, model.http.id)
+        try:
+            result = await engine.check(model, span=span)
+        finally:
+            span.end()
         return check_response_from_result(result)
 
     async def health_check(request, context):

@@ -88,7 +88,15 @@ def make_check_handler(engine: PolicyEngine, max_body: int = DEFAULT_MAX_BODY):
             return web.Response(status=413, text="request body too large")
 
         check_request = synthesize_check_request(request, body)
-        result = await engine.check(check_request)
+        from ..utils.tracing import RequestSpan
+
+        span = RequestSpan.from_headers(
+            check_request.http.headers, check_request.http.id
+        )
+        try:
+            result = await engine.check(check_request, span=span)
+        finally:
+            span.end(error=None)
 
         status = http_status_for(result.code, result.status)
         metrics_mod.response_status.labels(str(status)).inc()
@@ -125,7 +133,6 @@ def make_check_handler(engine: PolicyEngine, max_body: int = DEFAULT_MAX_BODY):
 
 def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_BODY) -> web.Application:
     app = web.Application(client_max_size=max_body + 1024)
-    app.router.add_route("*", "/check", make_check_handler(engine, max_body))
 
     async def healthz(_):
         return web.Response(text="ok")  # liveness (ref main.go:428-432)
@@ -148,4 +155,9 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
     app.router.add_get("/readyz", readyz)
     app.router.add_get("/metrics", server_metrics)
     app.router.add_get("/server-metrics", server_metrics)
+    # catch-all LAST: Envoy's HTTP ext_authz filter forwards the ORIGINAL
+    # request path (path_prefix + :path), so /check is just the conventional
+    # prefix — any path must evaluate (ref: pkg/service/auth.go:89-177
+    # synthesizes the CheckRequest from the incoming request itself)
+    app.router.add_route("*", "/{tail:.*}", make_check_handler(engine, max_body))
     return app

@@ -1,0 +1,138 @@
+"""Tracing: W3C TraceContext propagation end-to-end + optional OpenTelemetry
+SDK export (semantics: ref pkg/trace/exporter.go:26-117, trace.go:20-27 —
+request spans carry authorino.request_id and propagate x-request-id; W3C
+headers are injected into every outbound evaluator HTTP call).
+
+The image ships only the OTel *API*; when an SDK + OTLP exporter are
+installed, ``setup_tracing`` wires a real provider (endpoint URL semantics
+like the reference: ``rpc://host:port`` → gRPC OTLP, ``http(s)://`` → HTTP
+OTLP, basic-auth from URL userinfo).  Without the SDK, spans are lightweight
+native objects and propagation still works — the part that affects request
+correctness."""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+from urllib.parse import urlsplit
+
+log = logging.getLogger("authorino_tpu.trace")
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+_otel_tracer = None
+
+
+def setup_tracing(endpoint: str, insecure: bool = False, service_name: str = "authorino-tpu") -> bool:
+    """Configure a real OTel provider when the SDK is available.
+    Returns True when exporting is active (ref: CreateTraceProvider)."""
+    global _otel_tracer
+    if not endpoint:
+        return False
+    try:
+        from opentelemetry import trace as otel_trace
+        from opentelemetry.sdk.resources import Resource  # type: ignore
+        from opentelemetry.sdk.trace import TracerProvider  # type: ignore
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor  # type: ignore
+
+        split = urlsplit(endpoint)
+        headers = {}
+        if split.username:
+            import base64 as b64
+
+            cred = f"{split.username}:{split.password or ''}"
+            headers["authorization"] = "Basic " + b64.b64encode(cred.encode()).decode()
+        if split.scheme in ("rpc", "grpc"):
+            from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (  # type: ignore
+                OTLPSpanExporter,
+            )
+
+            exporter = OTLPSpanExporter(
+                endpoint=f"{split.hostname}:{split.port or 4317}",
+                insecure=insecure,
+                headers=headers or None,
+            )
+        else:
+            from opentelemetry.exporter.otlp.proto.http.trace_exporter import (  # type: ignore
+                OTLPSpanExporter,
+            )
+
+            exporter = OTLPSpanExporter(endpoint=endpoint, headers=headers or None)
+        provider = TracerProvider(resource=Resource.create({"service.name": service_name}))
+        provider.add_span_processor(BatchSpanProcessor(exporter))
+        otel_trace.set_tracer_provider(provider)
+        _otel_tracer = otel_trace.get_tracer("authorino-tpu")
+        return True
+    except ImportError as e:
+        log.warning(
+            "tracing endpoint configured but the OpenTelemetry SDK/exporter is "
+            "not installed (%s); spans propagate W3C context but are not exported",
+            e,
+        )
+        return False
+
+
+@dataclass
+class RequestSpan:
+    """Per-request span: parsed-or-minted W3C trace context
+    (ref: NewAuthorizationRequestSpan, pkg/trace/trace.go:20-27)."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+    request_id: str = ""
+    start: float = field(default_factory=time.monotonic)
+    _otel_span: Any = None
+
+    @classmethod
+    def from_headers(cls, headers: Dict[str, str], request_id: str = "") -> "RequestSpan":
+        tp = headers.get("traceparent", "")
+        m = _TRACEPARENT_RE.match(tp) if tp else None
+        if m:
+            trace_id = m.group(2)
+            sampled = bool(int(m.group(4), 16) & 1)
+        else:
+            trace_id = secrets.token_hex(16)
+            sampled = True
+        span = cls(
+            trace_id=trace_id,
+            span_id=secrets.token_hex(8),
+            sampled=sampled,
+            request_id=request_id,
+        )
+        if _otel_tracer is not None:
+            try:
+                span._otel_span = _otel_tracer.start_span(
+                    "Check", attributes={"authorino.request_id": request_id}
+                )
+            except Exception:
+                pass
+        return span
+
+    def traceparent(self) -> str:
+        """Outbound W3C header (new child span id per outbound call is
+        overkill for our purposes; the span id uniquely marks this hop)."""
+        flags = "01" if self.sampled else "00"
+        return f"00-{self.trace_id}-{self.span_id}-{flags}"
+
+    def inject(self, headers: Dict[str, str]) -> Dict[str, str]:
+        headers["traceparent"] = self.traceparent()
+        if self.request_id:
+            headers["x-request-id"] = self.request_id
+        return headers
+
+    def end(self, error: Optional[str] = None) -> None:
+        if self._otel_span is not None:
+            try:
+                if error:
+                    self._otel_span.set_attribute("error", error)
+                self._otel_span.end()
+            except Exception:
+                pass

@@ -1,0 +1,269 @@
+"""Local end-to-end harness: boots a fake IdP + the real CLI server with
+generated manifests, then asserts an expected-HTTP-status table — the
+standalone analog of the reference's kind-cluster e2e
+(ref: tests/e2e-test.sh:203-274 expected-status tables over the talker-api).
+
+Run:  python tests/e2e/harness.py            (CPU platform forced)
+Exit code 0 = all assertions passed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+IDP_PORT = 9143
+HTTP_PORT = 5091
+OIDC_PORT = 8183
+GRPC_PORT = 50191
+
+
+def make_idp_app(key):
+    from aiohttp import web
+
+    from authorino_tpu.utils import jose
+
+    issuer = f"http://127.0.0.1:{IDP_PORT}"
+    app = web.Application()
+
+    async def wk(request):
+        return web.json_response(
+            {"issuer": issuer, "jwks_uri": f"{issuer}/jwks", "userinfo_endpoint": f"{issuer}/userinfo"}
+        )
+
+    async def jwks(request):
+        return web.json_response({"keys": [jose.jwk_from_public_key(key.public_key(), kid="k1")]})
+
+    async def userinfo(request):
+        return web.json_response({"sub": "john", "email": "john@acme.com"})
+
+    app.router.add_get("/.well-known/openid-configuration", wk)
+    app.router.add_get("/jwks", jwks)
+    app.router.add_get("/userinfo", userinfo)
+    return app
+
+
+def write_manifests(tmpdir: str, wb_pem: bytes):
+    import yaml
+
+    api_secret = {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {
+            "name": "friend-key",
+            "namespace": "e2e",
+            "labels": {"audience": "talker-api", "authorino.kuadrant.io/managed-by": "authorino"},
+        },
+        "data": {"api_key": base64.b64encode(b"friend-secret-1").decode()},
+    }
+    wb_secret = {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {"name": "wristband-signing-key", "namespace": "e2e"},
+        "data": {"key.pem": base64.b64encode(wb_pem).decode()},
+    }
+    authconfig = {
+        "apiVersion": "authorino.kuadrant.io/v1beta2",
+        "kind": "AuthConfig",
+        "metadata": {"name": "talker-api-protection", "namespace": "e2e"},
+        "spec": {
+            "hosts": ["talker-api.example.com"],
+            "patterns": {
+                "api-path": [{"selector": "request.url_path", "operator": "matches", "value": "^/api"}]
+            },
+            "when": [{"selector": "request.method", "operator": "neq", "value": "OPTIONS"}],
+            "authentication": {
+                "friends": {
+                    "apiKey": {"selector": {"matchLabels": {"audience": "talker-api"}}},
+                    "credentials": {"authorizationHeader": {"prefix": "APIKEY"}},
+                },
+                "keycloak": {"jwt": {"issuerUrl": f"http://127.0.0.1:{IDP_PORT}"}},
+            },
+            "metadata": {"userinfo": {"userInfo": {"identitySource": "keycloak"}}},
+            "authorization": {
+                "deny-evil-org": {
+                    "patternMatching": {
+                        "patterns": [{"selector": "request.headers.x-org", "operator": "neq", "value": "evil"}]
+                    }
+                },
+                "admins-can-delete": {
+                    "opa": {
+                        "rego": 'allow { input.request.method != "DELETE" }\n'
+                                'allow { input.auth.identity.realm_access.roles[_] == "admin" }'
+                    }
+                },
+                "api-paths-only-for-jwt": {
+                    "patternMatching": {
+                        "patterns": [{"selector": "auth.identity.iss", "operator": "neq", "value": ""}]
+                    },
+                    "when": [{"patternRef": "api-path"}],
+                },
+            },
+            "response": {
+                "unauthorized": {
+                    "code": 302,
+                    "message": {"value": "redirecting"},
+                    "headers": {"Location": {"selector": "https://login.example.com?from={request.path}"}},
+                },
+                "success": {
+                    "headers": {
+                        "wristband": {
+                            "wristband": {
+                                "issuer": f"http://127.0.0.1:{OIDC_PORT}/e2e/talker-api-protection/wristband",
+                                "tokenDuration": 300,
+                                "signingKeyRefs": [{"name": "wristband-signing-key", "algorithm": "ES256"}],
+                            }
+                        },
+                        "x-auth-data": {
+                            "json": {"properties": {"method": {"selector": "request.method"}}}
+                        },
+                    }
+                },
+            },
+        },
+    }
+    path = os.path.join(tmpdir, "manifests.yaml")
+    with open(path, "w") as f:
+        yaml.dump_all([api_secret, wb_secret, authconfig], f)
+    return os.path.dirname(path)
+
+
+async def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import tempfile
+
+    import aiohttp
+    from aiohttp import web
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ec, rsa
+
+    from authorino_tpu.utils import jose
+
+    idp_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    wb_key = ec.generate_private_key(ec.SECP256R1())
+    wb_pem = wb_key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption(),
+    )
+
+    # fake IdP
+    idp_runner = web.AppRunner(make_idp_app(idp_key))
+    await idp_runner.setup()
+    await web.TCPSite(idp_runner, "127.0.0.1", IDP_PORT).start()
+
+    tmpdir = tempfile.mkdtemp(prefix="authorino-tpu-e2e-")
+    manifest_dir = write_manifests(tmpdir, wb_pem)
+
+    # the real server, in-process (same code path as `authorino-tpu server`)
+    from authorino_tpu.cli import build_parser, run_server
+
+    args = build_parser().parse_args(
+        [
+            "server",
+            "--watch-dir", manifest_dir,
+            "--ext-auth-http-port", str(HTTP_PORT),
+            "--ext-auth-grpc-port", str(GRPC_PORT),
+            "--oidc-http-port", str(OIDC_PORT),
+        ]
+    )
+    server_task = asyncio.ensure_future(run_server(args))
+    base = f"http://127.0.0.1:{HTTP_PORT}"
+
+    def jwt(claims=None):
+        iat = int(time.time())
+        payload = {"iss": f"http://127.0.0.1:{IDP_PORT}", "sub": "john", "iat": iat, "exp": iat + 300}
+        payload.update(claims or {})
+        return jose.sign_jwt(payload, idp_key, "RS256", kid="k1")
+
+    H = "talker-api.example.com"
+    admin_jwt = jwt({"realm_access": {"roles": ["admin"]}})
+    user_jwt = jwt()
+    expired_jwt = jwt({"exp": 10})
+
+    # expected-status table (ref: tests/e2e-test.sh:203-274 style)
+    TABLE = [
+        # (desc, method, path, headers, expected_status)
+        ("anonymous denied (401)", "GET", "/hello", {}, 401),
+        ("valid api key", "GET", "/hello", {"Authorization": "APIKEY friend-secret-1"}, 200),
+        ("invalid api key", "GET", "/hello", {"Authorization": "APIKEY nope"}, 401),
+        ("valid jwt", "GET", "/hello", {"Authorization": f"Bearer {user_jwt}"}, 200),
+        ("expired jwt", "GET", "/hello", {"Authorization": f"Bearer {expired_jwt}"}, 401),
+        ("OPTIONS skipped by top-level when", "OPTIONS", "/hello", {}, 200),
+        ("evil org denied with redirect", "GET", "/hello",
+         {"Authorization": "APIKEY friend-secret-1", "X-Org": "evil"}, 302),
+        ("api key cannot DELETE", "DELETE", "/hello", {"Authorization": "APIKEY friend-secret-1"}, 302),
+        ("admin jwt can DELETE", "DELETE", "/hello", {"Authorization": f"Bearer {admin_jwt}"}, 200),
+        ("api path requires jwt identity", "GET", "/api/x",
+         {"Authorization": "APIKEY friend-secret-1"}, 302),
+        ("api path with jwt ok", "GET", "/api/x", {"Authorization": f"Bearer {user_jwt}"}, 200),
+        ("unknown host 404", "GET", "/hello", {"__host": "nope.example.com"}, 404),
+    ]
+
+    # wait for readiness
+    async with aiohttp.ClientSession() as sess:
+        for _ in range(60):
+            try:
+                async with sess.get(f"{base}/readyz") as r:
+                    if r.status == 200:
+                        break
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.5)
+        else:
+            print("FAIL: server never became ready")
+            return 1
+
+        failures = 0
+        for desc, method, path, headers, expected in TABLE:
+            host = headers.pop("__host", H)
+            req_headers = {"Host": host, **headers}
+            async with sess.request(
+                method, f"{base}{path}", headers=req_headers, allow_redirects=False
+            ) as r:
+                status = r.status
+                mark = "PASS" if status == expected else "FAIL"
+                if status != expected:
+                    failures += 1
+                print(f"[{mark}] {desc}: {method} {path} → {status} (want {expected})")
+
+        # wristband token verifies against the served JWKS
+        async with sess.get(
+            f"{base}/check", headers={"Host": H, "Authorization": "APIKEY friend-secret-1"}
+        ) as r:
+            wb_token = r.headers.get("wristband", "")
+        async with sess.get(
+            f"http://127.0.0.1:{OIDC_PORT}/e2e/talker-api-protection/wristband/.well-known/openid-connect/certs"
+        ) as r:
+            jwks = (await r.json())["keys"]
+        try:
+            claims = jose.verify_jws(wb_token, jwks)
+            assert claims["exp"] - claims["iat"] == 300
+            print("[PASS] wristband verifies against served JWKS")
+        except Exception as e:
+            failures += 1
+            print(f"[FAIL] wristband verification: {e}")
+
+    server_task.cancel()
+    try:
+        await server_task
+    except (asyncio.CancelledError, Exception):
+        pass
+    await idp_runner.cleanup()
+    from authorino_tpu.utils.http import close_sessions
+
+    await close_sessions()
+    print(f"\n{'OK' if failures == 0 else 'FAILED'}: {len(TABLE) + 1 - failures}/{len(TABLE) + 1} assertions passed")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

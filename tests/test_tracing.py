@@ -1,0 +1,75 @@
+"""W3C trace-context propagation tests (ref: pkg/trace — spans propagate
+traceparent + x-request-id into outbound evaluator calls)."""
+
+import asyncio
+
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from authorino_tpu.authjson import CheckRequestModel, HttpRequestAttributes, JSONValue
+from authorino_tpu.evaluators import IdentityConfig, MetadataConfig, RuntimeAuthConfig
+from authorino_tpu.evaluators.identity import Noop
+from authorino_tpu.evaluators.metadata import GenericHttp
+from authorino_tpu.pipeline import AuthPipeline
+from authorino_tpu.utils.tracing import RequestSpan
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_traceparent_parse_and_mint():
+    parent = "00-0123456789abcdef0123456789abcdef-00f067aa0ba902b7-01"
+    span = RequestSpan.from_headers({"traceparent": parent}, request_id="req-1")
+    assert span.trace_id == "0123456789abcdef0123456789abcdef"  # trace id propagates
+    assert span.span_id != "00f067aa0ba902b7"  # new span id per hop
+    out = span.inject({})
+    assert out["traceparent"].startswith("00-0123456789abcdef0123456789abcdef-")
+    assert out["x-request-id"] == "req-1"
+
+    minted = RequestSpan.from_headers({}, request_id="req-2")
+    assert len(minted.trace_id) == 32 and len(minted.span_id) == 16
+
+
+def test_outbound_propagation_through_generic_http():
+    async def body():
+        seen = {}
+
+        async def meta(request):
+            seen["traceparent"] = request.headers.get("traceparent")
+            seen["x-request-id"] = request.headers.get("x-request-id")
+            return web.json_response({"ok": True})
+
+        app = web.Application()
+        app.router.add_get("/meta", meta)
+        server = TestServer(app)
+        await server.start_server()
+        try:
+            base = str(server.make_url("")).rstrip("/")
+            cfg = RuntimeAuthConfig(
+                identity=[IdentityConfig("anon", Noop())],
+                metadata=[MetadataConfig("m", GenericHttp(endpoint=JSONValue(static=base + "/meta")))],
+            )
+            req = CheckRequestModel(
+                http=HttpRequestAttributes(
+                    method="GET", path="/", host="svc.example.com",
+                    headers={"traceparent": "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"},
+                )
+            )
+            span = RequestSpan.from_headers(req.http.headers, "rid-9")
+            pipeline = AuthPipeline(req, cfg, span=span)
+            result = await pipeline.evaluate()
+            assert result.success()
+            assert seen["traceparent"].startswith("00-" + "ab" * 16 + "-")
+            assert seen["x-request-id"] == "rid-9"
+        finally:
+            await server.close()
+            from authorino_tpu.utils.http import close_sessions
+
+            await close_sessions()
+
+    run(body())
