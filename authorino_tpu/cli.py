@@ -58,13 +58,54 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--auth-config-label-selector", default=env_var("AUTH_CONFIG_LABEL_SELECTOR", ""))
     s.add_argument("--secret-label-selector", default=env_var("SECRET_LABEL_SELECTOR", "authorino.kuadrant.io/managed-by=authorino"))
     s.add_argument("--allow-superseding-host-subsets", action="store_true", default=env_var("ALLOW_SUPERSEDING_HOST_SUBSETS", False))
+    s.add_argument("--enable-leader-election", action="store_true", default=env_var("ENABLE_LEADER_ELECTION", False), help="Leader-elect the status writer (in-cluster mode)")
     s.add_argument("--tracing-service-endpoint", default=env_var("TRACING_SERVICE_ENDPOINT", ""), help="OTLP endpoint (rpc://host:port or http(s)://...)")
     s.add_argument("--tracing-service-insecure", action="store_true", default=env_var("TRACING_SERVICE_INSECURE", False))
     s.add_argument("--log-level", default=env_var("LOG_LEVEL", "info"))
     s.add_argument("--jax-platform", default=env_var("JAX_PLATFORM", ""), help="Force a jax platform (e.g. cpu) — useful without TPU access")
 
+    w = sub.add_parser("webhooks", help="Run the CRD conversion/validation webhook server")
+    w.add_argument("--webhook-service-port", type=int, default=env_var("WEBHOOK_SERVICE_PORT", 9443))
+    w.add_argument("--tls-cert", default=env_var("TLS_CERT", ""), help="PEM cert for the webhook listener")
+    w.add_argument("--tls-cert-key", default=env_var("TLS_CERT_KEY", ""))
+    w.add_argument("--log-level", default=env_var("LOG_LEVEL", "info"))
+
     sub.add_parser("version", help="Print version")
     return p
+
+
+async def run_webhooks(args) -> None:
+    """(ref: main.go `webhooks` command — conversion webhook server)"""
+    import ssl
+
+    from aiohttp import web
+
+    from .service.webhooks import build_webhook_app
+
+    logging.basicConfig(level=getattr(logging, args.log_level.upper(), logging.INFO))
+    log = logging.getLogger("authorino_tpu.webhooks")
+
+    if bool(args.tls_cert) != bool(args.tls_cert_key):
+        raise SystemExit("--tls-cert and --tls-cert-key must be provided together")
+    ssl_ctx = None
+    if args.tls_cert:
+        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ssl_ctx.load_cert_chain(args.tls_cert, args.tls_cert_key)
+
+    runner = web.AppRunner(build_webhook_app())
+    await runner.setup()
+    await web.TCPSite(runner, "0.0.0.0", args.webhook_service_port, ssl_context=ssl_ctx).start()
+    log.info("webhooks listening on :%d (tls=%s)", args.webhook_service_port, bool(ssl_ctx))
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
+    await runner.cleanup()
 
 
 async def run_server(args) -> None:
@@ -109,23 +150,47 @@ async def run_server(args) -> None:
     secret_selector = LabelSelector.parse(args.secret_label_selector) if args.secret_label_selector else None
 
     source = None
+    status_updater = None
     if args.in_cluster:
-        raise SystemExit("--in-cluster watch mode requires running inside Kubernetes (round 2)")
-    cluster = InMemoryCluster()
-    reconciler = AuthConfigReconciler(
-        engine,
-        cluster=cluster,
-        label_selector=selector,
-        allow_superseding_host_subsets=args.allow_superseding_host_subsets,
-    )
-    secret_reconciler = SecretReconciler(engine, secret_label_selector=secret_selector)
-    if args.watch_dir:
-        source = YamlDirSource(args.watch_dir, reconciler, cluster, secret_reconciler)
-        await source.sync()
+        # real-cluster control plane: watch AuthConfigs/Secrets, leader-elect
+        # the status writer (ref: main.go:241-336)
+        from .controllers.sources import K8sWatchSource
+        from .controllers.status_updater import AuthConfigStatusUpdater
+
+        cluster = RestCluster()
+        reconciler = AuthConfigReconciler(
+            engine,
+            cluster=cluster,
+            label_selector=selector,
+            allow_superseding_host_subsets=args.allow_superseding_host_subsets,
+        )
+        secret_reconciler = SecretReconciler(engine, secret_label_selector=secret_selector)
+        source = K8sWatchSource(
+            cluster, reconciler, secret_reconciler, secret_label_selector=secret_selector
+        )
         source.start()
-        log.info("watching manifests under %s", args.watch_dir)
+        status_updater = AuthConfigStatusUpdater(
+            reconciler, cluster, leases=cluster,
+            namespace=os.environ.get("POD_NAMESPACE", "default"),
+            leader_election=args.enable_leader_election,
+        ).start()
+        log.info("watching AuthConfigs via the Kubernetes API")
     else:
-        log.warning("no --watch-dir and not --in-cluster: serving an empty index")
+        cluster = InMemoryCluster()
+        reconciler = AuthConfigReconciler(
+            engine,
+            cluster=cluster,
+            label_selector=selector,
+            allow_superseding_host_subsets=args.allow_superseding_host_subsets,
+        )
+        secret_reconciler = SecretReconciler(engine, secret_label_selector=secret_selector)
+        if args.watch_dir:
+            source = YamlDirSource(args.watch_dir, reconciler, cluster, secret_reconciler)
+            await source.sync()
+            source.start()
+            log.info("watching manifests under %s", args.watch_dir)
+        else:
+            log.warning("no --watch-dir and not --in-cluster: serving an empty index")
 
     # HTTP /check
     app = build_app(engine, readiness=reconciler.ready, max_body=args.max_http_request_body_size)
@@ -154,6 +219,8 @@ async def run_server(args) -> None:
             pass
     await stop.wait()
     log.info("shutting down")
+    if status_updater is not None:
+        await status_updater.stop()
     if source is not None:
         await source.stop()
     await grpc_server.stop(2)
@@ -170,6 +237,9 @@ def main(argv=None) -> int:
         return 0
     if args.command == "server":
         asyncio.run(run_server(args))
+        return 0
+    if args.command == "webhooks":
+        asyncio.run(run_webhooks(args))
         return 0
     build_parser().print_help()
     return 1

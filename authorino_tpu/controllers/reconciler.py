@@ -143,6 +143,7 @@ class AuthConfigReconciler:
         async with self._lock:
             self._resources = {}
             deny_entries: List[EngineEntry] = []
+            stale_ids = set(self.status.all())
             for r in resources:
                 if not self.watched(r):
                     continue
@@ -154,6 +155,11 @@ class AuthConfigReconciler:
                 deny_entries.append(
                     EngineEntry(id=id_, hosts=hosts, runtime=new_deny_all_config())
                 )
+            # prune reports for configs deleted while the watch was down —
+            # a stale non-ready entry would wedge /readyz at 503 and make
+            # the status updater patch a deleted CR forever
+            for id_ in stale_ids - set(self._resources):
+                self.status.clear(id_)
             if not self._bootstrapped:
                 try:
                     self.engine.apply_snapshot(deny_entries, override=True)

@@ -20,10 +20,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import yaml
 
 from ..apis.convert import to_v1beta2
-from ..k8s.client import InMemoryCluster, LabelSelector, Secret
+from ..k8s.client import InMemoryCluster, LabelSelector, RestCluster, Secret
 from .reconciler import AuthConfigReconciler, SecretReconciler
 
-__all__ = ["YamlDirSource", "load_manifests"]
+__all__ = ["YamlDirSource", "K8sWatchSource", "load_manifests"]
 
 log = logging.getLogger("authorino_tpu.sources")
 
@@ -141,3 +141,116 @@ class YamlDirSource:
                 await self._task
             except (asyncio.CancelledError, Exception):
                 pass
+
+
+class K8sWatchSource:
+    """Real-cluster control plane: list + watch AuthConfigs and Secrets via
+    the REST client, feeding the reconcilers — the role controller-runtime's
+    informers play for the reference (ref: main.go:241-306).  On watch-stream
+    loss, re-lists (informer resync)."""
+
+    def __init__(
+        self,
+        cluster: RestCluster,
+        reconciler: AuthConfigReconciler,
+        secret_reconciler: Optional[SecretReconciler] = None,
+        secret_label_selector: Optional[LabelSelector] = None,
+        resync_interval_s: float = 10.0,
+    ):
+        self.cluster = cluster
+        self.reconciler = reconciler
+        self.secret_reconciler = secret_reconciler
+        self.secret_label_selector = secret_label_selector or LabelSelector.parse(
+            "authorino.kuadrant.io/managed-by=authorino"
+        )
+        self.resync_interval_s = resync_interval_s
+        self._tasks: List[asyncio.Task] = []
+
+    def _ac_params(self) -> Dict[str, str]:
+        """Server-side sharding: a label-selected instance must not stream
+        the whole cluster's AuthConfigs (ref: label_selector.go predicate,
+        here pushed down to the API like the secret path)."""
+        sel = self.reconciler.label_selector.to_string()
+        return {"labelSelector": sel} if sel else {}
+
+    async def _initial_sync(self) -> None:
+        items = await self.cluster.list_auth_configs(self.reconciler.label_selector)
+        await self.reconciler.reconcile_all([to_v1beta2(o) for o in items])
+
+    async def _watch_auth_configs(self) -> None:
+        path = self.cluster._ac_path()
+        while True:
+            try:
+                async for ev_type, obj in self.cluster.watch(path, self._ac_params()):
+                    meta = obj.get("metadata") or {}
+                    id_ = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+                    if ev_type == "DELETED":
+                        await self.reconciler.delete(id_)
+                    elif ev_type in ("ADDED", "MODIFIED"):
+                        await self.reconciler.upsert(to_v1beta2(obj))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("authconfig watch lost (%s); re-listing", e)
+            await asyncio.sleep(self.resync_interval_s)
+            try:
+                await self._initial_sync()
+            except Exception as e:
+                log.warning("authconfig re-list failed: %s", e)
+
+    async def _watch_secrets(self) -> None:
+        if self.secret_reconciler is None:
+            return
+        params = {}
+        sel = self.secret_label_selector.to_string()
+        if sel:
+            params["labelSelector"] = sel
+        first = True
+        known: Dict[tuple, Secret] = {}
+        while True:
+            if not first:
+                # events during the gap are gone from the stream; replay the
+                # current state (upserts + synthesized deletes) so adds and
+                # revocations aren't lost
+                try:
+                    listed = {s.key: s for s in await self.cluster.list_secrets(self.secret_label_selector)}
+                    for key in set(known) - set(listed):
+                        self.secret_reconciler.on_event("delete", known[key])
+                    for s in listed.values():
+                        self.secret_reconciler.on_event("upsert", s)
+                    known = listed
+                except Exception as e:
+                    log.warning("secret re-list failed: %s", e)
+            first = False
+            try:
+                async for ev_type, obj in self.cluster.watch("/api/v1/secrets", params):
+                    secret = RestCluster._secret_from_obj(obj)
+                    kind = "delete" if ev_type == "DELETED" else "upsert"
+                    if kind == "delete":
+                        known.pop(secret.key, None)
+                    else:
+                        known[secret.key] = secret
+                    self.secret_reconciler.on_event(kind, secret)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("secret watch lost (%s); retrying", e)
+            await asyncio.sleep(self.resync_interval_s)
+
+    async def run(self) -> None:
+        await self._initial_sync()
+        await asyncio.gather(self._watch_auth_configs(), self._watch_secrets())
+
+    def start(self) -> "K8sWatchSource":
+        loop = asyncio.get_event_loop()
+        self._tasks = [loop.create_task(self.run())]
+        return self
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks = []

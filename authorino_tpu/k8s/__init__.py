@@ -1,3 +1,4 @@
 """Kubernetes access seam: cluster reader protocol + in-memory/REST impls."""
 
 from .client import ClusterReader, InMemoryCluster, LabelSelector, RestCluster, Secret  # noqa: F401
+from .leader import InMemoryLeases, LeaderElector, Lease  # noqa: F401

@@ -161,16 +161,53 @@ class ClusterReader(Protocol):
 
 
 class InMemoryCluster:
-    """Fake cluster for tests/standalone mode; secret mutations notify
-    subscribers (drives the secret reconciler like a watch stream)."""
+    """Fake cluster for tests/standalone mode; secret/authconfig mutations
+    notify subscribers (drives the reconcilers like watch streams)."""
 
     def __init__(self):
         self._secrets: Dict[Tuple[str, str], Secret] = {}
         self._secret_listeners: List[Callable[[str, Secret], None]] = []
+        self._auth_configs: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._auth_config_listeners: List[Callable[[str, Dict[str, Any]], None]] = []
+        self.statuses: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self.token_reviews: Dict[str, Dict[str, Any]] = {}
         self.access_reviews: Callable[[Dict[str, Any]], Dict[str, Any]] = lambda spec: {
             "status": {"allowed": False}
         }
+
+    # --- authconfigs ---
+    @staticmethod
+    def _ac_key(obj: Dict[str, Any]) -> Tuple[str, str]:
+        meta = obj.get("metadata") or {}
+        return (meta.get("namespace", "default"), meta.get("name", ""))
+
+    def put_auth_config(self, obj: Dict[str, Any]) -> None:
+        self._auth_configs[self._ac_key(obj)] = obj
+        for fn in self._auth_config_listeners:
+            fn("upsert", obj)
+
+    def remove_auth_config(self, namespace: str, name: str) -> None:
+        obj = self._auth_configs.pop((namespace, name), None)
+        if obj is not None:
+            for fn in self._auth_config_listeners:
+                fn("delete", obj)
+
+    def on_auth_config_event(self, fn: Callable[[str, Dict[str, Any]], None]) -> None:
+        self._auth_config_listeners.append(fn)
+
+    async def list_auth_configs(self, selector: Optional["LabelSelector"] = None) -> List[Dict[str, Any]]:
+        out = []
+        for obj in self._auth_configs.values():
+            labels = (obj.get("metadata") or {}).get("labels") or {}
+            if selector is None or selector.matches(labels):
+                out.append(obj)
+        return out
+
+    async def patch_auth_config_status(self, namespace: str, name: str, status: Dict[str, Any]) -> None:
+        self.statuses[(namespace, name)] = status
+        obj = self._auth_configs.get((namespace, name))
+        if obj is not None:
+            obj["status"] = status
 
     # --- secrets ---
     def put_secret(self, secret: Secret) -> None:
@@ -206,6 +243,21 @@ class InMemoryCluster:
 
     async def subject_access_review(self, spec: Dict[str, Any]) -> Dict[str, Any]:
         return self.access_reviews(spec)
+
+    # --- leases (delegated to an in-memory CAS store) ---
+    @property
+    def _lease_store(self):
+        if not hasattr(self, "_leases_impl"):
+            from .leader import InMemoryLeases
+
+            self._leases_impl = InMemoryLeases()
+        return self._leases_impl
+
+    async def get_lease(self, namespace: str, name: str):
+        return await self._lease_store.get_lease(namespace, name)
+
+    async def put_lease(self, namespace: str, name: str, lease) -> bool:
+        return await self._lease_store.put_lease(namespace, name, lease)
 
 
 class RestCluster:
@@ -304,3 +356,139 @@ class RestCluster:
         return await self._request(
             "POST", "/apis/authorization.k8s.io/v1/subjectaccessreviews", json=body
         )
+
+    # --- AuthConfig CRs (authorino.kuadrant.io) ---------------------------
+    AC_GROUP = "authorino.kuadrant.io"
+    AC_VERSION = "v1beta2"
+
+    def _ac_path(self, namespace: Optional[str] = None, name: Optional[str] = None) -> str:
+        base = f"/apis/{self.AC_GROUP}/{self.AC_VERSION}"
+        if namespace:
+            base += f"/namespaces/{namespace}"
+        base += "/authconfigs"
+        if name:
+            base += f"/{name}"
+        return base
+
+    async def list_auth_configs(self, selector: Optional[LabelSelector] = None) -> List[Dict[str, Any]]:
+        params = {}
+        if selector is not None and not selector.empty():
+            params["labelSelector"] = selector.to_string()
+        payload = await self._request("GET", self._ac_path(), params=params)
+        return payload.get("items", [])
+
+    async def patch_auth_config_status(self, namespace: str, name: str, status: Dict[str, Any]) -> None:
+        """Status subresource merge-patch (the leader-elected writer's
+        operation — ref: controllers/auth_config_status_updater.go:35-103)."""
+        await self._request(
+            "PATCH",
+            self._ac_path(namespace, name) + "/status",
+            json={"status": status},
+            headers={"Content-Type": "application/merge-patch+json"},
+        )
+
+    async def watch(self, path: str, params: Optional[Dict[str, str]] = None,
+                    timeout_seconds: int = 300):
+        """Yield (event_type, object) from a K8s watch stream (chunked JSON
+        lines).  Caller re-lists + re-watches on stream end (the informer
+        resync the reference gets from controller-runtime).  Bounded both
+        server-side (timeoutSeconds) and client-side (sock_read) so a
+        half-open TCP connection can't hang the watch forever."""
+        import aiohttp
+
+        from ..utils import http as http_util
+
+        sess = http_util.get_session()
+        q = dict(params or {})
+        q["watch"] = "true"
+        q["timeoutSeconds"] = str(timeout_seconds)
+        headers = self._auth_headers()
+        client_timeout = aiohttp.ClientTimeout(total=None, sock_read=timeout_seconds + 30)
+        async with sess.request(
+            "GET", f"{self.base_url}{path}", params=q, headers=headers,
+            ssl=self._ssl_ctx(), timeout=client_timeout,
+        ) as resp:
+            if resp.status >= 300:
+                raise RuntimeError(f"k8s watch {path}: {resp.status}")
+            buf = b""
+            async for chunk in resp.content.iter_any():
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    yield ev.get("type", ""), ev.get("object", {})
+
+    # --- Leases (coordination.k8s.io/v1) ----------------------------------
+    def _lease_path(self, namespace: str, name: Optional[str] = None) -> str:
+        p = f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+        return f"{p}/{name}" if name else p
+
+    async def get_lease(self, namespace: str, name: str):
+        from .leader import Lease
+
+        try:
+            obj = await self._request("GET", self._lease_path(namespace, name))
+        except RuntimeError as e:
+            # only not-found means "unheld"; transient API errors must NOT
+            # look like a free lease or followers would seize leadership on
+            # every apiserver blip
+            if ": 404" in str(e):
+                return None
+            raise
+        spec = obj.get("spec") or {}
+        lease = Lease(
+            holder=spec.get("holderIdentity", ""),
+            acquire_time=0.0,
+            renew_time=0.0,
+            duration_s=float(spec.get("leaseDurationSeconds", 15)),
+            transitions=int(spec.get("leaseTransitions", 0)),
+        )
+        # renewTime is RFC3339; convert to a monotonic-comparable age
+        import datetime
+        import time as _time
+
+        rt = spec.get("renewTime")
+        if rt:
+            try:
+                dt = datetime.datetime.fromisoformat(rt.replace("Z", "+00:00"))
+                age = (datetime.datetime.now(datetime.timezone.utc) - dt).total_seconds()
+                lease.renew_time = _time.monotonic() - age
+            except ValueError:
+                pass
+        lease._resource_version = (obj.get("metadata") or {}).get("resourceVersion")  # type: ignore[attr-defined]
+        return lease
+
+    async def put_lease(self, namespace: str, name: str, lease) -> bool:
+        import datetime
+
+        now_iso = datetime.datetime.now(datetime.timezone.utc).isoformat().replace("+00:00", "Z")
+        body = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {
+                "holderIdentity": lease.holder,
+                "leaseDurationSeconds": int(lease.duration_s),
+                "renewTime": now_iso,
+                "leaseTransitions": lease.transitions,
+            },
+        }
+        rv = getattr(lease, "_resource_version", None)
+        if rv:
+            body["metadata"]["resourceVersion"] = rv
+        try:
+            try:
+                await self._request("PUT", self._lease_path(namespace, name), json=body)
+            except RuntimeError as e:
+                if "404" in str(e):
+                    await self._request("POST", self._lease_path(namespace), json=body)
+                else:
+                    raise
+            return True
+        except RuntimeError:
+            return False  # conflict: another holder updated first

@@ -1,0 +1,242 @@
+"""Leader election, leader-gated status updates, conversion/validation
+webhooks, and the K8s watch-source event plumbing."""
+
+import asyncio
+import json
+
+import pytest
+
+from authorino_tpu.controllers import AuthConfigReconciler
+from authorino_tpu.controllers.reconciler import STATUS_RECONCILED
+from authorino_tpu.controllers.status_updater import AuthConfigStatusUpdater
+from authorino_tpu.k8s import InMemoryCluster, InMemoryLeases, LeaderElector
+from authorino_tpu.runtime import PolicyEngine
+from authorino_tpu.service.webhooks import convert_review, validate_review
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+SPEC = {
+    "hosts": ["api.example.com"],
+    "authorization": {
+        "allow-all": {"patternMatching": {"patterns": [
+            {"selector": "request.method", "operator": "neq", "value": ""}
+        ]}}
+    },
+}
+
+
+def make_resource(name="cfg", ns="ns1", api="authorino.kuadrant.io/v1beta2", spec=None):
+    return {
+        "apiVersion": api,
+        "kind": "AuthConfig",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": spec or SPEC,
+    }
+
+
+class TestLeaderElection:
+    def test_single_winner_and_failover(self):
+        async def body():
+            leases = InMemoryLeases()
+            a = LeaderElector(leases, "replica-a", duration_s=0.2)
+            b = LeaderElector(leases, "replica-b", duration_s=0.2)
+            assert await a.try_acquire_or_renew() is True
+            assert await b.try_acquire_or_renew() is False
+            assert a.is_leader() and not b.is_leader()
+            # renew keeps leadership
+            assert await a.try_acquire_or_renew() is True
+            # expiry → failover
+            await asyncio.sleep(0.25)
+            assert await b.try_acquire_or_renew() is True
+            assert b.is_leader()
+            assert await a.try_acquire_or_renew() is False
+            assert not a.is_leader()
+
+        run(body())
+
+    def test_voluntary_release(self):
+        async def body():
+            leases = InMemoryLeases()
+            a = LeaderElector(leases, "a", duration_s=30.0)
+            b = LeaderElector(leases, "b", duration_s=30.0)
+            assert await a.try_acquire_or_renew()
+            await a.release()
+            assert await b.try_acquire_or_renew() is True
+
+        run(body())
+
+    def test_transition_callbacks(self):
+        events = []
+
+        async def body():
+            leases = InMemoryLeases()
+            a = LeaderElector(
+                leases, "a", duration_s=0.2,
+                on_started_leading=lambda: events.append("start"),
+                on_stopped_leading=lambda: events.append("stop"),
+            )
+            await a.try_acquire_or_renew()
+            await a.release()
+            assert events == ["start", "stop"]
+
+        run(body())
+
+
+class TestStatusUpdater:
+    def test_leader_writes_status_non_leader_does_not(self):
+        async def body():
+            engine = PolicyEngine()
+            cluster = InMemoryCluster()
+            rec = AuthConfigReconciler(engine, cluster=cluster)
+            await rec.reconcile_all([make_resource()])
+            assert rec.status.get("ns1/cfg").reason == STATUS_RECONCILED
+
+            upd = AuthConfigStatusUpdater(rec, cluster, leases=cluster, namespace="ns1")
+            # not leader yet → no writes
+            assert await upd.sync_once() == 0
+            assert ("ns1", "cfg") not in cluster.statuses
+            # acquire leadership → writes
+            assert await upd.elector.try_acquire_or_renew()
+            assert await upd.sync_once() == 1
+            status = cluster.statuses[("ns1", "cfg")]
+            assert status["summary"]["ready"] is True
+            assert status["summary"]["hostsReady"] == ["api.example.com"]
+            conds = {c["type"]: c["status"] for c in status["conditions"]}
+            assert conds == {"Available": "True", "Ready": "True"}
+            # unchanged → no rewrite
+            assert await upd.sync_once() == 0
+
+        run(body())
+
+    def test_no_leader_election_mode_always_writes(self):
+        async def body():
+            engine = PolicyEngine()
+            cluster = InMemoryCluster()
+            rec = AuthConfigReconciler(engine, cluster=cluster)
+            await rec.reconcile_all([make_resource()])
+            upd = AuthConfigStatusUpdater(rec, cluster, leader_election=False)
+            assert await upd.sync_once() == 1
+
+        run(body())
+
+
+class TestConversionWebhook:
+    def test_convert_v1beta2_to_v1beta1_and_back(self):
+        obj = make_resource()
+        review = {
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "kind": "ConversionReview",
+            "request": {
+                "uid": "u1",
+                "desiredAPIVersion": "authorino.kuadrant.io/v1beta1",
+                "objects": [obj],
+            },
+        }
+        out = convert_review(review)
+        assert out["kind"] == "ConversionReview"
+        assert out["response"]["uid"] == "u1"
+        assert out["response"]["result"]["status"] == "Success"
+        (conv,) = out["response"]["convertedObjects"]
+        assert conv["apiVersion"] == "authorino.kuadrant.io/v1beta1"
+        assert conv["metadata"]["name"] == "cfg"
+        # v1beta1 uses a list-shaped authorization
+        assert isinstance(conv["spec"]["authorization"], list)
+
+        back = convert_review(
+            {
+                "request": {
+                    "uid": "u2",
+                    "desiredAPIVersion": "authorino.kuadrant.io/v1beta2",
+                    "objects": [conv],
+                }
+            }
+        )
+        (round_tripped,) = back["response"]["convertedObjects"]
+        assert round_tripped["spec"]["authorization"] == SPEC["authorization"]
+
+    def test_convert_unsupported_version(self):
+        out = convert_review(
+            {"request": {"uid": "u", "desiredAPIVersion": "authorino.kuadrant.io/v9", "objects": []}}
+        )
+        assert out["response"]["result"]["status"] == "Failure"
+
+    def test_status_preserved(self):
+        obj = make_resource()
+        obj["status"] = {"summary": {"ready": True}}
+        out = convert_review(
+            {
+                "request": {
+                    "uid": "u",
+                    "desiredAPIVersion": "authorino.kuadrant.io/v1beta1",
+                    "objects": [obj],
+                }
+            }
+        )
+        assert out["response"]["convertedObjects"][0]["status"] == obj["status"]
+
+
+class TestValidationWebhook:
+    def _review(self, obj, op="CREATE"):
+        return {"request": {"uid": "u", "operation": op, "object": obj}}
+
+    def test_valid_spec_allowed(self):
+        out = validate_review(self._review(make_resource()))
+        assert out["response"]["allowed"] is True
+
+    def test_missing_hosts_rejected(self):
+        bad = make_resource(spec={"authorization": {}})
+        out = validate_review(self._review(bad))
+        assert out["response"]["allowed"] is False
+        assert "hosts" in out["response"]["status"]["message"]
+
+    def test_unknown_field_rejected(self):
+        bad = make_resource(spec={**SPEC, "identity": []})  # v1beta1 field in a v1beta2 CR
+        out = validate_review(self._review(bad))
+        assert out["response"]["allowed"] is False
+
+    def test_delete_always_allowed(self):
+        out = validate_review(self._review(make_resource(spec={}), op="DELETE"))
+        assert out["response"]["allowed"] is True
+
+    def test_bad_regex_rejected(self):
+        bad = make_resource(spec={
+            "hosts": ["h"],
+            "authorization": {"a": {"patternMatching": {"patterns": [
+                {"selector": "request.path", "operator": "matches", "value": "([unclosed"}
+            ]}}},
+        })
+        out = validate_review(self._review(bad))
+        assert out["response"]["allowed"] is False
+        assert "pattern" in out["response"]["status"]["message"].lower() or "regex" in out["response"]["status"]["message"].lower() or "invalid" in out["response"]["status"]["message"].lower()
+
+    def test_bad_operator_rejected(self):
+        bad = make_resource(spec={
+            "hosts": ["h"],
+            "when": [{"selector": "request.path", "operator": "gte", "value": "1"}],
+        })
+        out = validate_review(self._review(bad))
+        assert out["response"]["allowed"] is False
+
+
+class TestInMemoryAuthConfigStore:
+    def test_events_and_status_patch(self):
+        async def body():
+            cluster = InMemoryCluster()
+            seen = []
+            cluster.on_auth_config_event(lambda kind, obj: seen.append((kind, obj["metadata"]["name"])))
+            cluster.put_auth_config(make_resource("a"))
+            cluster.put_auth_config(make_resource("b"))
+            cluster.remove_auth_config("ns1", "a")
+            assert seen == [("upsert", "a"), ("upsert", "b"), ("delete", "a")]
+            assert [o["metadata"]["name"] for o in await cluster.list_auth_configs()] == ["b"]
+            await cluster.patch_auth_config_status("ns1", "b", {"summary": {"ready": True}})
+            assert (await cluster.list_auth_configs())[0]["status"]["summary"]["ready"] is True
+
+        run(body())
