@@ -151,23 +151,26 @@ async def run_server(args) -> None:
 
     source = None
     status_updater = None
+    cluster = RestCluster() if args.in_cluster else InMemoryCluster()
+    reconciler = AuthConfigReconciler(
+        engine,
+        cluster=cluster,
+        label_selector=selector,
+        allow_superseding_host_subsets=args.allow_superseding_host_subsets,
+    )
+    secret_reconciler = SecretReconciler(engine, secret_label_selector=secret_selector)
     if args.in_cluster:
         # real-cluster control plane: watch AuthConfigs/Secrets, leader-elect
         # the status writer (ref: main.go:241-336)
         from .controllers.sources import K8sWatchSource
         from .controllers.status_updater import AuthConfigStatusUpdater
 
-        cluster = RestCluster()
-        reconciler = AuthConfigReconciler(
-            engine,
-            cluster=cluster,
-            label_selector=selector,
-            allow_superseding_host_subsets=args.allow_superseding_host_subsets,
-        )
-        secret_reconciler = SecretReconciler(engine, secret_label_selector=secret_selector)
         source = K8sWatchSource(
             cluster, reconciler, secret_reconciler, secret_label_selector=secret_selector
         )
+        # block serving until the first list lands (cache-sync semantics);
+        # retries internally while the apiserver is unreachable
+        await source.sync()
         source.start()
         status_updater = AuthConfigStatusUpdater(
             reconciler, cluster, leases=cluster,
@@ -175,22 +178,13 @@ async def run_server(args) -> None:
             leader_election=args.enable_leader_election,
         ).start()
         log.info("watching AuthConfigs via the Kubernetes API")
+    elif args.watch_dir:
+        source = YamlDirSource(args.watch_dir, reconciler, cluster, secret_reconciler)
+        await source.sync()
+        source.start()
+        log.info("watching manifests under %s", args.watch_dir)
     else:
-        cluster = InMemoryCluster()
-        reconciler = AuthConfigReconciler(
-            engine,
-            cluster=cluster,
-            label_selector=selector,
-            allow_superseding_host_subsets=args.allow_superseding_host_subsets,
-        )
-        secret_reconciler = SecretReconciler(engine, secret_label_selector=secret_selector)
-        if args.watch_dir:
-            source = YamlDirSource(args.watch_dir, reconciler, cluster, secret_reconciler)
-            await source.sync()
-            source.start()
-            log.info("watching manifests under %s", args.watch_dir)
-        else:
-            log.warning("no --watch-dir and not --in-cluster: serving an empty index")
+        log.warning("no --watch-dir and not --in-cluster: serving an empty index")
 
     # HTTP /check
     app = build_app(engine, readiness=reconciler.ready, max_body=args.max_http_request_body_size)

@@ -32,7 +32,7 @@ from .compile import (
 )
 from .intern import EMPTY_ID, PAD
 
-__all__ = ["EncodedBatch", "encode_batch"]
+__all__ = ["EncodedBatch", "encode_batch", "encode_batch_py"]
 
 
 @dataclass
@@ -114,9 +114,27 @@ def encode_batch(
     config_rows: Sequence[int],
     batch_pad: int = 0,
 ) -> EncodedBatch:
-    """Encode a batch of Authorization-JSON docs (one per request) against a
-    compiled corpus.  ``config_rows[i]`` is the row of the request's host's
-    config.  ``batch_pad`` pads B up for shape-bucketing."""
+    """Encode a batch against a compiled corpus — native (C++) fast path
+    when available, else the Python reference implementation below.
+    ``config_rows[i]`` is the row of the request's host's config;
+    ``batch_pad`` pads B up for shape-bucketing."""
+    from ..native import get_native_encoder  # lazy: avoids import cycle
+
+    nat = get_native_encoder(policy)
+    if nat is not None:
+        out = nat.encode_batch(docs, config_rows, batch_pad)
+        if out is not None:
+            return out
+    return encode_batch_py(policy, docs, config_rows, batch_pad)
+
+
+def encode_batch_py(
+    policy: CompiledPolicy,
+    docs: Sequence[Any],
+    config_rows: Sequence[int],
+    batch_pad: int = 0,
+) -> EncodedBatch:
+    """Pure-Python reference encoder (semantic oracle for the native path)."""
     B = max(len(docs), 1)
     if batch_pad and batch_pad > B:
         B = batch_pad

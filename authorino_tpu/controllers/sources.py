@@ -237,8 +237,27 @@ class K8sWatchSource:
                 log.warning("secret watch lost (%s); retrying", e)
             await asyncio.sleep(self.resync_interval_s)
 
+    async def sync(self, max_attempts: int = 0) -> None:
+        """Initial list with retry — serving must not start (nor readiness
+        pass) on an empty index because the apiserver was briefly down at
+        boot.  max_attempts=0 retries forever (cache-sync semantics)."""
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                await self._initial_sync()
+                self._synced = True
+                return
+            except Exception as e:
+                if max_attempts and attempt >= max_attempts:
+                    raise
+                delay = min(2.0 * attempt, self.resync_interval_s)
+                log.warning("initial AuthConfig list failed (%s); retrying in %.1fs", e, delay)
+                await asyncio.sleep(delay)
+
     async def run(self) -> None:
-        await self._initial_sync()
+        if not getattr(self, "_synced", False):
+            await self.sync()
         await asyncio.gather(self._watch_auth_configs(), self._watch_secrets())
 
     def start(self) -> "K8sWatchSource":

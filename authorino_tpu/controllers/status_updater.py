@@ -65,7 +65,12 @@ class AuthConfigStatusUpdater:
         if not self._is_writer():
             return 0
         n = 0
-        for id_, _report in self.reconciler.status.all().items():
+        reports = self.reconciler.status.all()
+        # prune deleted configs: a recreated CR must get its status re-patched
+        # even when the recomputed status equals the last written one
+        for gone in set(self._written) - set(reports):
+            del self._written[gone]
+        for id_, _report in reports.items():
             status = self.reconciler.status.status_object(id_)
             if self._written.get(id_) == status:
                 continue

@@ -466,7 +466,12 @@ class RestCluster:
     async def put_lease(self, namespace: str, name: str, lease) -> bool:
         import datetime
 
-        now_iso = datetime.datetime.now(datetime.timezone.utc).isoformat().replace("+00:00", "Z")
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if getattr(lease, "released", False):
+            # voluntary release: persist a renewTime already past the lease
+            # duration so the next candidate can take over immediately
+            now -= datetime.timedelta(seconds=lease.duration_s + 1)
+        now_iso = now.isoformat().replace("+00:00", "Z")
         body = {
             "apiVersion": "coordination.k8s.io/v1",
             "kind": "Lease",

@@ -28,9 +28,10 @@ class Lease:
     renew_time: float
     duration_s: float
     transitions: int = 0
+    released: bool = False  # voluntary give-up: backends persist it as expired
 
     def expired(self, now: float) -> bool:
-        return now - self.renew_time > self.duration_s
+        return self.released or now - self.renew_time > self.duration_s
 
 
 class LeaseClient(Protocol):
@@ -158,6 +159,7 @@ class LeaderElector:
             if cur is not None and cur.holder == self.identity:
                 # mark expired so the next candidate can take it immediately
                 cur.renew_time = time.monotonic() - cur.duration_s - 1
+                cur.released = True
                 await self.leases.put_lease(self.namespace, self.name, cur)
         except Exception:
             pass

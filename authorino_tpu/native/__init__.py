@@ -1,0 +1,93 @@
+"""Native (C++) runtime components.
+
+``native/encoder.cpp`` + ``native/pymod.cpp`` build into one extension
+module (``_atpuenc``) implementing the host half of the hot path — selector
+walk → gjson-String render → intern lookup → tensor scatter — with two
+front-ends:
+
+  - ``encode_docs``: walks the Python dict documents directly (no JSON
+    round-trip); default.
+  - ``encode_json``: parses a JSON blob GIL-free with threads — wins on
+    many-core hosts / large batches (AUTHORINO_TPU_ENCODE_MODE=json).
+
+compiler/encode.py's Python implementation is the semantic reference and the
+automatic fallback.  Builds on first use with the baked-in g++ (no pip
+deps); AUTHORINO_TPU_NATIVE=0 forces the Python path.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import subprocess
+import sysconfig
+import threading
+
+__all__ = ["load_library", "native_enabled", "NativeEncoder", "get_native_encoder"]
+
+log = logging.getLogger("authorino_tpu.native")
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "_atpuenc.so")
+
+_lock = threading.Lock()
+_mod = None
+_load_failed = False
+
+
+def native_enabled() -> bool:
+    return os.environ.get("AUTHORINO_TPU_NATIVE", "1") not in ("0", "false", "no")
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-I", sysconfig.get_paths()["include"],
+        os.path.join(_NATIVE_DIR, "pymod.cpp"),
+        "-o", _LIB_PATH + ".tmp",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        detail = getattr(e, "stderr", b"")
+        log.warning("native encoder build failed (%s); using Python encoder: %s",
+                    e, detail.decode()[:500] if detail else "")
+        return False
+
+
+def load_library():
+    """Build (if stale) and import the _atpuenc extension; None on failure."""
+    global _mod, _load_failed
+    if _mod is not None or _load_failed or not native_enabled():
+        return _mod
+    with _lock:
+        if _mod is not None or _load_failed:
+            return _mod
+        try:
+            srcs = [os.path.join(_NATIVE_DIR, f) for f in ("encoder.cpp", "pymod.cpp")]
+            stale = (not os.path.exists(_LIB_PATH)
+                     or os.path.getmtime(_LIB_PATH) < max(os.path.getmtime(s) for s in srcs))
+        except OSError:
+            stale = True
+        if stale and not _build():
+            _load_failed = True
+            return None
+        try:
+            spec = importlib.util.spec_from_file_location("_atpuenc", _LIB_PATH)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception as e:
+            log.warning("native encoder load failed: %s", e)
+            _load_failed = True
+            return None
+        _mod = mod
+        return _mod
+
+
+from .encoder import NativeEncoder, get_native_encoder  # noqa: E402,F401

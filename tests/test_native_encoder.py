@@ -1,0 +1,230 @@
+"""Differential tests: native (C++) encoder vs the Python reference encoder.
+
+Every field of EncodedBatch must be bit-identical across randomized corpora
+and documents — strings, unicode/escapes, numbers (int/float/edge renderings),
+arrays with membership overflow, nested raw-JSON values, device-regex byte
+lanes and overflows, CPU-lane regexes, whole-tree fallbacks, and
+gjson-extended (complex) selectors finished in Python."""
+
+import json
+import random
+import string
+
+import numpy as np
+import pytest
+
+from authorino_tpu.compiler import ConfigRules, compile_corpus
+from authorino_tpu.compiler.encode import encode_batch_py as encode_batch
+from authorino_tpu.expressions import All, Any_, Operator, Pattern
+from authorino_tpu.native import get_native_encoder, load_library
+
+pytestmark = pytest.mark.skipif(load_library() is None, reason="native encoder unavailable")
+
+
+def assert_same(policy, docs, rows, batch_pad=0):
+    nat = get_native_encoder(policy)
+    assert nat is not None
+    a = encode_batch(policy, docs, rows, batch_pad=batch_pad)
+    b = nat.encode_batch(docs, rows, batch_pad=batch_pad)
+    assert b is not None, "native encoder bailed"
+    for f in ("attrs_val", "attrs_members", "overflow", "cpu_lane", "config_id",
+              "attr_bytes", "byte_ovf"):
+        av, bv = getattr(a, f), getattr(b, f)
+        assert np.array_equal(av, bv), (
+            f"{f} mismatch:\npy={av}\nnative={bv}\ndocs={json.dumps(docs)[:500]}"
+        )
+
+
+def one_config(*patterns, name="cfg-0", cond=None):
+    rule = All(*patterns) if len(patterns) > 1 else patterns[0]
+    return ConfigRules(name=name, evaluators=[(cond, rule)])
+
+
+class TestScalars:
+    def test_string_values(self):
+        policy = compile_corpus([one_config(Pattern("a.b", Operator.EQ, "x"))])
+        docs = [{"a": {"b": "x"}}, {"a": {"b": "y"}}, {"a": {}}, {}, {"a": {"b": ""}}]
+        assert_same(policy, docs, [0] * len(docs))
+
+    def test_numbers_and_bools(self):
+        pats = [
+            Pattern("v.i", Operator.EQ, "42"),
+            Pattern("v.f", Operator.EQ, "1.5"),
+            Pattern("v.b", Operator.EQ, "true"),
+            Pattern("v.n", Operator.NEQ, ""),
+        ]
+        policy = compile_corpus([one_config(*pats)])
+        docs = [
+            {"v": {"i": 42, "f": 1.5, "b": True, "n": None}},
+            {"v": {"i": 42.0, "f": 3, "b": False, "n": 0}},
+            {"v": {"i": -0.0, "f": 0.1, "b": True, "n": 10**30}},
+            {"v": {"i": 1e16, "f": 1.5e-7, "b": True, "n": -12345678901234567890}},
+            {"v": {"i": 0.30000000000000004, "f": 123456789.123456789, "b": True, "n": 2**63}},
+            {"v": {"i": 1e15 + 0.5, "f": -1.2345e22, "b": False, "n": 5e-324}},
+        ]
+        assert_same(policy, docs, [0] * len(docs))
+
+    def test_unicode_and_escapes(self):
+        policy = compile_corpus([one_config(Pattern("s", Operator.EQ, "héllo\nworld"))])
+        docs = [
+            {"s": "héllo\nworld"},
+            {"s": "naïve £ → 🎉"},
+            {"s": 'quote " backslash \\ tab\t'},
+            {"s": "nul\x00byte"},
+            {"s": "\x01\x02control"},
+        ]
+        assert_same(policy, docs, [0] * len(docs))
+
+
+class TestMembership:
+    def test_arrays_and_overflow(self):
+        pats = [
+            Pattern("roles", Operator.INCL, "admin"),
+            Pattern("groups", Operator.EXCL, "banned"),
+        ]
+        policy = compile_corpus([one_config(*pats)], members_k=4)
+        docs = [
+            {"roles": ["admin"], "groups": []},
+            {"roles": ["a", "b", "c", "d", "e", "admin"], "groups": list("abcdefg") + ["banned"]},
+            {"roles": "admin", "groups": None},           # scalar / null
+            {"roles": [1, 2.5, True, None], "groups": [["nested"], {"k": "v"}]},
+            {"roles": [f"r{i}" for i in range(20)], "groups": [f"g{i}" for i in range(20)]},
+        ]
+        assert_same(policy, docs, [0] * len(docs))
+
+    def test_nested_raw_json_rendering(self):
+        policy = compile_corpus([one_config(
+            Pattern("obj", Operator.EQ, '{"a":1,"b":[true,null]}'))])
+        docs = [
+            {"obj": {"a": 1, "b": [True, None]}},
+            {"obj": {"a": 1.0, "b": [True, None]}},   # float renders 1.0 in dumps
+            {"obj": [{"x": "é"}, 2.5, -0.0]},
+            {"obj": {"k": 'str with " and \\'}},
+        ]
+        assert_same(policy, docs, [0] * len(docs))
+
+
+class TestRegexLanes:
+    def test_dfa_lane_and_byte_overflow(self):
+        policy = compile_corpus([one_config(
+            Pattern("path", Operator.MATCHES, r"^/api/v\d+/"))])
+        docs = [
+            {"path": "/api/v1/x"},
+            {"path": "/other"},
+            {"path": "/api/v" + "9" * 300 + "/long-overflow"},   # > DFA_VALUE_BYTES
+            {"path": "nul\x00inside"},
+            {"path": 123},
+        ]
+        assert_same(policy, docs, [0] * len(docs))
+
+    def test_cpu_regex_lane(self):
+        # backreference → not DFA-compilable → OP_CPU
+        policy = compile_corpus([one_config(
+            Pattern("s", Operator.MATCHES, r"(ab)\1"))])
+        docs = [{"s": "abab"}, {"s": "ab"}, {"s": ""}, {}]
+        assert_same(policy, docs, [0] * len(docs))
+
+    def test_tree_cpu_fallback(self):
+        # invalid regex → whole-tree CPU oracle leaf
+        policy = compile_corpus([one_config(
+            Pattern("a", Operator.EQ, "1"),
+            Any_(Pattern("s", Operator.MATCHES, "([bad"), Pattern("b", Operator.EQ, "2")),
+        )])
+        docs = [{"a": "1", "s": "x", "b": "2"}, {"a": "1", "b": "3"}]
+        assert_same(policy, docs, [0] * len(docs))
+
+
+class TestComplexSelectors:
+    def test_modifiers_finished_in_python(self):
+        pats = [
+            Pattern("user.name|@case:upper", Operator.EQ, "ALICE"),
+            Pattern("plain.key", Operator.EQ, "v"),
+        ]
+        policy = compile_corpus([one_config(*pats)])
+        docs = [
+            {"user": {"name": "alice"}, "plain": {"key": "v"}},
+            {"user": {"name": "Bob"}, "plain": {"key": "w"}},
+        ]
+        assert_same(policy, docs, [0] * len(docs))
+
+    def test_array_index_path(self):
+        policy = compile_corpus([one_config(Pattern("items.1.id", Operator.EQ, "second"))])
+        docs = [
+            {"items": [{"id": "first"}, {"id": "second"}]},
+            {"items": [{"id": "only"}]},
+            {"items": "not-a-list"},
+        ]
+        assert_same(policy, docs, [0] * len(docs))
+
+    def test_escaped_dot_key(self):
+        policy = compile_corpus([one_config(
+            Pattern(r"headers.x\.request\.id", Operator.EQ, "r1"))])
+        docs = [{"headers": {"x.request.id": "r1"}}, {"headers": {"x": {"request": {"id": "r1"}}}}]
+        assert_same(policy, docs, [0] * len(docs))
+
+
+class TestMultiConfigRandomized:
+    def _random_corpus(self, rng, n_configs=8):
+        configs = []
+        for i in range(n_configs):
+            pats = [Pattern("request.method", Operator.EQ, rng.choice(["GET", "POST"]))]
+            for j in range(rng.randrange(1, 5)):
+                kind = rng.random()
+                if kind < 0.2:
+                    pats.append(Pattern("request.url_path", Operator.MATCHES, rf"^/svc-{i}/r{j}"))
+                elif kind < 0.5:
+                    pats.append(Pattern("auth.identity.roles", Operator.INCL, f"role-{i}-{j}"))
+                elif kind < 0.7:
+                    pats.append(Pattern("auth.identity.groups", Operator.EXCL, f"ban-{i}"))
+                else:
+                    pats.append(Pattern(f"request.headers.h{j}", Operator.NEQ, f"v{i}"))
+            configs.append(one_config(*pats, name=f"cfg-{i}",
+                                      cond=Pattern("env", Operator.NEQ, "dev") if rng.random() < 0.3 else None))
+        return configs
+
+    def _random_doc(self, rng):
+        return {
+            "request": {
+                "method": rng.choice(["GET", "POST", "PUT"]),
+                "url_path": rng.choice(["/svc-1/r0", "/svc-0/r1", "/x", "/" + "y" * rng.choice([3, 200])]),
+                "headers": {f"h{j}": rng.choice(["v0", "v3", "", 7, None]) for j in range(rng.randrange(4))},
+            },
+            "auth": {"identity": {
+                "roles": [f"role-{rng.randrange(8)}-{rng.randrange(5)}" for _ in range(rng.randrange(12))],
+                "groups": rng.choice([[], ["ban-1"], [f"g{k}" for k in range(15)], "scalar", None]),
+            }},
+            "env": rng.choice(["dev", "prod", 1, None]),
+        }
+
+    def test_randomized_differential(self):
+        rng = random.Random(1234)
+        for trial in range(5):
+            configs = self._random_corpus(rng)
+            policy = compile_corpus(configs, members_k=4)
+            n = rng.randrange(1, 40)
+            docs = [self._random_doc(rng) for _ in range(n)]
+            rows = [rng.randrange(len(configs)) for _ in range(n)]
+            assert_same(policy, docs, rows, batch_pad=rng.choice([0, 64]))
+
+    def test_empty_batch(self):
+        policy = compile_corpus([one_config(Pattern("a", Operator.EQ, "1"))])
+        assert_same(policy, [], [], batch_pad=8)
+
+
+class TestVerdictParity:
+    """End-to-end: native-encoded batches produce identical kernel verdicts."""
+
+    def test_verdicts_match(self):
+        from authorino_tpu.ops.pattern_eval import eval_batch_jit, to_device
+
+        rng = random.Random(7)
+        tc = TestMultiConfigRandomized()
+        configs = tc._random_corpus(rng)
+        policy = compile_corpus(configs, members_k=4)
+        params = to_device(policy)
+        docs = [tc._random_doc(rng) for _ in range(32)]
+        rows = [rng.randrange(len(configs)) for _ in range(32)]
+        nat = get_native_encoder(policy)
+        own_py, _ = eval_batch_jit(params, encode_batch(policy, docs, rows))
+        own_nat, _ = eval_batch_jit(params, nat.encode_batch(docs, rows))
+        assert np.array_equal(own_py, own_nat)
