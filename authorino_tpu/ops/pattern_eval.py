@@ -1,6 +1,5 @@
-"""Batched policy-evaluation kernel (pure JAX/XLA; int32 compares + masked
-boolean reductions — VPU-friendly, static shapes, no data-dependent control
-flow).
+"""Batched policy-evaluation kernel (pure JAX/XLA; static shapes, no
+data-dependent control flow).
 
 One call evaluates a micro-batch of requests against the *entire* compiled
 rule corpus and returns per-request per-config allow verdicts.  This replaces
@@ -8,10 +7,23 @@ the reference's per-request goroutine fan-out + per-pattern gjson walk
 (ref: pkg/service/auth_pipeline.go:150-182, pkg/jsonexp/expressions.go:59):
 equal-priority rules across all configs fuse into one kernel launch
 (SURVEY.md §2 P1/P2 mapping).
+
+Two lanes:
+
+  - ``matmul`` (default): gathers are pathological on TPU (scalar-unit
+    loops), so every gather is reformulated as a one-hot matmul on the MXU —
+    leaf operand gathers ride ``attrs @ attr_onehot``, the boolean circuit
+    becomes per-level count matmuls (AND ≡ count==width, OR ≡ count>0), and
+    per-config verdict extraction is an einsum against a one-hot of
+    ``config_id``.  bf16 operands, f32 accumulation — exact for 0/1 values
+    and interner ids < 2^24.
+  - ``gather``: the direct jnp.take formulation (reference lane; also used
+    when an interner outgrows exact-f32 range).
 """
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional, Tuple
 
@@ -35,15 +47,61 @@ from ..compiler.compile import (
 
 __all__ = ["DevicePolicy", "to_device", "eval_verdicts", "eval_batch_jit"]
 
+# exact integer range of f32 accumulation — larger interners must use the
+# gather lane
+_F32_EXACT = 1 << 24
+
+
+def _eval_lane() -> str:
+    return os.environ.get("AUTHORINO_TPU_EVAL_LANE", "matmul")
+
+
+def _matmul_operands(policy: CompiledPolicy) -> dict:
+    """One-hot / count matrices for the MXU lane (bf16; see module doc)."""
+    L = policy.n_leaves
+    A = policy.n_attrs
+    attr_onehot = np.zeros((A, L), dtype=np.float32)
+    attr_onehot[policy.leaf_attr, np.arange(L)] = 1.0
+
+    # per-level count matrices over the buffer prefix visible to that level
+    level_mats = []
+    cursor = 2 + L  # TRUE/FALSE slots + leaf block
+    for children, is_and in policy.levels:
+        rows, width = children.shape
+        m = np.zeros((rows, cursor), dtype=np.float32)
+        np.add.at(m, (np.repeat(np.arange(rows), width), children.reshape(-1)), 1.0)
+        level_mats.append((m, width))
+        cursor += rows
+
+    # eval-table one-hots over the full buffer
+    G, E = policy.eval_rule.shape
+    rule_m = np.zeros((G * E, cursor), dtype=np.float32)
+    rule_m[np.arange(G * E), policy.eval_rule.reshape(-1)] = 1.0
+    cond_m = np.zeros((G * E, cursor), dtype=np.float32)
+    cond_m[np.arange(G * E), policy.eval_cond.reshape(-1)] = 1.0
+    return {
+        "attr_onehot": attr_onehot.astype(jnp.bfloat16),
+        "level_mats": tuple(
+            (m.astype(jnp.bfloat16), np.int32(w)) for m, w in level_mats
+        ),
+        "rule_m": rule_m.astype(jnp.bfloat16),
+        "cond_m": cond_m.astype(jnp.bfloat16),
+    }
+
 
 def to_device(policy: CompiledPolicy, device=None) -> dict:
     """Upload a compiled corpus's operands as a pytree of device arrays.
     The engine double-buffers these and swaps atomically on reconcile
     (SURVEY.md §3.4: rule-tensor compile + device upload on index Set)."""
     put = partial(jax.device_put, device=device) if device is not None else jax.device_put
+    lane = _eval_lane()
+    if lane == "matmul" and len(policy.interner) >= _F32_EXACT:
+        lane = "gather"  # ids no longer exact in f32 accumulation
+    mm = jax.tree.map(put, _matmul_operands(policy)) if lane == "matmul" else None
     # per-dfa-row byte-tensor slot (attr → slot mapping folded in here)
     dfa_byte_slot = np.maximum(policy.attr_byte_slot[policy.dfa_leaf_attr], 0)
     return {
+        "matmul": mm,
         "leaf_op": put(jnp.asarray(policy.leaf_op)),
         "leaf_attr": put(jnp.asarray(policy.leaf_attr)),
         "leaf_const": put(jnp.asarray(policy.leaf_const)),
