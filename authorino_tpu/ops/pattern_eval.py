@@ -10,15 +10,29 @@ equal-priority rules across all configs fuse into one kernel launch
 
 Two lanes:
 
-  - ``matmul`` (default): gathers are pathological on TPU (scalar-unit
-    loops), so every gather is reformulated as a one-hot matmul on the MXU —
-    leaf operand gathers ride ``attrs @ attr_onehot``, the boolean circuit
-    becomes per-level count matmuls (AND ≡ count==width, OR ≡ count>0), and
-    per-config verdict extraction is an einsum against a one-hot of
-    ``config_id``.  bf16 operands, f32 accumulation — exact for 0/1 values
-    and interner ids < 2^24.
-  - ``gather``: the direct jnp.take formulation (reference lane; also used
-    when an interner outgrows exact-f32 range).
+  - ``matmul`` (default): gathers are pathological on TPU (they lower to
+    scalar-unit loops), so every gather is reformulated as a one-hot matmul
+    on the MXU:
+      * leaf operand selection rides ``attrs @ attr_onehot`` with
+        ``Precision.HIGHEST`` — XLA's 3-pass bf16 decomposition makes the
+        f32 product exact, and selecting through an exact 0/1 one-hot
+        reassembles interner ids < 2^24 bit-exactly;
+      * the boolean circuit becomes per-level *count* matmuls over the
+        result buffer (AND ≡ count==width since And-padding children point
+        at the constant-TRUE slot; OR ≡ count>0 with FALSE-slot padding);
+      * per-config rule/condition extraction and own-config selection are
+        one-hot matmuls/masked reductions;
+      * the regex-DFA byte scan keeps its ``lax.scan`` skeleton but each
+        step's transition lookup becomes a batched
+        (byte-one-hot × transition-table) matmul — values ≤ 255 are exact
+        in bf16.
+  - ``gather``: the direct jnp.take formulation — the semantic reference
+    for differential tests, and the automatic fallback when the interner
+    outgrows exact-f32 range (ids ≥ 2^24).
+
+Lane dispatch is structural: ``to_device`` builds the matmul operands (or
+not), and ``eval_verdicts`` branches on their presence at trace time, so the
+two lanes jit-cache independently.
 """
 
 from __future__ import annotations
@@ -51,15 +65,29 @@ __all__ = ["DevicePolicy", "to_device", "eval_verdicts", "eval_batch_jit"]
 # gather lane
 _F32_EXACT = 1 << 24
 
+_HIGH = jax.lax.Precision.HIGHEST
+
 
 def _eval_lane() -> str:
     return os.environ.get("AUTHORINO_TPU_EVAL_LANE", "matmul")
 
 
-def _matmul_operands(policy: CompiledPolicy) -> dict:
-    """One-hot / count matrices for the MXU lane (bf16; see module doc)."""
+def _mm_dtype(device=None):
+    """bf16 on MXU-bearing backends; f32 on CPU (whose dot kernels lack
+    BF16×BF16→F32 — and where f32 one-hot matmuls are exact natively).
+    Derived from the *target* device's platform when one is given."""
+    platform = device.platform if device is not None else jax.default_backend()
+    return jnp.float32 if platform == "cpu" else jnp.bfloat16
+
+
+def _matmul_operands(policy: CompiledPolicy, row_slot: np.ndarray, device=None) -> dict:
+    """One-hot / count matrices for the MXU lane (see module doc).
+    ``row_slot`` is the per-DFA-row byte-tensor slot (shared with the gather
+    lane's ``dfa_byte_slot`` so the two lanes can never disagree on which
+    byte tensor a row scans)."""
     L = policy.n_leaves
     A = policy.n_attrs
+    cdt = _mm_dtype(device)
     attr_onehot = np.zeros((A, L), dtype=np.float32)
     attr_onehot[policy.leaf_attr, np.arange(L)] = 1.0
 
@@ -70,7 +98,7 @@ def _matmul_operands(policy: CompiledPolicy) -> dict:
         rows, width = children.shape
         m = np.zeros((rows, cursor), dtype=np.float32)
         np.add.at(m, (np.repeat(np.arange(rows), width), children.reshape(-1)), 1.0)
-        level_mats.append((m, width))
+        level_mats.append((m.astype(cdt), float(width)))
         cursor += rows
 
     # eval-table one-hots over the full buffer
@@ -79,14 +107,37 @@ def _matmul_operands(policy: CompiledPolicy) -> dict:
     rule_m[np.arange(G * E), policy.eval_rule.reshape(-1)] = 1.0
     cond_m = np.zeros((G * E, cursor), dtype=np.float32)
     cond_m[np.arange(G * E), policy.eval_cond.reshape(-1)] = 1.0
-    return {
-        "attr_onehot": attr_onehot.astype(jnp.bfloat16),
-        "level_mats": tuple(
-            (m.astype(jnp.bfloat16), np.int32(w)) for m, w in level_mats
-        ),
-        "rule_m": rule_m.astype(jnp.bfloat16),
-        "cond_m": cond_m.astype(jnp.bfloat16),
+
+    out = {
+        "attr_onehot": attr_onehot,  # f32: exact selection via HIGHEST
+        "level_mats": tuple(level_mats),
+        "rule_m": rule_m.astype(cdt),
+        "cond_m": cond_m.astype(cdt),
     }
+
+    # device regex lane: matmul-form transition tables + spread one-hots
+    if policy.n_byte_attrs:
+        R = policy.dfa_tables.shape[0]
+        NB = policy.n_byte_attrs
+        slot_row_oh = np.zeros((NB, R), dtype=np.float32)
+        slot_row_oh[row_slot, np.arange(R)] = 1.0
+        is_dfa_leaf = policy.leaf_op == OP_REGEX_DFA
+        row_leaf_oh = np.zeros((R, L), dtype=np.float32)
+        row_leaf_oh[policy.leaf_dfa_row[is_dfa_leaf], np.nonzero(is_dfa_leaf)[0]] = 1.0
+        slot_leaf_oh = np.zeros((NB, L), dtype=np.float32)
+        leaf_slot = row_slot[policy.leaf_dfa_row[is_dfa_leaf]]
+        slot_leaf_oh[leaf_slot, np.nonzero(is_dfa_leaf)[0]] = 1.0
+        out.update(
+            {
+                # next-state values ≤ 255 and state count ≤ 256: exact in bf16
+                "dfa_tables_f": policy.dfa_tables.astype(cdt),
+                "dfa_accept_f": policy.dfa_accept.astype(cdt),
+                "slot_row_oh": slot_row_oh.astype(cdt),
+                "row_leaf_oh": row_leaf_oh.astype(cdt),
+                "slot_leaf_oh": slot_leaf_oh.astype(cdt),
+            }
+        )
+    return out
 
 
 def to_device(policy: CompiledPolicy, device=None) -> dict:
@@ -95,11 +146,16 @@ def to_device(policy: CompiledPolicy, device=None) -> dict:
     (SURVEY.md §3.4: rule-tensor compile + device upload on index Set)."""
     put = partial(jax.device_put, device=device) if device is not None else jax.device_put
     lane = _eval_lane()
-    if lane == "matmul" and len(policy.interner) >= _F32_EXACT:
+    if lane == "matmul" and len(policy.interner) + 4 >= _F32_EXACT:
         lane = "gather"  # ids no longer exact in f32 accumulation
-    mm = jax.tree.map(put, _matmul_operands(policy)) if lane == "matmul" else None
-    # per-dfa-row byte-tensor slot (attr → slot mapping folded in here)
+    # per-dfa-row byte-tensor slot (attr → slot mapping folded in here);
+    # shared by both lanes
     dfa_byte_slot = np.maximum(policy.attr_byte_slot[policy.dfa_leaf_attr], 0)
+    mm = (
+        jax.tree.map(put, _matmul_operands(policy, dfa_byte_slot, device=device))
+        if lane == "matmul"
+        else None
+    )
     return {
         "matmul": mm,
         "leaf_op": put(jnp.asarray(policy.leaf_op)),
@@ -125,16 +181,130 @@ def to_device(policy: CompiledPolicy, device=None) -> dict:
 DevicePolicy = dict
 
 
-def eval_verdicts(
-    params: DevicePolicy,
-    attrs_val: jnp.ndarray,      # [B, A] int32
-    attrs_members: jnp.ndarray,  # [B, A, K] int32
-    overflow: jnp.ndarray,       # [B, A] bool
-    cpu_lane: jnp.ndarray,       # [B, L] bool
-    attr_bytes: Optional[jnp.ndarray] = None,  # [B, NB, LB] uint8
-    byte_ovf: Optional[jnp.ndarray] = None,    # [B, NB] bool
-) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
-    """Returns (verdict [B, G] bool, (rule_results [B, G, E], skipped [B, G, E]))."""
+def _leaf_op_cascade(leaf_op, eq, incl, ovf, dfa_leaf_val, cpu_lane):
+    """Shared op-code dispatch: per-leaf boolean results from the lane's
+    primitive comparisons (identical semantics in both lanes)."""
+    op = leaf_op[None, :]
+    return jnp.where(
+        op == OP_EQ, eq,
+        jnp.where(
+            op == OP_NEQ, ~eq,
+            jnp.where(
+                op == OP_INCL, jnp.where(ovf, cpu_lane, incl),
+                jnp.where(
+                    op == OP_EXCL, jnp.where(ovf, cpu_lane, ~incl),
+                    jnp.where(
+                        op == OP_REGEX_DFA, dfa_leaf_val,
+                        # OP_CPU (regex) and OP_TREE_CPU ride the lane; OP_ERROR → False
+                        jnp.where((op == OP_CPU) | (op == OP_TREE_CPU), cpu_lane, False),
+                    ),
+                ),
+            ),
+        ),
+    )
+
+
+def _verdict_from_tables(params, cond, rule):
+    """Shared tail: per-config verdicts ∧ over evaluators of (¬cond ∨ rule)."""
+    skipped = params["eval_has_cond"][None, :, :] & ~cond
+    contrib = jnp.where(skipped, True, rule)
+    verdict = jnp.all(contrib, axis=-1)  # [B, G]
+    return verdict, (rule, skipped)
+
+
+# ---------------------------------------------------------------------------
+# matmul lane (MXU)
+# ---------------------------------------------------------------------------
+
+
+def _eval_verdicts_matmul(params, attrs_val, attrs_members, overflow, cpu_lane,
+                          attr_bytes, byte_ovf):
+    mm = params["matmul"]
+    f32 = jnp.float32
+    cdt = mm["rule_m"].dtype
+    B = attrs_val.shape[0]
+    attr_oh = mm["attr_onehot"]                              # [A, L] f32
+    const = params["leaf_const"].astype(f32)                 # [L]
+
+    # ---- leaf selection: one-hot matmuls, exact in f32 -------------------
+    val = jnp.matmul(attrs_val.astype(f32), attr_oh, precision=_HIGH)  # [B, L]
+    eq = val == const[None, :]
+    memb = jnp.einsum(
+        "bak,al->bkl", attrs_members.astype(f32), attr_oh, precision=_HIGH
+    )                                                        # [B, K, L]
+    incl = jnp.any(memb == const[None, None, :], axis=1)     # [B, L]
+    ovf = jnp.matmul(overflow.astype(f32), attr_oh, precision=_HIGH) > 0.5
+
+    # ---- device regex lane: DFA scan, transitions as batched matmuls -----
+    if params["dfa_tables"] is not None and attr_bytes is not None:
+        tables = mm["dfa_tables_f"]                          # [R, S, 256] bf16
+        R, S = tables.shape[0], tables.shape[1]
+        # spread each row's attr bytes from its slot: [B, NB, LB] → [B, R, LB]
+        row_bytes = jnp.einsum(
+            "bnl,nr->brl", attr_bytes.astype(cdt), mm["slot_row_oh"],
+            preferred_element_type=f32,
+        )
+        iota_s = jnp.arange(S, dtype=f32)
+        iota_c = jnp.arange(256, dtype=f32)
+
+        def dfa_step(state, byte_col):  # state [B,R] f32; byte_col [B,R] f32
+            byte_oh = (byte_col[..., None] == iota_c).astype(cdt)   # [B,R,256]
+            # per-state next-state given this byte: [R,S,256] × [B,R,256]
+            nxt_by_state = jnp.einsum(
+                "rsc,brc->brs", tables, byte_oh, preferred_element_type=f32
+            )
+            st_oh = (state[..., None] == iota_s).astype(f32)
+            nxt = jnp.sum(st_oh * nxt_by_state, axis=-1)
+            return nxt, None
+
+        init = jnp.zeros((B, R), dtype=f32)
+        final, _ = jax.lax.scan(dfa_step, init, jnp.transpose(row_bytes, (2, 0, 1)))
+        final_oh = (final[..., None] == iota_s).astype(cdt)
+        dfa_row_res = jnp.einsum(
+            "brs,rs->br", final_oh, mm["dfa_accept_f"], preferred_element_type=f32
+        ) > 0.5                                              # [B, R]
+        leaf_dfa = jnp.einsum(
+            "br,rl->bl", dfa_row_res.astype(cdt), mm["row_leaf_oh"],
+            preferred_element_type=f32,
+        ) > 0.5
+        leaf_bovf = jnp.einsum(
+            "bn,nl->bl", byte_ovf.astype(cdt), mm["slot_leaf_oh"],
+            preferred_element_type=f32,
+        ) > 0.5
+        dfa_leaf_val = jnp.where(leaf_bovf, cpu_lane, leaf_dfa)
+    else:
+        dfa_leaf_val = cpu_lane  # regexes ride the CPU lane entirely
+
+    res = _leaf_op_cascade(params["leaf_op"], eq, incl, ovf, dfa_leaf_val, cpu_lane)
+
+    # ---- boolean circuit: per-level count matmuls ------------------------
+    true_col = jnp.ones((B, 1), dtype=bool)
+    false_col = jnp.zeros((B, 1), dtype=bool)
+    buffer = jnp.concatenate([true_col, false_col, res], axis=1)
+    for (m, width), (_, is_and) in zip(mm["level_mats"], params["levels"]):
+        counts = jnp.matmul(
+            buffer.astype(cdt), m.T, preferred_element_type=f32
+        )                                                    # [B, rows]
+        # And-padding children point at TRUE (count includes them); Or-padding
+        # at FALSE (adds 0) — so count==width ≡ all, count>0 ≡ any
+        node = jnp.where(is_and[None, :], counts >= width - 0.5, counts > 0.5)
+        buffer = jnp.concatenate([buffer, node], axis=1)
+
+    # ---- per-config rule/cond extraction: one-hot matmuls ----------------
+    buf16 = buffer.astype(cdt)
+    G, E = params["eval_rule"].shape
+    rule = (jnp.matmul(buf16, mm["rule_m"].T, preferred_element_type=f32) > 0.5)
+    cond = (jnp.matmul(buf16, mm["cond_m"].T, preferred_element_type=f32) > 0.5)
+    return _verdict_from_tables(params, cond.reshape(B, G, E), rule.reshape(B, G, E))
+
+
+# ---------------------------------------------------------------------------
+# gather lane (semantic reference / large-interner fallback)
+# ---------------------------------------------------------------------------
+
+
+def _eval_verdicts_gather(params, attrs_val, attrs_members, overflow, cpu_lane,
+                          attr_bytes, byte_ovf):
     leaf_op = params["leaf_op"]          # [L]
     leaf_attr = params["leaf_attr"]      # [L]
     leaf_const = params["leaf_const"]    # [L]
@@ -169,24 +339,7 @@ def eval_verdicts(
     else:
         dfa_leaf_val = cpu_lane  # regexes ride the CPU lane entirely
 
-    op = leaf_op[None, :]
-    res = jnp.where(
-        op == OP_EQ, eq,
-        jnp.where(
-            op == OP_NEQ, ~eq,
-            jnp.where(
-                op == OP_INCL, jnp.where(ovf, cpu_lane, incl),
-                jnp.where(
-                    op == OP_EXCL, jnp.where(ovf, cpu_lane, ~incl),
-                    jnp.where(
-                        op == OP_REGEX_DFA, dfa_leaf_val,
-                        # OP_CPU (regex) and OP_TREE_CPU ride the lane; OP_ERROR → False
-                        jnp.where((op == OP_CPU) | (op == OP_TREE_CPU), cpu_lane, False),
-                    ),
-                ),
-            ),
-        ),
-    )
+    res = _leaf_op_cascade(leaf_op, eq, incl, ovf, dfa_leaf_val, cpu_lane)
 
     # ---- boolean-circuit reduction, level by level -----------------------
     true_col = jnp.ones((B, 1), dtype=bool)
@@ -198,16 +351,38 @@ def eval_verdicts(
         node = jnp.where(is_and[None, :], jnp.all(ch, axis=-1), jnp.any(ch, axis=-1))
         buffer = jnp.concatenate([buffer, node], axis=1)
 
-    # ---- per-config verdicts: ∧ over evaluators of (¬cond ∨ rule) --------
+    # ---- per-config verdicts ---------------------------------------------
     cond = jnp.take(buffer, params["eval_cond"].reshape(-1), axis=1)
     rule = jnp.take(buffer, params["eval_rule"].reshape(-1), axis=1)
     G, E = params["eval_rule"].shape
-    cond = cond.reshape(B, G, E)
-    rule = rule.reshape(B, G, E)
-    skipped = params["eval_has_cond"][None, :, :] & ~cond
-    contrib = jnp.where(skipped, True, rule)
-    verdict = jnp.all(contrib, axis=-1)                      # [B, G]
-    return verdict, (rule, skipped)
+    return _verdict_from_tables(
+        params, cond.reshape(B, G, E), rule.reshape(B, G, E)
+    )
+
+
+def eval_verdicts(
+    params: DevicePolicy,
+    attrs_val: jnp.ndarray,      # [B, A] int32
+    attrs_members: jnp.ndarray,  # [B, A, K] int32
+    overflow: jnp.ndarray,       # [B, A] bool
+    cpu_lane: jnp.ndarray,       # [B, L] bool
+    attr_bytes: Optional[jnp.ndarray] = None,  # [B, NB, LB] uint8
+    byte_ovf: Optional[jnp.ndarray] = None,    # [B, NB] bool
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Returns (verdict [B, G] bool, (rule_results [B, G, E], skipped [B, G, E]))."""
+    if params.get("matmul") is not None:
+        return _eval_verdicts_matmul(
+            params, attrs_val, attrs_members, overflow, cpu_lane, attr_bytes, byte_ovf
+        )
+    return _eval_verdicts_gather(
+        params, attrs_val, attrs_members, overflow, cpu_lane, attr_bytes, byte_ovf
+    )
+
+
+def _select_own(config_id: jnp.ndarray, n_configs: int) -> jnp.ndarray:
+    """[B, G] one-hot row mask of each request's own config (mask-reduce
+    instead of take_along_axis: gathers serialize on TPU)."""
+    return config_id[:, None] == jnp.arange(n_configs, dtype=config_id.dtype)[None, :]
 
 
 def forward(params, attrs_val, attrs_members, overflow, cpu_lane, config_id,
@@ -218,8 +393,8 @@ def forward(params, attrs_val, attrs_members, overflow, cpu_lane, config_id,
     verdict, _ = eval_verdicts(
         params, attrs_val, attrs_members, overflow, cpu_lane, attr_bytes, byte_ovf
     )
-    # select each request's own config column
-    own = jnp.take_along_axis(verdict, config_id[:, None], axis=1)[:, 0]
+    own_mask = _select_own(config_id, verdict.shape[1])
+    own = jnp.any(verdict & own_mask, axis=1)
     return own, verdict
 
 
@@ -235,10 +410,10 @@ def eval_full_jit(params, attrs_val, attrs_members, overflow, cpu_lane, config_i
     verdict, (rule, skipped) = eval_verdicts(
         params, attrs_val, attrs_members, overflow, cpu_lane, attr_bytes, byte_ovf
     )
-    own = jnp.take_along_axis(verdict, config_id[:, None], axis=1)[:, 0]
-    idx = config_id[:, None, None]
-    own_rule = jnp.take_along_axis(rule, idx, axis=1)[:, 0, :]
-    own_skipped = jnp.take_along_axis(skipped, idx, axis=1)[:, 0, :]
+    own_mask = _select_own(config_id, verdict.shape[1])
+    own = jnp.any(verdict & own_mask, axis=1)
+    own_rule = jnp.any(rule & own_mask[:, :, None], axis=1)
+    own_skipped = jnp.any(skipped & own_mask[:, :, None], axis=1)
     return own, own_rule, own_skipped
 
 

@@ -1,0 +1,122 @@
+"""Differential test: matmul (MXU) lane vs gather lane of the evaluation
+kernel — same compiled corpus, same encoded batches, bit-identical outputs.
+
+The gather lane is the semantic reference (ops/pattern_eval.py module doc);
+the matmul lane is the default serving lane.  A bf16 variant runs only where
+the backend has MXU-style bf16 dot support (skipped on CPU CI, exercised on
+real TPU runs)."""
+
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from authorino_tpu.compiler import ConfigRules, compile_corpus
+from authorino_tpu.compiler.encode import encode_batch_py
+from authorino_tpu.expressions import All, Any_, Operator, Pattern
+from authorino_tpu.ops import pattern_eval as pe
+
+
+def _mixed_corpus(n_configs=23, seed=5):
+    rng = random.Random(seed)
+    configs = []
+    for i in range(n_configs):
+        pats = [
+            Pattern("request.method", Operator.EQ, rng.choice(["GET", "POST"])),
+            Pattern("auth.identity.org", Operator.NEQ, f"org-{i % 7}"),
+            Pattern("auth.identity.roles", Operator.INCL, f"role-{i % 5}"),
+            Pattern("auth.identity.groups", Operator.EXCL, f"banned-{i % 3}"),
+            Pattern("request.url_path", Operator.MATCHES, rf"^/svc-{i % 4}/"),
+        ]
+        rule = All(pats[0], Any_(*pats[1:]))
+        cond = Pattern("request.headers.x-env", Operator.NEQ, "dev") if i % 2 else None
+        configs.append(ConfigRules(name=f"cfg-{i}", evaluators=[(cond, rule)]))
+    return configs
+
+
+def _docs(n, seed=11):
+    rng = random.Random(seed)
+    docs = []
+    for _ in range(n):
+        docs.append(
+            {
+                "request": {
+                    "method": rng.choice(["GET", "POST", "PUT"]),
+                    "url_path": rng.choice(["/svc-0/a", "/svc-1/b", "/other", "/svc-3/"]),
+                    "headers": {"x-env": rng.choice(["dev", "prod"])},
+                },
+                "auth": {
+                    "identity": {
+                        "org": f"org-{rng.randrange(9)}",
+                        "roles": [f"role-{rng.randrange(7)}" for _ in range(rng.randrange(0, 20))],
+                        "groups": [f"banned-{rng.randrange(5)}" for _ in range(rng.randrange(0, 3))],
+                    }
+                },
+            }
+        )
+    return docs
+
+
+def _both_lane_params(policy, monkeypatch):
+    monkeypatch.setenv("AUTHORINO_TPU_EVAL_LANE", "matmul")
+    params_mm = pe.to_device(policy)
+    monkeypatch.setenv("AUTHORINO_TPU_EVAL_LANE", "gather")
+    params_g = pe.to_device(policy)
+    assert params_mm["matmul"] is not None
+    assert params_g["matmul"] is None
+    return params_mm, params_g
+
+
+def test_matmul_lane_matches_gather_lane(monkeypatch):
+    policy = compile_corpus(_mixed_corpus(), members_k=4)
+    params_mm, params_g = _both_lane_params(policy, monkeypatch)
+    docs = _docs(64)
+    rows = [i % policy.n_configs for i in range(len(docs))]
+    enc = encode_batch_py(policy, docs, rows, batch_pad=64)
+    args = (
+        jnp.asarray(enc.attrs_val),
+        jnp.asarray(enc.attrs_members),
+        jnp.asarray(enc.overflow),
+        jnp.asarray(enc.cpu_lane),
+        jnp.asarray(enc.attr_bytes),
+        jnp.asarray(enc.byte_ovf),
+    )
+    v_mm, (r_mm, s_mm) = pe.eval_verdicts(params_mm, *args)
+    v_g, (r_g, s_g) = pe.eval_verdicts(params_g, *args)
+    np.testing.assert_array_equal(np.asarray(v_mm), np.asarray(v_g))
+    np.testing.assert_array_equal(np.asarray(r_mm), np.asarray(r_g))
+    np.testing.assert_array_equal(np.asarray(s_mm), np.asarray(s_g))
+
+
+def test_matmul_lane_bf16_matches_gather_lane(monkeypatch):
+    """bf16 operand numerics (the real TPU configuration)."""
+    if jax.default_backend() == "cpu":
+        pytest.skip("CPU dot kernels lack BF16xBF16->F32")
+    policy = compile_corpus(_mixed_corpus(31), members_k=4)
+    params_mm, params_g = _both_lane_params(policy, monkeypatch)
+    assert params_mm["matmul"]["rule_m"].dtype == jnp.bfloat16
+    docs = _docs(128, seed=17)
+    rows = [i % policy.n_configs for i in range(len(docs))]
+    enc = encode_batch_py(policy, docs, rows, batch_pad=128)
+    args = (
+        jnp.asarray(enc.attrs_val),
+        jnp.asarray(enc.attrs_members),
+        jnp.asarray(enc.overflow),
+        jnp.asarray(enc.cpu_lane),
+        jnp.asarray(enc.attr_bytes),
+        jnp.asarray(enc.byte_ovf),
+    )
+    v_mm, _ = pe.eval_verdicts(params_mm, *args)
+    v_g, _ = pe.eval_verdicts(params_g, *args)
+    np.testing.assert_array_equal(np.asarray(v_mm), np.asarray(v_g))
+
+
+def test_interner_overflow_falls_back_to_gather(monkeypatch):
+    policy = compile_corpus(_mixed_corpus(5), members_k=4)
+    monkeypatch.setenv("AUTHORINO_TPU_EVAL_LANE", "matmul")
+    monkeypatch.setattr(pe, "_F32_EXACT", len(policy.interner))
+    params = pe.to_device(policy)
+    assert params["matmul"] is None  # ids no longer exact in f32
