@@ -72,6 +72,8 @@ class ShapeTargets:
     n_attrs: int                       # padded A
     max_e: int                         # evaluator columns
     levels: Tuple[Tuple[int, int], ...]  # per level: (rows, children width)
+    n_member_attrs: int = 1            # compact membership rows (M)
+    n_cpu_leaves: int = 1              # dense CPU-lane columns (C)
 
     @staticmethod
     def union(shapes: Sequence["ShapeTargets"]) -> "ShapeTargets":
@@ -86,6 +88,8 @@ class ShapeTargets:
             n_attrs=max(s.n_attrs for s in shapes),
             max_e=max(s.max_e for s in shapes),
             levels=tuple(levels),
+            n_member_attrs=max(s.n_member_attrs for s in shapes),
+            n_cpu_leaves=max(s.n_cpu_leaves for s in shapes),
         )
 
 
@@ -146,6 +150,20 @@ class CompiledPolicy:
     leaf_is_membership: np.ndarray       # [L] bool — incl/excl (overflow-capable)
     members_k: int                       # K: membership vector width
 
+    # --- transfer-compaction metadata (see compiler/pack.py) ---
+    # attr → row in the compact [B, M, K] membership tensor (-1: attr has no
+    # incl/excl leaf and its members are never read by the kernel)
+    member_attr_slot: np.ndarray         # [A] int32
+    member_attrs: np.ndarray             # [M_real] int32 (attrs with slot >= 0)
+    n_member_attrs: int                  # M (padded >= 1)
+    # leaves whose value rides the dense CPU lane: op CPU/TREE_CPU always,
+    # plus REGEX_DFA (column read only under byte-overflow)
+    cpu_leaf_list: np.ndarray            # [C_real] int32 leaf idxs
+    n_cpu_leaves: int                    # C (padded >= 1)
+    # original expressions per config evaluator — the host-fallback oracle
+    # for requests the compact encoding cannot represent (membership overflow)
+    config_exprs: List[List[Tuple[Optional[Expression], Expression]]]
+
     @property
     def n_leaves(self) -> int:
         return int(self.leaf_op.shape[0])
@@ -168,6 +186,8 @@ class CompiledPolicy:
             self.n_leaves,
             self.n_attrs,
             self.members_k,
+            self.n_member_attrs,
+            self.n_cpu_leaves,
             tuple((lv[0].shape, ) for lv in self.levels),
             self.eval_rule.shape,
         )
@@ -178,6 +198,8 @@ class CompiledPolicy:
             n_attrs=len(self.attr_selectors),
             max_e=int(self.eval_rule.shape[1]),
             levels=tuple((int(c.shape[0]), int(c.shape[1])) for c, _ in self.levels),
+            n_member_attrs=self.n_member_attrs,
+            n_cpu_leaves=self.n_cpu_leaves,
         )
 
 
@@ -484,6 +506,28 @@ def compile_corpus(
         config_attrs.append(sorted(a))
         config_cpu_leaves.append(sorted(cl))
 
+    # 7. transfer-compaction metadata: which attrs' membership vectors the
+    # kernel can ever read (incl/excl leaves), and which leaves ride the
+    # dense CPU lane (true-CPU regex/tree leaves; DFA leaves' columns are
+    # read only under byte-overflow)
+    member_attr_slot = np.full((Ap,), -1, dtype=np.int32)
+    member_attrs_list: List[int] = []
+    for i in range(n_leaves):
+        if leaf_is_membership[i]:
+            a_i = int(leaf_attr[i])
+            if member_attr_slot[a_i] < 0:
+                member_attr_slot[a_i] = len(member_attrs_list)
+                member_attrs_list.append(a_i)
+    M = targets.n_member_attrs if targets is not None else max(len(member_attrs_list), 1)
+    assert M >= max(len(member_attrs_list), 1), "targets.n_member_attrs too small"
+
+    cpu_leaf_list_: List[int] = [
+        i for i in range(n_leaves)
+        if leaf_op[i] in (OP_CPU, OP_TREE_CPU, OP_REGEX_DFA)
+    ]
+    C = targets.n_cpu_leaves if targets is not None else max(len(cpu_leaf_list_), 1)
+    assert C >= max(len(cpu_leaf_list_), 1), "targets.n_cpu_leaves too small"
+
     return CompiledPolicy(
         leaf_op=leaf_op,
         leaf_attr=leaf_attr,
@@ -507,4 +551,10 @@ def compile_corpus(
         leaf_tree=leaf_tree,
         leaf_is_membership=leaf_is_membership,
         members_k=members_k,
+        member_attr_slot=member_attr_slot,
+        member_attrs=np.asarray(member_attrs_list, dtype=np.int32),
+        n_member_attrs=M,
+        cpu_leaf_list=np.asarray(cpu_leaf_list_, dtype=np.int32),
+        n_cpu_leaves=C,
+        config_exprs=[list(cfg.evaluators) for cfg in configs],
     )

@@ -1,0 +1,82 @@
+"""Transfer compaction: wide EncodedBatch → minimal device payload.
+
+The TPU sits behind a host↔device link whose bandwidth/latency dominates the
+hot path long before the MXU does (on this image it is a network tunnel; on a
+co-located chip it is still PCIe).  The wide encoder output is built for
+semantic clarity — [B, A, K] membership for every attr, a [B, L] CPU lane —
+but the kernel can only ever *read*:
+
+  - membership vectors of attrs with an incl/excl leaf  → [B, M, K], M ≤ A
+  - CPU-lane booleans of true-CPU leaves (regex fallback, whole-tree
+    oracle) and DFA leaves' byte-overflow columns        → [B, C], C ≪ L
+
+Everything else is dead weight on the wire (the [B, L] lane alone is ~8KB per
+request at 10k rules).  This module slices the payload down to what the
+kernel reads (~0.25KB per request) and flags the rare requests the compact
+form cannot represent — membership arrays with more than K elements, whose
+exact incl/excl answer the reference computes over the full array
+(ref: pkg/jsonexp/expressions.go:70-80) — for whole-request host fallback
+via the expression oracle (models/policy_model.py host_decide)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .compile import CompiledPolicy
+from .encode import EncodedBatch
+from .intern import PAD
+
+__all__ = ["DeviceBatch", "pack_batch"]
+
+
+@dataclass
+class DeviceBatch:
+    """What actually crosses the wire (plus host-side fallback flags)."""
+
+    attrs_val: np.ndarray      # [B, A] int32
+    members_c: np.ndarray      # [B, M, K] int32 — compact membership rows
+    cpu_dense: np.ndarray      # [B, C] bool — dense CPU-lane columns
+    config_id: np.ndarray      # [B] int32
+    attr_bytes: Optional[np.ndarray]  # [B, NB, LB] uint8 (None: no DFA lane)
+    byte_ovf: Optional[np.ndarray]    # [B, NB] bool
+    host_fallback: np.ndarray  # [B] bool — HOST-side only, never transferred
+
+
+def pack_batch(policy: CompiledPolicy, enc: EncodedBatch) -> DeviceBatch:
+    """Cheap numpy slicing; no per-request Python work."""
+    B = enc.attrs_val.shape[0]
+    M, C, K = policy.n_member_attrs, policy.n_cpu_leaves, policy.members_k
+
+    member_attrs = policy.member_attrs
+    m_real = member_attrs.shape[0]
+    if M == m_real:
+        members_c = np.ascontiguousarray(enc.attrs_members[:, member_attrs])
+    else:
+        members_c = np.full((B, M, K), PAD, dtype=np.int32)
+        members_c[:, :m_real] = enc.attrs_members[:, member_attrs]
+
+    cpu_list = policy.cpu_leaf_list
+    c_real = cpu_list.shape[0]
+    if C == c_real:
+        cpu_dense = np.ascontiguousarray(enc.cpu_lane[:, cpu_list])
+    else:
+        cpu_dense = np.zeros((B, C), dtype=bool)
+        cpu_dense[:, :c_real] = enc.cpu_lane[:, cpu_list]
+
+    # membership overflow on an attr the kernel reads → the compact form is
+    # lossy for this request; route it to the host oracle
+    host_fallback = enc.overflow[:, member_attrs].any(axis=1)
+
+    has_dfa = policy.n_byte_attrs > 0
+    return DeviceBatch(
+        attrs_val=enc.attrs_val,
+        members_c=members_c,
+        cpu_dense=cpu_dense,
+        config_id=enc.config_id,
+        attr_bytes=enc.attr_bytes if has_dfa else None,
+        byte_ovf=enc.byte_ovf if has_dfa else None,
+        host_fallback=host_fallback,
+    )

@@ -7,6 +7,12 @@ allow verdicts (SURVEY.md north star; replaces the per-request Go hot loop at
 ref: pkg/service/auth_pipeline.go:287-322 + pkg/jsonexp/expressions.go:59).
 There is no gradient training in this domain; the "training-step analog" is
 corpus compilation (reconcile-time) + this evaluation step (request-time).
+
+Requests whose membership arrays overflow the compact payload (K elements)
+are re-decided on host by the expression oracle — `host_results` implements
+the exact reference semantics (errors ⇒ False at the root;
+ref: pkg/jsonexp/expressions.go:59-100) and is also the differential-test
+oracle for the kernel.
 """
 
 from __future__ import annotations
@@ -20,9 +26,36 @@ import numpy as np
 
 from ..compiler.compile import CompiledPolicy, ConfigRules, compile_corpus
 from ..compiler.encode import EncodedBatch, encode_batch
+from ..compiler.pack import DeviceBatch, pack_batch
 from ..ops.pattern_eval import _eval_jit, forward, to_device
 
-__all__ = ["PolicyModel"]
+__all__ = ["PolicyModel", "host_results"]
+
+
+def host_results(
+    policy: CompiledPolicy, doc: Any, row: int
+) -> Tuple[bool, np.ndarray, np.ndarray]:
+    """Exact host-side decision for one request via the expression oracle:
+    (own verdict, per-evaluator rule results [E], skipped [E]) with the
+    same padding/tail semantics as the kernel's eval_full_jit."""
+    E = policy.eval_rule.shape[1]
+    rule_res = np.ones((E,), dtype=bool)       # padded cols: TRUE_SLOT
+    skipped = np.zeros((E,), dtype=bool)
+    for e, (cond, rule) in enumerate(policy.config_exprs[row]):
+        if cond is not None:
+            try:
+                cond_ok = bool(cond.matches(doc))
+            except Exception:
+                cond_ok = False
+            if not cond_ok:
+                skipped[e] = True
+                continue
+        try:
+            rule_res[e] = bool(rule.matches(doc))
+        except Exception:
+            rule_res[e] = False
+    own = bool(np.all(skipped | rule_res))
+    return own, rule_res, skipped
 
 
 class PolicyModel:
@@ -41,42 +74,48 @@ class PolicyModel:
 
     # ---- request path ----------------------------------------------------
 
-    def encode(self, docs: Sequence[Any], config_rows: Sequence[int], batch_pad: int = 0) -> EncodedBatch:
-        return encode_batch(self.policy, docs, config_rows, batch_pad=batch_pad)
+    def encode(self, docs: Sequence[Any], config_rows: Sequence[int], batch_pad: int = 0) -> DeviceBatch:
+        enc = encode_batch(self.policy, docs, config_rows, batch_pad=batch_pad)
+        return pack_batch(self.policy, enc)
 
-    def apply(self, encoded: EncodedBatch) -> Tuple[np.ndarray, np.ndarray]:
+    def apply(self, db: DeviceBatch) -> Tuple[np.ndarray, np.ndarray]:
         has_dfa = self.params["dfa_tables"] is not None
         own, verdict = self._apply(
             self.params,
-            jnp.asarray(encoded.attrs_val),
-            jnp.asarray(encoded.attrs_members),
-            jnp.asarray(encoded.overflow),
-            jnp.asarray(encoded.cpu_lane),
-            jnp.asarray(encoded.config_id),
-            jnp.asarray(encoded.attr_bytes) if has_dfa else None,
-            jnp.asarray(encoded.byte_ovf) if has_dfa else None,
+            jnp.asarray(db.attrs_val),
+            jnp.asarray(db.members_c),
+            jnp.asarray(db.cpu_dense),
+            jnp.asarray(db.config_id),
+            jnp.asarray(db.attr_bytes) if has_dfa else None,
+            jnp.asarray(db.byte_ovf) if has_dfa else None,
         )
         return np.asarray(own), np.asarray(verdict)
 
     def decide(self, docs: Sequence[Any], config_names: Sequence[str]) -> List[bool]:
-        rows = [self.policy.config_ids[n] for n in config_names]
-        own, _ = self.apply(self.encode(docs, rows))
-        return [bool(b) for b in own[: len(docs)]]
+        return self.decide_rows(docs, [self.policy.config_ids[n] for n in config_names])
+
+    def decide_rows(self, docs: Sequence[Any], rows: Sequence[int]) -> List[bool]:
+        db = self.encode(docs, rows)
+        own, _ = self.apply(db)
+        out = [bool(b) for b in own[: len(docs)]]
+        if db.host_fallback.any():
+            for r in np.nonzero(db.host_fallback[: len(docs)])[0]:
+                out[r], _, _ = host_results(self.policy, docs[r], rows[r])
+        return out
 
     # ---- graft-entry support --------------------------------------------
 
     def forward_fn_and_args(self, batch: int = 64):
         """A jittable forward fn + realistic example args (for compile checks)."""
-        enc = encode_batch(self.policy, [], [], batch_pad=batch)
+        db = self.encode([], [], batch_pad=batch)
         has_dfa = self.params["dfa_tables"] is not None
         args = (
             self.params,
-            jnp.asarray(enc.attrs_val),
-            jnp.asarray(enc.attrs_members),
-            jnp.asarray(enc.overflow),
-            jnp.asarray(enc.cpu_lane),
-            jnp.asarray(enc.config_id),
-            jnp.asarray(enc.attr_bytes) if has_dfa else None,
-            jnp.asarray(enc.byte_ovf) if has_dfa else None,
+            jnp.asarray(db.attrs_val),
+            jnp.asarray(db.members_c),
+            jnp.asarray(db.cpu_dense),
+            jnp.asarray(db.config_id),
+            jnp.asarray(db.attr_bytes) if has_dfa else None,
+            jnp.asarray(db.byte_ovf) if has_dfa else None,
         )
         return forward, args

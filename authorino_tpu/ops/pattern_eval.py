@@ -8,6 +8,13 @@ the reference's per-request goroutine fan-out + per-pattern gjson walk
 equal-priority rules across all configs fuse into one kernel launch
 (SURVEY.md §2 P1/P2 mapping).
 
+Inputs are the *compact* device payload (compiler/pack.py): [B, A] attr ids,
+[B, M, K] membership rows for incl/excl attrs only, a [B, C] dense CPU lane
+(C = true-CPU + DFA leaves, not the full leaf axis), and the DFA byte
+tensors.  Host↔device transfer is the real bottleneck (HBM/PCIe — or a
+network tunnel on this image), so the wire format carries only what the
+kernel reads and results return as one packed bool matrix.
+
 Two lanes:
 
   - ``matmul`` (default): gathers are pathological on TPU (they lower to
@@ -33,6 +40,11 @@ Two lanes:
 Lane dispatch is structural: ``to_device`` builds the matmul operands (or
 not), and ``eval_verdicts`` branches on their presence at trace time, so the
 two lanes jit-cache independently.
+
+Membership overflow (arrays longer than K) and DFA byte overflow cannot be
+answered from the compact payload per-leaf; overflowed *requests* are flagged
+host_fallback by pack_batch and re-decided on host by the expression oracle
+(models/policy_model.py) — the kernel result for those rows is ignored.
 """
 
 from __future__ import annotations
@@ -87,9 +99,24 @@ def _matmul_operands(policy: CompiledPolicy, row_slot: np.ndarray, device=None) 
     byte tensor a row scans)."""
     L = policy.n_leaves
     A = policy.n_attrs
+    M = policy.n_member_attrs
+    C = policy.n_cpu_leaves
     cdt = _mm_dtype(device)
     attr_onehot = np.zeros((A, L), dtype=np.float32)
     attr_onehot[policy.leaf_attr, np.arange(L)] = 1.0
+
+    # compact-membership one-hot: member slot of each incl/excl leaf's attr
+    memb_onehot = np.zeros((M, L), dtype=np.float32)
+    is_memb = policy.leaf_is_membership
+    if is_memb.any():
+        slots = policy.member_attr_slot[policy.leaf_attr[is_memb]]
+        memb_onehot[slots, np.nonzero(is_memb)[0]] = 1.0
+
+    # dense CPU lane spread: [C] columns → [L] leaf axis
+    cpu_oh = np.zeros((C, L), dtype=np.float32)
+    cl = policy.cpu_leaf_list
+    if cl.shape[0]:
+        cpu_oh[np.arange(cl.shape[0]), cl] = 1.0
 
     # per-level count matrices over the buffer prefix visible to that level
     level_mats = []
@@ -110,6 +137,8 @@ def _matmul_operands(policy: CompiledPolicy, row_slot: np.ndarray, device=None) 
 
     out = {
         "attr_onehot": attr_onehot,  # f32: exact selection via HIGHEST
+        "memb_onehot": memb_onehot,  # f32: exact selection via HIGHEST
+        "cpu_oh": cpu_oh.astype(cdt),
         "level_mats": tuple(level_mats),
         "rule_m": rule_m.astype(cdt),
         "cond_m": cond_m.astype(cdt),
@@ -140,12 +169,14 @@ def _matmul_operands(policy: CompiledPolicy, row_slot: np.ndarray, device=None) 
     return out
 
 
-def to_device(policy: CompiledPolicy, device=None) -> dict:
+def to_device(policy: CompiledPolicy, device=None, lane: Optional[str] = None) -> dict:
     """Upload a compiled corpus's operands as a pytree of device arrays.
     The engine double-buffers these and swaps atomically on reconcile
-    (SURVEY.md §3.4: rule-tensor compile + device upload on index Set)."""
+    (SURVEY.md §3.4: rule-tensor compile + device upload on index Set).
+    ``lane`` overrides the env-var lane selection (the sharded model passes
+    'gather' since its stacked params keep only gather-lane keys)."""
     put = partial(jax.device_put, device=device) if device is not None else jax.device_put
-    lane = _eval_lane()
+    lane = lane or _eval_lane()
     if lane == "matmul" and len(policy.interner) + 4 >= _F32_EXACT:
         lane = "gather"  # ids no longer exact in f32 accumulation
     # per-dfa-row byte-tensor slot (attr → slot mapping folded in here);
@@ -156,11 +187,23 @@ def to_device(policy: CompiledPolicy, device=None) -> dict:
         if lane == "matmul"
         else None
     )
+    # gather-lane helpers for the compact payload
+    L = policy.n_leaves
+    member_slot_of_leaf = np.maximum(
+        policy.member_attr_slot[policy.leaf_attr], 0
+    ).astype(np.int32)
+    # scatter targets: dense CPU cols → leaf axis; padding cols land in a
+    # dump slot at L (sliced off) so they can never clobber a real leaf
+    C = policy.n_cpu_leaves
+    cpu_scatter_idx = np.full((C,), L, dtype=np.int32)
+    cpu_scatter_idx[: policy.cpu_leaf_list.shape[0]] = policy.cpu_leaf_list
     return {
         "matmul": mm,
         "leaf_op": put(jnp.asarray(policy.leaf_op)),
         "leaf_attr": put(jnp.asarray(policy.leaf_attr)),
         "leaf_const": put(jnp.asarray(policy.leaf_const)),
+        "member_slot_of_leaf": put(jnp.asarray(member_slot_of_leaf)),
+        "cpu_scatter_idx": put(jnp.asarray(cpu_scatter_idx)),
         "levels": tuple(
             (put(jnp.asarray(children)), put(jnp.asarray(is_and)))
             for children, is_and in policy.levels
@@ -181,7 +224,16 @@ def to_device(policy: CompiledPolicy, device=None) -> dict:
 DevicePolicy = dict
 
 
-def _leaf_op_cascade(leaf_op, eq, incl, ovf, dfa_leaf_val, cpu_lane):
+def _cpu_full(params, cpu_dense):
+    """Spread the dense [B, C] CPU lane onto the [B, L] leaf axis."""
+    B = cpu_dense.shape[0]
+    L = params["leaf_op"].shape[0]
+    buf = jnp.zeros((B, L + 1), dtype=bool)
+    buf = buf.at[:, params["cpu_scatter_idx"]].set(cpu_dense)
+    return buf[:, :L]
+
+
+def _leaf_op_cascade(leaf_op, eq, incl, dfa_leaf_val, cpu_lane):
     """Shared op-code dispatch: per-leaf boolean results from the lane's
     primitive comparisons (identical semantics in both lanes)."""
     op = leaf_op[None, :]
@@ -190,9 +242,9 @@ def _leaf_op_cascade(leaf_op, eq, incl, ovf, dfa_leaf_val, cpu_lane):
         jnp.where(
             op == OP_NEQ, ~eq,
             jnp.where(
-                op == OP_INCL, jnp.where(ovf, cpu_lane, incl),
+                op == OP_INCL, incl,
                 jnp.where(
-                    op == OP_EXCL, jnp.where(ovf, cpu_lane, ~incl),
+                    op == OP_EXCL, ~incl,
                     jnp.where(
                         op == OP_REGEX_DFA, dfa_leaf_val,
                         # OP_CPU (regex) and OP_TREE_CPU ride the lane; OP_ERROR → False
@@ -217,7 +269,7 @@ def _verdict_from_tables(params, cond, rule):
 # ---------------------------------------------------------------------------
 
 
-def _eval_verdicts_matmul(params, attrs_val, attrs_members, overflow, cpu_lane,
+def _eval_verdicts_matmul(params, attrs_val, members_c, cpu_dense,
                           attr_bytes, byte_ovf):
     mm = params["matmul"]
     f32 = jnp.float32
@@ -230,10 +282,14 @@ def _eval_verdicts_matmul(params, attrs_val, attrs_members, overflow, cpu_lane,
     val = jnp.matmul(attrs_val.astype(f32), attr_oh, precision=_HIGH)  # [B, L]
     eq = val == const[None, :]
     memb = jnp.einsum(
-        "bak,al->bkl", attrs_members.astype(f32), attr_oh, precision=_HIGH
+        "bmk,ml->bkl", members_c.astype(f32), mm["memb_onehot"], precision=_HIGH
     )                                                        # [B, K, L]
     incl = jnp.any(memb == const[None, None, :], axis=1)     # [B, L]
-    ovf = jnp.matmul(overflow.astype(f32), attr_oh, precision=_HIGH) > 0.5
+
+    # ---- dense CPU lane spread onto the leaf axis ------------------------
+    cpu_lane = jnp.matmul(
+        cpu_dense.astype(cdt), mm["cpu_oh"], preferred_element_type=f32
+    ) > 0.5                                                  # [B, L]
 
     # ---- device regex lane: DFA scan, transitions as batched matmuls -----
     if params["dfa_tables"] is not None and attr_bytes is not None:
@@ -271,11 +327,12 @@ def _eval_verdicts_matmul(params, attrs_val, attrs_members, overflow, cpu_lane,
             "bn,nl->bl", byte_ovf.astype(cdt), mm["slot_leaf_oh"],
             preferred_element_type=f32,
         ) > 0.5
+        # overflowed values: exact answer precomputed into the CPU lane
         dfa_leaf_val = jnp.where(leaf_bovf, cpu_lane, leaf_dfa)
     else:
         dfa_leaf_val = cpu_lane  # regexes ride the CPU lane entirely
 
-    res = _leaf_op_cascade(params["leaf_op"], eq, incl, ovf, dfa_leaf_val, cpu_lane)
+    res = _leaf_op_cascade(params["leaf_op"], eq, incl, dfa_leaf_val, cpu_lane)
 
     # ---- boolean circuit: per-level count matmuls ------------------------
     true_col = jnp.ones((B, 1), dtype=bool)
@@ -303,7 +360,7 @@ def _eval_verdicts_matmul(params, attrs_val, attrs_members, overflow, cpu_lane,
 # ---------------------------------------------------------------------------
 
 
-def _eval_verdicts_gather(params, attrs_val, attrs_members, overflow, cpu_lane,
+def _eval_verdicts_gather(params, attrs_val, members_c, cpu_dense,
                           attr_bytes, byte_ovf):
     leaf_op = params["leaf_op"]          # [L]
     leaf_attr = params["leaf_attr"]      # [L]
@@ -314,9 +371,10 @@ def _eval_verdicts_gather(params, attrs_val, attrs_members, overflow, cpu_lane,
     # ---- leaf evaluation -------------------------------------------------
     val = jnp.take(attrs_val, leaf_attr, axis=1)            # [B, L]
     eq = val == leaf_const[None, :]
-    memb = jnp.take(attrs_members, leaf_attr, axis=1)       # [B, L, K]
+    memb = jnp.take(members_c, params["member_slot_of_leaf"], axis=1)  # [B, L, K]
     incl = jnp.any(memb == leaf_const[None, :, None], axis=-1)
-    ovf = jnp.take(overflow, leaf_attr, axis=1)             # [B, L]
+
+    cpu_lane = _cpu_full(params, cpu_dense)                 # [B, L]
 
     # ---- device regex lane: DFA scan over value bytes --------------------
     if params["dfa_tables"] is not None and attr_bytes is not None:
@@ -339,7 +397,7 @@ def _eval_verdicts_gather(params, attrs_val, attrs_members, overflow, cpu_lane,
     else:
         dfa_leaf_val = cpu_lane  # regexes ride the CPU lane entirely
 
-    res = _leaf_op_cascade(leaf_op, eq, incl, ovf, dfa_leaf_val, cpu_lane)
+    res = _leaf_op_cascade(leaf_op, eq, incl, dfa_leaf_val, cpu_lane)
 
     # ---- boolean-circuit reduction, level by level -----------------------
     true_col = jnp.ones((B, 1), dtype=bool)
@@ -363,19 +421,18 @@ def _eval_verdicts_gather(params, attrs_val, attrs_members, overflow, cpu_lane,
 def eval_verdicts(
     params: DevicePolicy,
     attrs_val: jnp.ndarray,      # [B, A] int32
-    attrs_members: jnp.ndarray,  # [B, A, K] int32
-    overflow: jnp.ndarray,       # [B, A] bool
-    cpu_lane: jnp.ndarray,       # [B, L] bool
+    members_c: jnp.ndarray,      # [B, M, K] int32 (compact membership)
+    cpu_dense: jnp.ndarray,      # [B, C] bool (dense CPU lane)
     attr_bytes: Optional[jnp.ndarray] = None,  # [B, NB, LB] uint8
     byte_ovf: Optional[jnp.ndarray] = None,    # [B, NB] bool
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """Returns (verdict [B, G] bool, (rule_results [B, G, E], skipped [B, G, E]))."""
     if params.get("matmul") is not None:
         return _eval_verdicts_matmul(
-            params, attrs_val, attrs_members, overflow, cpu_lane, attr_bytes, byte_ovf
+            params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf
         )
     return _eval_verdicts_gather(
-        params, attrs_val, attrs_members, overflow, cpu_lane, attr_bytes, byte_ovf
+        params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf
     )
 
 
@@ -385,13 +442,13 @@ def _select_own(config_id: jnp.ndarray, n_configs: int) -> jnp.ndarray:
     return config_id[:, None] == jnp.arange(n_configs, dtype=config_id.dtype)[None, :]
 
 
-def forward(params, attrs_val, attrs_members, overflow, cpu_lane, config_id,
+def forward(params, attrs_val, members_c, cpu_dense, config_id,
             attr_bytes=None, byte_ovf=None):
     """Canonical forward step: encoded micro-batch → (own verdicts [B],
     full verdict matrix [B, G]).  The single source of truth for
     verdict-selection logic (PolicyModel and the engine both use it)."""
     verdict, _ = eval_verdicts(
-        params, attrs_val, attrs_members, overflow, cpu_lane, attr_bytes, byte_ovf
+        params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf
     )
     own_mask = _select_own(config_id, verdict.shape[1])
     own = jnp.any(verdict & own_mask, axis=1)
@@ -402,13 +459,13 @@ _eval_jit = jax.jit(forward)
 
 
 @partial(jax.jit, static_argnames=())
-def eval_full_jit(params, attrs_val, attrs_members, overflow, cpu_lane, config_id,
+def eval_full_jit(params, attrs_val, members_c, cpu_dense, config_id,
                   attr_bytes=None, byte_ovf=None):
     """Like _eval_jit but also returns each request's own per-evaluator rule
     results + skipped flags [B, E] — what the pipeline's batched
     pattern-matching evaluators consume (runtime/engine.py)."""
     verdict, (rule, skipped) = eval_verdicts(
-        params, attrs_val, attrs_members, overflow, cpu_lane, attr_bytes, byte_ovf
+        params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf
     )
     own_mask = _select_own(config_id, verdict.shape[1])
     own = jnp.any(verdict & own_mask, axis=1)
@@ -417,18 +474,47 @@ def eval_full_jit(params, attrs_val, attrs_members, overflow, cpu_lane, config_i
     return own, own_rule, own_skipped
 
 
-def eval_batch_jit(params, encoded) -> Tuple[np.ndarray, np.ndarray]:
-    """Convenience wrapper: encoded batch (numpy) → (own verdicts [B],
-    full verdict matrix [B, G]) as numpy."""
+@partial(jax.jit, static_argnames=())
+def eval_packed_jit(params, attrs_val, members_c, cpu_dense, config_id,
+                    attr_bytes=None, byte_ovf=None):
+    """Hot-path variant: one packed [B, 1+2E] bool result (own verdict,
+    own rule results, own skipped) so the device→host read is a single
+    small transfer — the link's round-trip latency dominates the batch
+    budget, so one readback per batch is the contract."""
+    own, own_rule, own_skipped = eval_full_jit(
+        params, attrs_val, members_c, cpu_dense, config_id, attr_bytes, byte_ovf
+    )
+    return jnp.concatenate([own[:, None], own_rule, own_skipped], axis=1)
+
+
+def dispatch_packed(params, db) -> "jax.Array":
+    """Enqueue one compact batch (compiler/pack.py DeviceBatch) without
+    blocking; returns the on-device packed [B, 1+2E] result for a deferred
+    readback (jax async dispatch = transfer/compute of batch N+1 overlaps
+    the readback of batch N)."""
+    has_dfa = params["dfa_tables"] is not None
+    return eval_packed_jit(
+        params,
+        jnp.asarray(db.attrs_val),
+        jnp.asarray(db.members_c),
+        jnp.asarray(db.cpu_dense),
+        jnp.asarray(db.config_id),
+        jnp.asarray(db.attr_bytes) if has_dfa else None,
+        jnp.asarray(db.byte_ovf) if has_dfa else None,
+    )
+
+
+def eval_batch_jit(params, db) -> Tuple[np.ndarray, np.ndarray]:
+    """Convenience wrapper: compact batch (compiler/pack.py DeviceBatch) →
+    (own verdicts [B], full verdict matrix [B, G]) as numpy."""
     has_dfa = params["dfa_tables"] is not None
     own, verdict = _eval_jit(
         params,
-        jnp.asarray(encoded.attrs_val),
-        jnp.asarray(encoded.attrs_members),
-        jnp.asarray(encoded.overflow),
-        jnp.asarray(encoded.cpu_lane),
-        jnp.asarray(encoded.config_id),
-        jnp.asarray(encoded.attr_bytes) if has_dfa else None,
-        jnp.asarray(encoded.byte_ovf) if has_dfa else None,
+        jnp.asarray(db.attrs_val),
+        jnp.asarray(db.members_c),
+        jnp.asarray(db.cpu_dense),
+        jnp.asarray(db.config_id),
+        jnp.asarray(db.attr_bytes) if has_dfa else None,
+        jnp.asarray(db.byte_ovf) if has_dfa else None,
     )
     return np.asarray(own), np.asarray(verdict)

@@ -52,11 +52,11 @@ def build_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mes
 @dataclass
 class _ShardedEncoded:
     attrs_val: np.ndarray      # [B, S, A]
-    attrs_members: np.ndarray  # [B, S, A, K]
-    overflow: np.ndarray       # [B, S, A]
-    cpu_lane: np.ndarray       # [B, S, L]
+    members_c: np.ndarray      # [B, S, M, K] — compact membership rows
+    cpu_dense: np.ndarray      # [B, S, C] — dense CPU-lane columns
     shard_of: np.ndarray       # [B] which shard owns the request's config
     row_of: np.ndarray         # [B] row within that shard
+    host_fallback: np.ndarray  # [B] bool — exact re-decision on host
 
 
 class ShardedPolicyModel:
@@ -97,7 +97,9 @@ class ShardedPolicyModel:
             return np.concatenate([a, pad], axis=0)
 
         stacked: Dict[str, Any] = {}
-        per_shard_params = [to_device(p) for p in self.shards]
+        # gather lane: the stacked params keep only gather-lane keys, so
+        # building matmul operands per shard would be wasted upload
+        per_shard_params = [to_device(p, lane="gather") for p in self.shards]
         # stack on leading S axis (device-side stack is fine at these sizes)
         from ..compiler.compile import TRUE_SLOT
 
@@ -112,6 +114,8 @@ class ShardedPolicyModel:
             "leaf_op": stack("leaf_op"),
             "leaf_attr": stack("leaf_attr"),
             "leaf_const": stack("leaf_const"),
+            "member_slot_of_leaf": stack("member_slot_of_leaf"),
+            "cpu_scatter_idx": stack("cpu_scatter_idx"),
             "levels": tuple(
                 (
                     jnp.stack([jnp.asarray(p.levels[l][0]) for p in self.shards]),
@@ -139,6 +143,8 @@ class ShardedPolicyModel:
             "leaf_op": P("mp"),
             "leaf_attr": P("mp"),
             "leaf_const": P("mp"),
+            "member_slot_of_leaf": P("mp"),
+            "cpu_scatter_idx": P("mp"),
             "levels": lspec,
             "eval_cond": P("mp"),
             "eval_rule": P("mp"),
@@ -161,6 +167,8 @@ class ShardedPolicyModel:
             "leaf_op": place(p["leaf_op"], specs["leaf_op"]),
             "leaf_attr": place(p["leaf_attr"], specs["leaf_attr"]),
             "leaf_const": place(p["leaf_const"], specs["leaf_const"]),
+            "member_slot_of_leaf": place(p["member_slot_of_leaf"], specs["member_slot_of_leaf"]),
+            "cpu_scatter_idx": place(p["cpu_scatter_idx"], specs["cpu_scatter_idx"]),
             "levels": tuple(
                 (place(c, P("mp")), place(a, P("mp"))) for c, a in p["levels"]
             ),
@@ -179,11 +187,11 @@ class ShardedPolicyModel:
         mesh = self.mesh
         specs = self._param_specs()
 
-        def local_eval(params, attrs_val, attrs_members, overflow, cpu_lane):
+        def local_eval(params, attrs_val, members_c, cpu_dense):
             # params leading axis is the local S slice (size 1 per mp shard)
             sq = jax.tree_util.tree_map(lambda a: a[0], params)
             verdict, _ = eval_verdicts(
-                sq, attrs_val[:, 0], attrs_members[:, 0], overflow[:, 0], cpu_lane[:, 0]
+                sq, attrs_val[:, 0], members_c[:, 0], cpu_dense[:, 0]
             )
             return verdict  # [B_local, G]
 
@@ -195,7 +203,6 @@ class ShardedPolicyModel:
                 P("dp", "mp", None),
                 P("dp", "mp", None, None),
                 P("dp", "mp", None),
-                P("dp", "mp", None),
             ),
             out_specs=P("dp", "mp"),
         )
@@ -205,6 +212,7 @@ class ShardedPolicyModel:
 
     def encode(self, docs: Sequence[Any], config_names: Sequence[str], batch_pad: int = 0) -> _ShardedEncoded:
         from ..compiler.intern import EMPTY_ID, PAD
+        from ..compiler.pack import pack_batch
 
         B = max(len(docs), 1)
         if batch_pad and batch_pad > B:
@@ -214,13 +222,14 @@ class ShardedPolicyModel:
             B += dp - B % dp
         S = self.n_shards
         p0 = self.shards[0]
-        A, K, L = p0.n_attrs, p0.members_k, p0.n_leaves
+        A, K = p0.n_attrs, p0.members_k
+        M, C = p0.n_member_attrs, p0.n_cpu_leaves
         attrs_val = np.full((B, S, A), EMPTY_ID, dtype=np.int32)
-        attrs_members = np.full((B, S, A, K), PAD, dtype=np.int32)
-        overflow = np.zeros((B, S, A), dtype=bool)
-        cpu_lane = np.zeros((B, S, L), dtype=bool)
+        members_c = np.full((B, S, M, K), PAD, dtype=np.int32)
+        cpu_dense = np.zeros((B, S, C), dtype=bool)
         shard_of = np.zeros((B,), dtype=np.int32)
         row_of = np.zeros((B,), dtype=np.int32)
+        host_fallback = np.zeros((B,), dtype=bool)
         # group requests by owning shard and encode each group in ONE
         # batched call (per-request encode_batch would dominate the hot path)
         by_shard: Dict[int, List[int]] = {}
@@ -234,25 +243,31 @@ class ShardedPolicyModel:
                 [docs[r] for r in rs],
                 [int(row_of[r]) for r in rs],
             )
-            attrs_val[rs, shard] = enc.attrs_val[: len(rs)]
-            attrs_members[rs, shard] = enc.attrs_members[: len(rs)]
-            overflow[rs, shard] = enc.overflow[: len(rs)]
-            cpu_lane[rs, shard] = enc.cpu_lane[: len(rs)]
-        return _ShardedEncoded(attrs_val, attrs_members, overflow, cpu_lane, shard_of, row_of)
+            db = pack_batch(self.shards[shard], enc)
+            attrs_val[rs, shard] = db.attrs_val[: len(rs)]
+            members_c[rs, shard] = db.members_c[: len(rs)]
+            cpu_dense[rs, shard] = db.cpu_dense[: len(rs)]
+            host_fallback[rs] = db.host_fallback[: len(rs)]
+        return _ShardedEncoded(attrs_val, members_c, cpu_dense, shard_of, row_of, host_fallback)
 
     def apply(self, encoded: _ShardedEncoded) -> np.ndarray:
         verdict = self._step(
             self.params,
             jnp.asarray(encoded.attrs_val),
-            jnp.asarray(encoded.attrs_members),
-            jnp.asarray(encoded.overflow),
-            jnp.asarray(encoded.cpu_lane),
+            jnp.asarray(encoded.members_c),
+            jnp.asarray(encoded.cpu_dense),
         )
         v = np.asarray(verdict)  # [B, S*G]
         flat = encoded.shard_of * self.configs_per_shard + encoded.row_of
         return v[np.arange(v.shape[0]), flat]
 
     def decide(self, docs: Sequence[Any], config_names: Sequence[str]) -> List[bool]:
+        from ..models.policy_model import host_results
+
         enc = self.encode(docs, config_names)
         own = self.apply(enc)
-        return [bool(b) for b in own[: len(docs)]]
+        out = [bool(b) for b in own[: len(docs)]]
+        for r in np.nonzero(enc.host_fallback[: len(docs)])[0]:
+            shard, row = self.locator[config_names[r]]
+            out[r], _, _ = host_results(self.shards[shard], docs[r], int(row))
+        return out

@@ -173,24 +173,36 @@ class PolicyEngine:
                 p.future.set_result((own_rule[i], own_skipped[i]))
 
     def _run_batch(self, snap: _Snapshot, batch: List[_Pending]):
-        from ..ops.pattern_eval import eval_full_jit
+        from ..compiler.pack import pack_batch
+        from ..models.policy_model import host_results
+        from ..ops.pattern_eval import eval_packed_jit
         import jax.numpy as jnp
 
         policy = snap.policy
         rows = [policy.config_ids[p.config_name] for p in batch]
         enc = encode_batch(policy, [p.doc for p in batch], rows, batch_pad=_bucket(len(batch)))
+        db = pack_batch(policy, enc)
         has_dfa = snap.params["dfa_tables"] is not None
-        own, own_rule, own_skipped = eval_full_jit(
+        packed = np.asarray(eval_packed_jit(
             snap.params,
-            jnp.asarray(enc.attrs_val),
-            jnp.asarray(enc.attrs_members),
-            jnp.asarray(enc.overflow),
-            jnp.asarray(enc.cpu_lane),
-            jnp.asarray(enc.config_id),
-            jnp.asarray(enc.attr_bytes) if has_dfa else None,
-            jnp.asarray(enc.byte_ovf) if has_dfa else None,
-        )
-        return np.asarray(own_rule), np.asarray(own_skipped)
+            jnp.asarray(db.attrs_val),
+            jnp.asarray(db.members_c),
+            jnp.asarray(db.cpu_dense),
+            jnp.asarray(db.config_id),
+            jnp.asarray(db.attr_bytes) if has_dfa else None,
+            jnp.asarray(db.byte_ovf) if has_dfa else None,
+        ))
+        E = policy.eval_rule.shape[1]
+        own_rule = packed[:, 1:1 + E].copy()
+        own_skipped = packed[:, 1 + E:1 + 2 * E].copy()
+        if db.host_fallback.any():
+            # compact payload was lossy for these rows (membership overflow):
+            # exact re-decision on host via the expression oracle
+            for r in np.nonzero(db.host_fallback[: len(batch)])[0]:
+                _, own_rule[r], own_skipped[r] = host_results(
+                    policy, batch[r].doc, rows[r]
+                )
+        return own_rule, own_skipped
 
 
 def _bucket(n: int, minimum: int = 16) -> int:

@@ -14,7 +14,13 @@ import pytest
 from authorino_tpu.compiler import ConfigRules, compile_corpus, encode_batch
 from authorino_tpu.expressions import FALSE as FALSE_RULE
 from authorino_tpu.expressions import All, Any_, Operator, Pattern
-from authorino_tpu.ops import eval_batch_jit, to_device
+from authorino_tpu.models import PolicyModel
+
+
+def kernel_decide(policy, docs, rows):
+    """Production decision path: compact encode -> kernel -> host-fallback
+    merge for membership-overflow rows (models/policy_model.py)."""
+    return PolicyModel(policy).decide_rows(docs, rows)
 
 SELECTORS = [
     "request.method",
@@ -109,13 +115,13 @@ def test_differential_random_corpora(seed):
             evaluators.append((cond, random_expr(rng)))
         configs.append(ConfigRules(name=f"cfg-{i}", evaluators=evaluators))
 
-    policy = compile_corpus(configs, members_k=8)  # small K to force overflow lane
-    params = to_device(policy)
+    # small K forces membership overflow → host-fallback routing for those
+    # rows (kernel rows stay differential; fallback rows test the routing)
+    policy = compile_corpus(configs, members_k=8)
 
     docs = [random_doc(rng) for _ in range(64)]
     rows = [rng.randrange(n_configs) for _ in docs]
-    encoded = encode_batch(policy, docs, rows)
-    own, full = eval_batch_jit(params, encoded)
+    own = kernel_decide(policy, docs, rows)
 
     for r, (doc, row) in enumerate(zip(docs, rows)):
         expected = oracle_verdict(configs[row], doc)
@@ -136,12 +142,10 @@ def test_empty_and_edge_expressions():
         ConfigRules("gated", evaluators=[(Pattern("request.method", Operator.EQ, "GET"), FALSE)]),
     ]
     policy = compile_corpus(configs)
-    params = to_device(policy)
     docs = [{"request": {"method": m}} for m in ("GET", "POST")]
     # NOTE: the encoder resolves only each request's own config's attributes —
     # other configs' verdict columns are garbage by design. Route per config.
-    encoded = encode_batch(policy, docs + docs + docs + docs, [0, 0, 1, 1, 2, 2, 3, 3])
-    own, _ = eval_batch_jit(params, encoded)
+    own = kernel_decide(policy, docs + docs + docs + docs, [0, 0, 1, 1, 2, 2, 3, 3])
     # allow-all allows everything; deny-all denies; no evaluators → allow
     assert own[0] and own[1]
     assert not own[2] and not own[3]
@@ -155,18 +159,15 @@ def test_interning_exactness_no_collisions():
     # unseen request values must not equal any constant
     configs = [ConfigRules("c", evaluators=[(None, Pattern("a.b", Operator.EQ, "secret-value"))])]
     policy = compile_corpus(configs)
-    params = to_device(policy)
     docs = [{"a": {"b": "secret-value"}}, {"a": {"b": "other"}}, {"a": {}}, {}]
-    encoded = encode_batch(policy, docs, [0, 0, 0, 0])
-    own, _ = eval_batch_jit(params, encoded)
-    assert list(own) == [True, False, False, False]
+    own = kernel_decide(policy, docs, [0, 0, 0, 0])
+    assert own == [True, False, False, False]
 
     # eq "" matches a missing value (gjson String() of missing is "")
     configs = [ConfigRules("c", evaluators=[(None, Pattern("a.b", Operator.EQ, ""))])]
     policy = compile_corpus(configs)
-    encoded = encode_batch(policy, [{}, {"a": {"b": "x"}}], [0, 0])
-    own, _ = eval_batch_jit(to_device(policy), encoded)
-    assert list(own) == [True, False]
+    own = kernel_decide(policy, [{}, {"a": {"b": "x"}}], [0, 0])
+    assert own == [True, False]
 
 
 def test_membership_overflow_exact():
@@ -179,15 +180,13 @@ def test_membership_overflow_exact():
         ])
     ]
     policy = compile_corpus(configs, members_k=K)
-    params = to_device(policy)
     long_with_needle = {"roles": [f"r{i}" for i in range(10)] + ["needle"]}
     long_without = {"roles": [f"r{i}" for i in range(10)]}
     long_banned = {"roles": [f"r{i}" for i in range(10)] + ["needle", "banned"]}
     short_hit = {"roles": ["needle"]}
     docs = [long_with_needle, long_without, long_banned, short_hit]
-    encoded = encode_batch(policy, docs, [0] * 4)
-    own, _ = eval_batch_jit(params, encoded)
-    assert list(own) == [True, False, False, True]
+    own = kernel_decide(policy, docs, [0] * 4)
+    assert own == [True, False, False, True]
 
 
 def test_regex_lane():
@@ -196,12 +195,10 @@ def test_regex_lane():
         ConfigRules("bad", evaluators=[(None, Pattern("path", Operator.MATCHES, "(["))]),
     ]
     policy = compile_corpus(configs)
-    params = to_device(policy)
     docs = [{"path": "/pets/1"}, {"path": "/pets/x"}, {"path": "/pets/2"}]
-    encoded = encode_batch(policy, docs, [0, 0, 1])
-    own, _ = eval_batch_jit(params, encoded)
+    own = kernel_decide(policy, docs, [0, 0, 1])
     # invalid regex → evaluation error → deny (ref: error return denies)
-    assert list(own) == [True, False, False]
+    assert own == [True, False, False]
 
 
 def test_invalid_regex_error_propagation_matches_oracle():
@@ -218,12 +215,10 @@ def test_invalid_regex_error_propagation_matches_oracle():
         ConfigRules("cond-bad", evaluators=[(Any_(bad, true_leaf), FALSE_RULE)]),
     ]
     policy = compile_corpus(configs)
-    params = to_device(policy)
     doc = {"path": "/x", "m": "GET"}
-    encoded = encode_batch(policy, [doc] * 4, [0, 1, 2, 3])
-    own, _ = eval_batch_jit(params, encoded)
+    own = kernel_decide(policy, [doc] * 4, [0, 1, 2, 3])
     expected = [oracle_verdict(c, doc) for c in configs]
-    assert [bool(b) for b in own] == expected
+    assert own == expected
     # pin the concrete semantics too
     assert expected == [False, True, False, True]  # cond errors → skip → allow
 
@@ -232,6 +227,5 @@ def test_fast_resolver_negative_index_matches_selector():
     """items.-1 must resolve MISSING like selector.get, not Python-negative."""
     configs = [ConfigRules("c", evaluators=[(None, Pattern("items.-1", Operator.EQ, "b"))])]
     policy = compile_corpus(configs)
-    encoded = encode_batch(policy, [{"items": ["a", "b"]}], [0])
-    own, _ = eval_batch_jit(to_device(policy), encoded)
+    own = kernel_decide(policy, [{"items": ["a", "b"]}], [0])
     assert not own[0]

@@ -16,6 +16,7 @@ import pytest
 
 from authorino_tpu.compiler import ConfigRules, compile_corpus
 from authorino_tpu.compiler.encode import encode_batch_py
+from authorino_tpu.compiler.pack import pack_batch
 from authorino_tpu.expressions import All, Any_, Operator, Pattern
 from authorino_tpu.ops import pattern_eval as pe
 
@@ -75,14 +76,13 @@ def test_matmul_lane_matches_gather_lane(monkeypatch):
     params_mm, params_g = _both_lane_params(policy, monkeypatch)
     docs = _docs(64)
     rows = [i % policy.n_configs for i in range(len(docs))]
-    enc = encode_batch_py(policy, docs, rows, batch_pad=64)
+    db = pack_batch(policy, encode_batch_py(policy, docs, rows, batch_pad=64))
     args = (
-        jnp.asarray(enc.attrs_val),
-        jnp.asarray(enc.attrs_members),
-        jnp.asarray(enc.overflow),
-        jnp.asarray(enc.cpu_lane),
-        jnp.asarray(enc.attr_bytes),
-        jnp.asarray(enc.byte_ovf),
+        jnp.asarray(db.attrs_val),
+        jnp.asarray(db.members_c),
+        jnp.asarray(db.cpu_dense),
+        jnp.asarray(db.attr_bytes),
+        jnp.asarray(db.byte_ovf),
     )
     v_mm, (r_mm, s_mm) = pe.eval_verdicts(params_mm, *args)
     v_g, (r_g, s_g) = pe.eval_verdicts(params_g, *args)
@@ -100,14 +100,13 @@ def test_matmul_lane_bf16_matches_gather_lane(monkeypatch):
     assert params_mm["matmul"]["rule_m"].dtype == jnp.bfloat16
     docs = _docs(128, seed=17)
     rows = [i % policy.n_configs for i in range(len(docs))]
-    enc = encode_batch_py(policy, docs, rows, batch_pad=128)
+    db = pack_batch(policy, encode_batch_py(policy, docs, rows, batch_pad=128))
     args = (
-        jnp.asarray(enc.attrs_val),
-        jnp.asarray(enc.attrs_members),
-        jnp.asarray(enc.overflow),
-        jnp.asarray(enc.cpu_lane),
-        jnp.asarray(enc.attr_bytes),
-        jnp.asarray(enc.byte_ovf),
+        jnp.asarray(db.attrs_val),
+        jnp.asarray(db.members_c),
+        jnp.asarray(db.cpu_dense),
+        jnp.asarray(db.attr_bytes),
+        jnp.asarray(db.byte_ovf),
     )
     v_mm, _ = pe.eval_verdicts(params_mm, *args)
     v_g, _ = pe.eval_verdicts(params_g, *args)

@@ -215,6 +215,7 @@ class TestVerdictParity:
     """End-to-end: native-encoded batches produce identical kernel verdicts."""
 
     def test_verdicts_match(self):
+        from authorino_tpu.compiler.pack import pack_batch
         from authorino_tpu.ops.pattern_eval import eval_batch_jit, to_device
 
         rng = random.Random(7)
@@ -225,6 +226,6 @@ class TestVerdictParity:
         docs = [tc._random_doc(rng) for _ in range(32)]
         rows = [rng.randrange(len(configs)) for _ in range(32)]
         nat = get_native_encoder(policy)
-        own_py, _ = eval_batch_jit(params, encode_batch(policy, docs, rows))
-        own_nat, _ = eval_batch_jit(params, nat.encode_batch(docs, rows))
+        own_py, _ = eval_batch_jit(params, pack_batch(policy, encode_batch(policy, docs, rows)))
+        own_nat, _ = eval_batch_jit(params, pack_batch(policy, nat.encode_batch(docs, rows)))
         assert np.array_equal(own_py, own_nat)

@@ -12,6 +12,7 @@ from authorino_tpu.compiler import ConfigRules, compile_corpus, encode_batch
 from authorino_tpu.compiler.compile import OP_CPU, OP_REGEX_DFA
 from authorino_tpu.compiler.redfa import compile_regex_dfa
 from authorino_tpu.expressions import All, Any_, Operator, Pattern
+from authorino_tpu.compiler.pack import pack_batch
 from authorino_tpu.ops import eval_batch_jit, to_device
 
 from test_compiler_differential import oracle_verdict
@@ -65,7 +66,7 @@ def test_kernel_uses_dfa_lane():
     enc = encode_batch(policy, docs, [0] * 4)
     # the CPU lane must NOT have been consulted for in-range values
     assert not enc.cpu_lane.any()
-    own, _ = eval_batch_jit(params, enc)
+    own, _ = eval_batch_jit(params, pack_batch(policy, enc))
     assert list(own) == [True, False, True, False]
 
 
@@ -80,7 +81,7 @@ def test_long_value_overflow_falls_back_to_cpu():
     docs = [{"v": long_hit}, {"v": long_miss}, {"v": nul_hit}, {"v": "short needle"}]
     enc = encode_batch(policy, docs, [0] * 4)
     assert enc.byte_ovf[:3, 0].all() and not enc.byte_ovf[3, 0]
-    own, _ = eval_batch_jit(to_device(policy), enc)
+    own, _ = eval_batch_jit(to_device(policy), pack_batch(policy, enc))
     assert list(own) == [True, False, True, True]
 
 
@@ -104,6 +105,6 @@ def test_regex_heavy_corpus_matches_oracle(seed):
     ]
     rows = [rng.randrange(len(configs)) for _ in docs]
     enc = encode_batch(policy, docs, rows)
-    own, _ = eval_batch_jit(params, enc)
+    own, _ = eval_batch_jit(params, pack_batch(policy, enc))
     for r, (doc, row) in enumerate(zip(docs, rows)):
         assert bool(own[r]) == oracle_verdict(configs[row], doc), (seed, r, doc)
