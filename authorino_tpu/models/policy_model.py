@@ -78,6 +78,23 @@ class PolicyModel:
         enc = encode_batch(self.policy, docs, config_rows, batch_pad=batch_pad)
         return pack_batch(self.policy, enc)
 
+    def encode_json(self, parts: Sequence[bytes], config_rows: Sequence[int],
+                    batch_pad: int = 0) -> DeviceBatch:
+        """GIL-free encode from raw authorization-JSON bytes (one UTF-8 blob
+        per request — what a wire frontend already holds).  Falls back to
+        the Python encoder via json.loads when the native module is
+        unavailable."""
+        from ..native import get_native_encoder
+
+        nat = get_native_encoder(self.policy)
+        if nat is not None:
+            enc = nat.encode_json_parts(parts, config_rows, batch_pad)
+            if enc is not None:
+                return pack_batch(self.policy, enc)
+        import json
+
+        return self.encode([json.loads(pt) for pt in parts], config_rows, batch_pad)
+
     def apply(self, db: DeviceBatch) -> Tuple[np.ndarray, np.ndarray]:
         has_dfa = self.params["dfa_tables"] is not None
         own, verdict = self._apply(

@@ -38,6 +38,24 @@ def _addr(a: np.ndarray) -> int:
     return a.ctypes.data
 
 
+class _LazyDocs:
+    """Parse a doc from its JSON part only if a finishing task needs it."""
+
+    def __init__(self, parts: Sequence[bytes]):
+        self._parts = parts
+        self._cache: Dict[int, Any] = {}
+
+    def __getitem__(self, i: int):
+        doc = self._cache.get(i)
+        if doc is None:
+            doc = json.loads(self._parts[i])
+            self._cache[i] = doc
+        return doc
+
+    def __len__(self):
+        return len(self._parts)
+
+
 def _blob(strings: List[str]):
     """(blob bytes, offs int64[n+1])"""
     parts = [s.encode("utf-8") for s in strings]
@@ -117,16 +135,45 @@ class NativeEncoder:
             p.members_k, DFA_VALUE_BYTES, max(p.n_byte_attrs, 1),
         )
         self.mode = os.environ.get("AUTHORINO_TPU_ENCODE_MODE", "object")
+        # a few threads beyond the core count wins even on small hosts: the
+        # encode slices interleave with (GIL-released) RPC dispatch threads
+        # instead of running as one long burst that delays them
         self.n_threads = int(os.environ.get(
-            "AUTHORINO_TPU_ENCODE_THREADS", min(8, os.cpu_count() or 1)))
+            "AUTHORINO_TPU_ENCODE_THREADS", min(8, 4 * (os.cpu_count() or 1))))
 
     # ------------------------------------------------------------------
     def encode_batch(self, docs: Sequence[Any], config_rows: Sequence[int],
                      batch_pad: int = 0) -> Optional[EncodedBatch]:
         """Returns an EncodedBatch, or None if the native path bailed
         (caller falls back to the Python encoder)."""
+        n = len(docs)
+        if n and not isinstance(docs, list):
+            docs = list(docs)
+        if n and self.mode == "json":
+            try:
+                parts = [json.dumps(d, separators=(",", ":"), ensure_ascii=False).encode("utf-8")
+                         for d in docs]
+            except (TypeError, ValueError):
+                return None  # non-serializable doc → Python path raises the real error
+            return self.encode_json_parts(parts, config_rows, batch_pad, docs=docs)
+        return self._encode(docs, None, config_rows, batch_pad)
+
+    def encode_json_parts(self, parts: Sequence[bytes], config_rows: Sequence[int],
+                          batch_pad: int = 0, docs: Optional[Sequence[Any]] = None,
+                          ) -> Optional[EncodedBatch]:
+        """GIL-free hot-path entry: ``parts[i]`` is request i's authorization
+        JSON as UTF-8 bytes (what a wire frontend already holds).  The C
+        side parses + encodes with internal threads while the GIL is
+        released.  ``docs`` (parsed dicts) is only needed when the corpus
+        has whole-tree CPU leaves or gjson-extended selectors; when absent,
+        the rare task that needs one parses it from the blob on demand."""
+        return self._encode(docs, parts, config_rows, batch_pad)
+
+    def _encode(self, docs, parts, config_rows: Sequence[int],
+                batch_pad: int = 0) -> Optional[EncodedBatch]:
         p = self.policy
-        B = max(len(docs), 1)
+        n = len(parts) if parts is not None else len(docs)
+        B = max(n, 1)
         if batch_pad and batch_pad > B:
             B = batch_pad
         A, K, L = p.n_attrs, p.members_k, p.n_leaves
@@ -140,10 +187,7 @@ class NativeEncoder:
         attr_bytes = np.zeros((B, NB, DFA_VALUE_BYTES), dtype=np.uint8)
         byte_ovf = np.zeros((B, NB), dtype=bool)
 
-        n = len(docs)
         if n:
-            if not isinstance(docs, list):
-                docs = list(docs)
             rows = np.asarray(config_rows, dtype=np.int32)
             config_id[:n] = rows
             max_tasks = int(self._cpu_task_bound[rows].sum()) + 1
@@ -159,12 +203,7 @@ class NativeEncoder:
                 _addr(cpu_lane), _addr(attr_bytes), _addr(byte_ovf),
                 _addr(task_r), _addr(task_leaf), _addr(task_off), _addr(task_len),
             )
-            if self.mode == "json":
-                try:
-                    parts = [json.dumps(d, separators=(",", ":"), ensure_ascii=False).encode("utf-8")
-                             for d in docs]
-                except (TypeError, ValueError):
-                    return None  # non-serializable doc → Python path raises the real error
+            if parts is not None:
                 doc_offs = np.zeros(n + 1, dtype=np.int64)
                 np.cumsum([len(pt) for pt in parts], out=doc_offs[1:])
                 blob = b"".join(parts)
@@ -182,6 +221,10 @@ class NativeEncoder:
                     return None  # render error (non-serializable nested value)
             if rc < 0:
                 return None
+
+            need_doc = bool(self._complex_attrs) or rc
+            if need_doc and docs is None and parts is not None:
+                docs = _LazyDocs(parts)
 
             # ---- Python finishing: complex attrs + their cpu leaves ----
             if self._complex_attrs:
@@ -230,11 +273,12 @@ class NativeEncoder:
         lookup = p.interner.lookup
         complex_set = set(self._complex_attrs)
         K = p.members_k
-        for r, doc in enumerate(docs):
+        for r in range(len(docs)):
             row = int(rows[r])
             todo = [a for a in p.config_attrs[row] if a in complex_set]
             if not todo:
                 continue
+            doc = docs[r]
             res_by_attr: Dict[int, Any] = {}
             for attr in todo:
                 res = sel.get(doc, p.attr_selectors[attr])
