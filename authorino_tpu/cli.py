@@ -172,10 +172,15 @@ async def run_server(args) -> None:
         # retries internally while the apiserver is unreachable
         await source.sync()
         source.start()
+        from .k8s.leader import leader_election_id
+
         status_updater = AuthConfigStatusUpdater(
             reconciler, cluster, leases=cluster,
             namespace=os.environ.get("POD_NAMESPACE", "default"),
             leader_election=args.enable_leader_election,
+            # per-shard lease: derived from the watched label selector so
+            # label-sharded instances don't contend for one lease
+            lease_name=leader_election_id(args.auth_config_label_selector or ""),
         ).start()
         log.info("watching AuthConfigs via the Kubernetes API")
     elif args.watch_dir:

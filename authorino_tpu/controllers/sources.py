@@ -165,6 +165,11 @@ class K8sWatchSource:
         )
         self.resync_interval_s = resync_interval_s
         self._tasks: List[asyncio.Task] = []
+        # list→watch resourceVersion continuity: objects deleted between the
+        # list and the watch start still produce DELETED events when the
+        # watch resumes from the list's snapshot version
+        self._ac_rv: Optional[str] = None
+        self._sec_rv: Optional[str] = None
 
     def _ac_params(self) -> Dict[str, str]:
         """Server-side sharding: a label-selected instance must not stream
@@ -174,15 +179,33 @@ class K8sWatchSource:
         return {"labelSelector": sel} if sel else {}
 
     async def _initial_sync(self) -> None:
-        items = await self.cluster.list_auth_configs(self.reconciler.label_selector)
+        list_rv = getattr(self.cluster, "list_auth_configs_rv", None)
+        if list_rv is not None:
+            items, self._ac_rv = await list_rv(self.reconciler.label_selector)
+        else:
+            items = await self.cluster.list_auth_configs(self.reconciler.label_selector)
         await self.reconciler.reconcile_all([to_v1beta2(o) for o in items])
 
     async def _watch_auth_configs(self) -> None:
         path = self.cluster._ac_path()
         while True:
             try:
-                async for ev_type, obj in self.cluster.watch(path, self._ac_params()):
+                params = self._ac_params()
+                if self._ac_rv:
+                    params["resourceVersion"] = self._ac_rv
+                    params["allowWatchBookmarks"] = "true"
+                async for ev_type, obj in self.cluster.watch(path, params):
+                    if ev_type == "ERROR":
+                        # e.g. 410 Gone Status object: resume point is
+                        # invalid — drop it and re-list
+                        self._ac_rv = None
+                        break
                     meta = obj.get("metadata") or {}
+                    rv = meta.get("resourceVersion")
+                    if rv:
+                        self._ac_rv = rv
+                    if ev_type == "BOOKMARK":
+                        continue
                     id_ = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
                     if ev_type == "DELETED":
                         await self.reconciler.delete(id_)
@@ -191,7 +214,10 @@ class K8sWatchSource:
             except asyncio.CancelledError:
                 raise
             except Exception as e:
+                # includes 410 Gone (resourceVersion too old): the re-list
+                # below refreshes the snapshot + resume point
                 log.warning("authconfig watch lost (%s); re-listing", e)
+                self._ac_rv = None
             await asyncio.sleep(self.resync_interval_s)
             try:
                 await self._initial_sync()
@@ -213,7 +239,12 @@ class K8sWatchSource:
                 # current state (upserts + synthesized deletes) so adds and
                 # revocations aren't lost
                 try:
-                    listed = {s.key: s for s in await self.cluster.list_secrets(self.secret_label_selector)}
+                    list_rv = getattr(self.cluster, "list_secrets_rv", None)
+                    if list_rv is not None:
+                        secrets, self._sec_rv = await list_rv(self.secret_label_selector)
+                    else:
+                        secrets = await self.cluster.list_secrets(self.secret_label_selector)
+                    listed = {s.key: s for s in secrets}
                     for key in set(known) - set(listed):
                         self.secret_reconciler.on_event("delete", known[key])
                     for s in listed.values():
@@ -223,7 +254,19 @@ class K8sWatchSource:
                     log.warning("secret re-list failed: %s", e)
             first = False
             try:
-                async for ev_type, obj in self.cluster.watch("/api/v1/secrets", params):
+                q = dict(params)
+                if self._sec_rv:
+                    q["resourceVersion"] = self._sec_rv
+                    q["allowWatchBookmarks"] = "true"
+                async for ev_type, obj in self.cluster.watch("/api/v1/secrets", q):
+                    if ev_type == "ERROR":
+                        self._sec_rv = None
+                        break
+                    rv = (obj.get("metadata") or {}).get("resourceVersion")
+                    if rv:
+                        self._sec_rv = rv
+                    if ev_type not in ("ADDED", "MODIFIED", "DELETED"):
+                        continue  # BOOKMARK or unknown: never a Secret object
                     secret = RestCluster._secret_from_obj(obj)
                     kind = "delete" if ev_type == "DELETED" else "upsert"
                     if kind == "delete":
@@ -235,6 +278,7 @@ class K8sWatchSource:
                 raise
             except Exception as e:
                 log.warning("secret watch lost (%s); retrying", e)
+                self._sec_rv = None
             await asyncio.sleep(self.resync_interval_s)
 
     async def sync(self, max_attempts: int = 0) -> None:

@@ -40,6 +40,7 @@ class AuthConfigStatusUpdater:
         identity: Optional[str] = None,
         interval_s: float = 2.0,
         leader_election: bool = True,
+        lease_name: Optional[str] = None,
     ):
         self.reconciler = reconciler
         self.writer = writer
@@ -52,6 +53,7 @@ class AuthConfigStatusUpdater:
                 leases,
                 identity=identity or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}",
                 namespace=namespace,
+                name=lease_name,
                 # on leadership change, rewrite everything (a prior leader may
                 # have written stale statuses)
                 on_started_leading=self._written.clear,

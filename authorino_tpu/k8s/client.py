@@ -322,13 +322,20 @@ class RestCluster:
         )
 
     async def list_secrets(self, selector: LabelSelector, namespace: Optional[str] = None) -> List[Secret]:
+        secrets, _ = await self.list_secrets_rv(selector, namespace)
+        return secrets
+
+    async def list_secrets_rv(
+        self, selector: LabelSelector, namespace: Optional[str] = None
+    ) -> Tuple[List[Secret], Optional[str]]:
         path = f"/api/v1/namespaces/{namespace}/secrets" if namespace else "/api/v1/secrets"
         params = {}
         sel = selector.to_string()
         if sel:
             params["labelSelector"] = sel
         payload = await self._request("GET", path, params=params)
-        return [self._secret_from_obj(o) for o in payload.get("items", [])]
+        rv = (payload.get("metadata") or {}).get("resourceVersion")
+        return [self._secret_from_obj(o) for o in payload.get("items", [])], rv
 
     async def get_secret(self, namespace: str, name: str) -> Optional[Secret]:
         try:
@@ -371,11 +378,21 @@ class RestCluster:
         return base
 
     async def list_auth_configs(self, selector: Optional[LabelSelector] = None) -> List[Dict[str, Any]]:
+        items, _ = await self.list_auth_configs_rv(selector)
+        return items
+
+    async def list_auth_configs_rv(
+        self, selector: Optional[LabelSelector] = None
+    ) -> Tuple[List[Dict[str, Any]], Optional[str]]:
+        """List + the list's resourceVersion, so a watch can start exactly
+        where this snapshot ends (no missed-delete gap between list and
+        watch — ref: controller-runtime informer ListAndWatch)."""
         params = {}
         if selector is not None and not selector.empty():
             params["labelSelector"] = selector.to_string()
         payload = await self._request("GET", self._ac_path(), params=params)
-        return payload.get("items", [])
+        rv = (payload.get("metadata") or {}).get("resourceVersion")
+        return payload.get("items", []), rv
 
     async def patch_auth_config_status(self, namespace: str, name: str, status: Dict[str, Any]) -> None:
         """Status subresource merge-patch (the leader-elected writer's

@@ -11,14 +11,25 @@ expired, renew every ``renew_interval``, step down when renewal fails.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional, Protocol
 
-__all__ = ["Lease", "LeaseClient", "InMemoryLeases", "LeaderElector"]
+__all__ = ["Lease", "LeaseClient", "InMemoryLeases", "LeaderElector",
+           "leader_election_id"]
 
 log = logging.getLogger("authorino_tpu.leader")
+
+
+def leader_election_id(auth_config_label_selector: str = "") -> str:
+    """Lease name derived from the watched AuthConfig label selector, so two
+    label-sharded instances in one namespace elect independent leaders and
+    both shards' statuses get written (ref: main.go LeaderElectionID =
+    sha256(watchedAuthConfigLabelSelector)[:8 hex] + suffix)."""
+    digest = hashlib.sha256(auth_config_label_selector.encode("utf-8")).hexdigest()
+    return f"{digest[:8]}.authorino.kuadrant.io"
 
 
 @dataclass
@@ -70,21 +81,29 @@ class LeaderElector:
         leases: LeaseClient,
         identity: str,
         namespace: str = "default",
-        name: str = "cb88d2de.authorino.kuadrant.io",
+        name: Optional[str] = None,
         duration_s: float = 15.0,
         renew_interval: float = 2.0,
+        renew_deadline_s: Optional[float] = None,
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
     ):
         self.leases = leases
         self.identity = identity
         self.namespace = namespace
-        self.name = name
+        self.name = name if name is not None else leader_election_id()
         self.duration_s = duration_s
         self.renew_interval = renew_interval
+        # client-go defaults: renewDeadline (10s) strictly inside
+        # leaseDuration (15s), so a partitioned leader demotes itself
+        # before any follower can legally acquire the expired lease
+        self.renew_deadline_s = (
+            renew_deadline_s if renew_deadline_s is not None else duration_s * 2.0 / 3.0
+        )
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self._leading = False
+        self._last_renew = 0.0
         self._task: Optional[asyncio.Task] = None
 
     def is_leader(self) -> bool:
@@ -111,10 +130,20 @@ class LeaderElector:
                 if rv is not None:
                     lease._resource_version = rv  # type: ignore[attr-defined]
             ok = await self.leases.put_lease(self.namespace, self.name, lease)
+            if ok:
+                self._last_renew = now
             self._set_leading(bool(ok))
             return bool(ok)
-        except Exception as e:  # API unreachable → can't claim leadership
+        except Exception as e:  # API unreachable — retryable while leading
             log.warning("lease renew failed: %s", e)
+            # renew-deadline semantics (client-go): a transient API error
+            # does not demote the leader — no other replica can take the
+            # still-unexpired lease, and demoting leaves zero status
+            # writers.  Step down at the renew deadline, strictly before
+            # lease expiry, so a partitioned leader never overlaps a
+            # follower that legally acquires the expired lease.
+            if self._leading and now - self._last_renew <= self.renew_deadline_s:
+                return True
             self._set_leading(False)
             return False
 

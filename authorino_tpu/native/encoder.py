@@ -38,6 +38,24 @@ def _addr(a: np.ndarray) -> int:
     return a.ctypes.data
 
 
+import re as _re
+
+# the exact grammar the C walkers' array-index parse accepts (encoder.cpp
+# walk / pymod.cpp walk_py: ASCII space/tab trim, one sign, ASCII digits)
+_C_INT_FORM = _re.compile(r"^[ \t]*[+-]?[0-9]+[ \t]*\Z")  # \Z: '$' would pass '1\n'
+
+
+def _int_divergent(seg: str) -> bool:
+    """True when Python int(seg) accepts a form the C parsers reject
+    (underscores, non-ASCII digits, unicode whitespace): the attr must be
+    Python-finished or the two paths disagree on list-index segments."""
+    try:
+        int(seg)
+    except (ValueError, TypeError):
+        return False
+    return _C_INT_FORM.match(seg) is None
+
+
 class _LazyDocs:
     """Parse a doc from its JSON part only if a finishing task needs it."""
 
@@ -82,7 +100,8 @@ class NativeEncoder:
         self._complex_attrs: List[int] = []
         for a, selector_str in enumerate(p.attr_selectors):
             parsed = sel._parse_path(selector_str) if selector_str else ()
-            if selector_str and all(s.kind == "key" for s in parsed):
+            if (selector_str and all(s.kind == "key" for s in parsed)
+                    and not any(_int_divergent(s.key) for s in parsed)):
                 segs.extend(s.key for s in parsed)
             else:
                 attr_complex[a] = 1

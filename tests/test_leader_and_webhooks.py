@@ -72,6 +72,53 @@ class TestLeaderElection:
 
         run(body())
 
+    def test_transient_renew_error_keeps_leading_until_deadline(self):
+        # client-go renew-deadline semantics: an apiserver blip must not
+        # demote the leader while the lease is still unexpired — nobody
+        # else can take it, so demoting leaves zero status writers
+        class FlakyLeases(InMemoryLeases):
+            fail = False
+
+            async def put_lease(self, namespace, name, lease):
+                if self.fail:
+                    raise RuntimeError("apiserver unavailable")
+                return await super().put_lease(namespace, name, lease)
+
+        async def body():
+            leases = FlakyLeases()
+            a = LeaderElector(leases, "a", duration_s=0.3)  # renew deadline 0.2
+            assert await a.try_acquire_or_renew() is True
+            leases.fail = True
+            assert await a.try_acquire_or_renew() is True  # blip: still leading
+            assert a.is_leader()
+            # past the renew deadline but before lease expiry: demote now,
+            # strictly before any follower could acquire (no split-brain)
+            await asyncio.sleep(0.25)
+            assert await a.try_acquire_or_renew() is False
+            assert not a.is_leader()
+            leases.fail = False
+            assert await a.try_acquire_or_renew() is True
+
+        run(body())
+
+    def test_lease_name_derived_from_label_selector(self):
+        from authorino_tpu.k8s.leader import leader_election_id
+
+        a = leader_election_id("shard=a")
+        b = leader_election_id("shard=b")
+        assert a != b
+        assert a.endswith(".authorino.kuadrant.io")
+        assert leader_election_id("shard=a") == a  # deterministic
+        # two label-sharded instances elect independent leaders
+        async def body():
+            leases = InMemoryLeases()
+            ea = LeaderElector(leases, "replica-1", name=a)
+            eb = LeaderElector(leases, "replica-2", name=b)
+            assert await ea.try_acquire_or_renew() is True
+            assert await eb.try_acquire_or_renew() is True
+
+        run(body())
+
     def test_transition_callbacks(self):
         events = []
 

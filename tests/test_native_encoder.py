@@ -156,6 +156,22 @@ class TestComplexSelectors:
         ]
         assert_same(policy, docs, [0] * len(docs))
 
+    def test_array_index_int_divergent_segments(self):
+        # segments Python int() accepts but the C digit parser rejects
+        # (underscores, non-ASCII decimal digits) must be Python-finished,
+        # not silently resolved to missing by the native walk
+        policy = compile_corpus([
+            one_config(Pattern("items.1_0.id", Operator.EQ, "eleventh"), name="cfg-0"),
+            ConfigRules(name="cfg-1", evaluators=[
+                (None, Pattern("items.١.id", Operator.EQ, "second"))]),
+        ])
+        docs = [
+            {"items": [{"id": f"item-{i}"} for i in range(12)]},
+            {"items": [{"id": "first"}, {"id": "second"}]},
+            {"items": []},
+        ]
+        assert_same(policy, docs, [0, 1, 0])
+
     def test_escaped_dot_key(self):
         policy = compile_corpus([one_config(
             Pattern(r"headers.x\.request\.id", Operator.EQ, "r1"))])
