@@ -74,6 +74,12 @@ class ShapeTargets:
     levels: Tuple[Tuple[int, int], ...]  # per level: (rows, children width)
     n_member_attrs: int = 1            # compact membership rows (M)
     n_cpu_leaves: int = 1              # dense CPU-lane columns (C)
+    # device regex lane: DFA row/state/byte-slot axes must also stack across
+    # shards.  n_byte_attrs > 0 in the union forces every shard to carry a
+    # (possibly dummy) DFA lane so the stacked param structure is uniform.
+    n_dfa_rows: int = 1
+    n_dfa_states: int = 1
+    n_byte_attrs: int = 0
 
     @staticmethod
     def union(shapes: Sequence["ShapeTargets"]) -> "ShapeTargets":
@@ -90,6 +96,9 @@ class ShapeTargets:
             levels=tuple(levels),
             n_member_attrs=max(s.n_member_attrs for s in shapes),
             n_cpu_leaves=max(s.n_cpu_leaves for s in shapes),
+            n_dfa_rows=max(s.n_dfa_rows for s in shapes),
+            n_dfa_states=max(s.n_dfa_states for s in shapes),
+            n_byte_attrs=max(s.n_byte_attrs for s in shapes),
         )
 
 
@@ -200,6 +209,9 @@ class CompiledPolicy:
             levels=tuple((int(c.shape[0]), int(c.shape[1])) for c, _ in self.levels),
             n_member_attrs=self.n_member_attrs,
             n_cpu_leaves=self.n_cpu_leaves,
+            n_dfa_rows=int(self.dfa_tables.shape[0]),
+            n_dfa_states=int(self.dfa_tables.shape[1]),
+            n_byte_attrs=self.n_byte_attrs,
         )
 
 
@@ -318,10 +330,11 @@ def compile_corpus(
 ) -> CompiledPolicy:
     """Compile all configs' pattern rules into one CompiledPolicy.
 
-    ``targets`` forces final operand shapes (must dominate the natural ones);
-    ``interner`` lets tensor-parallel shards share one global string table;
-    ``enable_dfa=False`` routes all regexes to the CPU lane (used by the
-    sharded model, whose stacking does not yet unify DFA table shapes)."""
+    ``targets`` forces final operand shapes — including the DFA row/state/
+    byte axes, so tensor-parallel shards stack uniformly (must dominate the
+    natural shapes); ``interner`` lets shards share one global string table;
+    ``enable_dfa=False`` routes all regexes to the CPU lane (tests and manual
+    fallback — the sharded model rides the device DFA lane by default)."""
     interner = interner if interner is not None else StringInterner()
     lw = _Lowerer(interner, members_k, enable_dfa=enable_dfa)
 
@@ -442,12 +455,19 @@ def compile_corpus(
         assert targets.n_attrs >= n_attrs, "targets.n_attrs too small"
         Ap = targets.n_attrs
 
-    # device regex lane tables (stacked per leaf, states padded to max)
+    # device regex lane tables (stacked per leaf, states padded to max).
+    # Targets force R/S/NB so independently-compiled shards stack (padded
+    # rows are never referenced by any leaf; padded states self-loop).
     R = len(dfa_rows)
     S = max((d.n_states for _, d in dfa_rows), default=1)
-    dfa_tables = np.zeros((max(R, 1), S, 256), dtype=np.uint8)
-    dfa_accept = np.zeros((max(R, 1), S), dtype=bool)
-    dfa_leaf_attr = np.zeros((max(R, 1),), dtype=np.int32)
+    Rp = max(R, 1)
+    if targets is not None:
+        assert targets.n_dfa_rows >= Rp, "targets.n_dfa_rows too small"
+        assert targets.n_dfa_states >= S, "targets.n_dfa_states too small"
+        Rp, S = targets.n_dfa_rows, targets.n_dfa_states
+    dfa_tables = np.zeros((Rp, S, 256), dtype=np.uint8)
+    dfa_accept = np.zeros((Rp, S), dtype=bool)
+    dfa_leaf_attr = np.zeros((Rp,), dtype=np.int32)
     attr_byte_slot = np.full((Ap,), -1, dtype=np.int32)
     n_byte_attrs = 0
     for r_i, (attr, dfa) in enumerate(dfa_rows):
@@ -461,6 +481,11 @@ def compile_corpus(
         if attr_byte_slot[attr] < 0:
             attr_byte_slot[attr] = n_byte_attrs
             n_byte_attrs += 1
+    if targets is not None:
+        assert targets.n_byte_attrs >= n_byte_attrs, "targets.n_byte_attrs too small"
+        # force a uniform (possibly dummy) byte-tensor axis so shards whose
+        # sub-corpus has fewer (or no) regexes still stack with the others
+        n_byte_attrs = targets.n_byte_attrs
     attr_selectors = [""] * Ap
     for sel, idx in lw.attrs.items():
         attr_selectors[idx] = sel

@@ -100,6 +100,19 @@ class LeaderElector:
         self.renew_deadline_s = (
             renew_deadline_s if renew_deadline_s is not None else duration_s * 2.0 / 3.0
         )
+        # client-go rejects these at construction (leaderelection.go config
+        # validation): a deadline at/after lease expiry voids the "demote
+        # strictly before a follower can acquire" invariant
+        if self.renew_deadline_s >= duration_s:
+            raise ValueError(
+                f"renew_deadline_s ({self.renew_deadline_s}) must be < "
+                f"duration_s ({duration_s})"
+            )
+        if renew_interval >= self.renew_deadline_s:
+            raise ValueError(
+                f"renew_interval ({renew_interval}) must be < "
+                f"renew_deadline_s ({self.renew_deadline_s})"
+            )
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self._leading = False
@@ -142,7 +155,13 @@ class LeaderElector:
             # writers.  Step down at the renew deadline, strictly before
             # lease expiry, so a partitioned leader never overlaps a
             # follower that legally acquires the expired lease.
-            if self._leading and now - self._last_renew <= self.renew_deadline_s:
+            # re-read the clock: time blocked inside the failed API call
+            # counts against the deadline (a request that hangs past lease
+            # expiry must demote NOW, not one cycle later)
+            if (
+                self._leading
+                and time.monotonic() - self._last_renew <= self.renew_deadline_s
+            ):
                 return True
             self._set_leading(False)
             return False

@@ -313,7 +313,9 @@ def _eval_verdicts_matmul(params, attrs_val, members_c, cpu_dense,
             nxt = jnp.sum(st_oh * nxt_by_state, axis=-1)
             return nxt, None
 
-        init = jnp.zeros((B, R), dtype=f32)
+        # derive the scan's init carry from a varying input (zero-multiplied)
+        # so its manual-mesh "varying" type matches inside shard_map
+        init = row_bytes[:, :, 0] * 0.0
         final, _ = jax.lax.scan(dfa_step, init, jnp.transpose(row_bytes, (2, 0, 1)))
         final_oh = (final[..., None] == iota_s).astype(cdt)
         dfa_row_res = jnp.einsum(
@@ -387,7 +389,9 @@ def _eval_verdicts_gather(params, attrs_val, members_c, cpu_dense,
             nxt = tables[row_idx, states, byte_col.astype(jnp.int32)]
             return nxt.astype(jnp.int32), None
 
-        init = jnp.zeros((B, R), dtype=jnp.int32)
+        # init carry derived from a varying input (zero-multiplied) so its
+        # manual-mesh "varying" type matches inside shard_map
+        init = (row_bytes[:, :, 0] * 0).astype(jnp.int32)
         final, _ = jax.lax.scan(dfa_step, init, jnp.transpose(row_bytes, (2, 0, 1)))
         dfa_row_res = params["dfa_accept"][row_idx, final]   # [B, R]
         leaf_dfa = jnp.take(dfa_row_res, params["leaf_dfa_row"], axis=1)  # [B, L]

@@ -12,9 +12,13 @@ verdict gather, which XLA lays onto ICI.
 Layout:
   - configs are round-robined into ``mp`` groups; each group compiles as its
     own sub-corpus against a shared interner, with ShapeTargets forcing
-    identical operand shapes; arrays stack on a leading [S] axis
+    identical operand shapes (incl. DFA row/state/byte-slot axes, so the
+    device regex lane rides the mesh too); arrays stack on a leading [S] axis
   - mesh ('dp', 'mp'): batch is sharded over dp, the [S] corpus axis over mp
-  - shard_map evaluates each (dp, mp) block locally → verdict [B, S*G]
+  - shard_map evaluates each (dp, mp) block locally → verdict [B, S*G] plus
+    per-evaluator rule/skipped [B, S*G, E] — the same outputs as the
+    single-corpus ``eval_full_jit``, so PolicyEngine can serve from a
+    sharded snapshot when more than one device is present
 """
 
 from __future__ import annotations
@@ -40,6 +44,99 @@ from ..ops.pattern_eval import eval_verdicts, to_device
 __all__ = ["ShardedPolicyModel", "build_mesh"]
 
 
+# jitted sharded steps cached per (mesh, has_dfa, n_levels): reconcile-time
+# apply_snapshot builds a fresh ShardedPolicyModel, and a per-model
+# jax.jit(shard_map(...)) closure would force a full XLA recompile on every
+# snapshot even at unchanged shapes — the sharded analog of the module-level
+# eval_packed_jit cache on the single-corpus path.
+_STEP_CACHE: Dict[Tuple[Mesh, bool, int], Any] = {}
+
+
+def _param_specs(has_dfa: bool, n_levels: int):
+    lspec = tuple((P("mp"), P("mp")) for _ in range(n_levels))
+    mp = P("mp")
+    return {
+        "leaf_op": mp,
+        "leaf_attr": mp,
+        "leaf_const": mp,
+        "member_slot_of_leaf": mp,
+        "cpu_scatter_idx": mp,
+        "levels": lspec,
+        "eval_cond": mp,
+        "eval_rule": mp,
+        "eval_has_cond": mp,
+        # None params are empty pytree nodes; specs mirror the structure
+        "dfa_tables": mp if has_dfa else None,
+        "dfa_accept": mp if has_dfa else None,
+        "dfa_byte_slot": mp if has_dfa else None,
+        "leaf_dfa_row": mp if has_dfa else None,
+    }
+
+
+def _sharded_step(mesh: Mesh, has_dfa: bool, n_levels: int):
+    """Own-config evaluation step over the mesh: each mp shard evaluates its
+    sub-corpus, selects the rows of requests whose config it owns, and the
+    tiny [B], [B, E] results combine with one psum over 'mp' — so the
+    device→host readback is own-rows only, never the [B, S*G(, E)] matrices
+    (the sharded analog of eval_packed_jit's one-small-readback contract)."""
+    key = (mesh, has_dfa, n_levels)
+    step = _STEP_CACHE.get(key)
+    if step is not None:
+        return step
+    specs = _param_specs(has_dfa, n_levels)
+
+    def local_eval(params, attrs_val, members_c, cpu_dense,
+                   attr_bytes, byte_ovf, shard_of, row_of):
+        # params leading axis is the local S slice (size 1 per mp shard)
+        sq = jax.tree_util.tree_map(lambda a: a[0], params)
+        verdict, (rule, skipped) = eval_verdicts(
+            sq,
+            attrs_val[:, 0],
+            members_c[:, 0],
+            cpu_dense[:, 0],
+            attr_bytes[:, 0] if has_dfa else None,
+            byte_ovf[:, 0] if has_dfa else None,
+        )
+        # own-config one-hot rows local to this shard (other shards see all-
+        # False masks for the request); psum over mp merges the disjoint parts
+        G = verdict.shape[1]
+        mp_idx = jax.lax.axis_index("mp")
+        mask = (shard_of == mp_idx)[:, None] & (
+            row_of[:, None] == jnp.arange(G, dtype=row_of.dtype)[None, :]
+        )                                                        # [B_l, G]
+        own = jnp.any(verdict & mask, axis=1)
+        own_rule = jnp.any(rule & mask[:, :, None], axis=1)      # [B_l, E]
+        own_skip = jnp.any(skipped & mask[:, :, None], axis=1)
+        merged = jax.lax.psum(
+            jnp.concatenate(
+                [own[:, None], own_rule, own_skip], axis=1
+            ).astype(jnp.int32),
+            "mp",
+        )
+        return merged > 0                                        # [B_l, 1+2E]
+
+    byte_specs = (
+        (P("dp", "mp", None, None), P("dp", "mp", None))
+        if has_dfa
+        else (None, None)
+    )
+    step = jax.jit(
+        jax.shard_map(
+            local_eval,
+            mesh=mesh,
+            in_specs=(
+                specs,
+                P("dp", "mp", None),
+                P("dp", "mp", None, None),
+                P("dp", "mp", None),
+            ) + byte_specs + (P("dp"), P("dp")),
+            out_specs=P("dp"),
+        )
+    )
+    _STEP_CACHE[key] = step
+    return step
+
+
 def build_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mesh:
     devices = np.asarray(jax.devices()[: n_devices or len(jax.devices())])
     n = devices.size
@@ -54,6 +151,8 @@ class _ShardedEncoded:
     attrs_val: np.ndarray      # [B, S, A]
     members_c: np.ndarray      # [B, S, M, K] — compact membership rows
     cpu_dense: np.ndarray      # [B, S, C] — dense CPU-lane columns
+    attr_bytes: Optional[np.ndarray]  # [B, S, NB, LB] uint8 (None: no DFA lane)
+    byte_ovf: Optional[np.ndarray]    # [B, S, NB] bool
     shard_of: np.ndarray       # [B] which shard owns the request's config
     row_of: np.ndarray         # [B] row within that shard
     host_fallback: np.ndarray  # [B] bool — exact re-decision on host
@@ -75,17 +174,19 @@ class ShardedPolicyModel:
             groups[shard].append(cfg)
 
         # two-pass compile: natural shapes → union targets → final compile.
-        # enable_dfa=False: regexes ride the CPU lane here — DFA table shapes
-        # are not yet unified across shards (single-corpus serving uses them)
+        # The union carries the DFA row/state/byte axes, so shards with
+        # regexes stack their device-DFA tables and regex-free shards carry
+        # a dummy lane of the same shape.
         first = [
-            compile_corpus(g, members_k=members_k, interner=interner, enable_dfa=False)
+            compile_corpus(g, members_k=members_k, interner=interner)
             for g in groups
         ]
         targets = ShapeTargets.union([p.shape_targets() for p in first])
         self.shards: List[CompiledPolicy] = [
-            compile_corpus(g, members_k=members_k, interner=interner, targets=targets, enable_dfa=False)
+            compile_corpus(g, members_k=members_k, interner=interner, targets=targets)
             for g in groups
         ]
+        self.has_dfa = self.shards[0].n_byte_attrs > 0
         # eval tables may still differ in row count (configs per shard): pad G
         G = max(p.n_configs for p in self.shards)
         self.configs_per_shard = G
@@ -96,7 +197,6 @@ class ShardedPolicyModel:
             pad = np.full((G - a.shape[0],) + a.shape[1:], fill, dtype=a.dtype)
             return np.concatenate([a, pad], axis=0)
 
-        stacked: Dict[str, Any] = {}
         # gather lane: the stacked params keep only gather-lane keys, so
         # building matmul operands per shard would be wasted upload
         per_shard_params = [to_device(p, lane="gather") for p in self.shards]
@@ -126,87 +226,37 @@ class ShardedPolicyModel:
             "eval_cond": jnp.asarray(eval_cond),
             "eval_rule": jnp.asarray(eval_rule),
             "eval_has_cond": jnp.asarray(eval_has),
-            # regexes ride the CPU lane in the sharded path (enable_dfa=False)
-            "dfa_tables": None,
-            "dfa_accept": None,
-            "dfa_byte_slot": None,
-            "leaf_dfa_row": None,
+            # device regex lane (uniform across shards by ShapeTargets union;
+            # None pytree nodes when no shard has a DFA-compilable regex)
+            "dfa_tables": stack("dfa_tables") if self.has_dfa else None,
+            "dfa_accept": stack("dfa_accept") if self.has_dfa else None,
+            "dfa_byte_slot": stack("dfa_byte_slot") if self.has_dfa else None,
+            "leaf_dfa_row": stack("leaf_dfa_row") if self.has_dfa else None,
         }
         self._place_params()
-        self._step = self._build_step()
+        self._step = _sharded_step(mesh, self.has_dfa, n_levels)
 
     # ------------------------------------------------------------------
 
-    def _param_specs(self):
-        lspec = tuple((P("mp"), P("mp")) for _ in self.params["levels"])
-        return {
-            "leaf_op": P("mp"),
-            "leaf_attr": P("mp"),
-            "leaf_const": P("mp"),
-            "member_slot_of_leaf": P("mp"),
-            "cpu_scatter_idx": P("mp"),
-            "levels": lspec,
-            "eval_cond": P("mp"),
-            "eval_rule": P("mp"),
-            "eval_has_cond": P("mp"),
-            # None params are empty pytree nodes; specs mirror the structure
-            "dfa_tables": None,
-            "dfa_accept": None,
-            "dfa_byte_slot": None,
-            "leaf_dfa_row": None,
-        }
-
     def _place_params(self):
-        specs = self._param_specs()
+        specs = _param_specs(self.has_dfa, len(self.params["levels"]))
 
         def place(a, spec):
+            if a is None:
+                return None
             return jax.device_put(a, NamedSharding(self.mesh, spec))
 
         p = self.params
         self.params = {
-            "leaf_op": place(p["leaf_op"], specs["leaf_op"]),
-            "leaf_attr": place(p["leaf_attr"], specs["leaf_attr"]),
-            "leaf_const": place(p["leaf_const"], specs["leaf_const"]),
-            "member_slot_of_leaf": place(p["member_slot_of_leaf"], specs["member_slot_of_leaf"]),
-            "cpu_scatter_idx": place(p["cpu_scatter_idx"], specs["cpu_scatter_idx"]),
+            **{
+                k: place(p[k], specs[k])
+                for k in p
+                if k != "levels"
+            },
             "levels": tuple(
                 (place(c, P("mp")), place(a, P("mp"))) for c, a in p["levels"]
             ),
-            "eval_cond": place(p["eval_cond"], specs["eval_cond"]),
-            "eval_rule": place(p["eval_rule"], specs["eval_rule"]),
-            "eval_has_cond": place(p["eval_has_cond"], specs["eval_has_cond"]),
-            "dfa_tables": None,
-            "dfa_accept": None,
-            "dfa_byte_slot": None,
-            "leaf_dfa_row": None,
         }
-
-    def _build_step(self):
-        shard_map = jax.shard_map
-
-        mesh = self.mesh
-        specs = self._param_specs()
-
-        def local_eval(params, attrs_val, members_c, cpu_dense):
-            # params leading axis is the local S slice (size 1 per mp shard)
-            sq = jax.tree_util.tree_map(lambda a: a[0], params)
-            verdict, _ = eval_verdicts(
-                sq, attrs_val[:, 0], members_c[:, 0], cpu_dense[:, 0]
-            )
-            return verdict  # [B_local, G]
-
-        step = shard_map(
-            local_eval,
-            mesh=mesh,
-            in_specs=(
-                specs,
-                P("dp", "mp", None),
-                P("dp", "mp", None, None),
-                P("dp", "mp", None),
-            ),
-            out_specs=P("dp", "mp"),
-        )
-        return jax.jit(step)
 
     # ------------------------------------------------------------------
 
@@ -227,6 +277,14 @@ class ShardedPolicyModel:
         attrs_val = np.full((B, S, A), EMPTY_ID, dtype=np.int32)
         members_c = np.full((B, S, M, K), PAD, dtype=np.int32)
         cpu_dense = np.zeros((B, S, C), dtype=bool)
+        if self.has_dfa:
+            from ..compiler.compile import DFA_VALUE_BYTES
+
+            NB = p0.n_byte_attrs
+            attr_bytes = np.zeros((B, S, NB, DFA_VALUE_BYTES), dtype=np.uint8)
+            byte_ovf = np.zeros((B, S, NB), dtype=bool)
+        else:
+            attr_bytes = byte_ovf = None
         shard_of = np.zeros((B,), dtype=np.int32)
         row_of = np.zeros((B,), dtype=np.int32)
         host_fallback = np.zeros((B,), dtype=bool)
@@ -247,19 +305,59 @@ class ShardedPolicyModel:
             attrs_val[rs, shard] = db.attrs_val[: len(rs)]
             members_c[rs, shard] = db.members_c[: len(rs)]
             cpu_dense[rs, shard] = db.cpu_dense[: len(rs)]
+            if self.has_dfa:
+                attr_bytes[rs, shard] = db.attr_bytes[: len(rs)]
+                byte_ovf[rs, shard] = db.byte_ovf[: len(rs)]
             host_fallback[rs] = db.host_fallback[: len(rs)]
-        return _ShardedEncoded(attrs_val, members_c, cpu_dense, shard_of, row_of, host_fallback)
+        return _ShardedEncoded(
+            attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf,
+            shard_of, row_of, host_fallback,
+        )
 
-    def apply(self, encoded: _ShardedEncoded) -> np.ndarray:
-        verdict = self._step(
+    def _run_step(self, encoded: _ShardedEncoded) -> np.ndarray:
+        """Packed own-rows result [B, 1+2E] — one small readback per batch
+        (own-config selection happens on device, inside the shard_map)."""
+        packed = self._step(
             self.params,
             jnp.asarray(encoded.attrs_val),
             jnp.asarray(encoded.members_c),
             jnp.asarray(encoded.cpu_dense),
+            jnp.asarray(encoded.attr_bytes) if self.has_dfa else None,
+            jnp.asarray(encoded.byte_ovf) if self.has_dfa else None,
+            jnp.asarray(encoded.shard_of),
+            jnp.asarray(encoded.row_of),
         )
-        v = np.asarray(verdict)  # [B, S*G]
-        flat = encoded.shard_of * self.configs_per_shard + encoded.row_of
-        return v[np.arange(v.shape[0]), flat]
+        return np.asarray(packed)
+
+    def apply(self, encoded: _ShardedEncoded) -> np.ndarray:
+        return self._run_step(encoded)[:, 0]
+
+    def apply_full(self, encoded: _ShardedEncoded) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Own-config (verdict [B], rule results [B, E], skipped [B, E]) —
+        the same contract as the single-corpus ``eval_full_jit``."""
+        packed = self._run_step(encoded)
+        E = int(self.shards[0].eval_rule.shape[1])
+        own = packed[:, 0]
+        own_rule = packed[:, 1:1 + E].copy()      # writable: host fallback
+        own_skipped = packed[:, 1 + E:1 + 2 * E].copy()
+        return own, own_rule, own_skipped
+
+    def run_full(
+        self, docs: Sequence[Any], config_names: Sequence[str], batch_pad: int = 0
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Serving entry (PolicyEngine._run_batch contract): per-request
+        per-evaluator (rule_results [B, E], skipped [B, E]), with requests
+        the compact encoding cannot represent re-decided on host."""
+        from ..models.policy_model import host_results
+
+        enc = self.encode(docs, config_names, batch_pad=batch_pad)
+        _, own_rule, own_skipped = self.apply_full(enc)
+        for r in np.nonzero(enc.host_fallback[: len(docs)])[0]:
+            shard, row = self.locator[config_names[r]]
+            _, own_rule[r], own_skipped[r] = host_results(
+                self.shards[shard], docs[r], int(row)
+            )
+        return own_rule, own_skipped
 
     def decide(self, docs: Sequence[Any], config_names: Sequence[str]) -> List[bool]:
         from ..models.policy_model import host_results

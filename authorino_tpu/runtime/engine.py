@@ -43,18 +43,29 @@ class EngineEntry:
 
 
 class _Snapshot:
-    """Immutable compiled corpus + device params (double-buffered)."""
+    """Immutable compiled corpus + device params (double-buffered).
 
-    def __init__(self, entries: Sequence[EngineEntry], members_k: int = 16):
+    With a multi-device mesh, the corpus compiles as a ShardedPolicyModel
+    (rules axis tensor-parallel over 'mp', batch over 'dp') — the TPU-era
+    successor of the reference's label-selector instance sharding
+    (ref: controllers/label_selector.go:14-45)."""
+
+    def __init__(self, entries: Sequence[EngineEntry], members_k: int = 16, mesh=None):
         from ..ops.pattern_eval import to_device
 
         self.by_id: Dict[str, EngineEntry] = {e.id: e for e in entries}
         rules = [e.rules for e in entries if e.rules is not None]
         self.policy: Optional[CompiledPolicy] = None
         self.params = None
+        self.sharded = None
         if rules:
-            self.policy = compile_corpus(rules, members_k=members_k)
-            self.params = to_device(self.policy)
+            if mesh is not None:
+                from ..parallel import ShardedPolicyModel
+
+                self.sharded = ShardedPolicyModel(rules, mesh, members_k=members_k)
+            else:
+                self.policy = compile_corpus(rules, members_k=members_k)
+                self.params = to_device(self.policy)
 
 
 @dataclass
@@ -71,12 +82,18 @@ class PolicyEngine:
         max_delay_s: float = 0.0005,
         timeout_s: Optional[float] = None,
         members_k: int = 16,
+        mesh: Any = "auto",
     ):
+        """``mesh="auto"`` shards the rule corpus over all visible devices
+        when more than one is present (dp × mp ShardedPolicyModel);
+        ``mesh=None`` forces the single-corpus path; an explicit
+        ``jax.sharding.Mesh`` pins the layout."""
         self.index: HostIndex[EngineEntry] = HostIndex()
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
         self.timeout_s = timeout_s
         self.members_k = members_k
+        self._mesh = mesh
         self._snapshot: Optional[_Snapshot] = None
         self._swap_lock = threading.Lock()
         self._pending: List[_Pending] = []
@@ -84,11 +101,20 @@ class PolicyEngine:
 
     # ---- control plane ---------------------------------------------------
 
+    def _resolve_mesh(self):
+        if self._mesh == "auto":
+            import jax
+
+            from ..parallel import build_mesh
+
+            self._mesh = build_mesh() if len(jax.devices()) > 1 else None
+        return self._mesh
+
     def apply_snapshot(self, entries: Sequence[EngineEntry], override: bool = True) -> None:
         """Compile the new corpus off the serving path, then atomically swap
         snapshot + index (double buffering: in-flight batches keep the old
         params alive until their futures resolve)."""
-        snap = _Snapshot(entries, members_k=self.members_k)
+        snap = _Snapshot(entries, members_k=self.members_k, mesh=self._resolve_mesh())
         new_index: HostIndex[EngineEntry] = HostIndex()
         for e in entries:
             for host in e.hosts:
@@ -156,7 +182,7 @@ class PolicyEngine:
 
     async def _flush(self, batch: List[_Pending]) -> None:
         snap = self._snapshot
-        if snap is None or snap.policy is None:
+        if snap is None or (snap.policy is None and snap.sharded is None):
             for p in batch:
                 if not p.future.done():
                     p.future.set_exception(RuntimeError("no compiled policy snapshot"))
@@ -173,6 +199,12 @@ class PolicyEngine:
                 p.future.set_result((own_rule[i], own_skipped[i]))
 
     def _run_batch(self, snap: _Snapshot, batch: List[_Pending]):
+        if snap.sharded is not None:
+            return snap.sharded.run_full(
+                [p.doc for p in batch],
+                [p.config_name for p in batch],
+                batch_pad=_bucket(len(batch)),
+            )
         from ..compiler.pack import pack_batch
         from ..models.policy_model import host_results
         from ..ops.pattern_eval import eval_packed_jit

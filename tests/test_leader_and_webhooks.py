@@ -45,8 +45,8 @@ class TestLeaderElection:
     def test_single_winner_and_failover(self):
         async def body():
             leases = InMemoryLeases()
-            a = LeaderElector(leases, "replica-a", duration_s=0.2)
-            b = LeaderElector(leases, "replica-b", duration_s=0.2)
+            a = LeaderElector(leases, "replica-a", duration_s=0.2, renew_interval=0.02)
+            b = LeaderElector(leases, "replica-b", duration_s=0.2, renew_interval=0.02)
             assert await a.try_acquire_or_renew() is True
             assert await b.try_acquire_or_renew() is False
             assert a.is_leader() and not b.is_leader()
@@ -86,7 +86,7 @@ class TestLeaderElection:
 
         async def body():
             leases = FlakyLeases()
-            a = LeaderElector(leases, "a", duration_s=0.3)  # renew deadline 0.2
+            a = LeaderElector(leases, "a", duration_s=0.3, renew_interval=0.02)  # renew deadline 0.2
             assert await a.try_acquire_or_renew() is True
             leases.fail = True
             assert await a.try_acquire_or_renew() is True  # blip: still leading
@@ -98,6 +98,42 @@ class TestLeaderElection:
             assert not a.is_leader()
             leases.fail = False
             assert await a.try_acquire_or_renew() is True
+
+        run(body())
+
+    def test_invalid_timing_config_rejected(self):
+        # client-go hard-errors on leaseDuration <= renewDeadline and
+        # renewDeadline <= retryPeriod — silently accepting them re-opens
+        # the two-leaders-during-partition window
+        import pytest
+
+        leases = InMemoryLeases()
+        with pytest.raises(ValueError):
+            LeaderElector(leases, "a", duration_s=15.0, renew_deadline_s=20.0)
+        with pytest.raises(ValueError):
+            LeaderElector(leases, "a", duration_s=15.0, renew_deadline_s=10.0,
+                          renew_interval=10.0)
+
+    def test_hanging_renew_counts_against_deadline(self):
+        # a renew call that BLOCKS past the deadline must demote on the
+        # failure path immediately — the clock is re-read after the await,
+        # not captured before it
+        class HangingLeases(InMemoryLeases):
+            hang_s = 0.0
+
+            async def put_lease(self, namespace, name, lease):
+                if self.hang_s:
+                    await asyncio.sleep(self.hang_s)
+                    raise RuntimeError("apiserver partitioned")
+                return await super().put_lease(namespace, name, lease)
+
+        async def body():
+            leases = HangingLeases()
+            a = LeaderElector(leases, "a", duration_s=0.3, renew_interval=0.02)
+            assert await a.try_acquire_or_renew() is True
+            leases.hang_s = 0.25  # blocks past the 0.2 renew deadline
+            assert await a.try_acquire_or_renew() is False
+            assert not a.is_leader()
 
         run(body())
 
@@ -125,7 +161,7 @@ class TestLeaderElection:
         async def body():
             leases = InMemoryLeases()
             a = LeaderElector(
-                leases, "a", duration_s=0.2,
+                leases, "a", duration_s=0.2, renew_interval=0.02,
                 on_started_leading=lambda: events.append("start"),
                 on_stopped_leading=lambda: events.append("stop"),
             )

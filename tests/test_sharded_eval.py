@@ -56,3 +56,125 @@ def test_sharded_params_actually_sharded():
     assert m.params["leaf_op"].shape[0] == 4
     shard_devs = {d for d in m.params["leaf_op"].sharding.device_set}
     assert len(shard_devs) == 8  # placed across the whole mesh
+
+
+def test_sharded_dfa_lane_rides_the_mesh():
+    """Regexes concentrated in a few configs: only some shards naturally
+    have DFA rows, the ShapeTargets union forces a uniform lane, and the
+    device verdicts still match the oracle."""
+    from authorino_tpu.expressions import All, Operator, Pattern
+
+    configs = []
+    for i in range(9):  # 9 configs over mp=4 shards → uneven
+        pats = [Pattern("request.method", Operator.EQ, "GET")]
+        if i % 3 == 0:  # regexes only in configs 0,3,6 → shards 0,3,2
+            pats.append(Pattern("request.url_path", Operator.MATCHES, rf"^/svc-{i}/\d+$"))
+        configs.append(ConfigRules(name=f"cfg-{i}", evaluators=[(None, All(*pats))]))
+    mesh = build_mesh(n_devices=8, dp=2)
+    m = ShardedPolicyModel(configs, mesh, members_k=4)
+    assert m.has_dfa and m.params["dfa_tables"] is not None
+
+    docs, names, expected = [], [], []
+    for i in range(9):
+        for path, ok in [(f"/svc-{i}/42", True), (f"/svc-{i}/x", False)]:
+            docs.append({"request": {"method": "GET", "url_path": path}})
+            names.append(f"cfg-{i}")
+            expected.append(ok if i % 3 == 0 else True)
+    assert m.decide(docs, names) == expected
+
+
+def test_sharded_full_outputs_match_single_corpus():
+    """apply_full returns the same own (verdict, rule, skipped) tensors as
+    the single-corpus eval_full_jit — the contract PolicyEngine serves."""
+    import jax.numpy as jnp
+
+    from authorino_tpu.ops.pattern_eval import eval_full_jit
+
+    rng = random.Random(21)
+    configs = make_corpus(rng, 11)
+    mesh = build_mesh(n_devices=8, dp=2)
+    sharded = ShardedPolicyModel(configs, mesh, members_k=8)
+    single = PolicyModel.from_configs(configs, members_k=8)
+
+    docs = [random_doc(rng) for _ in range(24)]
+    names = [f"cfg-{rng.randrange(len(configs))}" for _ in docs]
+    rows = [single.policy.config_ids[n] for n in names]
+
+    enc_s = sharded.encode(docs, names)
+    own_s, rule_s, skip_s = sharded.apply_full(enc_s)
+
+    db = single.encode(docs, rows)
+    has_dfa = single.params["dfa_tables"] is not None
+    own_1, rule_1, skip_1 = (
+        np.asarray(a)
+        for a in eval_full_jit(
+            single.params,
+            jnp.asarray(db.attrs_val),
+            jnp.asarray(db.members_c),
+            jnp.asarray(db.cpu_dense),
+            jnp.asarray(db.config_id),
+            jnp.asarray(db.attr_bytes) if has_dfa else None,
+            jnp.asarray(db.byte_ovf) if has_dfa else None,
+        )
+    )
+    B = len(docs)
+    ok = ~enc_s.host_fallback[:B]  # compact-lossy rows go to the host oracle
+    E = min(rule_s.shape[1], rule_1.shape[1])  # padding columns may differ
+    assert (own_s[:B][ok] == own_1[:B][ok]).all()
+    assert (rule_s[:B, :E][ok] == rule_1[:B, :E][ok]).all()
+    assert (skip_s[:B, :E][ok] == skip_1[:B, :E][ok]).all()
+
+
+def test_engine_serves_from_sharded_snapshot():
+    """PolicyEngine auto-detects the multi-device mesh, compiles the corpus
+    as a ShardedPolicyModel (non-default members_k plumbed through), and the
+    batched submit path returns oracle-exact rule/skipped."""
+    import asyncio
+
+    from authorino_tpu.expressions import All, Any_, Operator, Pattern
+    from authorino_tpu.runtime import EngineEntry, PolicyEngine
+
+    engine = PolicyEngine(max_batch=4, max_delay_s=0.001, members_k=4)
+    entries = []
+    exprs = {}
+    for i in range(6):
+        rule = All(
+            Pattern("request.method", Operator.EQ, "GET"),
+            Any_(
+                Pattern("auth.identity.roles", Operator.INCL, f"r{i}"),
+                Pattern("request.url_path", Operator.MATCHES, rf"^/pub-{i}/"),
+            ),
+        )
+        exprs[f"ns/cfg-{i}"] = rule
+        entries.append(
+            EngineEntry(
+                id=f"ns/cfg-{i}",
+                hosts=[f"svc-{i}.example.com"],
+                runtime=None,
+                rules=ConfigRules(name=f"ns/cfg-{i}", evaluators=[(None, rule)]),
+            )
+        )
+    engine.apply_snapshot(entries)
+    assert engine._snapshot.sharded is not None  # 8 virtual devices → sharded
+    assert engine._snapshot.sharded.shards[0].members_k == 4
+
+    docs = [
+        {"request": {"method": "GET", "url_path": "/pub-2/x"},
+         "auth": {"identity": {"roles": ["nope"]}}},
+        {"request": {"method": "GET", "url_path": "/priv"},
+         "auth": {"identity": {"roles": ["r3", "other"]}}},
+        {"request": {"method": "POST", "url_path": "/pub-4/x"},
+         "auth": {"identity": {"roles": ["r4"]}}},
+        # membership overflow vs members_k=4 → host-fallback lane
+        {"request": {"method": "GET", "url_path": "/priv"},
+         "auth": {"identity": {"roles": [f"x{k}" for k in range(9)] + ["r5"]}}},
+    ]
+    names = ["ns/cfg-2", "ns/cfg-3", "ns/cfg-4", "ns/cfg-5"]
+
+    async def run():
+        return await asyncio.gather(*[engine.submit(d, n) for d, n in zip(docs, names)])
+
+    results = asyncio.new_event_loop().run_until_complete(run())
+    got = [bool(rule[0]) for rule, _ in results]
+    expected = [bool(exprs[n].matches(d)) for d, n in zip(docs, names)]
+    assert got == expected == [True, True, False, True]
