@@ -1,0 +1,41 @@
+# authorino-tpu serving image (parity: ref Dockerfile:8 — the reference
+# builds a static Go binary; here the image carries the Python package, the
+# native C++ batch encoder prebuilt from source, and jax from the base
+# image).
+#
+# Build from a jax-enabled base so the TPU runtime libraries match the host
+# (on GKE TPU node pools use the cloud-tpu base; for CPU-only serving any
+# python base works with JAX_PLATFORM=cpu).
+ARG BASE_IMAGE=python:3.11-slim
+FROM ${BASE_IMAGE} AS build
+
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /src
+COPY pyproject.toml README.md ./
+COPY authorino_tpu ./authorino_tpu
+COPY native ./native
+
+RUN pip install --no-cache-dir .
+
+# Prebuild the native batch encoder at the path the loader probes
+# (authorino_tpu/native/__init__.py: <pkg>/native/_build/_atpuenc.so, with
+# sources expected at <site-packages>/native for the staleness check).
+# The loader falls back to the pure-Python encoder if any of this is absent.
+RUN SITE=$(python -c "import sysconfig; print(sysconfig.get_paths()['purelib'])") && \
+    cp -r native "$SITE/native" && \
+    mkdir -p "$SITE/authorino_tpu/native/_build" && \
+    g++ -O2 -std=c++17 -shared -fPIC -pthread \
+        -I "$(python -c "import sysconfig; print(sysconfig.get_paths()['include'])")" \
+        "$SITE/native/pymod.cpp" \
+        -o "$SITE/authorino_tpu/native/_build/_atpuenc.so" && \
+    touch "$SITE/authorino_tpu/native/_build/_atpuenc.so"
+
+FROM ${BASE_IMAGE}
+RUN groupadd -r authorino && useradd -r -g authorino -u 1001 authorino
+COPY --from=build /usr/local/lib/python3.11/site-packages /usr/local/lib/python3.11/site-packages
+COPY --from=build /usr/local/bin/authorino-tpu /usr/local/bin/authorino-tpu
+USER 1001
+ENTRYPOINT ["authorino-tpu"]
+CMD ["server"]

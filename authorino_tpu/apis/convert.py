@@ -239,8 +239,9 @@ def to_v1beta2(resource: dict) -> dict:
                     "url": er.get("endpoint", ""),
                     "sharedSecretRef": er.get("sharedSecretRef"),
                     "ttl": er.get("ttl", 0),
-                    "credentials": _v1_credentials_to_v2(er.get("credentials")),
                 }
+                if er.get("credentials"):
+                    z["opa"]["externalPolicy"]["credentials"] = _v1_credentials_to_v2(er["credentials"])
         elif az.get("kubernetes") is not None:
             k = az["kubernetes"]
             z["kubernetesSubjectAccessReview"] = {
@@ -435,6 +436,8 @@ def to_v1beta1(resource: dict) -> dict:
                     "sharedSecretRef": ep.get("sharedSecretRef"),
                     "ttl": ep.get("ttl", 0),
                 }
+                if ep.get("credentials"):
+                    d["opa"]["externalRegistry"]["credentials"] = _v2_credentials_to_v1(ep["credentials"])
         elif z.get("kubernetesSubjectAccessReview") is not None:
             k = z["kubernetesSubjectAccessReview"]
             d["kubernetes"] = {

@@ -226,7 +226,8 @@ def _round_up(n: int, multiple: int = 8, minimum: int = 8) -> int:
 
 
 class _Lowerer:
-    def __init__(self, interner: StringInterner, members_k: int, enable_dfa: bool = True):
+    def __init__(self, interner: StringInterner, members_k: int, enable_dfa: bool = True,
+                 dfa_cache: Optional[Dict[str, Optional["object"]]] = None):
         self.interner = interner
         self.members_k = members_k
         self.enable_dfa = enable_dfa
@@ -237,7 +238,12 @@ class _Lowerer:
         self.nodes: List[Tuple[int, bool, List[int]]] = []
         self.depth_of: Dict[int, int] = {TRUE_SLOT: 0, FALSE_SLOT: 0}
         self.tree_leaf_by_expr: Dict[int, int] = {}
-        self._dfa_cache: Dict[str, Optional["object"]] = {}
+        # regex determinization is the most expensive part of compilation;
+        # a caller-shared cache lets the sharded model's two-pass compile
+        # (and all its shards) determinize each distinct regex once
+        self._dfa_cache: Dict[str, Optional["object"]] = (
+            dfa_cache if dfa_cache is not None else {}
+        )
 
     def _dfa_for(self, pattern: str):
         hit = self._dfa_cache.get(pattern, _DFA_MISS)
@@ -327,6 +333,7 @@ def compile_corpus(
     targets: Optional[ShapeTargets] = None,
     interner: Optional[StringInterner] = None,
     enable_dfa: bool = True,
+    dfa_cache: Optional[Dict[str, Any]] = None,
 ) -> CompiledPolicy:
     """Compile all configs' pattern rules into one CompiledPolicy.
 
@@ -336,7 +343,7 @@ def compile_corpus(
     ``enable_dfa=False`` routes all regexes to the CPU lane (tests and manual
     fallback — the sharded model rides the device DFA lane by default)."""
     interner = interner if interner is not None else StringInterner()
-    lw = _Lowerer(interner, members_k, enable_dfa=enable_dfa)
+    lw = _Lowerer(interner, members_k, enable_dfa=enable_dfa, dfa_cache=dfa_cache)
 
     # 1. lower every expression; remember (cond_ref, rule_ref) per evaluator
     per_config: List[Tuple[str, List[Tuple[Optional[int], int]]]] = []

@@ -83,7 +83,7 @@ class LeaderElector:
         namespace: str = "default",
         name: Optional[str] = None,
         duration_s: float = 15.0,
-        renew_interval: float = 2.0,
+        renew_interval: Optional[float] = None,
         renew_deadline_s: Optional[float] = None,
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
@@ -93,10 +93,14 @@ class LeaderElector:
         self.namespace = namespace
         self.name = name if name is not None else leader_election_id()
         self.duration_s = duration_s
-        self.renew_interval = renew_interval
         # client-go defaults: renewDeadline (10s) strictly inside
-        # leaseDuration (15s), so a partitioned leader demotes itself
-        # before any follower can legally acquire the expired lease
+        # leaseDuration (15s) and retryPeriod (2s) inside renewDeadline —
+        # so a partitioned leader demotes itself before any follower can
+        # legally acquire the expired lease.  Defaults scale with
+        # duration_s so short test leases stay valid without extra args.
+        self.renew_interval = (
+            renew_interval if renew_interval is not None else duration_s * 2.0 / 15.0
+        )
         self.renew_deadline_s = (
             renew_deadline_s if renew_deadline_s is not None else duration_s * 2.0 / 3.0
         )
@@ -108,9 +112,9 @@ class LeaderElector:
                 f"renew_deadline_s ({self.renew_deadline_s}) must be < "
                 f"duration_s ({duration_s})"
             )
-        if renew_interval >= self.renew_deadline_s:
+        if self.renew_interval >= self.renew_deadline_s:
             raise ValueError(
-                f"renew_interval ({renew_interval}) must be < "
+                f"renew_interval ({self.renew_interval}) must be < "
                 f"renew_deadline_s ({self.renew_deadline_s})"
             )
         self.on_started_leading = on_started_leading

@@ -176,14 +176,17 @@ class ShardedPolicyModel:
         # two-pass compile: natural shapes → union targets → final compile.
         # The union carries the DFA row/state/byte axes, so shards with
         # regexes stack their device-DFA tables and regex-free shards carry
-        # a dummy lane of the same shape.
+        # a dummy lane of the same shape.  One dfa_cache spans both passes
+        # and all shards: each distinct regex determinizes exactly once.
+        dfa_cache: Dict[str, Any] = {}
         first = [
-            compile_corpus(g, members_k=members_k, interner=interner)
+            compile_corpus(g, members_k=members_k, interner=interner, dfa_cache=dfa_cache)
             for g in groups
         ]
         targets = ShapeTargets.union([p.shape_targets() for p in first])
         self.shards: List[CompiledPolicy] = [
-            compile_corpus(g, members_k=members_k, interner=interner, targets=targets)
+            compile_corpus(g, members_k=members_k, interner=interner, targets=targets,
+                           dfa_cache=dfa_cache)
             for g in groups
         ]
         self.has_dfa = self.shards[0].n_byte_attrs > 0

@@ -1,0 +1,441 @@
+"""Golden round-trip conversion suite: v1beta1 ↔ v1beta2.
+
+Scenario breadth modeled on the reference's 1,023-LoC conversion test
+(ref: api/v1beta2/auth_config_conversion_test.go): every evaluator kind,
+all credentials variants, denyWith, named patterns, top-level and
+per-evaluator conditions, priorities/metrics/caching, extended properties,
+response wrappers, and callbacks.  Fixtures are written in canonical form
+(the shape the converter itself emits) so round-trips must be *exactly*
+equal — any dropped or renamed field fails loudly instead of silently.
+"""
+
+import copy
+
+import pytest
+
+from authorino_tpu.apis.convert import (
+    API_VERSION_V1BETA1,
+    API_VERSION_V1BETA2,
+    to_v1beta1,
+    to_v1beta2,
+)
+
+
+def v1(spec):
+    return {
+        "apiVersion": API_VERSION_V1BETA1,
+        "kind": "AuthConfig",
+        "metadata": {"name": "golden", "namespace": "ns"},
+        "spec": spec,
+    }
+
+
+def v2(spec):
+    return {
+        "apiVersion": API_VERSION_V1BETA2,
+        "kind": "AuthConfig",
+        "metadata": {"name": "golden", "namespace": "ns"},
+        "spec": spec,
+    }
+
+
+def roundtrip_v1(resource):
+    """v1beta1 → v1beta2 → v1beta1 must be exactly equal."""
+    src = copy.deepcopy(resource)
+    out = to_v1beta1(to_v1beta2(resource))
+    assert out == src, _diff(src, out)
+
+
+def roundtrip_v2(resource):
+    """v1beta2 → v1beta1 → v1beta2 must be exactly equal."""
+    src = copy.deepcopy(resource)
+    out = to_v1beta2(to_v1beta1(resource))
+    assert out == src, _diff(src, out)
+
+
+def _diff(a, b, path=""):
+    lines = []
+
+    def walk(x, y, p):
+        if isinstance(x, dict) and isinstance(y, dict):
+            for k in sorted(set(x) | set(y)):
+                if k not in x:
+                    lines.append(f"+ {p}.{k} = {y[k]!r}")
+                elif k not in y:
+                    lines.append(f"- {p}.{k} = {x[k]!r}")
+                else:
+                    walk(x[k], y[k], f"{p}.{k}")
+        elif isinstance(x, list) and isinstance(y, list):
+            if len(x) != len(y):
+                lines.append(f"~ {p}: len {len(x)} != {len(y)}")
+            for i, (xi, yi) in enumerate(zip(x, y)):
+                walk(xi, yi, f"{p}[{i}]")
+        elif x != y:
+            lines.append(f"~ {p}: {x!r} != {y!r}")
+
+    walk(a, b, path or "$")
+    return "\n".join(lines) or "(structures equal)"
+
+
+# ---------------------------------------------------------------------------
+# identity / authentication
+# ---------------------------------------------------------------------------
+
+CREDENTIALS_V1 = [
+    {"in": "authorization_header", "keySelector": "Bearer"},
+    {"in": "authorization_header", "keySelector": "APIKEY"},
+    {"in": "custom_header", "keySelector": "X-API-Key"},
+    {"in": "query", "keySelector": "api_key"},
+    {"in": "cookie", "keySelector": "APIKEY"},
+]
+
+
+@pytest.mark.parametrize("credentials", CREDENTIALS_V1)
+def test_api_key_identity_all_credentials_variants(credentials):
+    roundtrip_v1(v1({
+        "hosts": ["app.example.com"],
+        "identity": [{
+            "name": "api-key",
+            "credentials": credentials,
+            "apiKey": {
+                "selector": {"matchLabels": {"audience": "app"}},
+                "allNamespaces": True,
+            },
+        }],
+    }))
+
+
+def test_oidc_identity_with_extended_properties_and_cache():
+    roundtrip_v1(v1({
+        "hosts": ["app.example.com"],
+        "identity": [{
+            "name": "keycloak",
+            "priority": 1,
+            "metrics": True,
+            "when": [{"selector": "request.path", "operator": "neq", "value": "/public"}],
+            "cache": {
+                "key": {"valueFrom": {"authJSON": "auth.identity.sub"}},
+                "ttl": 300,
+            },
+            "credentials": {"in": "authorization_header", "keySelector": "Bearer"},
+            "extendedProperties": [
+                {"name": "tenant", "overwrite": False, "value": "acme"},
+                {"name": "roles", "overwrite": True,
+                 "valueFrom": {"authJSON": "auth.identity.realm_access.roles"}},
+            ],
+            "oidc": {"endpoint": "https://kc.example.com/realms/demo", "ttl": 600},
+        }],
+    }))
+
+
+def test_oauth2_introspection_identity():
+    roundtrip_v1(v1({
+        "hosts": ["h"],
+        "identity": [{
+            "name": "opaque",
+            "oauth2": {
+                "tokenIntrospectionUrl": "https://idp/introspect",
+                "tokenTypeHint": "access_token",
+                "credentialsRef": {"name": "idp-credentials"},
+            },
+        }],
+    }))
+
+
+def test_mtls_kubernetes_plain_anonymous_identities():
+    roundtrip_v1(v1({
+        "hosts": ["h"],
+        "identity": [
+            {"name": "mtls", "mtls": {
+                "selector": {"matchLabels": {"pki": "internal"}},
+                "allNamespaces": False,
+            }},
+            {"name": "sa-token", "kubernetes": {"audiences": ["talker-api", "other"]}},
+            {"name": "plain", "plain": {"authJSON": "context.metadata_context.filter_metadata.envoy\\.filters\\.http\\.jwt_authn|verified_jwt"}},
+            {"name": "anon", "anonymous": {}},
+        ],
+    }))
+
+
+# ---------------------------------------------------------------------------
+# metadata
+# ---------------------------------------------------------------------------
+
+def test_metadata_http_userinfo_uma():
+    roundtrip_v1(v1({
+        "hosts": ["h"],
+        "metadata": [
+            {
+                "name": "geo",
+                "priority": 2,
+                "http": {
+                    "endpoint": "https://geo.example.com/{context.request.http.headers.x-forwarded-for.@extract:{\"sep\":\",\"}}",
+                    "method": "POST",
+                    "contentType": "application/x-www-form-urlencoded",
+                    "body": {"valueFrom": {"authJSON": "auth.identity.user"}},
+                    "bodyParameters": [
+                        {"name": "city", "valueFrom": {"authJSON": "request.headers.x-city"}},
+                        {"name": "static", "value": "fixed"},
+                    ],
+                    "headers": [
+                        {"name": "X-Secret", "valueFrom": {"authJSON": "auth.metadata.secret"}},
+                    ],
+                    "sharedSecretRef": {"name": "geo-secret", "key": "shared"},
+                    "credentials": {"in": "custom_header", "keySelector": "X-Auth"},
+                },
+            },
+            {"name": "userinfo", "userInfo": {"identitySource": "keycloak"}},
+            {"name": "resources", "uma": {
+                "endpoint": "https://kc.example.com/realms/demo",
+                "credentialsRef": {"name": "uma-credentials"},
+            }},
+        ],
+    }))
+
+
+def test_metadata_http_oauth2_credentials():
+    roundtrip_v1(v1({
+        "hosts": ["h"],
+        "metadata": [{
+            "name": "ext",
+            "http": {
+                "endpoint": "https://ext/metadata",
+                "method": "GET",
+                "oauth2": {
+                    "tokenUrl": "https://idp/token",
+                    "clientId": "authorino",
+                    "clientSecretRef": {"name": "oauth", "key": "secret"},
+                    "scopes": ["read"],
+                },
+            },
+        }],
+    }))
+
+
+# ---------------------------------------------------------------------------
+# authorization
+# ---------------------------------------------------------------------------
+
+def test_pattern_matching_authorization_with_named_patterns():
+    roundtrip_v1(v1({
+        "hosts": ["h"],
+        "patterns": {
+            "admin-path": [{"selector": "request.path", "operator": "matches", "value": "^/admin(/.*)?$"}],
+            "safe-verbs": [{"selector": "request.method", "operator": "incl", "value": "GET"}],
+        },
+        "when": [{"patternRef": "safe-verbs"}],
+        "authorization": [{
+            "name": "rbac",
+            "json": {"rules": [
+                {"patternRef": "admin-path"},
+                {"any": [
+                    {"selector": "auth.identity.roles", "operator": "incl", "value": "admin"},
+                    {"all": [
+                        {"selector": "auth.identity.roles", "operator": "incl", "value": "operator"},
+                        {"selector": "request.method", "operator": "eq", "value": "GET"},
+                    ]},
+                ]},
+            ]},
+        }],
+    }))
+
+
+def test_opa_authorization_inline_and_external():
+    roundtrip_v1(v1({
+        "hosts": ["h"],
+        "authorization": [{
+            "name": "opa",
+            "opa": {
+                "inlineRego": "allow { input.auth.identity.admin }",
+                "allValues": True,
+                "externalRegistry": {
+                    "endpoint": "https://registry/policy.rego",
+                    "sharedSecretRef": {"name": "opa-registry", "key": "token"},
+                    "ttl": 120,
+                    "credentials": {"in": "authorization_header", "keySelector": "Bearer"},
+                },
+            },
+        }],
+    }))
+
+
+def test_kubernetes_sar_authorization():
+    roundtrip_v1(v1({
+        "hosts": ["h"],
+        "authorization": [{
+            "name": "sar",
+            "kubernetes": {
+                "user": {"valueFrom": {"authJSON": "auth.identity.username"}},
+                "groups": ["system:authenticated"],
+                "resourceAttributes": {
+                    "namespace": {"value": "default"},
+                    "resource": {"valueFrom": {"authJSON": "context.request.http.path.@extract:{\"sep\":\"/\",\"pos\":1}"}},
+                    "verb": {"value": "get"},
+                },
+            },
+        }],
+    }))
+
+
+def test_authzed_spicedb_authorization():
+    roundtrip_v1(v1({
+        "hosts": ["h"],
+        "authorization": [{
+            "name": "spicedb",
+            "authzed": {
+                "endpoint": "spicedb.example.com:50051",
+                "insecure": True,
+                "sharedSecretRef": {"name": "spicedb-token", "key": "grpc-preshared-key"},
+                "subject": {
+                    "name": {"valueFrom": {"authJSON": "auth.identity.sub"}},
+                    "kind": {"value": "user"},
+                },
+                "resource": {
+                    "name": {"valueFrom": {"authJSON": "context.request.http.path.@extract:{\"sep\":\"/\",\"pos\":2}"}},
+                    "kind": {"value": "document"},
+                },
+                "permission": {"value": "read"},
+            },
+        }],
+    }))
+
+
+# ---------------------------------------------------------------------------
+# response / denyWith / callbacks
+# ---------------------------------------------------------------------------
+
+def test_deny_with_full_customization():
+    roundtrip_v1(v1({
+        "hosts": ["h"],
+        "denyWith": {
+            "unauthenticated": {
+                "code": 302,
+                "message": {"value": "redirecting to login"},
+                "headers": [
+                    {"name": "Location", "valueFrom": {"authJSON": "http://login.example.com?redirect_to={context.request.http.path}"}},
+                ],
+                "body": {"value": "unauthenticated"},
+            },
+            "unauthorized": {
+                "code": 403,
+                "message": {"valueFrom": {"authJSON": "auth.metadata.denial-reason"}},
+            },
+        },
+    }))
+
+
+def test_response_wristband_json_plain_with_wrappers():
+    roundtrip_v1(v1({
+        "hosts": ["h"],
+        "response": [
+            {
+                "name": "wristband",
+                "wrapper": "httpHeader",
+                "wrapperKey": "x-wristband",
+                "wristband": {
+                    "issuer": "https://authorino-oidc:8083/ns/golden/wristband",
+                    "customClaims": [
+                        {"name": "scope", "valueFrom": {"authJSON": "auth.identity.scope"}},
+                    ],
+                    "tokenDuration": 300,
+                    "signingKeyRefs": [{"name": "signing-key", "algorithm": "ES256"}],
+                },
+            },
+            {
+                "name": "headers",
+                "wrapper": "httpHeader",
+                "wrapperKey": "x-auth-data",
+                "json": {"properties": [
+                    {"name": "username", "valueFrom": {"authJSON": "auth.identity.username"}},
+                    {"name": "app", "value": "talker-api"},
+                ]},
+            },
+            {
+                "name": "plain-token",
+                "wrapper": "httpHeader",
+                "plain": {"valueFrom": {"authJSON": "auth.credential"}},
+            },
+            # envoyDynamicMetadata entries LAST: v1beta2 groups success
+            # responses by wrapper (headers vs dynamicMetadata), so the
+            # canonical v1beta1 order lists all httpHeader wrappers first —
+            # regrouping is semantic-preserving (same as the reference,
+            # where Go map iteration already drops list order)
+            {
+                "name": "rate-limit-data",
+                "wrapper": "envoyDynamicMetadata",
+                "wrapperKey": "ext_auth_data",
+                "json": {"properties": [
+                    {"name": "username", "valueFrom": {"authJSON": "auth.identity.preferred_username"}},
+                ]},
+            },
+        ],
+    }))
+
+
+def test_callbacks_http():
+    roundtrip_v1(v1({
+        "hosts": ["h"],
+        "callbacks": [{
+            "name": "audit",
+            "priority": 3,
+            "when": [{"selector": "auth.authorization.rbac", "operator": "eq", "value": "true"}],
+            "http": {
+                "endpoint": "https://audit.example.com/log",
+                "method": "POST",
+                "contentType": "application/json",
+                "body": {"valueFrom": {"authJSON": "context.request"}},
+            },
+        }],
+    }))
+
+
+# ---------------------------------------------------------------------------
+# the big one: every section at once, both directions
+# ---------------------------------------------------------------------------
+
+FULL_V1_SPEC = {
+    "hosts": ["talker-api.example.com", "*.wild.example.com"],
+    "patterns": {
+        "api-route": [{"selector": "request.path", "operator": "matches", "value": "^/api/"}],
+    },
+    "when": [{"patternRef": "api-route"}],
+    "identity": [
+        {"name": "k", "credentials": {"in": "authorization_header", "keySelector": "APIKEY"},
+         "apiKey": {"selector": {"matchLabels": {"app": "talker"}}, "allNamespaces": False}},
+        {"name": "o", "oidc": {"endpoint": "https://kc/realms/demo", "ttl": 0}},
+    ],
+    "metadata": [
+        {"name": "u", "userInfo": {"identitySource": "o"}},
+    ],
+    "authorization": [
+        {"name": "rules", "priority": 1,
+         "json": {"rules": [{"selector": "auth.identity.email_verified", "operator": "eq", "value": "true"}]}},
+    ],
+    "denyWith": {
+        "unauthorized": {"code": 403, "message": {"value": "nope"}},
+    },
+    "response": [
+        {"name": "hdr", "wrapper": "httpHeader", "wrapperKey": "x-data",
+         "json": {"properties": [{"name": "user", "valueFrom": {"authJSON": "auth.identity.sub"}}]}},
+    ],
+    "callbacks": [
+        {"name": "cb", "http": {"endpoint": "https://cb/log", "method": "POST"}},
+    ],
+}
+
+
+def test_full_spec_roundtrip_v1():
+    roundtrip_v1(v1(copy.deepcopy(FULL_V1_SPEC)))
+
+
+def test_full_spec_roundtrip_v2():
+    # the v2 shape of the same resource, canonical per the converter
+    resource2 = to_v1beta2(v1(copy.deepcopy(FULL_V1_SPEC)))
+    roundtrip_v2(resource2)
+
+
+def test_conversion_is_idempotent_on_target_version():
+    r1 = v1(copy.deepcopy(FULL_V1_SPEC))
+    assert to_v1beta1(r1) is r1            # already v1beta1: unchanged
+    r2 = to_v1beta2(r1)
+    assert to_v1beta2(r2) is r2            # already v1beta2: unchanged
