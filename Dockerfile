@@ -23,6 +23,8 @@ RUN pip install --no-cache-dir .
 # (authorino_tpu/native/__init__.py: <pkg>/native/_build/_atpuenc.so, with
 # sources expected at <site-packages>/native for the staleness check).
 # The loader falls back to the pure-Python encoder if any of this is absent.
+# Stage into a fixed path: site-packages' real location depends on the base
+# image's Python version, so the final stage re-derives it via sysconfig.
 RUN SITE=$(python -c "import sysconfig; print(sysconfig.get_paths()['purelib'])") && \
     cp -r native "$SITE/native" && \
     mkdir -p "$SITE/authorino_tpu/native/_build" && \
@@ -30,12 +32,17 @@ RUN SITE=$(python -c "import sysconfig; print(sysconfig.get_paths()['purelib'])"
         -I "$(python -c "import sysconfig; print(sysconfig.get_paths()['include'])")" \
         "$SITE/native/pymod.cpp" \
         -o "$SITE/authorino_tpu/native/_build/_atpuenc.so" && \
-    touch "$SITE/authorino_tpu/native/_build/_atpuenc.so"
+    touch "$SITE/authorino_tpu/native/_build/_atpuenc.so" && \
+    mkdir -p /staged && cp -r "$SITE" /staged/site-packages && \
+    cp /usr/local/bin/authorino-tpu /staged/authorino-tpu
 
 FROM ${BASE_IMAGE}
 RUN groupadd -r authorino && useradd -r -g authorino -u 1001 authorino
-COPY --from=build /usr/local/lib/python3.11/site-packages /usr/local/lib/python3.11/site-packages
-COPY --from=build /usr/local/bin/authorino-tpu /usr/local/bin/authorino-tpu
+COPY --from=build /staged /staged
+RUN python -c "import shutil, sysconfig; \
+shutil.copytree('/staged/site-packages', sysconfig.get_paths()['purelib'], dirs_exist_ok=True)" && \
+    install -m 0755 /staged/authorino-tpu /usr/local/bin/authorino-tpu && \
+    rm -rf /staged
 USER 1001
 ENTRYPOINT ["authorino-tpu"]
 CMD ["server"]

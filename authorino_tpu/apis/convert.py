@@ -13,6 +13,14 @@ from typing import Any, Dict, List, Optional
 
 __all__ = ["to_v1beta2", "to_v1beta1", "API_VERSION_V1BETA1", "API_VERSION_V1BETA2"]
 
+
+def _clean(d: dict) -> dict:
+    """Drop None-valued keys: omitted optionals (sharedSecretRef,
+    credentialsRef, audiences…) must stay omitted through a round-trip —
+    injecting explicit nulls rewrites the stored resource on every webhook
+    conversion."""
+    return {k: v for k, v in d.items() if v is not None}
+
 API_VERSION_V1BETA1 = "authorino.kuadrant.io/v1beta1"
 API_VERSION_V1BETA2 = "authorino.kuadrant.io/v1beta2"
 
@@ -185,18 +193,18 @@ def to_v1beta2(resource: dict) -> dict:
                 "ttl": ident["oidc"].get("ttl", 0),
             }
         elif ident.get("oauth2") is not None:
-            a["oauth2Introspection"] = {
+            a["oauth2Introspection"] = _clean({
                 "endpoint": ident["oauth2"].get("tokenIntrospectionUrl", ""),
                 "tokenTypeHint": ident["oauth2"].get("tokenTypeHint", ""),
                 "credentialsRef": ident["oauth2"].get("credentialsRef"),
-            }
+            })
         elif ident.get("mtls") is not None:
             a["x509"] = {
                 "selector": ident["mtls"].get("selector"),
                 "allNamespaces": ident["mtls"].get("allNamespaces", False),
             }
         elif ident.get("kubernetes") is not None:
-            a["kubernetesTokenReview"] = {"audiences": ident["kubernetes"].get("audiences")}
+            a["kubernetesTokenReview"] = _clean({"audiences": ident["kubernetes"].get("audiences")})
         elif ident.get("plain") is not None:
             a["plain"] = {"selector": ident["plain"].get("authJSON", "")}
         elif ident.get("anonymous") is not None:
@@ -235,19 +243,19 @@ def to_v1beta2(resource: dict) -> dict:
             }
             if o.get("externalRegistry"):
                 er = o["externalRegistry"]
-                z["opa"]["externalPolicy"] = {
+                z["opa"]["externalPolicy"] = _clean({
                     "url": er.get("endpoint", ""),
                     "sharedSecretRef": er.get("sharedSecretRef"),
                     "ttl": er.get("ttl", 0),
-                }
+                })
                 if er.get("credentials"):
                     z["opa"]["externalPolicy"]["credentials"] = _v1_credentials_to_v2(er["credentials"])
         elif az.get("kubernetes") is not None:
             k = az["kubernetes"]
-            z["kubernetesSubjectAccessReview"] = {
+            z["kubernetesSubjectAccessReview"] = _clean({
                 "user": _v1_static_or_selector((k.get("user") or {}).get("value"), (k.get("user") or {}).get("valueFrom")),
                 "groups": k.get("groups"),
-            }
+            })
             if k.get("resourceAttributes"):
                 z["kubernetesSubjectAccessReview"]["resourceAttributes"] = {
                     key: _v1_static_or_selector(v.get("value"), v.get("valueFrom"))
@@ -255,7 +263,7 @@ def to_v1beta2(resource: dict) -> dict:
                 }
         elif az.get("authzed") is not None:
             s = az["authzed"]
-            z["spicedb"] = {
+            z["spicedb"] = _clean({
                 "endpoint": s.get("endpoint", ""),
                 "insecure": s.get("insecure", False),
                 "sharedSecretRef": s.get("sharedSecretRef"),
@@ -265,7 +273,7 @@ def to_v1beta2(resource: dict) -> dict:
                     (s.get("permission") or {}).get("value"),
                     (s.get("permission") or {}).get("valueFrom"),
                 ),
-            }
+            })
         authorization[az.get("name", "")] = z
     if authorization:
         spec2["authorization"] = authorization
@@ -389,15 +397,15 @@ def to_v1beta1(resource: dict) -> dict:
             i["oidc"] = {"endpoint": a["jwt"].get("issuerUrl", ""), "ttl": a["jwt"].get("ttl", 0)}
         elif a.get("oauth2Introspection") is not None:
             o = a["oauth2Introspection"]
-            i["oauth2"] = {
+            i["oauth2"] = _clean({
                 "tokenIntrospectionUrl": o.get("endpoint", ""),
                 "tokenTypeHint": o.get("tokenTypeHint", ""),
                 "credentialsRef": o.get("credentialsRef"),
-            }
+            })
         elif a.get("x509") is not None:
             i["mtls"] = a["x509"]
         elif a.get("kubernetesTokenReview") is not None:
-            i["kubernetes"] = {"audiences": a["kubernetesTokenReview"].get("audiences")}
+            i["kubernetes"] = _clean({"audiences": a["kubernetesTokenReview"].get("audiences")})
         elif a.get("plain") is not None:
             i["plain"] = {"authJSON": a["plain"].get("selector", "")}
         elif a.get("anonymous") is not None:
@@ -431,33 +439,33 @@ def to_v1beta1(resource: dict) -> dict:
             d["opa"] = {"inlineRego": o.get("rego", ""), "allValues": o.get("allValues", False)}
             if o.get("externalPolicy"):
                 ep = o["externalPolicy"]
-                d["opa"]["externalRegistry"] = {
+                d["opa"]["externalRegistry"] = _clean({
                     "endpoint": ep.get("url", ""),
                     "sharedSecretRef": ep.get("sharedSecretRef"),
                     "ttl": ep.get("ttl", 0),
-                }
+                })
                 if ep.get("credentials"):
                     d["opa"]["externalRegistry"]["credentials"] = _v2_credentials_to_v1(ep["credentials"])
         elif z.get("kubernetesSubjectAccessReview") is not None:
             k = z["kubernetesSubjectAccessReview"]
-            d["kubernetes"] = {
+            d["kubernetes"] = _clean({
                 "user": _v2_to_v1_value(k.get("user")),
                 "groups": k.get("groups"),
-            }
+            })
             if k.get("resourceAttributes"):
                 d["kubernetes"]["resourceAttributes"] = {
                     key: _v2_to_v1_value(v) for key, v in k["resourceAttributes"].items()
                 }
         elif z.get("spicedb") is not None:
             s = z["spicedb"]
-            d["authzed"] = {
+            d["authzed"] = _clean({
                 "endpoint": s.get("endpoint", ""),
                 "insecure": s.get("insecure", False),
                 "sharedSecretRef": s.get("sharedSecretRef"),
                 "subject": {k: _v2_to_v1_value(v) for k, v in (s.get("subject") or {}).items()},
                 "resource": {k: _v2_to_v1_value(v) for k, v in (s.get("resource") or {}).items()},
                 "permission": _v2_to_v1_value(s.get("permission")),
-            }
+            })
         authorization.append(d)
     if authorization:
         spec1["authorization"] = authorization

@@ -467,12 +467,49 @@ class _Sentinel:
 _SENTINEL = _Sentinel()
 
 
+# plain-key fast lane: path → tuple of keys when every segment is a key
+# (the overwhelming majority of selectors in real AuthConfigs), else False.
+# Walking raw values skips the per-step Result allocation of _resolve —
+# this sits on the per-pattern hot path of the CPU expression oracle.
+_FAST_CACHE: Dict[str, Any] = {}
+
+
 def get(doc: Any, path: str) -> Result:
     """Resolve ``path`` against a parsed JSON document (the structural
     equivalent of gjson.Get over marshaled text, ref: pkg/jsonexp/expressions.go:61)."""
     if path == "":
         return Result(doc)
-    return _resolve(Result(doc), _parse_path(path))
+    fast = _FAST_CACHE.get(path)
+    if fast is None:
+        segs = _parse_path(path)
+        fast = (
+            tuple(s.key for s in segs)
+            if all(s.kind == "key" for s in segs)
+            else False
+        )
+        if len(_FAST_CACHE) < 65536:
+            _FAST_CACHE[path] = fast
+    if fast is False:
+        return _resolve(Result(doc), _parse_path(path))
+    cur = doc
+    for key in fast:
+        if isinstance(cur, dict):
+            if key in cur:
+                cur = cur[key]
+            else:
+                return Result.MISSING
+        elif isinstance(cur, list):
+            try:
+                idx = int(key)
+            except ValueError:
+                return Result.MISSING
+            if 0 <= idx < len(cur):
+                cur = cur[idx]
+            else:
+                return Result.MISSING
+        else:
+            return Result.MISSING
+    return Result(cur)
 
 
 def get_path(doc: Any, path: str) -> Any:

@@ -169,6 +169,27 @@ def decode_unverified(token: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         raise JoseError(f"malformed JWT: {e}")
 
 
+# constructing a public-key object from a JWK costs ~100µs in cryptography —
+# on the per-request JWT-verify path that dwarfs the signature check itself.
+# Cache by key material (not dict identity: JWKS refreshes rebuild the dicts).
+_PUBKEY_CACHE: Dict[Tuple, Any] = {}
+
+
+def _cached_public_key(jwk: Dict[str, Any]):
+    # the tuple must cover EVERY field that determines the key material —
+    # omitting "k" would collapse all symmetric (oct) keys onto one entry,
+    # verifying HMAC tokens against the wrong secret
+    k = (jwk.get("kty"), jwk.get("n"), jwk.get("e"),
+         jwk.get("crv"), jwk.get("x"), jwk.get("y"), jwk.get("k"))
+    key = _PUBKEY_CACHE.get(k)
+    if key is None:
+        key = public_key_from_jwk(jwk)
+        if len(_PUBKEY_CACHE) > 256:  # bound: rotated keys age out wholesale
+            _PUBKEY_CACHE.clear()
+        _PUBKEY_CACHE[k] = key
+    return key
+
+
 def verify_jws(token: str, keys: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Verify signature against a JWKS key list; returns the claims."""
     try:
@@ -189,7 +210,7 @@ def verify_jws(token: str, keys: List[Dict[str, Any]]) -> Dict[str, Any]:
         if jwk.get("alg") and jwk["alg"] != alg:
             continue
         try:
-            key = public_key_from_jwk(jwk)
+            key = _cached_public_key(jwk)
         except Exception:
             continue
         if _verify_raw(alg, key, signing_input, sig):

@@ -424,6 +424,39 @@ FULL_V1_SPEC = {
 }
 
 
+def test_omitted_optionals_stay_omitted():
+    """Optional refs (sharedSecretRef, credentialsRef, audiences, groups)
+    left out of the source must NOT come back as explicit nulls — a null
+    injected by the conversion webhook rewrites the stored resource."""
+    roundtrip_v1(v1({
+        "hosts": ["h"],
+        "identity": [
+            {"name": "opaque", "oauth2": {
+                "tokenIntrospectionUrl": "https://idp/introspect",
+                "tokenTypeHint": "access_token",
+            }},
+            {"name": "sa", "kubernetes": {}},
+        ],
+        "authorization": [
+            {"name": "opa-ext", "opa": {
+                "inlineRego": "allow { true }",
+                "allValues": False,
+                "externalRegistry": {"endpoint": "https://r/p.rego", "ttl": 30},
+            }},
+            {"name": "sar", "kubernetes": {
+                "user": {"valueFrom": {"authJSON": "auth.identity.user"}},
+            }},
+            {"name": "spicedb", "authzed": {
+                "endpoint": "db:50051",
+                "insecure": False,
+                "subject": {"kind": {"value": "user"}},
+                "resource": {"kind": {"value": "doc"}},
+                "permission": {"value": "read"},
+            }},
+        ],
+    }))
+
+
 def test_full_spec_roundtrip_v1():
     roundtrip_v1(v1(copy.deepcopy(FULL_V1_SPEC)))
 

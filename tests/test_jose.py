@@ -1,0 +1,39 @@
+"""JOSE regression tests (the broader verify paths are covered through the
+OIDC/wristband evaluator tests)."""
+
+import base64
+
+import pytest
+
+from authorino_tpu.utils import jose
+
+
+def oct_jwk(secret: bytes, kid: str = "") -> dict:
+    k = base64.urlsafe_b64encode(secret).rstrip(b"=").decode()
+    out = {"kty": "oct", "k": k}
+    if kid:
+        out["kid"] = kid
+    return out
+
+
+class TestPublicKeyCache:
+    def test_distinct_hmac_secrets_never_collide(self):
+        # the key cache must key on the key MATERIAL: two oct JWKs with
+        # different secrets are different keys — a collision verifies
+        # tokens against the wrong secret (authentication bypass)
+        token = jose.sign_jwt({"sub": "x"}, b"secret-one", "HS256")
+        assert jose.verify_jws(token, [oct_jwk(b"secret-one")]) == {"sub": "x"}
+        with pytest.raises(jose.JoseError):
+            jose.verify_jws(token, [oct_jwk(b"secret-two")])
+
+    def test_rotated_rsa_keys_never_collide(self):
+        from cryptography.hazmat.primitives.asymmetric import rsa
+
+        old = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        new = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        token = jose.sign_jwt({"sub": "x"}, old, "RS256", kid="k1")
+        old_jwk = jose.jwk_from_public_key(old.public_key(), kid="k1")
+        new_jwk = jose.jwk_from_public_key(new.public_key(), kid="k1")
+        assert jose.verify_jws(token, [old_jwk]) == {"sub": "x"}
+        with pytest.raises(jose.JoseError):
+            jose.verify_jws(token, [new_jwk])  # same kid, rotated material
