@@ -33,7 +33,8 @@ RUN SITE=$(python -c "import sysconfig; print(sysconfig.get_paths()['purelib'])"
         "$SITE/native/pymod.cpp" \
         -o "$SITE/authorino_tpu/native/_build/_atpuenc.so" && \
     touch "$SITE/authorino_tpu/native/_build/_atpuenc.so" && \
-    mkdir -p /staged && cp -r "$SITE" /staged/site-packages && \
+    mkdir -p /staged && cp -a "$SITE" /staged/site-packages && \
+    touch /staged/site-packages/authorino_tpu/native/_build/_atpuenc.so && \
     cp /usr/local/bin/authorino-tpu /staged/authorino-tpu
 
 FROM ${BASE_IMAGE}
@@ -41,8 +42,13 @@ RUN groupadd -r authorino && useradd -r -g authorino -u 1001 authorino
 COPY --from=build /staged /staged
 RUN python -c "import shutil, sysconfig; \
 shutil.copytree('/staged/site-packages', sysconfig.get_paths()['purelib'], dirs_exist_ok=True)" && \
+    python -c "import os, sysconfig; \
+os.utime(sysconfig.get_paths()['purelib'] + '/authorino_tpu/native/_build/_atpuenc.so')" && \
     install -m 0755 /staged/authorino-tpu /usr/local/bin/authorino-tpu && \
     rm -rf /staged
+# the utime keeps the prebuilt .so newer than the staged sources — the
+# loader's mtime staleness check must not trigger a rebuild in the
+# runtime image (no g++, non-root site-packages → permanent Python fallback)
 USER 1001
 ENTRYPOINT ["authorino-tpu"]
 CMD ["server"]

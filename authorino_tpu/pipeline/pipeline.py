@@ -135,6 +135,22 @@ class AuthPipeline:
                     metrics_mod.evaluator_cancelled.labels(*mlabels).inc()
                 raise
 
+    async def _store_identity(self, conf, obj):
+        """Success tail shared by the fast and racing identity paths:
+        store, resolve extended properties, re-store — rolling back on
+        extension failure (ref :222-241).  Returns (ok, error_message)."""
+        self.identity_results[conf] = obj
+        self._sync_auth()
+        try:
+            extended = await conf.resolve_extended_properties(self)
+        except Exception as e:
+            del self.identity_results[conf]
+            self._sync_auth()
+            return False, str(e)
+        self.identity_results[conf] = extended
+        self._sync_auth()
+        return True, None
+
     @staticmethod
     def _priority_buckets(configs: List[PhaseConfig]) -> List[List[PhaseConfig]]:
         buckets: Dict[int, List[PhaseConfig]] = {}
@@ -153,6 +169,29 @@ class AuthPipeline:
         count = len(configs)
         errors: Dict[str, str] = {}
         for bucket in self._priority_buckets(configs):
+            if len(bucket) == 1:
+                # single-evaluator bucket (the common case): direct await —
+                # the task + asyncio.wait machinery only pays off when there
+                # are siblings to race/cancel
+                conf = bucket[0]
+                try:
+                    obj = await self._call_one(conf)
+                except _Skip:
+                    continue
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    if count == 1:
+                        return str(e)
+                    errors[conf.name] = str(e)
+                    continue
+                ok, err = await self._store_identity(conf, obj)
+                if ok:
+                    return None
+                if count == 1:
+                    return err
+                errors[conf.name] = err
+                continue
             tasks = {
                 asyncio.ensure_future(self._call_one(conf)): conf for conf in bucket
             }
@@ -175,21 +214,13 @@ class AuthPipeline:
                                 return str(e)
                             errors[conf.name] = str(e)
                             continue
-                        # success: store, extend, store again (ref :222-241)
-                        self.identity_results[conf] = obj
-                        self._sync_auth()
-                        try:
-                            extended = await conf.resolve_extended_properties(self)
-                        except Exception as e:
-                            del self.identity_results[conf]
-                            self._sync_auth()
-                            if count == 1:
-                                return str(e)
-                            errors[conf.name] = str(e)
-                            continue
-                        self.identity_results[conf] = extended
-                        self._sync_auth()
-                        return None
+                        ok, err = await self._store_identity(conf, obj)
+                        if ok:
+                            return None
+                        if count == 1:
+                            return err
+                        errors[conf.name] = err
+                        continue
             finally:
                 for t in tasks:
                     if not t.done():
@@ -199,6 +230,15 @@ class AuthPipeline:
     async def _evaluate_fire_all(self, configs: List[PhaseConfig], results: Dict[Any, Any]) -> None:
         """metadata/callbacks: failures tolerated (ref :260-285, :351-376)."""
         for bucket in self._priority_buckets(configs):
+            if len(bucket) == 1:
+                try:
+                    results[bucket[0]] = await self._call_one(bucket[0])
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass  # tolerated
+                self._sync_auth()
+                continue
             outs = await asyncio.gather(
                 *(self._call_one(c) for c in bucket), return_exceptions=True
             )
@@ -211,6 +251,21 @@ class AuthPipeline:
     async def _evaluate_authorization(self) -> Optional[str]:
         """All must pass; cancel others on first denial (ref :287-322)."""
         for bucket in self._priority_buckets(self.config.authorization):
+            if len(bucket) == 1:
+                c = bucket[0]
+                try:
+                    obj = await self._call_one(c)
+                except _Skip:
+                    self._sync_auth()
+                    continue
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    self._sync_auth()
+                    return str(e)
+                self.authorization_results[c] = obj
+                self._sync_auth()
+                continue
             tasks = {asyncio.ensure_future(self._call_one(c)): c for c in bucket}
             pending = set(tasks)
             failure: Optional[str] = None
@@ -242,6 +297,15 @@ class AuthPipeline:
 
     async def _evaluate_response(self) -> Tuple[Dict[str, str], Dict[str, Any]]:
         for bucket in self._priority_buckets(self.config.response):
+            if len(bucket) == 1:
+                try:
+                    self.response_results[bucket[0]] = await self._call_one(bucket[0])
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass  # tolerated like the gather path
+                self._sync_auth()
+                continue
             outs = await asyncio.gather(
                 *(self._call_one(c) for c in bucket), return_exceptions=True
             )
