@@ -32,6 +32,7 @@ kernel selects them by op code / overflow mask (SURVEY.md §7 "hard parts").
 
 from __future__ import annotations
 
+import os
 import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -53,8 +54,12 @@ OP_EQ, OP_NEQ, OP_INCL, OP_EXCL, OP_CPU, OP_ERROR, OP_TREE_CPU, OP_REGEX_DFA = (
 )
 
 # max value length evaluated on the device regex lane; longer values (or
-# values containing NUL) fall back to the CPU regex lane per request
-DFA_VALUE_BYTES = 128
+# values containing NUL) fall back to the CPU regex lane per request — an
+# exactness-preserving overflow, so this is purely a transfer/compute vs
+# fallback-rate dial.  The byte tensor is [B, NB, DFA_VALUE_BYTES] on the
+# wire, the single biggest payload when regexes are present; 64 covers
+# typical URL paths/headers with headroom.
+DFA_VALUE_BYTES = int(os.environ.get("AUTHORINO_TPU_DFA_VALUE_BYTES", "64"))
 
 TRUE_SLOT = 0
 FALSE_SLOT = 1

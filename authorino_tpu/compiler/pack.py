@@ -34,10 +34,13 @@ __all__ = ["DeviceBatch", "pack_batch"]
 
 @dataclass
 class DeviceBatch:
-    """What actually crosses the wire (plus host-side fallback flags)."""
+    """What actually crosses the wire (plus host-side fallback flags).
+    Id tensors travel as int16 whenever the corpus interner fits (< 32k
+    distinct constants — virtually always): the ids are the bulk of the
+    payload, and the kernel upcasts on device after the transfer."""
 
-    attrs_val: np.ndarray      # [B, A] int32
-    members_c: np.ndarray      # [B, M, K] int32 — compact membership rows
+    attrs_val: np.ndarray      # [B, A] int16/int32 (wire dtype)
+    members_c: np.ndarray      # [B, M, K] int16/int32 — compact membership
     cpu_dense: np.ndarray      # [B, C] bool — dense CPU-lane columns
     config_id: np.ndarray      # [B] int32
     attr_bytes: Optional[np.ndarray]  # [B, NB, LB] uint8 (None: no DFA lane)
@@ -45,17 +48,23 @@ class DeviceBatch:
     host_fallback: np.ndarray  # [B] bool — HOST-side only, never transferred
 
 
+def wire_dtype(policy: CompiledPolicy):
+    """int16 when every id (incl. the UNSEEN/PAD sentinels) fits."""
+    return np.int16 if len(policy.interner) < 32767 else np.int32
+
+
 def pack_batch(policy: CompiledPolicy, enc: EncodedBatch) -> DeviceBatch:
     """Cheap numpy slicing; no per-request Python work."""
     B = enc.attrs_val.shape[0]
     M, C, K = policy.n_member_attrs, policy.n_cpu_leaves, policy.members_k
+    dt = wire_dtype(policy)
 
     member_attrs = policy.member_attrs
     m_real = member_attrs.shape[0]
     if M == m_real:
-        members_c = np.ascontiguousarray(enc.attrs_members[:, member_attrs])
+        members_c = np.ascontiguousarray(enc.attrs_members[:, member_attrs], dtype=dt)
     else:
-        members_c = np.full((B, M, K), PAD, dtype=np.int32)
+        members_c = np.full((B, M, K), PAD, dtype=dt)
         members_c[:, :m_real] = enc.attrs_members[:, member_attrs]
 
     cpu_list = policy.cpu_leaf_list
@@ -72,7 +81,7 @@ def pack_batch(policy: CompiledPolicy, enc: EncodedBatch) -> DeviceBatch:
 
     has_dfa = policy.n_byte_attrs > 0
     return DeviceBatch(
-        attrs_val=enc.attrs_val,
+        attrs_val=enc.attrs_val.astype(dt, copy=False),
         members_c=members_c,
         cpu_dense=cpu_dense,
         config_id=enc.config_id,

@@ -431,6 +431,12 @@ def eval_verdicts(
     byte_ovf: Optional[jnp.ndarray] = None,    # [B, NB] bool
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """Returns (verdict [B, G] bool, (rule_results [B, G, E], skipped [B, G, E]))."""
+    # ids travel as int16 when the interner fits (compiler/pack.py
+    # wire_dtype); upcast on device AFTER the transfer
+    if attrs_val.dtype != jnp.int32:
+        attrs_val = attrs_val.astype(jnp.int32)
+    if members_c.dtype != jnp.int32:
+        members_c = members_c.astype(jnp.int32)
     if params.get("matmul") is not None:
         return _eval_verdicts_matmul(
             params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf
