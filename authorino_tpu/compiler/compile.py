@@ -85,6 +85,9 @@ class ShapeTargets:
     n_dfa_rows: int = 1
     n_dfa_states: int = 1
     n_byte_attrs: int = 0
+    # eval-table rows (configs per shard) — unified so per-shard device
+    # pytrees (incl. the matmul lane's [G*E, cursor] one-hots) stack
+    n_configs: int = 1
 
     @staticmethod
     def union(shapes: Sequence["ShapeTargets"]) -> "ShapeTargets":
@@ -104,6 +107,7 @@ class ShapeTargets:
             n_dfa_rows=max(s.n_dfa_rows for s in shapes),
             n_dfa_states=max(s.n_dfa_states for s in shapes),
             n_byte_attrs=max(s.n_byte_attrs for s in shapes),
+            n_configs=max(s.n_configs for s in shapes),
         )
 
 
@@ -217,6 +221,7 @@ class CompiledPolicy:
             n_dfa_rows=int(self.dfa_tables.shape[0]),
             n_dfa_states=int(self.dfa_tables.shape[1]),
             n_byte_attrs=self.n_byte_attrs,
+            n_configs=self.n_configs,
         )
 
 
@@ -419,17 +424,23 @@ def compile_corpus(
             children[row, len(buf_kids):] = padv
         levels.append((children, is_and))
 
-    # 4. per-config evaluator tables
+    # 4. per-config evaluator tables.  Targets pad the row count so shards
+    # stack; padded rows are all-TRUE_SLOT — trivially-allow configs that no
+    # request can ever select (row ids only cover the real configs).
     n_configs = len(per_config)
+    Gp = n_configs
+    if targets is not None:
+        assert targets.n_configs >= n_configs, "targets.n_configs too small"
+        Gp = targets.n_configs
     max_e = max((len(p[1]) for p in per_config), default=1) or 1
     if targets is not None:
         assert targets.max_e >= max_e, "targets.max_e too small"
         max_e = targets.max_e
     elif pad:
         max_e = _round_up(max_e, minimum=2)
-    eval_cond = np.full((n_configs, max_e), TRUE_SLOT, dtype=np.int32)
-    eval_rule = np.full((n_configs, max_e), TRUE_SLOT, dtype=np.int32)
-    eval_has_cond = np.zeros((n_configs, max_e), dtype=bool)
+    eval_cond = np.full((Gp, max_e), TRUE_SLOT, dtype=np.int32)
+    eval_rule = np.full((Gp, max_e), TRUE_SLOT, dtype=np.int32)
+    eval_has_cond = np.zeros((Gp, max_e), dtype=bool)
     config_ids: Dict[str, int] = {}
     for row, (name, pairs) in enumerate(per_config):
         config_ids[name] = row
@@ -542,6 +553,11 @@ def compile_corpus(
             collect_attrs(rule, a, cl)
         config_attrs.append(sorted(a))
         config_cpu_leaves.append(sorted(cl))
+    # per-config metadata padded alongside the eval-table rows (Gp): padded
+    # configs resolve nothing and evaluate vacuously true, and no request
+    # ever maps to them
+    config_attrs += [[] for _ in range(Gp - n_configs)]
+    config_cpu_leaves += [[] for _ in range(Gp - n_configs)]
 
     # 7. transfer-compaction metadata: which attrs' membership vectors the
     # kernel can ever read (incl/excl leaves), and which leaves ride the
@@ -593,5 +609,6 @@ def compile_corpus(
         n_member_attrs=M,
         cpu_leaf_list=np.asarray(cpu_leaf_list_, dtype=np.int32),
         n_cpu_leaves=C,
-        config_exprs=[list(cfg.evaluators) for cfg in configs],
+        config_exprs=[list(cfg.evaluators) for cfg in configs]
+        + [[] for _ in range(Gp - n_configs)],
     )

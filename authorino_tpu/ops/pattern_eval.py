@@ -119,13 +119,16 @@ def _matmul_operands(policy: CompiledPolicy, row_slot: np.ndarray, device=None) 
         cpu_oh[np.arange(cl.shape[0]), cl] = 1.0
 
     # per-level count matrices over the buffer prefix visible to that level
+    # (the count threshold — the level's child width — is recovered at trace
+    # time from params["levels"]; keeping it out of the operands leaves the
+    # pytree all-array, so the sharded model can stack it on a mesh axis)
     level_mats = []
     cursor = 2 + L  # TRUE/FALSE slots + leaf block
     for children, is_and in policy.levels:
         rows, width = children.shape
         m = np.zeros((rows, cursor), dtype=np.float32)
         np.add.at(m, (np.repeat(np.arange(rows), width), children.reshape(-1)), 1.0)
-        level_mats.append((m.astype(cdt), float(width)))
+        level_mats.append(m.astype(cdt))
         cursor += rows
 
     # eval-table one-hots over the full buffer
@@ -169,13 +172,19 @@ def _matmul_operands(policy: CompiledPolicy, row_slot: np.ndarray, device=None) 
     return out
 
 
-def to_device(policy: CompiledPolicy, device=None, lane: Optional[str] = None) -> dict:
+def to_device(policy: CompiledPolicy, device=None, lane: Optional[str] = None,
+              host: bool = False) -> dict:
     """Upload a compiled corpus's operands as a pytree of device arrays.
     The engine double-buffers these and swaps atomically on reconcile
     (SURVEY.md §3.4: rule-tensor compile + device upload on index Set).
-    ``lane`` overrides the env-var lane selection (the sharded model passes
-    'gather' since its stacked params keep only gather-lane keys)."""
-    put = partial(jax.device_put, device=device) if device is not None else jax.device_put
+    ``lane`` overrides the env-var lane selection; ``host=True`` keeps the
+    operands as host numpy arrays — the sharded model stacks per-shard
+    pytrees host-side and transfers each shard's slice exactly once via a
+    mesh-sharded device_put, instead of staging everything on device 0."""
+    if host:
+        put = np.asarray
+    else:
+        put = partial(jax.device_put, device=device) if device is not None else jax.device_put
     lane = lane or _eval_lane()
     if lane == "matmul" and len(policy.interner) + 4 >= _F32_EXACT:
         lane = "gather"  # ids no longer exact in f32 accumulation
@@ -197,27 +206,29 @@ def to_device(policy: CompiledPolicy, device=None, lane: Optional[str] = None) -
     C = policy.n_cpu_leaves
     cpu_scatter_idx = np.full((C,), L, dtype=np.int32)
     cpu_scatter_idx[: policy.cpu_leaf_list.shape[0]] = policy.cpu_leaf_list
+    # operands are numpy throughout: `put` is the ONLY device transfer (or a
+    # no-op for host=True), so nothing ever stages on the default device
     return {
         "matmul": mm,
-        "leaf_op": put(jnp.asarray(policy.leaf_op)),
-        "leaf_attr": put(jnp.asarray(policy.leaf_attr)),
-        "leaf_const": put(jnp.asarray(policy.leaf_const)),
-        "member_slot_of_leaf": put(jnp.asarray(member_slot_of_leaf)),
-        "cpu_scatter_idx": put(jnp.asarray(cpu_scatter_idx)),
+        "leaf_op": put(policy.leaf_op),
+        "leaf_attr": put(policy.leaf_attr),
+        "leaf_const": put(policy.leaf_const),
+        "member_slot_of_leaf": put(member_slot_of_leaf),
+        "cpu_scatter_idx": put(cpu_scatter_idx),
         "levels": tuple(
-            (put(jnp.asarray(children)), put(jnp.asarray(is_and)))
+            (put(children), put(is_and))
             for children, is_and in policy.levels
         ),
-        "eval_cond": put(jnp.asarray(policy.eval_cond)),
-        "eval_rule": put(jnp.asarray(policy.eval_rule)),
-        "eval_has_cond": put(jnp.asarray(policy.eval_has_cond)),
+        "eval_cond": put(policy.eval_cond),
+        "eval_rule": put(policy.eval_rule),
+        "eval_has_cond": put(policy.eval_has_cond),
         # device regex lane; None (a static pytree node, not a traced leaf)
         # when the corpus has no DFA-compilable regexes, so the kernel's
         # python-level `is None` check specializes at trace time
-        "dfa_tables": put(jnp.asarray(policy.dfa_tables)) if policy.n_byte_attrs else None,
-        "dfa_accept": put(jnp.asarray(policy.dfa_accept)) if policy.n_byte_attrs else None,
-        "dfa_byte_slot": put(jnp.asarray(dfa_byte_slot.astype(np.int32))) if policy.n_byte_attrs else None,
-        "leaf_dfa_row": put(jnp.asarray(policy.leaf_dfa_row)) if policy.n_byte_attrs else None,
+        "dfa_tables": put(policy.dfa_tables) if policy.n_byte_attrs else None,
+        "dfa_accept": put(policy.dfa_accept) if policy.n_byte_attrs else None,
+        "dfa_byte_slot": put(dfa_byte_slot.astype(np.int32)) if policy.n_byte_attrs else None,
+        "leaf_dfa_row": put(policy.leaf_dfa_row) if policy.n_byte_attrs else None,
     }
 
 
@@ -340,7 +351,8 @@ def _eval_verdicts_matmul(params, attrs_val, members_c, cpu_dense,
     true_col = jnp.ones((B, 1), dtype=bool)
     false_col = jnp.zeros((B, 1), dtype=bool)
     buffer = jnp.concatenate([true_col, false_col, res], axis=1)
-    for (m, width), (_, is_and) in zip(mm["level_mats"], params["levels"]):
+    for m, (children, is_and) in zip(mm["level_mats"], params["levels"]):
+        width = children.shape[1]  # static: the level's padded child count
         counts = jnp.matmul(
             buffer.astype(cdt), m.T, preferred_element_type=f32
         )                                                    # [B, rows]

@@ -44,46 +44,28 @@ from ..ops.pattern_eval import eval_verdicts, to_device
 __all__ = ["ShardedPolicyModel", "build_mesh"]
 
 
-# jitted sharded steps cached per (mesh, has_dfa, n_levels): reconcile-time
-# apply_snapshot builds a fresh ShardedPolicyModel, and a per-model
-# jax.jit(shard_map(...)) closure would force a full XLA recompile on every
-# snapshot even at unchanged shapes — the sharded analog of the module-level
-# eval_packed_jit cache on the single-corpus path.
-_STEP_CACHE: Dict[Tuple[Mesh, bool, int], Any] = {}
+# jitted sharded steps cached per (mesh, has_dfa, has_matmul, n_levels):
+# reconcile-time apply_snapshot builds a fresh ShardedPolicyModel, and a
+# per-model jax.jit(shard_map(...)) closure would force a full XLA recompile
+# on every snapshot even at unchanged shapes — the sharded analog of the
+# module-level eval_packed_jit cache on the single-corpus path.  The flags
+# pin the params/specs pytree STRUCTURE (lane presence changes it), so a
+# gather-lane model can never reuse a matmul-traced step.
+_STEP_CACHE: Dict[Tuple[Mesh, bool, bool, int], Any] = {}
 
 
-def _param_specs(has_dfa: bool, n_levels: int):
-    lspec = tuple((P("mp"), P("mp")) for _ in range(n_levels))
-    mp = P("mp")
-    return {
-        "leaf_op": mp,
-        "leaf_attr": mp,
-        "leaf_const": mp,
-        "member_slot_of_leaf": mp,
-        "cpu_scatter_idx": mp,
-        "levels": lspec,
-        "eval_cond": mp,
-        "eval_rule": mp,
-        "eval_has_cond": mp,
-        # None params are empty pytree nodes; specs mirror the structure
-        "dfa_tables": mp if has_dfa else None,
-        "dfa_accept": mp if has_dfa else None,
-        "dfa_byte_slot": mp if has_dfa else None,
-        "leaf_dfa_row": mp if has_dfa else None,
-    }
-
-
-def _sharded_step(mesh: Mesh, has_dfa: bool, n_levels: int):
+def _sharded_step(mesh: Mesh, has_dfa: bool, has_matmul: bool, n_levels: int, specs):
     """Own-config evaluation step over the mesh: each mp shard evaluates its
     sub-corpus, selects the rows of requests whose config it owns, and the
     tiny [B], [B, E] results combine with one psum over 'mp' — so the
     device→host readback is own-rows only, never the [B, S*G(, E)] matrices
-    (the sharded analog of eval_packed_jit's one-small-readback contract)."""
-    key = (mesh, has_dfa, n_levels)
+    (the sharded analog of eval_packed_jit's one-small-readback contract).
+    ``specs`` mirrors the stacked-params structure (P('mp') on every leaf);
+    the cache key's flags pin that structure."""
+    key = (mesh, has_dfa, has_matmul, n_levels)
     step = _STEP_CACHE.get(key)
     if step is not None:
         return step
-    specs = _param_specs(has_dfa, n_levels)
 
     def local_eval(params, attrs_val, members_c, cpu_dense,
                    attr_bytes, byte_ovf, shard_of, row_of):
@@ -190,76 +172,27 @@ class ShardedPolicyModel:
             for g in groups
         ]
         self.has_dfa = self.shards[0].n_byte_attrs > 0
-        # eval tables may still differ in row count (configs per shard): pad G
-        G = max(p.n_configs for p in self.shards)
-        self.configs_per_shard = G
-
-        def pad_rows(a: np.ndarray, fill) -> np.ndarray:
-            if a.shape[0] == G:
-                return a
-            pad = np.full((G - a.shape[0],) + a.shape[1:], fill, dtype=a.dtype)
-            return np.concatenate([a, pad], axis=0)
-
-        # gather lane: the stacked params keep only gather-lane keys, so
-        # building matmul operands per shard would be wasted upload
-        per_shard_params = [to_device(p, lane="gather") for p in self.shards]
-        # stack on leading S axis (device-side stack is fine at these sizes)
-        from ..compiler.compile import TRUE_SLOT
-
-        def stack(key):
-            return jnp.stack([pp[key] for pp in per_shard_params])
-
-        eval_cond = np.stack([pad_rows(p.eval_cond, TRUE_SLOT) for p in self.shards])
-        eval_rule = np.stack([pad_rows(p.eval_rule, TRUE_SLOT) for p in self.shards])
-        eval_has = np.stack([pad_rows(p.eval_has_cond, False) for p in self.shards])
+        # targets unified every operand shape (incl. eval-table rows), so
+        # the whole per-shard device pytree — gather lane, matmul lane, DFA
+        # lane — stacks on a leading [S] axis with one tree.map
+        self.configs_per_shard = self.shards[0].n_configs
+        # host-side staging: stack numpy operands, then ONE mesh-sharded
+        # device_put per leaf — each shard's slice transfers straight to its
+        # devices (no transient 2-3x corpus copy on device 0)
+        per_shard_params = [to_device(p, host=True) for p in self.shards]
+        self.params = jax.tree.map(
+            lambda *xs: np.stack(xs), *per_shard_params
+        )
+        self.has_matmul = self.params.get("matmul") is not None
+        specs = jax.tree.map(lambda _: P("mp"), self.params)
+        self.params = jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            self.params, specs,
+        )
         n_levels = len(self.shards[0].levels)
-        self.params = {
-            "leaf_op": stack("leaf_op"),
-            "leaf_attr": stack("leaf_attr"),
-            "leaf_const": stack("leaf_const"),
-            "member_slot_of_leaf": stack("member_slot_of_leaf"),
-            "cpu_scatter_idx": stack("cpu_scatter_idx"),
-            "levels": tuple(
-                (
-                    jnp.stack([jnp.asarray(p.levels[l][0]) for p in self.shards]),
-                    jnp.stack([jnp.asarray(p.levels[l][1]) for p in self.shards]),
-                )
-                for l in range(n_levels)
-            ),
-            "eval_cond": jnp.asarray(eval_cond),
-            "eval_rule": jnp.asarray(eval_rule),
-            "eval_has_cond": jnp.asarray(eval_has),
-            # device regex lane (uniform across shards by ShapeTargets union;
-            # None pytree nodes when no shard has a DFA-compilable regex)
-            "dfa_tables": stack("dfa_tables") if self.has_dfa else None,
-            "dfa_accept": stack("dfa_accept") if self.has_dfa else None,
-            "dfa_byte_slot": stack("dfa_byte_slot") if self.has_dfa else None,
-            "leaf_dfa_row": stack("leaf_dfa_row") if self.has_dfa else None,
-        }
-        self._place_params()
-        self._step = _sharded_step(mesh, self.has_dfa, n_levels)
-
-    # ------------------------------------------------------------------
-
-    def _place_params(self):
-        specs = _param_specs(self.has_dfa, len(self.params["levels"]))
-
-        def place(a, spec):
-            if a is None:
-                return None
-            return jax.device_put(a, NamedSharding(self.mesh, spec))
-
-        p = self.params
-        self.params = {
-            **{
-                k: place(p[k], specs[k])
-                for k in p
-                if k != "levels"
-            },
-            "levels": tuple(
-                (place(c, P("mp")), place(a, P("mp"))) for c, a in p["levels"]
-            ),
-        }
+        self._step = _sharded_step(
+            mesh, self.has_dfa, self.has_matmul, n_levels, specs
+        )
 
     # ------------------------------------------------------------------
 

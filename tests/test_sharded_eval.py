@@ -58,6 +58,23 @@ def test_sharded_params_actually_sharded():
     assert len(shard_devs) == 8  # placed across the whole mesh
 
 
+def test_sharded_matmul_lane_active():
+    """The stacked params carry the MXU matmul operands (one per shard,
+    leading [S] axis) — the sharded path must not silently fall back to the
+    gather formulation."""
+    rng = random.Random(31)
+    configs = make_corpus(rng, 9)
+    mesh = build_mesh(n_devices=8, dp=2)  # mp = 4
+    m = ShardedPolicyModel(configs, mesh, members_k=8)
+    assert m.has_matmul and m.params["matmul"] is not None
+    assert m.params["matmul"]["attr_onehot"].shape[0] == 4  # [S, A, L]
+    # and it still matches the oracle end-to-end
+    docs = [random_doc(rng) for _ in range(16)]
+    names = [f"cfg-{rng.randrange(9)}" for _ in docs]
+    expected = [oracle_verdict(configs[int(n.split('-')[1])], d) for d, n in zip(docs, names)]
+    assert m.decide(docs, names) == expected
+
+
 def test_sharded_dfa_lane_rides_the_mesh():
     """Regexes concentrated in a few configs: only some shards naturally
     have DFA rows, the ShapeTargets union forces a uniform lane, and the
