@@ -18,8 +18,14 @@ surfaces as a translate error — fail closed):
   - references over ``input`` and rule results; array/object indexing
   - built-ins: count, contains, startswith, endswith, lower, upper, split,
     concat, trim, trim_prefix, trim_suffix, replace, sprintf, to_number,
-    abs, max, min, sum, object.get, array.concat, json.unmarshal
-"""
+    abs, max, min, sum, sort, indexof, substring, object.get, array.concat,
+    json.unmarshal, regex.match/re_match, time.now_ns, is_null/is_string/
+    is_boolean/is_number/is_array/is_object
+
+``regex.match`` evaluates through the linear-time DFA engine
+(compiler/redfa.py) whenever the pattern is DFA-compilable — matching
+OPA's RE2 guarantee against request-controlled input; patterns outside the
+DFA subset fall back to Python ``re`` (backtracking)."""
 
 from __future__ import annotations
 
@@ -128,6 +134,8 @@ class ObjectLit:
 class CallExpr:
     fn: str
     args: List[Any]
+    # postfix ref applied to the call result: sort(x)[0], split(s, "/")[1]
+    path: List[Any] = field(default_factory=list)
 
 
 @dataclass
@@ -407,7 +415,19 @@ class _Parser:
                         self.next()
                 self.expect("op", ")")
                 fn = ".".join(fn_parts) if fn_parts else base
-                return CallExpr(fn, args)
+                call = CallExpr(fn, args)
+                # postfix refs on the call result: sort(x)[0].name …
+                while True:
+                    t = self.peek()
+                    if t.kind == "op" and t.value == ".":
+                        self.next()
+                        call.path.append(self.expect("name").value)
+                    elif t.kind == "op" and t.value == "[":
+                        self.next()
+                        call.path.append(self._parse_term())
+                        self.expect("op", "]")
+                    else:
+                        return call
             else:
                 break
         if not path:
@@ -418,6 +438,37 @@ class _Parser:
 # ---------------------------------------------------------------------------
 # Evaluator
 # ---------------------------------------------------------------------------
+
+_REGEX_CACHE: Dict[str, Any] = {}
+
+
+def _regex_match(pattern: str, value: str) -> bool:
+    """Search semantics (like Go MatchString / gjson `%`).  DFA lane first
+    (linear time — OPA's RE2 guarantee against request-controlled values);
+    Python re only for patterns outside the DFA subset and for values
+    containing NUL, which the DFA reserves as padding (backtracking there —
+    policy authors are semi-trusted, and NUL values are vanishingly rare).
+    Acceptance is read from the FINAL state only, exactly like the device
+    kernel's scan: `$`-anchored DFAs are not absorbing-accept."""
+    ent = _REGEX_CACHE.get(pattern)
+    if ent is None:
+        from ...compiler.redfa import compile_regex_dfa
+
+        ent = compile_regex_dfa(pattern)
+        if ent is None:
+            ent = re.compile(pattern)
+        if len(_REGEX_CACHE) > 1024:
+            _REGEX_CACHE.clear()
+        _REGEX_CACHE[pattern] = ent
+    raw = value.encode("utf-8")
+    if isinstance(ent, re.Pattern) or 0 in raw:
+        rx = ent if isinstance(ent, re.Pattern) else re.compile(pattern)
+        return rx.search(value) is not None
+    trans, accept, state = ent.trans, ent.accept, ent.start
+    for b in raw:
+        state = int(trans[state, b])
+    return bool(accept[state])
+
 
 def _builtin(fn: str, args: List[Any]) -> Any:
     try:
@@ -466,6 +517,36 @@ def _builtin(fn: str, args: List[Any]) -> Any:
             return list(args[0]) + list(args[1])
         if fn == "json.unmarshal":
             return json.loads(args[0])
+        if fn in ("regex.match", "re_match"):
+            return _regex_match(str(args[0]), str(args[1]))
+        if fn == "indexof":
+            return str(args[0]).find(str(args[1]))
+        if fn == "substring":
+            s, off, length = str(args[0]), int(args[1]), int(args[2])
+            if off < 0:
+                # OPA errors on negative offsets (expression undefined →
+                # rule fails); slicing from the end would fail OPEN on the
+                # common substring(s, indexof(s, x), n) miss
+                raise RegoError("substring: negative offset")
+            return s[off:] if length < 0 else s[off:off + length]
+        if fn == "sort":
+            return sorted(args[0])
+        if fn == "time.now_ns":
+            import time as _time
+
+            return _time.time_ns()
+        if fn == "is_null":
+            return args[0] is None
+        if fn == "is_string":
+            return isinstance(args[0], str)
+        if fn == "is_boolean":
+            return isinstance(args[0], bool)
+        if fn == "is_number":
+            return isinstance(args[0], (int, float)) and not isinstance(args[0], bool)
+        if fn == "is_array":
+            return isinstance(args[0], list)
+        if fn == "is_object":
+            return isinstance(args[0], dict)
     except RegoError:
         raise
     except Exception as e:
@@ -615,7 +696,11 @@ class _Evaluator:
             arg_vals = [next(self._term_values(a, bindings), _UNDEFINED) for a in term.args]
             if _UNDEFINED in arg_vals:
                 return
-            yield _builtin(term.fn, arg_vals)
+            result = _builtin(term.fn, arg_vals)
+            if term.path:
+                yield from self._walk_path([result], term.path, bindings)
+            else:
+                yield result
         elif isinstance(term, Ref):
             yield from self._ref_values(term, bindings)
         elif isinstance(term, (BinExpr, NotExpr, InExpr)):
@@ -638,33 +723,34 @@ class _Evaluator:
         else:
             raise RegoError(f"rego: unsafe variable {ref.base!r}")
 
-        def walk(values: List[Any], path: List[Any]) -> Iterator[Any]:
-            if not path:
-                yield from values
-                return
-            seg, rest = path[0], path[1:]
-            out: List[Any] = []
-            for v in values:
-                if isinstance(seg, str):
-                    if isinstance(v, dict) and seg in v:
-                        yield from walk([v[seg]], rest)
-                elif isinstance(seg, Var) and seg.name == "_":
-                    items = v if isinstance(v, list) else (
-                        list(v.values()) if isinstance(v, dict) else []
-                    )
-                    for item in items:
-                        yield from walk([item], rest)
-                else:
-                    for key in self._term_values(seg, bindings):
-                        if isinstance(v, list) and isinstance(key, (int, float)):
-                            i = int(key)
-                            if 0 <= i < len(v):
-                                yield from walk([v[i]], rest)
-                        elif isinstance(v, dict) and key in v:
-                            yield from walk([v[key]], rest)
-            return
+        yield from self._walk_path(roots, ref.path, bindings)
 
-        yield from walk(roots, ref.path)
+    def _walk_path(self, values: List[Any], path: List[Any],
+                   bindings: Dict[str, Any]) -> Iterator[Any]:
+        """Ref-path walk over candidate values (shared by Ref bases and
+        postfix refs on call results)."""
+        if not path:
+            yield from values
+            return
+        seg, rest = path[0], path[1:]
+        for v in values:
+            if isinstance(seg, str):
+                if isinstance(v, dict) and seg in v:
+                    yield from self._walk_path([v[seg]], rest, bindings)
+            elif isinstance(seg, Var) and seg.name == "_":
+                items = v if isinstance(v, list) else (
+                    list(v.values()) if isinstance(v, dict) else []
+                )
+                for item in items:
+                    yield from self._walk_path([item], rest, bindings)
+            else:
+                for key in self._term_values(seg, bindings):
+                    if isinstance(v, list) and isinstance(key, (int, float)):
+                        i = int(key)
+                        if 0 <= i < len(v):
+                            yield from self._walk_path([v[i]], rest, bindings)
+                    elif isinstance(v, dict) and key in v:
+                        yield from self._walk_path([v[key]], rest, bindings)
 
 
 def compile_module(rego_src: str, package: str = "policy") -> RegoModule:

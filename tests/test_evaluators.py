@@ -394,6 +394,50 @@ class TestRego:
             compile_module("allow { every x in input.xs { x > 1 } }")
 
 
+class TestRegoBuiltinsExtra:
+    def _eval(self, rego_src, input_doc):
+        from authorino_tpu.evaluators.authorization import rego
+
+        module = rego.compile_module("default allow = false\n" + rego_src, package="t")
+        return module.evaluate(input_doc)["allow"]
+
+    def test_regex_match(self):
+        src = 'allow { regex.match("^/api/v[0-9]+/", input.path) }'
+        assert self._eval(src, {"path": "/api/v2/pets"}) is True
+        assert self._eval(src, {"path": "/admin"}) is False
+
+    def test_substring_indexof(self):
+        src = 'allow { indexof(input.s, "-") == 3 ; substring(input.s, 0, 3) == "abc" }'
+        assert self._eval(src, {"s": "abc-def"}) is True
+        assert self._eval(src, {"s": "ab-cdef"}) is False
+
+    def test_type_checks_and_sort(self):
+        src = ('allow { is_string(input.s) ; is_number(input.n) ; '
+               'is_array(input.a) ; sort(input.a)[0] == 1 }')
+        assert self._eval(src, {"s": "x", "n": 2, "a": [3, 1, 2]}) is True
+        assert self._eval(src, {"s": 1, "n": 2, "a": [3, 1, 2]}) is False
+
+    def test_substring_negative_offset_fails_closed(self):
+        # OPA errors on negative offsets; slicing from the end would fail
+        # OPEN on the common substring(s, indexof(s, x), n) miss
+        from authorino_tpu.evaluators.authorization import rego
+
+        src = 'allow { substring(input.s, indexof(input.s, "#"), 2) == "ef" }'
+        with pytest.raises(rego.RegoError, match="negative offset"):
+            self._eval(src, {"s": "abcdef"})
+
+    def test_regex_match_linear_time_on_catastrophic_pattern(self):
+        # ^(a+)+$ explodes under backtracking engines; the DFA lane must
+        # answer in linear time like OPA's RE2
+        import time
+
+        src = 'allow { regex.match("^(a+)+$", input.v) }'
+        t0 = time.perf_counter()
+        assert self._eval(src, {"v": "a" * 28 + "!"}) is False
+        assert self._eval(src, {"v": "a" * 28}) is True
+        assert time.perf_counter() - t0 < 1.0
+
+
 class TestOPAEvaluator:
     def test_opa_call(self):
         opa = OPA("policy", inline_rego='allow { input.auth.identity.anonymous == true }')
