@@ -152,6 +152,16 @@ class AuthPipeline:
         return True, None
 
     @staticmethod
+    def _reap_tasks(tasks) -> None:
+        """Cancel still-pending racers; retrieve losers' exceptions so
+        asyncio never logs exception-never-retrieved for them."""
+        for t in tasks:
+            if not t.done():
+                t.cancel()
+            elif not t.cancelled():
+                t.exception()
+
+    @staticmethod
     def _priority_buckets(configs: List[PhaseConfig]) -> List[List[PhaseConfig]]:
         buckets: Dict[int, List[PhaseConfig]] = {}
         for c in configs:
@@ -222,9 +232,7 @@ class AuthPipeline:
                         errors[conf.name] = err
                         continue
             finally:
-                for t in tasks:
-                    if not t.done():
-                        t.cancel()
+                self._reap_tasks(tasks)
         return _json.dumps(errors, separators=(",", ":"), sort_keys=True)
 
     async def _evaluate_fire_all(self, configs: List[PhaseConfig], results: Dict[Any, Any]) -> None:
@@ -290,9 +298,7 @@ class AuthPipeline:
                 if failure is not None:
                     return failure
             finally:
-                for t in tasks:
-                    if not t.done():
-                        t.cancel()
+                self._reap_tasks(tasks)
         return None
 
     async def _evaluate_response(self) -> Tuple[Dict[str, str], Dict[str, Any]]:

@@ -48,7 +48,8 @@ def make_idp_app(key):
     return app
 
 
-def write_manifests(tmpdir: str, wb_pem: bytes):
+def write_manifests(tmpdir: str, wb_pem: bytes, api_key: bytes = b"friend-secret-1",
+                    evil_org: str = "evil"):
     import yaml
 
     api_secret = {
@@ -59,7 +60,7 @@ def write_manifests(tmpdir: str, wb_pem: bytes):
             "namespace": "e2e",
             "labels": {"audience": "talker-api", "authorino.kuadrant.io/managed-by": "authorino"},
         },
-        "data": {"api_key": base64.b64encode(b"friend-secret-1").decode()},
+        "data": {"api_key": base64.b64encode(api_key).decode()},
     }
     wb_secret = {
         "apiVersion": "v1",
@@ -88,7 +89,7 @@ def write_manifests(tmpdir: str, wb_pem: bytes):
             "authorization": {
                 "deny-evil-org": {
                     "patternMatching": {
-                        "patterns": [{"selector": "request.headers.x-org", "operator": "neq", "value": "evil"}]
+                        "patterns": [{"selector": "request.headers.x-org", "operator": "neq", "value": evil_org}]
                     }
                 },
                 "admins-can-delete": {
@@ -128,8 +129,11 @@ def write_manifests(tmpdir: str, wb_pem: bytes):
         },
     }
     path = os.path.join(tmpdir, "manifests.yaml")
-    with open(path, "w") as f:
+    # atomic replace: the dir watcher polls (mtime, size) every 2s and must
+    # never observe a truncated mid-write file
+    with open(path + ".tmp", "w") as f:
         yaml.dump_all([api_secret, wb_secret, authconfig], f)
+    os.replace(path + ".tmp", path)
     return os.path.dirname(path)
 
 
@@ -252,6 +256,53 @@ async def main() -> int:
             failures += 1
             print(f"[FAIL] wristband verification: {e}")
 
+        # ---- live rotation (ref tests/e2e-test.sh: API-key revocation +
+        # AuthConfig update): rewrite the manifests — the dir watcher must
+        # revoke the old key, trust the new one, and recompile the rule
+        # corpus (atomic device swap) with the flipped org rule
+        write_manifests(tmpdir, wb_pem, api_key=b"friend-secret-2", evil_org="rogue")
+
+        async def status_of(headers):
+            req_headers = {"Host": H, **headers}
+            async with sess.get(f"{base}/hello", headers=req_headers,
+                                allow_redirects=False) as r:
+                return r.status
+
+        rotated = False
+        for _ in range(20):  # poll interval is 2s; allow for reconcile lag
+            await asyncio.sleep(1.0)
+            old_k = await status_of({"Authorization": "APIKEY friend-secret-1"})
+            new_k = await status_of({"Authorization": "APIKEY friend-secret-2"})
+            if old_k == 401 and new_k == 200:
+                rotated = True
+                break
+        if rotated:
+            print("[PASS] live API-key rotation: old key revoked, new key trusted")
+        else:
+            failures += 1
+            print(f"[FAIL] live API-key rotation (old={old_k}, new={new_k})")
+
+        # secret rotation lands before the async corpus recompile (the
+        # snapshot swap runs in a thread after the secret events) — poll.
+        # Gated on the rotation having landed: polling with a never-trusted
+        # key would cascade the same watcher failure under a second name.
+        recompiled = False
+        for _ in range(20 if rotated else 0):
+            evil_now = await status_of({"Authorization": "APIKEY friend-secret-2", "X-Org": "evil"})
+            rogue_now = await status_of({"Authorization": "APIKEY friend-secret-2", "X-Org": "rogue"})
+            if (evil_now, rogue_now) == (200, 302):
+                recompiled = True
+                break
+            await asyncio.sleep(1.0)
+        if recompiled:
+            print("[PASS] live corpus recompile: org rule flipped (evil allowed, rogue denied)")
+        elif not rotated:
+            failures += 1
+            print("[FAIL] live corpus recompile: skipped (rotation never landed)")
+        else:
+            failures += 1
+            print(f"[FAIL] live corpus recompile (evil={evil_now}, rogue={rogue_now})")
+
     server_task.cancel()
     try:
         await server_task
@@ -261,7 +312,8 @@ async def main() -> int:
     from authorino_tpu.utils.http import close_sessions
 
     await close_sessions()
-    print(f"\n{'OK' if failures == 0 else 'FAILED'}: {len(TABLE) + 1 - failures}/{len(TABLE) + 1} assertions passed")
+    n_assertions = len(TABLE) + 3  # + wristband + rotation + recompile
+    print(f"\n{'OK' if failures == 0 else 'FAILED'}: {n_assertions - failures}/{n_assertions} assertions passed")
     return 1 if failures else 0
 
 
