@@ -53,8 +53,29 @@ def wire_dtype(policy: CompiledPolicy):
     return np.int16 if len(policy.interner) < 32767 else np.int32
 
 
-def pack_batch(policy: CompiledPolicy, enc: EncodedBatch) -> DeviceBatch:
-    """Cheap numpy slicing; no per-request Python work."""
+def _trim_bytes(attr_bytes: np.ndarray) -> np.ndarray:
+    """Drop trailing all-zero byte columns, bucketed to powers of two (≥16)
+    to bound jit variants.  Exact: NUL padding is identity in every DFA
+    (compiler/redfa.py), so the final scan state — the only thing the
+    kernel reads — is unchanged.  The byte tensor is the largest single
+    wire item; typical values (URL paths, headers) use a fraction of the
+    DFA_VALUE_BYTES budget."""
+    from ..utils import bucket_pow2
+
+    LB = attr_bytes.shape[-1]
+    used = attr_bytes.any(axis=tuple(range(attr_bytes.ndim - 1)))  # [LB]
+    max_used = int(np.nonzero(used)[0][-1]) + 1 if used.any() else 1
+    eff = bucket_pow2(max_used)
+    if eff >= LB:
+        return attr_bytes
+    return np.ascontiguousarray(attr_bytes[..., :eff])
+
+
+def pack_batch(policy: CompiledPolicy, enc: EncodedBatch,
+               trim_bytes: bool = True) -> DeviceBatch:
+    """Cheap numpy slicing; no per-request Python work.  ``trim_bytes=False``
+    skips the byte-column trim — the sharded model assembles per-shard
+    batches into one tensor and trims once at the end instead."""
     B = enc.attrs_val.shape[0]
     M, C, K = policy.n_member_attrs, policy.n_cpu_leaves, policy.members_k
     dt = wire_dtype(policy)
@@ -85,7 +106,8 @@ def pack_batch(policy: CompiledPolicy, enc: EncodedBatch) -> DeviceBatch:
         members_c=members_c,
         cpu_dense=cpu_dense,
         config_id=enc.config_id,
-        attr_bytes=enc.attr_bytes if has_dfa else None,
+        attr_bytes=(_trim_bytes(enc.attr_bytes) if trim_bytes else enc.attr_bytes)
+        if has_dfa else None,
         byte_ovf=enc.byte_ovf if has_dfa else None,
         host_fallback=host_fallback,
     )

@@ -126,13 +126,23 @@ class PolicyModel:
         """A jittable forward fn + realistic example args (for compile checks)."""
         db = self.encode([], [], batch_pad=batch)
         has_dfa = self.params["dfa_tables"] is not None
+        attr_bytes = db.attr_bytes
+        if has_dfa:
+            # re-pad to the full byte budget: an empty batch trims to the
+            # minimum width, but the compile check must cover the widest
+            # DFA-scan variant production values can trigger
+            from ..compiler.compile import DFA_VALUE_BYTES
+
+            full = np.zeros(attr_bytes.shape[:-1] + (DFA_VALUE_BYTES,), dtype=np.uint8)
+            full[..., : attr_bytes.shape[-1]] = attr_bytes
+            attr_bytes = full
         args = (
             self.params,
             jnp.asarray(db.attrs_val),
             jnp.asarray(db.members_c),
             jnp.asarray(db.cpu_dense),
             jnp.asarray(db.config_id),
-            jnp.asarray(db.attr_bytes) if has_dfa else None,
+            jnp.asarray(attr_bytes) if has_dfa else None,
             jnp.asarray(db.byte_ovf) if has_dfa else None,
         )
         return forward, args

@@ -237,14 +237,21 @@ class ShardedPolicyModel:
                 [docs[r] for r in rs],
                 [int(row_of[r]) for r in rs],
             )
-            db = pack_batch(self.shards[shard], enc)
+            db = pack_batch(self.shards[shard], enc, trim_bytes=False)
             attrs_val[rs, shard] = db.attrs_val[: len(rs)]
             members_c[rs, shard] = db.members_c[: len(rs)]
             cpu_dense[rs, shard] = db.cpu_dense[: len(rs)]
             if self.has_dfa:
-                attr_bytes[rs, shard] = db.attr_bytes[: len(rs)]
+                # per-shard batches may be byte-trimmed (pack._trim_bytes);
+                # assign into the prefix, then trim the assembled tensor once
+                lb = db.attr_bytes.shape[-1]
+                attr_bytes[rs, shard, :, :lb] = db.attr_bytes[: len(rs)]
                 byte_ovf[rs, shard] = db.byte_ovf[: len(rs)]
             host_fallback[rs] = db.host_fallback[: len(rs)]
+        if self.has_dfa:
+            from ..compiler.pack import _trim_bytes
+
+            attr_bytes = _trim_bytes(attr_bytes)
         return _ShardedEncoded(
             attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf,
             shard_of, row_of, host_fallback,

@@ -152,14 +152,15 @@ class AuthPipeline:
         return True, None
 
     @staticmethod
-    def _reap_tasks(tasks) -> None:
-        """Cancel still-pending racers; retrieve losers' exceptions so
-        asyncio never logs exception-never-retrieved for them."""
+    async def _reap_tasks(tasks) -> None:
+        """Cancel still-pending racers and AWAIT them out: a racer whose
+        cleanup raises something other than CancelledError while unwinding
+        would otherwise still log exception-never-retrieved; gather with
+        return_exceptions consumes every outcome."""
         for t in tasks:
             if not t.done():
                 t.cancel()
-            elif not t.cancelled():
-                t.exception()
+        await asyncio.gather(*tasks, return_exceptions=True)
 
     @staticmethod
     def _priority_buckets(configs: List[PhaseConfig]) -> List[List[PhaseConfig]]:
@@ -232,7 +233,7 @@ class AuthPipeline:
                         errors[conf.name] = err
                         continue
             finally:
-                self._reap_tasks(tasks)
+                await self._reap_tasks(tasks)
         return _json.dumps(errors, separators=(",", ":"), sort_keys=True)
 
     async def _evaluate_fire_all(self, configs: List[PhaseConfig], results: Dict[Any, Any]) -> None:
@@ -298,7 +299,7 @@ class AuthPipeline:
                 if failure is not None:
                     return failure
             finally:
-                self._reap_tasks(tasks)
+                await self._reap_tasks(tasks)
         return None
 
     async def _evaluate_response(self) -> Tuple[Dict[str, str], Dict[str, Any]]:

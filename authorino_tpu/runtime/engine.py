@@ -237,8 +237,4 @@ class PolicyEngine:
         return own_rule, own_skipped
 
 
-def _bucket(n: int, minimum: int = 16) -> int:
-    b = minimum
-    while b < n:
-        b *= 2
-    return b
+from ..utils import bucket_pow2 as _bucket  # noqa: E402 — shared bucketing policy
