@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--secret-label-selector", default=env_var("SECRET_LABEL_SELECTOR", "authorino.kuadrant.io/managed-by=authorino"))
     s.add_argument("--allow-superseding-host-subsets", action="store_true", default=env_var("ALLOW_SUPERSEDING_HOST_SUBSETS", False))
     s.add_argument("--enable-leader-election", action="store_true", default=env_var("ENABLE_LEADER_ELECTION", False), help="Leader-elect the status writer (in-cluster mode)")
+    s.add_argument("--tls-cert", default=env_var("TLS_CERT", ""), help="PEM cert for the ext_authz gRPC + HTTP listeners (ref main.go:456-470; TLS >= 1.2)")
+    s.add_argument("--tls-cert-key", default=env_var("TLS_CERT_KEY", ""))
+    s.add_argument("--oidc-tls-cert", default=env_var("OIDC_TLS_CERT", ""), help="PEM cert for the OIDC discovery listener")
+    s.add_argument("--oidc-tls-cert-key", default=env_var("OIDC_TLS_CERT_KEY", ""))
     s.add_argument("--tracing-service-endpoint", default=env_var("TRACING_SERVICE_ENDPOINT", ""), help="OTLP endpoint (rpc://host:port or http(s)://...)")
     s.add_argument("--tracing-service-insecure", action="store_true", default=env_var("TRACING_SERVICE_INSECURE", False))
     s.add_argument("--log-level", default=env_var("LOG_LEVEL", "info"))
@@ -74,10 +78,23 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-async def run_webhooks(args) -> None:
-    """(ref: main.go `webhooks` command — conversion webhook server)"""
+def _ssl_ctx(cert: str, key: str, what: str = "--tls-cert"):
+    """Server-side TLS context, minimum 1.2 like the reference
+    (ref main.go:456-470)."""
     import ssl
 
+    if bool(cert) != bool(key):
+        raise SystemExit(f"{what} and {what}-key must be provided together")
+    if not cert:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_cert_chain(cert, key)
+    return ctx
+
+
+async def run_webhooks(args) -> None:
+    """(ref: main.go `webhooks` command — conversion webhook server)"""
     from aiohttp import web
 
     from .service.webhooks import build_webhook_app
@@ -85,12 +102,7 @@ async def run_webhooks(args) -> None:
     logging.basicConfig(level=getattr(logging, args.log_level.upper(), logging.INFO))
     log = logging.getLogger("authorino_tpu.webhooks")
 
-    if bool(args.tls_cert) != bool(args.tls_cert_key):
-        raise SystemExit("--tls-cert and --tls-cert-key must be provided together")
-    ssl_ctx = None
-    if args.tls_cert:
-        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        ssl_ctx.load_cert_chain(args.tls_cert, args.tls_cert_key)
+    ssl_ctx = _ssl_ctx(args.tls_cert, args.tls_cert_key)
 
     runner = web.AppRunner(build_webhook_app())
     await runner.setup()
@@ -134,6 +146,22 @@ async def run_server(args) -> None:
 
     cache_mod.EVALUATOR_CACHE_MAX_ENTRIES = args.evaluator_cache_size
     metrics_mod.DEEP_METRICS_ENABLED = args.deep_metrics_enabled
+
+    # TLS material loads BEFORE the control plane starts: a bad flag/path
+    # must fail at startup, not mid-boot with a leader lease already held.
+    # All reads are adjacent so every listener serves the same certificate
+    # even if a cert-manager rotation lands during startup.
+    ext_ssl = _ssl_ctx(args.tls_cert, args.tls_cert_key)
+    oidc_ssl = _ssl_ctx(args.oidc_tls_cert, args.oidc_tls_cert_key, "--oidc-tls-cert")
+    tls_credentials = None
+    if ext_ssl is not None:
+        import grpc as grpc_mod
+
+        with open(args.tls_cert_key, "rb") as f:
+            key_pem = f.read()
+        with open(args.tls_cert, "rb") as f:
+            cert_pem = f.read()
+        tls_credentials = grpc_mod.ssl_server_credentials([(key_pem, cert_pem)])
 
     if args.tracing_service_endpoint:
         from .utils.tracing import setup_tracing
@@ -195,19 +223,22 @@ async def run_server(args) -> None:
     app = build_app(engine, readiness=reconciler.ready, max_body=args.max_http_request_body_size)
     runner = web.AppRunner(app)
     await runner.setup()
-    await web.TCPSite(runner, "0.0.0.0", args.ext_auth_http_port).start()
-    log.info("http /check listening on :%d", args.ext_auth_http_port)
+    await web.TCPSite(runner, "0.0.0.0", args.ext_auth_http_port, ssl_context=ext_ssl).start()
+    log.info("http /check listening on :%d (tls=%s)", args.ext_auth_http_port, bool(ext_ssl))
 
     # OIDC discovery (wristbands)
     oidc_runner = web.AppRunner(build_oidc_app(engine))
     await oidc_runner.setup()
-    await web.TCPSite(oidc_runner, "0.0.0.0", args.oidc_http_port).start()
-    log.info("oidc discovery listening on :%d", args.oidc_http_port)
+    await web.TCPSite(oidc_runner, "0.0.0.0", args.oidc_http_port, ssl_context=oidc_ssl).start()
+    log.info("oidc discovery listening on :%d (tls=%s)", args.oidc_http_port, bool(oidc_ssl))
 
     # gRPC ext_authz
-    grpc_server = build_server(engine, address=f"0.0.0.0:{args.ext_auth_grpc_port}")
+    grpc_server = build_server(
+        engine, address=f"0.0.0.0:{args.ext_auth_grpc_port}",
+        tls_credentials=tls_credentials,
+    )
     await grpc_server.start()
-    log.info("grpc ext_authz listening on :%d", args.ext_auth_grpc_port)
+    log.info("grpc ext_authz listening on :%d (tls=%s)", args.ext_auth_grpc_port, bool(tls_credentials))
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

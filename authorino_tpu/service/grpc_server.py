@@ -193,7 +193,10 @@ def build_server(
         )
     )
     if tls_credentials is not None:
-        server.add_secure_port(address, tls_credentials)
+        port = server.add_secure_port(address, tls_credentials)
     else:
-        server.add_insecure_port(address)
+        port = server.add_insecure_port(address)
+    # OS-assigned port for ":0" addresses (tests); real deployments pass a
+    # fixed port and read back the same number
+    server.bound_port = port
     return server

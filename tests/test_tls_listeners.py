@@ -1,0 +1,143 @@
+"""TLS on the serving listeners: the ext_authz gRPC frontend must accept
+TLS >= 1.2 connections and the HTTP adapter must serve HTTPS when
+--tls-cert/--tls-cert-key are given (ref: main.go:456-470)."""
+
+import asyncio
+import datetime
+import ssl
+
+import grpc
+import pytest
+
+from authorino_tpu import protos
+from authorino_tpu.compiler import ConfigRules
+from authorino_tpu.evaluators import AuthorizationConfig, IdentityConfig, RuntimeAuthConfig
+from authorino_tpu.evaluators.authorization import PatternMatching
+from authorino_tpu.evaluators.identity import Noop
+from authorino_tpu.expressions import All, Operator, Pattern
+from authorino_tpu.runtime import EngineEntry, PolicyEngine
+from authorino_tpu.service.grpc_server import build_server
+
+external_auth_pb2 = protos.external_auth_pb2
+
+
+@pytest.fixture(scope="module")
+def self_signed():
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=1))
+        .not_valid_after(now + datetime.timedelta(hours=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]), critical=False
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    return cert_pem, key_pem
+
+
+def make_engine():
+    engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+    rules = All(Pattern("request.method", Operator.NEQ, "DELETE"))
+    runtime = RuntimeAuthConfig(
+        identity=[IdentityConfig("anon", Noop())],
+        authorization=[AuthorizationConfig("rules", PatternMatching(rules))],
+    )
+    engine.apply_snapshot([
+        EngineEntry(id="ns/cfg", hosts=["svc.example.com"], runtime=runtime,
+                    rules=ConfigRules(name="ns/cfg", evaluators=[(None, rules)]))
+    ])
+    return engine
+
+
+def test_grpc_tls_check(self_signed):
+    cert_pem, key_pem = self_signed
+
+    async def run():
+        engine = make_engine()
+        creds = grpc.ssl_server_credentials([(key_pem, cert_pem)])
+        # port 0: OS-assigned, like the other service tests (no EADDRINUSE)
+        server = build_server(engine, address="localhost:0", tls_credentials=creds)
+        port = server.bound_port
+        await server.start()
+        try:
+            chan_creds = grpc.ssl_channel_credentials(root_certificates=cert_pem)
+            async with grpc.aio.secure_channel(f"localhost:{port}", chan_creds) as ch:
+                call = ch.unary_unary(
+                    "/envoy.service.auth.v3.Authorization/Check",
+                    request_serializer=external_auth_pb2.CheckRequest.SerializeToString,
+                    response_deserializer=external_auth_pb2.CheckResponse.FromString,
+                )
+                req = external_auth_pb2.CheckRequest()
+                http = req.attributes.request.http
+                http.method = "GET"
+                http.host = "svc.example.com"
+                http.headers["host"] = "svc.example.com"
+                resp = await call(req)
+                assert resp.status.code == 0
+        finally:
+            await server.stop(0.1)
+
+    asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_http_tls_check(self_signed, tmp_path):
+    cert_pem, key_pem = self_signed
+    cert_file = tmp_path / "tls.crt"
+    key_file = tmp_path / "tls.key"
+    cert_file.write_bytes(cert_pem)
+    key_file.write_bytes(key_pem)
+
+    from authorino_tpu.cli import _ssl_ctx
+    from authorino_tpu.service.http_server import build_app
+
+    async def run():
+        import aiohttp
+        from aiohttp import web
+
+        engine = make_engine()
+        server_ctx = _ssl_ctx(str(cert_file), str(key_file))
+        assert server_ctx.minimum_version == ssl.TLSVersion.TLSv1_2
+        runner = web.AppRunner(build_app(engine))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0, ssl_context=server_ctx)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        try:
+            client_ctx = ssl.create_default_context(cadata=cert_pem.decode())
+            client_ctx.check_hostname = False
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"https://127.0.0.1:{port}/check",
+                    headers={"Host": "svc.example.com"},
+                    ssl=client_ctx,
+                ) as r:
+                    assert r.status == 200
+        finally:
+            await runner.cleanup()
+
+    asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_mismatched_flags_rejected():
+    from authorino_tpu.cli import _ssl_ctx
+
+    with pytest.raises(SystemExit):
+        _ssl_ctx("/some/cert.pem", "")
