@@ -18,6 +18,8 @@ tests):
     with operators ``== != < <= > >= % !%``
   - ``|`` pipe behaves like ``.`` (gjson's array-vs-pipe nuance is out of
     scope; documented limitation)
+  - multipaths ``{a.b,"name":c}`` (object) and ``[a.b,c]`` (array)
+    composition; missing members are omitted
   - modifiers ``@name`` / ``@name:arg`` — reference's custom set
     ``@extract @replace @case @base64 @strip`` (ref: pkg/json/json.go:259-263)
     plus the cheap gjson builtins ``@this @keys @values @flatten @reverse
@@ -474,11 +476,120 @@ _SENTINEL = _Sentinel()
 _FAST_CACHE: Dict[str, Any] = {}
 
 
+def _split_multipath(body: str) -> List[str]:
+    """Split a multipath body on depth-0 commas (quotes and all bracket
+    kinds respected)."""
+    parts: List[str] = []
+    buf: List[str] = []
+    depth = 0
+    in_quote = False
+    i, n = 0, len(body)
+    while i < n:
+        c = body[i]
+        if c == "\\" and i + 1 < n:
+            buf.append(c)
+            buf.append(body[i + 1])
+            i += 2
+            continue
+        if c == '"':
+            in_quote = not in_quote
+        elif not in_quote:
+            if c in "{[(":
+                depth += 1
+            elif c in "}])":
+                depth -= 1
+        if c == "," and depth == 0 and not in_quote:
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(c)
+        i += 1
+    parts.append("".join(buf))
+    return [p.strip() for p in parts if p.strip()]
+
+
+def _default_mp_key(path: str) -> str:
+    """gjson: the default object key of a multipath member is the last
+    plain path component (modifiers/queries keep the raw text)."""
+    segs = _split_segments(path)
+    last = segs[-1] if segs else path
+    return last.replace("\\.", ".")
+
+
+def _split_mp_key(member: str) -> Tuple[Optional[str], str]:
+    """Split an object-multipath member at its first depth-0 colon (gjson
+    accepts both quoted and unquoted keys: ``"n":a.b`` and ``n:a.b``)."""
+    depth = 0
+    in_quote = False
+    i, n = 0, len(member)
+    while i < n:
+        c = member[i]
+        if c == "\\" and i + 1 < n:
+            i += 2
+            continue
+        if c == '"':
+            in_quote = not in_quote
+        elif not in_quote:
+            if c in "{[(":
+                depth += 1
+            elif c in "}])":
+                depth -= 1
+            elif c == ":" and depth == 0:
+                key = member[:i].strip()
+                if len(key) >= 2 and key[0] == '"' and key[-1] == '"':
+                    key = key[1:-1].replace('\\"', '"')
+                return key, member[i + 1:].strip()
+        i += 1
+    return None, member
+
+
+# parsed multipath members, cached like _PATH_CACHE — multipaths ride the
+# same per-request hot path as plain selectors
+_MP_CACHE: Dict[str, Tuple[bool, List[Tuple[Optional[str], str]]]] = {}
+
+
+def _multipath(doc: Any, path: str) -> Result:
+    """gjson multipaths: ``{a.b,"name":c,count:d.#}`` builds an object,
+    ``[a.b,c]`` builds an array; missing members are omitted
+    (gjson multipath semantics — the composition feature of its
+    path syntax)."""
+    parsed = _MP_CACHE.get(path)
+    if parsed is None:
+        is_obj = path[0] == "{"
+        members = [_split_mp_key(m) for m in _split_multipath(path[1:-1])]
+        parsed = (is_obj, members)
+        if len(_MP_CACHE) < 65536:
+            _MP_CACHE[path] = parsed
+    is_obj, members = parsed
+    if is_obj:
+        out_obj: Dict[str, Any] = {}
+        for key, sub in members:
+            r = get(doc, sub)
+            if r.exists:
+                out_obj[key if key is not None else _default_mp_key(sub)] = r.value
+        return Result(out_obj)
+    out_arr: List[Any] = []
+    for _, sub in members:
+        r = get(doc, sub)
+        if r.exists:
+            out_arr.append(r.value)
+    return Result(out_arr)
+
+
+def _is_multipath(path: str) -> bool:
+    return len(path) >= 2 and (
+        (path[0] == "{" and path[-1] == "}")
+        or (path[0] == "[" and path[-1] == "]")
+    )
+
+
 def get(doc: Any, path: str) -> Result:
     """Resolve ``path`` against a parsed JSON document (the structural
     equivalent of gjson.Get over marshaled text, ref: pkg/jsonexp/expressions.go:61)."""
     if path == "":
         return Result(doc)
+    if _is_multipath(path):
+        return _multipath(doc, path)
     fast = _FAST_CACHE.get(path)
     if fast is None:
         segs = _parse_path(path)

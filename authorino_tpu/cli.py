@@ -149,8 +149,10 @@ async def run_server(args) -> None:
 
     # TLS material loads BEFORE the control plane starts: a bad flag/path
     # must fail at startup, not mid-boot with a leader lease already held.
-    # All reads are adjacent so every listener serves the same certificate
-    # even if a cert-manager rotation lands during startup.
+    # The reads are back-to-back, which narrows (but cannot eliminate —
+    # ssl.load_cert_chain only takes paths) the window in which a live
+    # cert rotation could leave the gRPC and HTTP listeners on different
+    # certificates; a restart converges them.
     ext_ssl = _ssl_ctx(args.tls_cert, args.tls_cert_key)
     oidc_ssl = _ssl_ctx(args.oidc_tls_cert, args.oidc_tls_cert_key, "--oidc-tls-cert")
     tls_credentials = None

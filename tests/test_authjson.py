@@ -85,6 +85,48 @@ class TestSelector:
         assert get(DOC, "auth.identity.missing").array() == []
 
 
+class TestMultipaths:
+    """gjson multipath composition: {obj} and [arr] construction
+    (gjson path syntax; closes the documented selector-engine gap)."""
+
+    def test_object_multipath_default_keys(self):
+        r = get(DOC, "{auth.identity.username,request.http.path}")
+        assert r.py() == {"username": "john", "path": "/hello"}
+
+    def test_object_multipath_named_keys(self):
+        r = get(DOC, '{"user":auth.identity.username,"p":request.http.path}')
+        assert r.py() == {"user": "john", "p": "/hello"}
+
+    def test_array_multipath(self):
+        r = get(DOC, "[auth.identity.username,request.http.path]")
+        assert r.py() == ["john", "/hello"]
+
+    def test_missing_members_omitted(self):
+        assert get(DOC, "{auth.identity.username,auth.nope}").py() == {"username": "john"}
+        assert get(DOC, "[auth.nope,request.http.path]").py() == ["/hello"]
+
+    def test_nested_multipath(self):
+        r = get(DOC, '{"who":{auth.identity.username},"hdr":[request.http.headers.x-tag]}')
+        assert r.py() == {"who": {"username": "john"}, "hdr": ["One Two Three"]}
+
+    def test_object_multipath_shadowed_by_templates_in_jsonvalue(self):
+        # parity nuance shared with the reference: JSONValue treats any
+        # {...} as a template placeholder (ref pkg/json/json.go:59
+        # IsTemplate), so OBJECT multipaths only apply at the raw selector
+        # level (pattern expressions); ARRAY multipaths work everywhere
+        from authorino_tpu.authjson import JSONValue
+
+        assert JSONValue(pattern="[auth.identity.username]").resolve_for(DOC) == ["john"]
+        v = JSONValue(pattern="{auth.identity.username}")
+        assert v.resolve_for(DOC) != {"username": "john"}  # template path wins
+
+    def test_multipath_with_query_member(self):
+        doc = {"items": [{"n": "a", "v": 1}, {"n": "b", "v": 2}]}
+        # both quoted and unquoted keys, like gjson
+        r = get(doc, '{"first_b":items.#(n==b).v,count:items.#}')
+        assert r.py() == {"first_b": 2, "count": 2}
+
+
 class TestModifiers:
     def test_extract(self):
         assert (
