@@ -140,33 +140,8 @@ _PATH_CACHE: Dict[str, Tuple[_Seg, ...]] = {}
 
 
 def _split_segments(path: str) -> List[str]:
-    segs: List[str] = []
-    buf: List[str] = []
-    depth = 0
-    in_quote = False
-    i, n = 0, len(path)
-    while i < n:
-        c = path[i]
-        if c == "\\" and i + 1 < n:
-            buf.append(c)
-            buf.append(path[i + 1])
-            i += 2
-            continue
-        if c == '"':
-            in_quote = not in_quote
-        elif not in_quote:
-            if c == "(":
-                depth += 1
-            elif c == ")":
-                depth = max(0, depth - 1)
-        if c in ".|" and depth == 0 and not in_quote:
-            segs.append("".join(buf))
-            buf = []
-        else:
-            buf.append(c)
-        i += 1
-    segs.append("".join(buf))
-    return segs
+    # parens only: plain-path keys may contain braces/brackets literally
+    return _depth0_split(path, ".|", opens="(", closes=")")
 
 
 _QUERY_RE = re.compile(r"^#\((.*)\)(#?)$", re.S)
@@ -476,70 +451,69 @@ _SENTINEL = _Sentinel()
 _FAST_CACHE: Dict[str, Any] = {}
 
 
-def _split_multipath(body: str) -> List[str]:
-    """Split a multipath body on depth-0 commas (quotes and all bracket
-    kinds respected)."""
+def _depth0_split(text: str, delims: str, opens: str = "{[(",
+                  closes: str = "}])") -> List[str]:
+    """Split ``text`` on depth-0 delimiter characters, respecting
+    backslash escapes, double quotes, and bracket nesting — the one scanner
+    shared by segment and multipath splitting."""
     parts: List[str] = []
     buf: List[str] = []
     depth = 0
     in_quote = False
-    i, n = 0, len(body)
+    i, n = 0, len(text)
     while i < n:
-        c = body[i]
+        c = text[i]
         if c == "\\" and i + 1 < n:
             buf.append(c)
-            buf.append(body[i + 1])
+            buf.append(text[i + 1])
             i += 2
             continue
         if c == '"':
             in_quote = not in_quote
         elif not in_quote:
-            if c in "{[(":
+            if c in opens:
                 depth += 1
-            elif c in "}])":
+            elif c in closes:
                 depth -= 1
-        if c == "," and depth == 0 and not in_quote:
+        if c in delims and depth == 0 and not in_quote:
             parts.append("".join(buf))
             buf = []
         else:
             buf.append(c)
         i += 1
     parts.append("".join(buf))
-    return [p.strip() for p in parts if p.strip()]
+    return parts
+
+
+def _split_multipath(body: str) -> List[str]:
+    return [p.strip() for p in _depth0_split(body, ",") if p.strip()]
 
 
 def _default_mp_key(path: str) -> str:
     """gjson: the default object key of a multipath member is the last
-    plain path component (modifiers/queries keep the raw text)."""
+    PLAIN path component — modifiers/hash/query segments are skipped
+    (``a.b.username|@case:upper`` keys as ``username``)."""
     segs = _split_segments(path)
-    last = segs[-1] if segs else path
-    return last.replace("\\.", ".")
+    for seg in reversed(segs):
+        if seg and not seg.startswith("@") and not seg.startswith("#"):
+            return seg.replace("\\.", ".")
+    return (segs[-1] if segs else path).replace("\\.", ".")
+
+
+# keyed object-multipath members: a quoted string or a bare word followed by
+# ':'.  Restricting bare keys to word characters keeps modifier arguments
+# (`@case:upper`) and query operators out of key position.
+_MP_QUOTED_KEY = re.compile(r'^"((?:[^"\\]|\\.)*)"\s*:\s*(.+)$', re.S)
+_MP_BARE_KEY = re.compile(r"^([A-Za-z0-9_\-]+)\s*:\s*(.+)$", re.S)
 
 
 def _split_mp_key(member: str) -> Tuple[Optional[str], str]:
-    """Split an object-multipath member at its first depth-0 colon (gjson
-    accepts both quoted and unquoted keys: ``"n":a.b`` and ``n:a.b``)."""
-    depth = 0
-    in_quote = False
-    i, n = 0, len(member)
-    while i < n:
-        c = member[i]
-        if c == "\\" and i + 1 < n:
-            i += 2
-            continue
-        if c == '"':
-            in_quote = not in_quote
-        elif not in_quote:
-            if c in "{[(":
-                depth += 1
-            elif c in "}])":
-                depth -= 1
-            elif c == ":" and depth == 0:
-                key = member[:i].strip()
-                if len(key) >= 2 and key[0] == '"' and key[-1] == '"':
-                    key = key[1:-1].replace('\\"', '"')
-                return key, member[i + 1:].strip()
-        i += 1
+    m = _MP_QUOTED_KEY.match(member)
+    if m:
+        return m.group(1).replace('\\"', '"'), m.group(2).strip()
+    m = _MP_BARE_KEY.match(member)
+    if m:
+        return m.group(1), m.group(2).strip()
     return None, member
 
 
@@ -576,11 +550,28 @@ def _multipath(doc: Any, path: str) -> Result:
     return Result(out_arr)
 
 
-def _is_multipath(path: str) -> bool:
-    return len(path) >= 2 and (
-        (path[0] == "{" and path[-1] == "}")
-        or (path[0] == "[" and path[-1] == "]")
-    )
+def _mp_prefix_end(path: str) -> int:
+    """Index of the bracket closing ``path[0]`` (quotes/escapes honored);
+    -1 when unbalanced."""
+    depth = 0
+    in_quote = False
+    i, n = 0, len(path)
+    while i < n:
+        c = path[i]
+        if c == "\\" and i + 1 < n:
+            i += 2
+            continue
+        if c == '"':
+            in_quote = not in_quote
+        elif not in_quote:
+            if c in "{[(":
+                depth += 1
+            elif c in "}])":
+                depth -= 1
+                if depth == 0:
+                    return i
+        i += 1
+    return -1
 
 
 def get(doc: Any, path: str) -> Result:
@@ -588,8 +579,16 @@ def get(doc: Any, path: str) -> Result:
     equivalent of gjson.Get over marshaled text, ref: pkg/jsonexp/expressions.go:61)."""
     if path == "":
         return Result(doc)
-    if _is_multipath(path):
-        return _multipath(doc, path)
+    if path[0] in "{[":
+        end = _mp_prefix_end(path)
+        if end == len(path) - 1:
+            return _multipath(doc, path)
+        if end > 0 and path[end + 1] in ".|":
+            # multipath result piped onward (modifiers, sub-paths):
+            # {a,b}|@values, [a,b].0 …
+            base = _multipath(doc, path[: end + 1])
+            return _resolve(base, _parse_path(path[end + 2:]))
+        return Result.MISSING  # unbalanced multipath
     fast = _FAST_CACHE.get(path)
     if fast is None:
         segs = _parse_path(path)

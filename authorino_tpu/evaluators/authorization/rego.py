@@ -14,7 +14,11 @@ surfaces as a translate error — fail closed):
   - body expressions (newline/``;`` separated, logical AND):
     comparisons ``== != < <= > >=``, assignment ``:=``, unification ``=``
     (simple var binding), negation ``not``, membership ``x in xs``,
+    ``every v in xs { ... }`` / ``every k, v in xs { ... }``,
     existential iteration over ``ref[_]`` / ``ref[i]`` variables
+  - comprehensions: array ``[head | body]``, set ``{head | body}``
+    (yields a deduped list — OPA's JSON serialization of sets), object
+    ``{key: head | body}``
   - references over ``input`` and rule results; array/object indexing
   - built-ins: count, contains, startswith, endswith, lower, upper, split,
     concat, trim, trim_prefix, trim_suffix, replace, sprintf, to_number,
@@ -146,6 +150,30 @@ class BinExpr:
 
 
 @dataclass
+class EveryExpr:
+    """``every v in xs { body }`` / ``every k, v in xs { body }`` (Rego v1):
+    satisfied iff the body is satisfiable for every element of the domain
+    (vacuously true on an empty domain)."""
+
+    key: Optional[str]
+    val: str
+    domain: Any
+    body: List[Any]
+
+
+@dataclass
+class Compr:
+    """Comprehension term: ``[head | body]`` (array), ``{head | body}``
+    (set — yielded as a deduped list, OPA's JSON serialization of sets),
+    ``{key: head | body}`` (object)."""
+
+    kind: str  # "array" | "set" | "object"
+    head: Any
+    key_head: Any = None
+    body: List[Any] = field(default_factory=list)
+
+
+@dataclass
 class NotExpr:
     expr: Any
 
@@ -264,6 +292,10 @@ class _Parser:
 
     def _parse_rule(self) -> Rule:
         t = self.peek()
+        if t.kind == "name" and t.value == "else":
+            # rule-level `else` chains are unsupported; parsing `else` as a
+            # rule named "else" would silently drop the chaining semantics
+            raise RegoError(f"rego: unsupported keyword 'else' at line {t.line}")
         if t.kind == "name" and t.value == "default":
             self.next()
             name = self.expect("name").value
@@ -298,12 +330,12 @@ class _Parser:
             raise RegoError(f"rego parse error at line {t.line}: expected rule body")
         return Rule(name=name, value=value, body=body)
 
-    def _parse_body(self) -> List[Any]:
+    def _parse_body(self, end: str = "}") -> List[Any]:
         exprs: List[Any] = []
         while True:
             self.skip_newlines()
             t = self.peek()
-            if t.kind == "op" and t.value == "}":
+            if t.kind == "op" and t.value == end:
                 return exprs
             if t.kind == "eof":
                 raise RegoError("rego parse error: unexpected EOF in rule body")
@@ -319,6 +351,24 @@ class _Parser:
         if t.kind == "name" and t.value == "not":
             self.next()
             return NotExpr(self._parse_expr())
+        if t.kind == "name" and t.value == "every":
+            self.next()
+            first = self.expect("name").value
+            key = None
+            val = first
+            if self.peek().kind == "op" and self.peek().value == ",":
+                self.next()
+                key = first
+                val = self.expect("name").value
+            nxt = self.expect("name")
+            if nxt.value != "in":
+                raise RegoError(f"rego parse error at line {nxt.line}: expected 'in' after every vars")
+            domain = self._parse_term()
+            self.skip_newlines()
+            self.expect("op", "{")
+            body = self._parse_body()
+            self.expect("op", "}")
+            return EveryExpr(key=key, val=val, domain=domain, body=body)
         if t.kind == "name" and t.value == "some":
             self.next()
             names = [self.expect("name").value]
@@ -335,12 +385,22 @@ class _Parser:
         t = self.peek()
         if t.kind == "name" and t.value == "in":
             self.next()
-            return InExpr(left, self._parse_term())
+            return self._reject_with(InExpr(left, self._parse_term()))
         if t.kind == "op" and t.value in ("==", "!=", "<", "<=", ">", ">=", "=", ":="):
             op = self.next().value
             right = self._parse_term()
-            return BinExpr(op, left, right)
-        return left
+            return self._reject_with(BinExpr(op, left, right))
+        return self._reject_with(left)
+
+    def _reject_with(self, expr: Any) -> Any:
+        """`with` (input/data mocking) is NOT supported — parsing past it
+        would silently change the policy's meaning (the trailing tokens
+        become separate body expressions).  Fail closed at compile on EVERY
+        expression exit, not just bare terms."""
+        t = self.peek()
+        if t.kind == "name" and t.value == "with":
+            raise RegoError(f"rego: unsupported keyword 'with' at line {t.line}")
+        return expr
 
     def _parse_term(self) -> Any:
         t = self.peek()
@@ -353,10 +413,18 @@ class _Parser:
         if t.kind == "op" and t.value == "[":
             self.next()
             items = []
+            first = True
             while not (self.peek().kind == "op" and self.peek().value == "]"):
                 self.skip_newlines()
                 items.append(self._parse_term())
                 self.skip_newlines()
+                if first and self.peek().kind == "op" and self.peek().value == "|":
+                    # array comprehension: [head | body]
+                    self.next()
+                    body = self._parse_body(end="]")
+                    self.expect("op", "]")
+                    return Compr("array", items[0], body=body)
+                first = False
                 if self.peek().kind == "op" and self.peek().value == ",":
                     self.next()
             self.expect("op", "]")
@@ -364,12 +432,28 @@ class _Parser:
         if t.kind == "op" and t.value == "{":
             self.next()
             items: List[Tuple[Any, Any]] = []
+            first = True
             while not (self.peek().kind == "op" and self.peek().value == "}"):
                 self.skip_newlines()
                 key = self._parse_term()
+                self.skip_newlines()
+                if first and self.peek().kind == "op" and self.peek().value == "|":
+                    # set comprehension: {head | body}
+                    self.next()
+                    body = self._parse_body()
+                    self.expect("op", "}")
+                    return Compr("set", key, body=body)
                 self.expect("op", ":")
                 val = self._parse_term()
+                self.skip_newlines()
+                if first and self.peek().kind == "op" and self.peek().value == "|":
+                    # object comprehension: {key: head | body}
+                    self.next()
+                    body = self._parse_body()
+                    self.expect("op", "}")
+                    return Compr("object", val, key_head=key, body=body)
                 items.append((key, val))
+                first = False
                 self.skip_newlines()
                 if self.peek().kind == "op" and self.peek().value == ",":
                     self.next()
@@ -625,6 +709,27 @@ class _Evaluator:
                         yield bindings
                         return
             return
+        if isinstance(expr, EveryExpr):
+            for hay in self._term_values(expr.domain, bindings):
+                if isinstance(hay, list):
+                    pairs = list(enumerate(hay))
+                elif isinstance(hay, dict):
+                    pairs = list(hay.items())
+                else:
+                    continue  # non-collection domain: undefined
+                ok = True
+                for k, v in pairs:
+                    nb = dict(bindings)
+                    if expr.key is not None:
+                        nb[expr.key] = k
+                    nb[expr.val] = v
+                    if next(self._eval_body(expr.body, nb), None) is None:
+                        ok = False
+                        break
+                if ok:  # incl. the vacuous empty-domain case
+                    yield bindings
+                    return
+            return
         if isinstance(expr, InExpr):
             for hay in self._term_values(expr.haystack, bindings):
                 items = hay if isinstance(hay, list) else (
@@ -692,6 +797,45 @@ class _Evaluator:
                 )
                 for k, v in term.items
             }
+        elif isinstance(term, Compr):
+            if term.kind == "object":
+                obj: Dict[Any, Any] = {}
+                for b in self._eval_body(term.body, dict(bindings)):
+                    k = next(self._term_values(term.key_head, b), _UNDEFINED)
+                    v = next(self._term_values(term.head, b), _UNDEFINED)
+                    if k is not _UNDEFINED and v is not _UNDEFINED:
+                        if k in obj and obj[k] != v:
+                            # OPA: conflicting keys are an eval error →
+                            # deny; last-write-wins would fail OPEN
+                            raise RegoError(
+                                f"object comprehension: conflicting values for key {k!r}"
+                            )
+                        obj[k] = v
+                yield obj
+            else:
+                out: List[Any] = []
+                seen: set = set()
+                for b in self._eval_body(term.body, dict(bindings)):
+                    v = next(self._term_values(term.head, b), _UNDEFINED)
+                    if v is _UNDEFINED:
+                        continue
+                    if term.kind == "set":
+                        # type-tagged dedup: bools must not conflate with
+                        # numbers (Python 1 == True; OPA sets keep both),
+                        # but 1 and 1.0 are the same JSON number
+                        if isinstance(v, bool):
+                            key = ("b", v)
+                        elif isinstance(v, (int, float)):
+                            key = ("n", float(v))
+                        elif isinstance(v, str):
+                            key = ("s", v)
+                        else:
+                            key = ("j", json.dumps(v, sort_keys=True, default=str))
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                    out.append(v)
+                yield out
         elif isinstance(term, CallExpr):
             arg_vals = [next(self._term_values(a, bindings), _UNDEFINED) for a in term.args]
             if _UNDEFINED in arg_vals:

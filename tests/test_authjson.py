@@ -109,6 +109,16 @@ class TestMultipaths:
         r = get(DOC, '{"who":{auth.identity.username},"hdr":[request.http.headers.x-tag]}')
         assert r.py() == {"who": {"username": "john"}, "hdr": ["One Two Three"]}
 
+    def test_multipath_member_with_modifier_arg(self):
+        # a ':' inside a modifier argument must NOT read as a member key
+        r = get(DOC, "{auth.identity.username|@case:upper}")
+        assert r.py() == {"username": "JOHN"}
+
+    def test_multipath_piped_into_modifier(self):
+        r = get(DOC, "{auth.identity.username,request.http.path}|@values")
+        assert sorted(r.py()) == ["/hello", "john"]
+        assert get(DOC, "[auth.identity.username,request.http.path].1").py() == "/hello"
+
     def test_object_multipath_shadowed_by_templates_in_jsonvalue(self):
         # parity nuance shared with the reference: JSONValue treats any
         # {...} as a template placeholder (ref pkg/json/json.go:59

@@ -120,7 +120,7 @@ class TestTranslate:
                 translate_auth_config(
                     "x",
                     "ns",
-                    {"hosts": ["h"], "authorization": {"z": {"opa": {"rego": "allow { every x in y { x } }"}}}},
+                    {"hosts": ["h"], "authorization": {"z": {"opa": {"rego": "allow { x := 1 + 2 }"}}}},
                 )
             )
 
@@ -213,7 +213,7 @@ class TestReconciler:
         async def body():
             engine = PolicyEngine()
             rec = AuthConfigReconciler(engine)
-            bad = resource(spec={"hosts": ["h.example.com"], "authorization": {"z": {"opa": {"rego": "allow { every x in y { x } }"}}}})
+            bad = resource(spec={"hosts": ["h.example.com"], "authorization": {"z": {"opa": {"rego": "allow { x := 1 + 2 }"}}}})
             await rec.reconcile_all([bad])
             assert rec.status.get("tenant/ac").reason == STATUS_CACHING_ERROR
             assert not rec.ready()

@@ -390,8 +390,15 @@ class TestRego:
         assert not m.evaluate({"tiers": ["silver"], "banned": False})["allow"]
 
     def test_unsupported_syntax_rejected(self):
+        # arithmetic, `with` mocking, and rule-level `else` chains are all
+        # outside the subset — and must fail CLOSED at compile, never be
+        # silently misparsed into a policy that means something else
         with pytest.raises(RegoError):
-            compile_module("allow { every x in input.xs { x > 1 } }")
+            compile_module("allow { x := 1 + 2 }")
+        with pytest.raises(RegoError):
+            compile_module("allow { input.x with input as {} }")
+        with pytest.raises(RegoError):
+            compile_module('allow { input.x }\nelse = true { input.y }')
 
 
 class TestRegoBuiltinsExtra:
@@ -426,6 +433,63 @@ class TestRegoBuiltinsExtra:
         with pytest.raises(rego.RegoError, match="negative offset"):
             self._eval(src, {"s": "abcdef"})
 
+    def test_every(self):
+        src = 'allow { every r in input.roles { startswith(r, "team-") } }'
+        assert self._eval(src, {"roles": ["team-a", "team-b"]}) is True
+        assert self._eval(src, {"roles": ["team-a", "other"]}) is False
+        assert self._eval(src, {"roles": []}) is True  # vacuous
+
+    def test_every_key_value(self):
+        src = 'allow { every k, v in input.limits { v <= 10 ; k != "forbidden" } }'
+        assert self._eval(src, {"limits": {"a": 5, "b": 10}}) is True
+        assert self._eval(src, {"limits": {"a": 11}}) is False
+        assert self._eval(src, {"limits": {"forbidden": 1}}) is False
+
+    def test_array_comprehension(self):
+        src = ('names := [u.name | some u in input.users ; u.admin]\n'
+               'allow { count(names) == 2 ; names[0] == "a" }')
+        assert self._eval(src, {"users": [
+            {"name": "a", "admin": True}, {"name": "b", "admin": False},
+            {"name": "c", "admin": True}]}) is True
+
+    def test_set_and_object_comprehensions(self):
+        src = ('tiers := {u.tier | some u in input.users}\n'
+               'by_name := {u.name: u.tier | some u in input.users}\n'
+               'allow { count(tiers) == 2 ; by_name.a == "gold" }')
+        assert self._eval(src, {"users": [
+            {"name": "a", "tier": "gold"}, {"name": "b", "tier": "free"},
+            {"name": "c", "tier": "gold"}]}) is True
+
+    def test_with_rejected_after_comparison_and_assignment(self):
+        from authorino_tpu.evaluators.authorization import rego
+
+        for src in [
+            "allow { input.x == 1 with input as {} }",
+            "allow { x := input.y with input as {} }",
+            "allow { input.x with input as {} }",
+        ]:
+            with pytest.raises(rego.RegoError, match="with"):
+                rego.compile_module("default allow = false\n" + src)
+
+    def test_object_comprehension_key_conflict_denies(self):
+        from authorino_tpu.evaluators.authorization import rego
+
+        src = ('by := {u.name: u.role | some u in input.users}\n'
+               'allow { by.alice == "admin" }')
+        with pytest.raises(rego.RegoError, match="conflicting"):
+            self._eval(src, {"users": [
+                {"name": "alice", "role": "viewer"},
+                {"name": "alice", "role": "admin"}]})
+        # duplicate key with the SAME value is fine (like OPA)
+        assert self._eval(src, {"users": [
+            {"name": "alice", "role": "admin"},
+            {"name": "alice", "role": "admin"}]}) is True
+
+    def test_set_comprehension_bool_number_distinct(self):
+        src = 's := {x | some x in input.xs}\nallow { count(s) == 2 }'
+        assert self._eval(src, {"xs": [1, True]}) is True  # OPA: 2 elements
+        assert self._eval(src, {"xs": [1, 1.0]}) is False  # numbers equal
+
     def test_regex_match_linear_time_on_catastrophic_pattern(self):
         # ^(a+)+$ explodes under backtracking engines; the DFA lane must
         # answer in linear time like OPA's RE2
@@ -458,7 +522,7 @@ class TestOPAEvaluator:
 
     def test_invalid_rego_rejected_at_compile(self):
         with pytest.raises(ValueError, match="invalid rego"):
-            OPA("policy", inline_rego="allow { every x in input { x } }")
+            OPA("policy", inline_rego="allow { x := 1 + 2 }")
 
 
 class TestWristband:
