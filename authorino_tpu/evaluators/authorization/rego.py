@@ -10,7 +10,10 @@ surfaces as a translate error — fail closed):
   - ``package``/``import`` headers (imports of ``input`` aliases only)
   - ``default <name> = <term>``
   - rules: ``name { body }``, ``name = term { body }``, ``name := term``,
-    ``name if { body }`` (v1 sugar), multiple definitions (logical OR)
+    ``name if { body }`` (v1 sugar), multiple definitions (logical OR),
+    partial set rules ``name contains term { body }`` (v1) and
+    ``name[term] { body }`` (v0) — the rule document is the set of head
+    values over all satisfying bindings (OPA sets serialize as arrays)
   - body expressions (newline/``;`` separated, logical AND):
     comparisons ``== != < <= > >=``, assignment ``:=``, unification ``=``
     (simple var binding), negation ``not``, membership ``x in xs``,
@@ -195,6 +198,10 @@ class Rule:
     value: Any          # term producing the rule value (Const(True) default)
     body: List[Any]     # expressions (AND)
     is_default: bool = False
+    # partial set rule (`name contains term { body }` / `name[term] { body }`):
+    # the rule document is the set of head values over ALL satisfying
+    # bindings of ALL definitions (OPA sets serialize as arrays)
+    is_set: bool = False
 
 
 @dataclass
@@ -278,7 +285,13 @@ class _Parser:
             if rule.is_default:
                 defaults[rule.name] = rule.value
             else:
-                rules.setdefault(rule.name, []).append(rule)
+                defs = rules.setdefault(rule.name, [])
+                if defs and defs[0].is_set != rule.is_set:
+                    raise RegoError(
+                        f"rego: conflicting rule types for {rule.name!r} "
+                        "(complete vs partial set)"
+                    )
+                defs.append(rule)
         return RegoModule(package=package, rules=rules, defaults=defaults)
 
     def _parse_dotted_name(self) -> str:
@@ -308,27 +321,49 @@ class _Parser:
         name = self.expect("name").value
         value: Any = Const(True)
         body: List[Any] = []
+        is_set = False
 
         t = self.peek()
+        # partial set rules: `name contains term { body }` (v1) and
+        # `name[term] { body }` (v0); a bodyless `name[term]` is always-member
+        if t.kind == "name" and t.value == "contains":
+            self.next()
+            value = self._parse_term()
+            is_set = True
+            t = self.peek()
+        elif t.kind == "op" and t.value == "[":
+            self.next()
+            value = self._parse_term()
+            self.expect("op", "]")
+            is_set = True
+            t = self.peek()
         # name = term / name := term
-        if t.kind == "op" and t.value in ("=", ":="):
+        if not is_set and t.kind == "op" and t.value in ("=", ":="):
             self.next()
             value = self._parse_term()
             t = self.peek()
-        # optional `if` (v1)
+        # optional `if` (v1): followed by a block body or a single
+        # brace-less expression (`allow if input.x == 1`)
+        has_if = False
         if t.kind == "name" and t.value == "if":
             self.next()
+            has_if = True
             t = self.peek()
         if t.kind == "op" and t.value == "{":
             self.next()
             body = self._parse_body()
             self.expect("op", "}")
-        elif not body and isinstance(value, Const) and value.value is True and not (
+        elif has_if:
+            # brace-less `if expr` — dropping it would make the rule
+            # unconditional (fail open) and reparse the condition as a
+            # phantom rule
+            body = [self._parse_expr()]
+        elif not body and not is_set and isinstance(value, Const) and value.value is True and not (
             t.kind in ("newline", "eof")
         ):
             # bare `name expr`? not supported
             raise RegoError(f"rego parse error at line {t.line}: expected rule body")
-        return Rule(name=name, value=value, body=body)
+        return Rule(name=name, value=value, body=body, is_set=is_set)
 
     def _parse_body(self, end: str = "}") -> List[Any]:
         exprs: List[Any] = []
@@ -523,6 +558,19 @@ class _Parser:
 # Evaluator
 # ---------------------------------------------------------------------------
 
+def _set_key(v: Any) -> Tuple:
+    """Type-tagged dedup key for set semantics: bools must not conflate
+    with numbers (Python 1 == True; OPA sets keep both), but 1 and 1.0 are
+    the same JSON number."""
+    if isinstance(v, bool):
+        return ("b", v)
+    if isinstance(v, (int, float)):
+        return ("n", float(v))
+    if isinstance(v, str):
+        return ("s", v)
+    return ("j", json.dumps(v, sort_keys=True, default=str))
+
+
 _REGEX_CACHE: Dict[str, Any] = {}
 
 
@@ -653,7 +701,27 @@ class _Evaluator:
         self._in_progress.add(name)
         try:
             result = _UNDEFINED
-            for rule in self.module.rules.get(name, []):
+            defs = self.module.rules.get(name, [])
+            if defs and defs[0].is_set:
+                # partial set rule: union of head values over every
+                # satisfying binding of every definition (empty set when
+                # nothing matches — defined, like OPA)
+                out: List[Any] = []
+                seen: set = set()
+                for rule in defs:
+                    for bindings in self._eval_body(rule.body, {}):
+                        # the head may itself iterate (banned[x[_]]): every
+                        # value of every binding joins the set
+                        for v in self._term_values(rule.value, bindings):
+                            if v is _UNDEFINED:
+                                continue
+                            key = _set_key(v)
+                            if key not in seen:
+                                seen.add(key)
+                                out.append(v)
+                self._cache[name] = out
+                return out
+            for rule in defs:
                 for bindings in self._eval_body(rule.body, {}):
                     vals = list(self._term_values(rule.value, bindings))
                     if vals:
@@ -820,17 +888,7 @@ class _Evaluator:
                     if v is _UNDEFINED:
                         continue
                     if term.kind == "set":
-                        # type-tagged dedup: bools must not conflate with
-                        # numbers (Python 1 == True; OPA sets keep both),
-                        # but 1 and 1.0 are the same JSON number
-                        if isinstance(v, bool):
-                            key = ("b", v)
-                        elif isinstance(v, (int, float)):
-                            key = ("n", float(v))
-                        elif isinstance(v, str):
-                            key = ("s", v)
-                        else:
-                            key = ("j", json.dumps(v, sort_keys=True, default=str))
+                        key = _set_key(v)
                         if key in seen:
                             continue
                         seen.add(key)

@@ -460,6 +460,41 @@ class TestRegoBuiltinsExtra:
             {"name": "a", "tier": "gold"}, {"name": "b", "tier": "free"},
             {"name": "c", "tier": "gold"}]}) is True
 
+    def test_partial_set_rules(self):
+        # v1 `contains` form — the modern deny-set idiom
+        src = ('violations contains msg { input.x > 5 ; msg := "too big" }\n'
+               'violations contains msg { input.y == "bad" ; msg := "bad y" }\n'
+               'allow { count(violations) == 0 }')
+        assert self._eval(src, {"x": 1, "y": "ok"}) is True
+        assert self._eval(src, {"x": 9, "y": "ok"}) is False
+        assert self._eval(src, {"x": 9, "y": "bad"}) is False
+        # v0 bracket form, multiple bindings dedupe as a set
+        src0 = ('roles[r] { some r in input.rs }\n'
+                'allow { count(roles) == 2 }')
+        assert self._eval(src0, {"rs": ["a", "b", "a"]}) is True
+
+    def test_braceless_if_bodies(self):
+        # v1 brace-less form: the condition must BIND, not silently drop
+        src = ('deny contains "x" if input.flagged\n'
+               'allow if count(deny) == 0')
+        assert self._eval(src, {"flagged": True}) is False
+        assert self._eval(src, {"flagged": False}) is True
+
+    def test_set_rule_iterating_head(self):
+        # every value of an iterating head joins the set, not just the first
+        src = ('banned contains input.blocked[_] { true }\n'
+               'allow { not input.user in banned }')
+        assert self._eval(src, {"blocked": ["a", "b", "c"], "user": "c"}) is False
+        assert self._eval(src, {"blocked": ["a", "b", "c"], "user": "z"}) is True
+
+    def test_partial_set_conflicting_types_rejected(self):
+        from authorino_tpu.evaluators.authorization import rego
+
+        with pytest.raises(rego.RegoError, match="conflicting rule types"):
+            rego.compile_module(
+                'x contains v { v := input.a }\nx { input.b }'
+            )
+
     def test_with_rejected_after_comparison_and_assignment(self):
         from authorino_tpu.evaluators.authorization import rego
 
