@@ -25,9 +25,12 @@ Two service-level modes measure the full stack:
                  buffered snapshot) under a sliding-window load.  One
                  Python process tops out around ~16-20k RPS — the asyncio
                  per-request task machinery (~45µs/request) saturates the
-                 event loop long before the device does, so the deployment
-                 story is N frontend processes sharing the one device
-                 (capacity per the pipelined number).
+                 event loop long before the device does (the pipelined
+                 number is the device+encode capacity).  Scaling past one
+                 process means replicas (each with its own chip, like the
+                 reference's replica scaling) or a native frontend feeding
+                 one device-owner process — TPUs are process-exclusive, so
+                 N Python frontends cannot share one chip directly.
   --mode grpc    full-wire Check() over a local grpc.aio server — adds the
                  Python gRPC tax (~1.2k RPS/process); the reference's Go
                  wire is far cheaper, which is why the C++ frontend remains
