@@ -37,8 +37,8 @@ __all__ = ["EncodedBatch", "encode_batch", "encode_batch_py"]
 
 @dataclass
 class EncodedBatch:
-    attrs_val: np.ndarray      # [B, A] int32
-    attrs_members: np.ndarray  # [B, A, K] int32
+    attrs_val: np.ndarray      # [B, A] wire dtype (int16/int32 — pack.wire_dtype)
+    attrs_members: np.ndarray  # [B, A, K] wire dtype
     overflow: np.ndarray       # [B, A] bool
     cpu_lane: np.ndarray       # [B, L] bool
     config_id: np.ndarray      # [B] int32
@@ -142,8 +142,11 @@ def encode_batch_py(
     K = policy.members_k
     L = policy.n_leaves
 
-    attrs_val = np.full((B, A), EMPTY_ID, dtype=np.int32)
-    attrs_members = np.full((B, A, K), PAD, dtype=np.int32)
+    from .pack import wire_dtype
+
+    dt = wire_dtype(policy)  # int16 when the interner fits (pack.py)
+    attrs_val = np.full((B, A), EMPTY_ID, dtype=dt)
+    attrs_members = np.full((B, A, K), PAD, dtype=dt)
     overflow = np.zeros((B, A), dtype=bool)
     cpu_lane = np.zeros((B, L), dtype=bool)
     config_id = np.zeros((B,), dtype=np.int32)

@@ -30,6 +30,7 @@ from ..compiler.compile import (
 )
 from ..compiler.encode import EncodedBatch, _MISSING, _render
 from ..compiler.intern import EMPTY_ID, PAD
+from ..compiler.pack import wire_dtype
 
 __all__ = ["NativeEncoder", "get_native_encoder"]
 
@@ -198,8 +199,13 @@ class NativeEncoder:
         A, K, L = p.n_attrs, p.members_k, p.n_leaves
         NB = max(p.n_byte_attrs, 1)
 
-        attrs_val = np.full((B, A), EMPTY_ID, dtype=np.int32)
-        attrs_members = np.full((B, A, K), PAD, dtype=np.int32)
+        # wire dtype: ids store as int16 when the interner fits — the C
+        # encoder writes the narrow type directly, so pack_batch never pays
+        # a cast pass over the dominant tensors
+        dt = wire_dtype(p)
+        attrs_val = np.full((B, A), EMPTY_ID, dtype=dt)
+        attrs_members = np.full((B, A, K), PAD, dtype=dt)
+        elem16 = 1 if dt == np.int16 else 0
         overflow = np.zeros((B, A), dtype=bool)
         cpu_lane = np.zeros((B, L), dtype=bool)
         config_id = np.zeros((B,), dtype=np.int32)
@@ -229,13 +235,13 @@ class NativeEncoder:
                 rc = self._mod.encode_json(
                     self._handle, blob, _addr(doc_offs), n, _addr(rows),
                     A, K, L, NB, DFA_VALUE_BYTES, *out_addrs,
-                    max_tasks, _addr(arena), arena_cap, self.n_threads)
+                    max_tasks, _addr(arena), arena_cap, self.n_threads, elem16)
             else:
                 try:
                     rc = self._mod.encode_docs(
                         self._handle, self._seg_objs, docs, _addr(rows), n,
                         A, K, L, NB, DFA_VALUE_BYTES, *out_addrs,
-                        max_tasks, _addr(arena), arena_cap)
+                        max_tasks, _addr(arena), arena_cap, elem16)
                 except Exception:
                     return None  # render error (non-serializable nested value)
             if rc < 0:

@@ -677,16 +677,23 @@ Policy* atpu_policy_new(
 
 void atpu_policy_free(Policy* p) { delete p; }
 
+// id stores go through store_id so the wire buffers can be int16 when the
+// interner fits (compiler/pack.py wire_dtype) — halves the dominant tensors
+static inline void store_id(void* base, int64_t idx, int32_t v, int elem16) {
+  if (elem16) ((int16_t*)base)[idx] = (int16_t)v;
+  else ((int32_t*)base)[idx] = v;
+}
+
 int64_t atpu_encode(
     const Policy* p,
     const char* json_blob, const int64_t* doc_offs, int32_t n_docs,
     const int32_t* config_rows,
     int32_t A, int32_t K, int32_t L, int32_t NB, int32_t DVB,
-    int32_t* attrs_val, int32_t* attrs_members, uint8_t* overflow,
+    void* attrs_val, void* attrs_members, uint8_t* overflow,
     uint8_t* cpu_lane, uint8_t* attr_bytes, uint8_t* byte_ovf,
     int32_t* task_r, int32_t* task_leaf, int64_t* task_val_off, int32_t* task_val_len,
     int32_t max_tasks, char* task_arena, int64_t arena_cap,
-    int32_t n_threads) {
+    int32_t n_threads, int32_t elem16) {
   if (n_threads < 1) n_threads = 1;
   if (n_threads > n_docs) n_threads = n_docs > 0 ? n_docs : 1;
 
@@ -725,7 +732,7 @@ int64_t atpu_encode(
         rendered.clear();
         render(doc, node, rendered);
         int32_t vid = p->interner.lookup(rendered.data(), rendered.size());
-        attrs_val[(int64_t)r * A + attr] = vid;
+        store_id(attrs_val, (int64_t)r * A + attr, vid, elem16);
         int32_t slot = p->attr_byte_slot[attr];
         if (slot >= 0) {
           if ((int64_t)rendered.size() > DVB ||
@@ -746,11 +753,11 @@ int64_t atpu_encode(
             render(doc, c, tmp);
             int32_t eid = p->interner.lookup(tmp.data(), tmp.size());
             elems.push_back(eid);
-            if (k < K) attrs_members[((int64_t)r * A + attr) * K + k] = eid;
+            if (k < K) store_id(attrs_members, ((int64_t)r * A + attr) * K + k, eid, elem16);
           }
           if ((int32_t)elems.size() > K) overflow[(int64_t)r * A + attr] = 1;
         } else if (node >= 0 && n.type != V_NULL) {
-          attrs_members[((int64_t)r * A + attr) * K] = vid;
+          store_id(attrs_members, ((int64_t)r * A + attr) * K, vid, elem16);
           elems.push_back(vid);
         }
       }

@@ -121,24 +121,27 @@ PyObject* walk_py(PyObject* doc, const Policy* p, PyObject* seg_objs, int32_t at
 //             A, K, L, NB, DVB,
 //             attrs_val, attrs_members, overflow, cpu_lane, attr_bytes, byte_ovf,
 //             task_r, task_leaf, task_val_off, task_val_len, max_tasks,
-//             arena_addr, arena_cap)  (all *_addr are numpy .ctypes.data ints)
+//             arena_addr, arena_cap, elem16)
+//             (all *_addr are numpy .ctypes.data ints; elem16: id buffers
+//              are int16 when the interner fits — see pack.wire_dtype)
 PyObject* encode_docs(PyObject*, PyObject* args) {
   PyObject* cap; PyObject* seg_objs; PyObject* docs;
   unsigned long long rows_a, av_a, am_a, ov_a, cl_a, ab_a, bo_a;
   unsigned long long tr_a, tl_a, to_a, tv_a, arena_a;
-  int n_docs, A, K, L, NB, DVB, max_tasks;
+  int n_docs, A, K, L, NB, DVB, max_tasks, elem16;
   long long arena_cap;
   if (!PyArg_ParseTuple(
-          args, "OOOKiiiiiiKKKKKKKKKKiKL",
+          args, "OOOKiiiiiiKKKKKKKKKKiKLi",
           &cap, &seg_objs, &docs, &rows_a, &n_docs, &A, &K, &L, &NB, &DVB,
           &av_a, &am_a, &ov_a, &cl_a, &ab_a, &bo_a,
-          &tr_a, &tl_a, &to_a, &tv_a, &max_tasks, &arena_a, &arena_cap))
+          &tr_a, &tl_a, &to_a, &tv_a, &max_tasks, &arena_a, &arena_cap,
+          &elem16))
     return nullptr;
   Policy* p = (Policy*)PyCapsule_GetPointer(cap, "atpu.Policy");
   if (p == nullptr) return nullptr;
   const int32_t* rows = (const int32_t*)rows_a;
-  int32_t* attrs_val = (int32_t*)av_a;
-  int32_t* attrs_members = (int32_t*)am_a;
+  void* attrs_val = (void*)av_a;
+  void* attrs_members = (void*)am_a;
   uint8_t* overflow = (uint8_t*)ov_a;
   uint8_t* cpu_lane = (uint8_t*)cl_a;
   uint8_t* attr_bytes = (uint8_t*)ab_a;
@@ -162,7 +165,7 @@ PyObject* encode_docs(PyObject*, PyObject* args) {
       rendered.clear();
       if (!render_py(v, rendered)) return nullptr;
       int32_t vid = p->interner.lookup(rendered.data(), rendered.size());
-      attrs_val[(int64_t)r * A + attr] = vid;
+      store_id(attrs_val, (int64_t)r * A + attr, vid, elem16);
       int32_t slot = p->attr_byte_slot[attr];
       if (slot >= 0) {
         if ((int64_t)rendered.size() > DVB ||
@@ -182,11 +185,11 @@ PyObject* encode_docs(PyObject*, PyObject* args) {
           if (!render_py(PyList_GET_ITEM(v, k), tmp)) return nullptr;
           int32_t eid = p->interner.lookup(tmp.data(), tmp.size());
           elems.push_back(eid);
-          if (k < K) attrs_members[((int64_t)r * A + attr) * K + k] = eid;
+          if (k < K) store_id(attrs_members, ((int64_t)r * A + attr) * K + k, eid, elem16);
         }
         if ((int64_t)n > K) overflow[(int64_t)r * A + attr] = 1;
       } else if (v != nullptr && v != Py_None) {
-        attrs_members[((int64_t)r * A + attr) * K] = vid;
+        store_id(attrs_members, ((int64_t)r * A + attr) * K, vid, elem16);
         elems.push_back(vid);
       }
     }
@@ -233,20 +236,20 @@ PyObject* policy_new_py(PyObject*, PyObject* args) {
 
 // encode_json_py(policy_capsule, blob, doc_offs_addr, n_docs, rows_addr,
 //                A, K, L, NB, DVB, <6 out addrs>, <4 task addrs>, max_tasks,
-//                arena_addr, arena_cap, n_threads)
+//                arena_addr, arena_cap, n_threads, elem16)
 // GIL released around the C encode (threaded path for many-core hosts).
 PyObject* encode_json_py(PyObject*, PyObject* args) {
   PyObject* cap; Py_buffer blob;
   unsigned long long do_a, rows_a, av_a, am_a, ov_a, cl_a, ab_a, bo_a;
   unsigned long long tr_a, tl_a, to_a, tv_a, arena_a;
-  int n_docs, A, K, L, NB, DVB, max_tasks, n_threads;
+  int n_docs, A, K, L, NB, DVB, max_tasks, n_threads, elem16;
   long long arena_cap;
   if (!PyArg_ParseTuple(
-          args, "Oy*KiKiiiiiKKKKKKKKKKiKLi",
+          args, "Oy*KiKiiiiiKKKKKKKKKKiKLii",
           &cap, &blob, &do_a, &n_docs, &rows_a, &A, &K, &L, &NB, &DVB,
           &av_a, &am_a, &ov_a, &cl_a, &ab_a, &bo_a,
           &tr_a, &tl_a, &to_a, &tv_a, &max_tasks, &arena_a, &arena_cap,
-          &n_threads))
+          &n_threads, &elem16))
     return nullptr;
   Policy* p = (Policy*)PyCapsule_GetPointer(cap, "atpu.Policy");
   if (p == nullptr) { PyBuffer_Release(&blob); return nullptr; }
@@ -254,11 +257,11 @@ PyObject* encode_json_py(PyObject*, PyObject* args) {
   Py_BEGIN_ALLOW_THREADS
   rc = atpu_encode(p, (const char*)blob.buf, (const int64_t*)do_a, n_docs,
                    (const int32_t*)rows_a, A, K, L, NB, DVB,
-                   (int32_t*)av_a, (int32_t*)am_a, (uint8_t*)ov_a,
+                   (void*)av_a, (void*)am_a, (uint8_t*)ov_a,
                    (uint8_t*)cl_a, (uint8_t*)ab_a, (uint8_t*)bo_a,
                    (int32_t*)tr_a, (int32_t*)tl_a, (int64_t*)to_a,
                    (int32_t*)tv_a, max_tasks, (char*)arena_a, arena_cap,
-                   n_threads);
+                   n_threads, elem16);
   Py_END_ALLOW_THREADS
   PyBuffer_Release(&blob);
   return PyLong_FromLongLong(rc);
