@@ -18,7 +18,9 @@ surfaces as a translate error — fail closed):
     comparisons ``== != < <= > >=``, assignment ``:=``, unification ``=``
     (simple var binding), negation ``not``, membership ``x in xs``,
     ``every v in xs { ... }`` / ``every k, v in xs { ... }``,
-    existential iteration over ``ref[_]`` / ``ref[i]`` variables
+    existential iteration over ``ref[_]`` / ``ref[i]`` variables,
+    numeric arithmetic ``+ - * / %`` with parentheses and unary minus
+    (numbers only; modulo on integers — OPA operator semantics)
   - comprehensions: array ``[head | body]``, set ``{head | body}``
     (yields a deduped list — OPA's JSON serialization of sets), object
     ``{key: head | body}``
@@ -59,8 +61,8 @@ _TOKEN_RE = re.compile(
   | (?P<newline>\n)
   | (?P<string>"(?:[^"\\]|\\.)*")
   | (?P<rawstring>`[^`]*`)
-  | (?P<number>-?\d+(?:\.\d+)?)
-  | (?P<op>:=|==|!=|<=|>=|\[|\]|\{|\}|\(|\)|,|;|:|\.|<|>|=|\|)
+  | (?P<number>\d+(?:\.\d+)?)
+  | (?P<op>:=|==|!=|<=|>=|\[|\]|\{|\}|\(|\)|,|;|:|\.|<|>|=|\||\+|-|\*|/|%)
   | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
 """,
     re.X,
@@ -174,6 +176,17 @@ class Compr:
     head: Any
     key_head: Any = None
     body: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class ArithExpr:
+    """Numeric arithmetic: + - * / %  (numbers only, like OPA's operators;
+    string concat is the `concat` builtin).  `right is None` encodes unary
+    minus."""
+
+    op: str
+    left: Any
+    right: Any = None
 
 
 @dataclass
@@ -438,6 +451,45 @@ class _Parser:
         return expr
 
     def _parse_term(self) -> Any:
+        # precedence: additive > multiplicative > unary > primary.
+        # Arithmetic is numbers-only (OPA semantics); string concat is the
+        # `concat` builtin.
+        left = self._parse_mul()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("+", "-"):
+                op = self.next().value
+                left = ArithExpr(op, left, self._parse_mul())
+            else:
+                return left
+
+    def _parse_mul(self) -> Any:
+        left = self._parse_unary()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "/", "%"):
+                op = self.next().value
+                left = ArithExpr(op, left, self._parse_unary())
+            else:
+                return left
+
+    def _parse_unary(self) -> Any:
+        t = self.peek()
+        if t.kind == "op" and t.value == "-":
+            self.next()
+            operand = self._parse_unary()
+            if isinstance(operand, Const) and isinstance(operand.value, (int, float)) \
+                    and not isinstance(operand.value, bool):
+                return Const(-operand.value)  # fold literals: default x = -1
+            return ArithExpr("-", operand, None)
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            inner = self._parse_term()
+            self.expect("op", ")")
+            return inner
+        return self._parse_primary()
+
+    def _parse_primary(self) -> Any:
         t = self.peek()
         if t.kind == "string":
             self.next()
@@ -865,6 +917,39 @@ class _Evaluator:
                 )
                 for k, v in term.items
             }
+        elif isinstance(term, ArithExpr):
+            def check_num(v):
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    raise RegoError(f"arithmetic on non-number: {v!r}")
+
+            op = term.op
+            # iterate ALL operand values — ref[_] existential semantics
+            # flow through arithmetic exactly like through comparisons
+            for a in self._term_values(term.left, bindings):
+                check_num(a)
+                if term.right is None:  # unary minus
+                    yield -a
+                    continue
+                for b in self._term_values(term.right, bindings):
+                    check_num(b)
+                    try:
+                        if op == "+":
+                            yield a + b
+                        elif op == "-":
+                            yield a - b
+                        elif op == "*":
+                            yield a * b
+                        elif op == "/":
+                            yield a / b  # OPA: number division (3/2 == 1.5)
+                        else:  # %
+                            if isinstance(a, float) or isinstance(b, float):
+                                raise RegoError("modulo on non-integer")
+                            # Go big.Int.Rem (truncated): sign of the
+                            # DIVIDEND — Python % floors toward the divisor
+                            r = abs(a) % abs(b)
+                            yield r if a >= 0 else -r
+                    except ZeroDivisionError:
+                        raise RegoError("divide by zero")
         elif isinstance(term, Compr):
             if term.kind == "object":
                 obj: Dict[Any, Any] = {}

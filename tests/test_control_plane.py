@@ -120,7 +120,7 @@ class TestTranslate:
                 translate_auth_config(
                     "x",
                     "ns",
-                    {"hosts": ["h"], "authorization": {"z": {"opa": {"rego": "allow { x := 1 + 2 }"}}}},
+                    {"hosts": ["h"], "authorization": {"z": {"opa": {"rego": "f(x) = 1 { true }"}}}},
                 )
             )
 
@@ -213,7 +213,7 @@ class TestReconciler:
         async def body():
             engine = PolicyEngine()
             rec = AuthConfigReconciler(engine)
-            bad = resource(spec={"hosts": ["h.example.com"], "authorization": {"z": {"opa": {"rego": "allow { x := 1 + 2 }"}}}})
+            bad = resource(spec={"hosts": ["h.example.com"], "authorization": {"z": {"opa": {"rego": "f(x) = 1 { true }"}}}})
             await rec.reconcile_all([bad])
             assert rec.status.get("tenant/ac").reason == STATUS_CACHING_ERROR
             assert not rec.ready()

@@ -390,11 +390,11 @@ class TestRego:
         assert not m.evaluate({"tiers": ["silver"], "banned": False})["allow"]
 
     def test_unsupported_syntax_rejected(self):
-        # arithmetic, `with` mocking, and rule-level `else` chains are all
-        # outside the subset — and must fail CLOSED at compile, never be
-        # silently misparsed into a policy that means something else
+        # user functions, `with` mocking, and rule-level `else` chains are
+        # all outside the subset — and must fail CLOSED at compile, never
+        # be silently misparsed into a policy that means something else
         with pytest.raises(RegoError):
-            compile_module("allow { x := 1 + 2 }")
+            compile_module("f(x) = 1 { true }")
         with pytest.raises(RegoError):
             compile_module("allow { input.x with input as {} }")
         with pytest.raises(RegoError):
@@ -472,6 +472,34 @@ class TestRegoBuiltinsExtra:
         src0 = ('roles[r] { some r in input.rs }\n'
                 'allow { count(roles) == 2 }')
         assert self._eval(src0, {"rs": ["a", "b", "a"]}) is True
+
+    def test_arithmetic(self):
+        src = ('allow { count(input.roles) + 1 > 2 ; input.n * 2 <= 10 ; '
+               'input.n % 2 == 1 ; (input.n + 1) / 2 == 3 ; -input.n == 0 - 5 }')
+        assert self._eval(src, {"roles": ["a", "b"], "n": 5}) is True
+        assert self._eval(src, {"roles": [], "n": 5}) is False
+
+    def test_arithmetic_iterates_refs(self):
+        # existential ref[_] semantics flow THROUGH arithmetic: any element
+        # satisfying the expression satisfies the rule (OPA behavior)
+        src = "deny { input.scores[_] - input.threshold > 0 }\nallow { not deny }"
+        assert self._eval(src, {"scores": [1, 100], "threshold": 50}) is False
+        assert self._eval(src, {"scores": [1, 2], "threshold": 50}) is True
+
+    def test_modulo_truncated_like_go(self):
+        # Go big.Int.Rem: sign of the dividend (-7 rem 2 == -1, not 1)
+        src = "allow { input.n % 2 == 1 }"
+        assert self._eval(src, {"n": 7}) is True
+        assert self._eval(src, {"n": -7}) is False
+        assert self._eval("allow { input.n % 2 == 0 - 1 }", {"n": -7}) is True
+
+    def test_arithmetic_errors_deny(self):
+        from authorino_tpu.evaluators.authorization import rego
+
+        with pytest.raises(rego.RegoError, match="divide by zero"):
+            self._eval("allow { input.a / input.b == 1 }", {"a": 1, "b": 0})
+        with pytest.raises(rego.RegoError, match="non-number"):
+            self._eval('allow { input.s + 1 == 2 }', {"s": "x"})
 
     def test_braceless_if_bodies(self):
         # v1 brace-less form: the condition must BIND, not silently drop
@@ -557,7 +585,7 @@ class TestOPAEvaluator:
 
     def test_invalid_rego_rejected_at_compile(self):
         with pytest.raises(ValueError, match="invalid rego"):
-            OPA("policy", inline_rego="allow { x := 1 + 2 }")
+            OPA("policy", inline_rego="f(x) = 1 { true }")
 
 
 class TestWristband:
