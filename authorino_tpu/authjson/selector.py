@@ -16,8 +16,10 @@ tests):
   - ``#`` yields array length when final, else maps over elements
   - ``#(field==value)`` queries (first match), ``#(...)#`` (all matches),
     with operators ``== != < <= > >= % !%``
-  - ``|`` pipe behaves like ``.`` (gjson's array-vs-pipe nuance is out of
-    scope; documented limitation)
+  - ``|`` pipe: identical to ``.`` on plain paths; after a ``#`` mapping a
+    piped segment applies to the COLLECTED array instead of mapping per
+    element (``a.#.b|0`` → first of the mapped values — gjson's
+    array-vs-pipe distinction)
   - multipaths ``{a.b,"name":c}`` (object) and ``[a.b,c]`` (array)
     composition; missing members are omitted
   - modifiers ``@name`` / ``@name:arg`` — reference's custom set
@@ -134,6 +136,10 @@ class _Seg:
     # modifier parts
     mod_name: str = ""
     mod_arg: str = ""
+    # leading separator was '|': after a `#` mapping, a piped segment
+    # applies to the COLLECTED array instead of mapping per element
+    # (gjson's array-vs-pipe distinction)
+    piped: bool = False
 
 
 _PATH_CACHE: Dict[str, Tuple[_Seg, ...]] = {}
@@ -177,22 +183,26 @@ def _parse_path(path: str) -> Tuple[_Seg, ...]:
     if cached is not None:
         return cached
     segs: List[_Seg] = []
-    for raw_seg in _split_segments(path):
+    parts, seps = _depth0_split(path, ".|", opens="(", closes=")", with_delims=True)
+    for raw_seg, sep in zip(parts, seps):
         if raw_seg == "":
             continue
+        piped = sep == "|"
         if raw_seg.startswith("@"):
             name, _, arg = raw_seg[1:].partition(":")
-            segs.append(_Seg(kind="mod", mod_name=name, mod_arg=arg))
+            segs.append(_Seg(kind="mod", mod_name=name, mod_arg=arg, piped=piped))
         elif raw_seg == "#":
-            segs.append(_Seg(kind="hash"))
+            segs.append(_Seg(kind="hash", piped=piped))
         elif raw_seg.startswith("#("):
             m = _QUERY_RE.match(raw_seg)
             if m:
-                segs.append(_parse_query(m.group(1), m.group(2) == "#"))
+                q = _parse_query(m.group(1), m.group(2) == "#")
+                q.piped = piped
+                segs.append(q)
             else:
-                segs.append(_Seg(kind="key", key=raw_seg))
+                segs.append(_Seg(kind="key", key=raw_seg, piped=piped))
         else:
-            segs.append(_Seg(kind="key", key=raw_seg.replace("\\.", ".").replace("\\\\", "\\")))
+            segs.append(_Seg(kind="key", key=raw_seg.replace("\\.", ".").replace("\\\\", "\\"), piped=piped))
     out = tuple(segs)
     if len(_PATH_CACHE) < 65536:
         _PATH_CACHE[path] = out
@@ -367,7 +377,10 @@ def _apply_modifier(res: Result, seg: _Seg) -> Result:
 def _fan_out(elems: List[Any], rest: Tuple[_Seg, ...]) -> Result:
     """Map the remaining path over array elements (used by `#` and `#(...)#`);
     modifiers in the tail apply to the collected array, not per element."""
-    cut = next((j for j, s in enumerate(rest) if s.kind == "mod"), len(rest))
+    cut = next(
+        (j for j, s in enumerate(rest) if s.kind == "mod" or s.piped),
+        len(rest),
+    )
     inner, tail = rest[:cut], rest[cut:]
     collected = []
     for e in elems:
@@ -452,11 +465,13 @@ _FAST_CACHE: Dict[str, Any] = {}
 
 
 def _depth0_split(text: str, delims: str, opens: str = "{[(",
-                  closes: str = "}])") -> List[str]:
+                  closes: str = "}])", with_delims: bool = False):
     """Split ``text`` on depth-0 delimiter characters, respecting
     backslash escapes, double quotes, and bracket nesting — the one scanner
-    shared by segment and multipath splitting."""
+    shared by segment and multipath splitting.  ``with_delims=True`` also
+    returns the delimiter character preceding each part (parts[0] → '')."""
     parts: List[str] = []
+    seps: List[str] = [""]
     buf: List[str] = []
     depth = 0
     in_quote = False
@@ -477,12 +492,13 @@ def _depth0_split(text: str, delims: str, opens: str = "{[(",
                 depth -= 1
         if c in delims and depth == 0 and not in_quote:
             parts.append("".join(buf))
+            seps.append(c)
             buf = []
         else:
             buf.append(c)
         i += 1
     parts.append("".join(buf))
-    return parts
+    return (parts, seps) if with_delims else parts
 
 
 def _split_multipath(body: str) -> List[str]:

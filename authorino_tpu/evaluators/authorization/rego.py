@@ -241,6 +241,46 @@ class RegoModule:
 _UNDEFINED = object()
 
 
+def _fold_const(term) -> Any:
+    """Constant-fold arithmetic over literals (``default x = 60 * 60``);
+    anything non-constant folds to itself."""
+    if isinstance(term, ArithExpr):
+        left = _fold_const(term.left)
+        if not (isinstance(left, Const) and isinstance(left.value, (int, float))
+                and not isinstance(left.value, bool)):
+            return term
+        if term.right is None:
+            return Const(-left.value)
+        right = _fold_const(term.right)
+        if not (isinstance(right, Const) and isinstance(right.value, (int, float))
+                and not isinstance(right.value, bool)):
+            return term
+        a, b = left.value, right.value
+        try:
+            if term.op == "+":
+                return Const(a + b)
+            if term.op == "-":
+                return Const(a - b)
+            if term.op == "*":
+                return Const(a * b)
+            if term.op == "/":
+                return Const(_exact_div(a, b))
+            r = abs(a) % abs(b)
+            return Const(r if a >= 0 else -r)
+        except ZeroDivisionError:
+            raise RegoError("divide by zero in constant expression")
+    return term
+
+
+def _exact_div(a, b):
+    """OPA number division: 3/2 == 1.5 but 4/2 == 2 (exact quotients stay
+    integers in the serialized JSON)."""
+    r = a / b
+    if isinstance(r, float) and r.is_integer() and abs(r) < 2**53:
+        return int(r)
+    return r
+
+
 def _const_value(term) -> Any:
     if isinstance(term, Const):
         return term.value
@@ -328,7 +368,13 @@ class _Parser:
             op = self.next()
             if not (op.kind == "op" and op.value in ("=", ":=")):
                 raise RegoError(f"rego parse error at line {op.line}: expected = after default")
-            value = self._parse_term()
+            value = _fold_const(self._parse_term())
+            if not isinstance(value, Const):
+                # fail closed at COMPILE: a non-constant default would
+                # otherwise reconcile Ready and error on every request
+                raise RegoError(
+                    f"rego parse error at line {op.line}: default value must be a constant"
+                )
             return Rule(name=name, value=value, body=[], is_default=True)
 
         name = self.expect("name").value
@@ -940,7 +986,8 @@ class _Evaluator:
                         elif op == "*":
                             yield a * b
                         elif op == "/":
-                            yield a / b  # OPA: number division (3/2 == 1.5)
+                            # OPA number division: 3/2 == 1.5, 4/2 == 2
+                            yield _exact_div(a, b)
                         else:  # %
                             if isinstance(a, float) or isinstance(b, float):
                                 raise RegoError("modulo on non-integer")

@@ -66,6 +66,17 @@ class TestSelector:
         assert get(DOC, "auth.identity.roles.#").py() == 2
         assert not get(DOC, "auth.identity.roles.5").exists
 
+    def test_pipe_after_hash_applies_to_collected_array(self):
+        # gjson array-vs-pipe: a.#.b|0 indexes the mapped ARRAY; a.#.b.0
+        # keeps mapping per element (strings aren't indexable → omitted)
+        doc = {"friends": [{"first": "Dale"}, {"first": "Roger"}, {"first": "Jane"}]}
+        assert get(doc, "friends.#.first|0").py() == "Dale"
+        assert get(doc, "friends.#.first|#").py() == 3
+        assert get(doc, "friends.#.first.0").py() == []
+        # plain paths: | and . identical
+        assert get(doc, "friends|0|first").py() == "Dale"
+        assert get(doc, "friends.0.first").py() == "Dale"
+
     def test_hash_mapping(self):
         assert get(DOC, "auth.metadata.resources.#.uri").py() == ["/a", "/b", "/c"]
 

@@ -486,6 +486,23 @@ class TestRegoBuiltinsExtra:
         assert self._eval(src, {"scores": [1, 100], "threshold": 50}) is False
         assert self._eval(src, {"scores": [1, 2], "threshold": 50}) is True
 
+    def test_default_constant_folding_and_rejection(self):
+        from authorino_tpu.evaluators.authorization import rego
+
+        m = rego.compile_module("default limit = 60 * 60\nallow { input.x }", package="t")
+        assert m.evaluate({"x": True}) == {"limit": 3600, "allow": True}
+        with pytest.raises(rego.RegoError, match="must be a constant"):
+            rego.compile_module("default limit = input.x + 1")
+
+    def test_exact_integer_division(self):
+        src = "x := input.a / input.b\nallow { x == 2 }"
+        from authorino_tpu.evaluators.authorization import rego
+
+        m = rego.compile_module("default allow = false\n" + src, package="t")
+        out = m.evaluate({"a": 4, "b": 2})
+        assert out["x"] == 2 and not isinstance(out["x"], float)  # JSON "2", not "2.0"
+        assert rego.compile_module("y := 3 / 2", package="t").evaluate({})["y"] == 1.5
+
     def test_modulo_truncated_like_go(self):
         # Go big.Int.Rem: sign of the dividend (-7 rem 2 == -1, not 1)
         src = "allow { input.n % 2 == 1 }"
