@@ -36,7 +36,75 @@ import re
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-__all__ = ["Result", "get", "get_path", "num_str", "to_raw_json", "parse_raw"]
+__all__ = [
+    "Result", "get", "get_path", "num_str", "to_raw_json", "parse_raw",
+    "WALK_MISS", "compile_walk", "render_value",
+]
+
+WALK_MISS = object()  # compile_walk's missing-value sentinel
+
+
+def render_value(v: Any) -> str:
+    """gjson Result.String() of a resolved value (WALK_MISS → missing).
+    The single source of the rendering rules — Result.string() and the
+    compiled pattern closures (expressions/ast.py) both delegate here."""
+    if v is WALK_MISS or v is None:
+        return ""
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    t = type(v)  # exact-type ladder first: the hot shapes, no MRO walk
+    if t is str:
+        return v
+    if t is int or t is float:
+        return num_str(v)
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return num_str(v)
+    return to_raw_json(v)
+
+
+def compile_walk(path: str) -> Optional[Callable[[Any], Any]]:
+    """A doc→value walker for plain dot-paths (the overwhelmingly common
+    selector shape), or None when the path needs the full gjson engine
+    (multipaths, ``#`` maps, queries, modifiers).  get()'s fast lane and the
+    compiled pattern closures share this walker — missing resolves to
+    WALK_MISS."""
+    if path == "":
+        return lambda doc: doc
+    if path[0] in "{[":
+        return None
+    segs = _parse_path(path)
+    if not all(s.kind == "key" for s in segs):
+        return None
+    keys = tuple(s.key for s in segs)
+
+    def walk(doc, _keys=keys, _MISS=WALK_MISS):
+        cur = doc
+        for key in _keys:
+            if isinstance(cur, dict):
+                if key in cur:
+                    cur = cur[key]
+                else:
+                    return _MISS
+            elif isinstance(cur, list):
+                try:
+                    idx = int(key)
+                except ValueError:
+                    return _MISS
+                if 0 <= idx < len(cur):
+                    cur = cur[idx]
+                else:
+                    return _MISS
+            else:
+                return _MISS
+        return cur
+
+    return walk
 
 
 def num_str(x) -> str:
@@ -85,16 +153,9 @@ class Result:
     MISSING: "Result"
 
     def string(self) -> str:
-        if not self.exists or self.value is None:
+        if not self.exists:
             return ""
-        v = self.value
-        if isinstance(v, bool):
-            return "true" if v else "false"
-        if isinstance(v, (int, float)):
-            return num_str(v)
-        if isinstance(v, str):
-            return v
-        return to_raw_json(v)
+        return render_value(self.value)
 
     def py(self) -> Any:
         return self.value if self.exists else None
@@ -607,35 +668,13 @@ def get(doc: Any, path: str) -> Result:
         return Result.MISSING  # unbalanced multipath
     fast = _FAST_CACHE.get(path)
     if fast is None:
-        segs = _parse_path(path)
-        fast = (
-            tuple(s.key for s in segs)
-            if all(s.kind == "key" for s in segs)
-            else False
-        )
+        fast = compile_walk(path) or False
         if len(_FAST_CACHE) < 65536:
             _FAST_CACHE[path] = fast
     if fast is False:
         return _resolve(Result(doc), _parse_path(path))
-    cur = doc
-    for key in fast:
-        if isinstance(cur, dict):
-            if key in cur:
-                cur = cur[key]
-            else:
-                return Result.MISSING
-        elif isinstance(cur, list):
-            try:
-                idx = int(key)
-            except ValueError:
-                return Result.MISSING
-            if 0 <= idx < len(cur):
-                cur = cur[idx]
-            else:
-                return Result.MISSING
-        else:
-            return Result.MISSING
-    return Result(cur)
+    v = fast(doc)
+    return Result.MISSING if v is WALK_MISS else Result(v)
 
 
 def get_path(doc: Any, path: str) -> Any:

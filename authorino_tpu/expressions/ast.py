@@ -23,9 +23,12 @@ from __future__ import annotations
 import re
 from dataclasses import dataclass
 from enum import Enum
-from typing import Any, Tuple, Union
+from typing import Any, Callable, Tuple, Union
 
 from ..authjson import selector
+from ..authjson.selector import WALK_MISS as _MISS
+from ..authjson.selector import compile_walk as _compile_walk
+from ..authjson.selector import render_value as _render
 
 __all__ = [
     "Operator", "Pattern", "And", "Or", "All", "Any_", "Expression",
@@ -53,6 +56,71 @@ class Operator(str, Enum):
             raise PatternError(f"unsupported operator for json authorization: {s!r}")
 
 
+def _compile_pattern(pat: "Pattern") -> Callable[[Any], bool]:
+    """Close the selector walk, operator dispatch, and value rendering over
+    one function — resolved once at construction instead of per call (the
+    reference re-parses its gjson selector and recompiles its regex on every
+    Matches, ref: pkg/jsonexp/expressions.go:61,87)."""
+    op = pat.operator
+    want = pat.value
+    walk = _compile_walk(pat.selector)
+    if walk is None:
+        sel_get = selector.get
+        path = pat.selector
+        if op is Operator.EQ:
+            return lambda doc: want == sel_get(doc, path).string()
+        if op is Operator.NEQ:
+            return lambda doc: want != sel_get(doc, path).string()
+        if op is Operator.INCL:
+            return lambda doc: any(
+                want == item.string() for item in sel_get(doc, path).array())
+        if op is Operator.EXCL:
+            return lambda doc: all(
+                want != item.string() for item in sel_get(doc, path).array())
+        rx = pat._regex  # MATCHES
+
+        def run_rx_slow(doc, _rx=rx, _err=getattr(pat, "_regex_error", "invalid regex")):
+            if _rx is None:
+                raise PatternError(_err)
+            return _rx.search(sel_get(doc, path).string()) is not None
+
+        return run_rx_slow
+
+    if op is Operator.EQ:
+        return lambda doc: want == _render(walk(doc))
+    if op is Operator.NEQ:
+        return lambda doc: want != _render(walk(doc))
+    if op is Operator.INCL:
+        # gjson array(): list → elements; missing/None → []; scalar → [self]
+        def run_incl(doc, _walk=walk, _want=want):
+            v = _walk(doc)
+            if type(v) is list:
+                return any(_want == _render(e) for e in v)
+            if v is _MISS or v is None:
+                return False
+            return _want == _render(v)
+
+        return run_incl
+    if op is Operator.EXCL:
+        def run_excl(doc, _walk=walk, _want=want):
+            v = _walk(doc)
+            if type(v) is list:
+                return all(_want != _render(e) for e in v)
+            if v is _MISS or v is None:
+                return True
+            return _want != _render(v)
+
+        return run_excl
+    rx = pat._regex  # MATCHES
+
+    def run_rx(doc, _walk=walk, _rx=rx, _err=getattr(pat, "_regex_error", "invalid regex")):
+        if _rx is None:
+            raise PatternError(_err)
+        return _rx.search(_render(_walk(doc))) is not None
+
+    return run_rx
+
+
 @dataclass(frozen=True)
 class Pattern:
     selector: str
@@ -72,24 +140,12 @@ class Pattern:
                 object.__setattr__(self, "_regex_error", str(e))
         else:
             object.__setattr__(self, "_regex", None)
+        # shadow the class method with the compiled closure (instance
+        # attribute wins on lookup — one call layer, zero per-call dispatch)
+        object.__setattr__(self, "matches", _compile_pattern(self))
 
-    def matches(self, doc: Any) -> bool:
-        obtained = selector.get(doc, self.selector)
-        op = self.operator
-        if op is Operator.EQ:
-            return self.value == obtained.string()
-        if op is Operator.NEQ:
-            return self.value != obtained.string()
-        if op is Operator.INCL:
-            return any(self.value == item.string() for item in obtained.array())
-        if op is Operator.EXCL:
-            return all(self.value != item.string() for item in obtained.array())
-        if op is Operator.MATCHES:
-            rx = getattr(self, "_regex", None)
-            if rx is None:
-                raise PatternError(getattr(self, "_regex_error", "invalid regex"))
-            return rx.search(obtained.string()) is not None
-        raise PatternError("unsupported operator for json authorization")
+    def matches(self, doc: Any) -> bool:  # overridden per-instance in __post_init__
+        raise AssertionError("unreachable: compiled in __post_init__")
 
     def __str__(self):
         return f"{self.selector} {self.operator.value} {self.value}"
@@ -99,7 +155,16 @@ class Pattern:
 class And:
     children: Tuple["Expression", ...] = ()
 
-    def matches(self, doc: Any) -> bool:
+    def __post_init__(self):
+        fns = tuple(c.matches for c in self.children)
+        if len(fns) == 1:
+            run = fns[0]
+        else:
+            def run(doc, _fns=fns):
+                return all(f(doc) for f in _fns)
+        object.__setattr__(self, "matches", run)
+
+    def matches(self, doc: Any) -> bool:  # overridden per-instance
         return all(c.matches(doc) for c in self.children)
 
     def __str__(self):
@@ -110,7 +175,16 @@ class And:
 class Or:
     children: Tuple["Expression", ...] = ()
 
-    def matches(self, doc: Any) -> bool:
+    def __post_init__(self):
+        fns = tuple(c.matches for c in self.children)
+        if len(fns) == 1:
+            run = fns[0]
+        else:
+            def run(doc, _fns=fns):
+                return any(f(doc) for f in _fns)
+        object.__setattr__(self, "matches", run)
+
+    def matches(self, doc: Any) -> bool:  # overridden per-instance
         return any(c.matches(doc) for c in self.children)
 
     def __str__(self):
