@@ -48,6 +48,7 @@ def _build() -> bool:
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
         "-I", sysconfig.get_paths()["include"],
         os.path.join(_NATIVE_DIR, "pymod.cpp"),
+        "-ldl",  # frontend.cpp dlopens libnghttp2 (absent → slow lanes only)
         "-o", _LIB_PATH + ".tmp",
     ]
     try:
@@ -70,7 +71,8 @@ def load_library():
         if _mod is not None or _load_failed:
             return _mod
         try:
-            srcs = [os.path.join(_NATIVE_DIR, f) for f in ("encoder.cpp", "pymod.cpp")]
+            srcs = [os.path.join(_NATIVE_DIR, f)
+                    for f in ("encoder.cpp", "frontend.cpp", "pymod.cpp")]
             stale = (not os.path.exists(_LIB_PATH)
                      or os.path.getmtime(_LIB_PATH) < max(os.path.getmtime(s) for s in srcs))
         except OSError:
@@ -88,6 +90,31 @@ def load_library():
             return None
         _mod = mod
         return _mod
+
+
+_LOADGEN_PATH = os.path.join(_BUILD_DIR, "loadgen")
+
+
+def build_loadgen():
+    """Build (if stale) the standalone HTTP/2 load generator
+    (native/loadgen.cpp); returns its path or None."""
+    src = os.path.join(_NATIVE_DIR, "loadgen.cpp")
+    try:
+        stale = (not os.path.exists(_LOADGEN_PATH)
+                 or os.path.getmtime(_LOADGEN_PATH) < os.path.getmtime(src))
+    except OSError:
+        stale = True
+    if not stale:
+        return _LOADGEN_PATH
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-std=c++17", src, "-o", _LOADGEN_PATH + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_LOADGEN_PATH + ".tmp", _LOADGEN_PATH)
+        return _LOADGEN_PATH
+    except (subprocess.SubprocessError, OSError) as e:
+        log.warning("loadgen build failed: %s", e)
+        return None
 
 
 from .encoder import NativeEncoder, get_native_encoder  # noqa: E402,F401

@@ -96,8 +96,21 @@ class PolicyEngine:
         self._mesh = mesh
         self._snapshot: Optional[_Snapshot] = None
         self._swap_lock = threading.Lock()
-        self._pending: List[_Pending] = []
-        self._flush_handle: Optional[asyncio.TimerHandle] = None
+        # micro-batch queues are PER event loop: the gRPC/HTTP servers and
+        # the native frontend's slow lane may share one engine from
+        # different loops, and asyncio futures/timers are loop-owned
+        self._pending: Dict[Any, List[_Pending]] = {}
+        self._flush_handles: Dict[Any, asyncio.TimerHandle] = {}
+        self._swap_listeners: List[Any] = []
+
+    # swap listeners: the native frontend rebuilds its C++ snapshot after
+    # every corpus swap (runtime/native_frontend.py refresh)
+    def add_swap_listener(self, cb) -> None:
+        self._swap_listeners.append(cb)
+
+    def remove_swap_listener(self, cb) -> None:
+        if cb in self._swap_listeners:
+            self._swap_listeners.remove(cb)
 
     # ---- control plane ---------------------------------------------------
 
@@ -122,6 +135,8 @@ class PolicyEngine:
         with self._swap_lock:
             self._snapshot = snap
             self.index = new_index
+        for cb in list(self._swap_listeners):
+            cb()
 
     def snapshot_policy(self) -> Optional[CompiledPolicy]:
         snap = self._snapshot
@@ -163,21 +178,27 @@ class PolicyEngine:
         request's per-evaluator (rule_results [E], skipped [E])."""
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._pending.append(_Pending(doc, config_name, fut))
-        if len(self._pending) >= self.max_batch:
-            self._schedule_flush()
-        elif self._flush_handle is None:
-            self._flush_handle = loop.call_later(self.max_delay_s, self._schedule_flush)
+        q = self._pending.get(loop)
+        if q is None:
+            q = self._pending[loop] = []
+        q.append(_Pending(doc, config_name, fut))
+        if len(q) >= self.max_batch:
+            self._schedule_flush(loop)
+        elif loop not in self._flush_handles:
+            self._flush_handles[loop] = loop.call_later(
+                self.max_delay_s, self._schedule_flush, loop)
         return await fut
 
-    def _schedule_flush(self) -> None:
-        if self._flush_handle is not None:
-            self._flush_handle.cancel()
-            self._flush_handle = None
-        batch = self._pending
+    def _schedule_flush(self, loop) -> None:
+        # always runs on `loop` (its call_later, or a submit running on it),
+        # so the flush task + future completions stay loop-local
+        handle = self._flush_handles.pop(loop, None)
+        if handle is not None:
+            handle.cancel()
+        batch = self._pending.get(loop)
         if not batch:
             return
-        self._pending = []
+        self._pending[loop] = []
         asyncio.ensure_future(self._flush(batch))
 
     async def _flush(self, batch: List[_Pending]) -> None:

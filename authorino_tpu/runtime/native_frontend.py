@@ -1,0 +1,539 @@
+"""Python half of the native device-owner gRPC frontend (native/frontend.cpp).
+
+One process owns the TPU; the wire runs in C++.  This module decides, per
+AuthConfig, whether its FULL pipeline semantics reduce to the compiled
+kernel verdict (the *fast lane*: anonymous identity + compiled
+pattern-matching authorization + static responses — then packed column 0 is
+exactly the pipeline's decision, ops/pattern_eval.py eval_verdicts), builds
+the C++ encode plans + byte-exact response templates (with the same pb2 code
+as service/grpc_server.py so fast-lane responses match the Python server
+bit for bit), and runs two Python threads:
+
+  - dispatcher: one JAX dispatch per micro-batch (the only per-batch Python)
+  - slow lane: full AuthPipeline for everything else (OIDC identities,
+    metadata fetches, templated denyWith, wildcard-host corpora, …)
+
+Reference parity: main.go:437-488 (one-process gRPC server),
+pkg/service/auth.go:239-310 (Check flow incl. host override + port strip).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..authjson import selector as sel
+from ..compiler.compile import (
+    DFA_VALUE_BYTES,
+    OP_CPU,
+    OP_REGEX_DFA,
+    OP_TREE_CPU,
+    CompiledPolicy,
+)
+from ..compiler.intern import PAD
+from ..compiler.pack import _trim_bytes, wire_dtype
+from ..evaluators.base import DenyWithValues, RuntimeAuthConfig
+from ..evaluators.authorization import PatternMatching
+from ..evaluators.identity import Noop
+from ..pipeline.pipeline import AuthResult
+from ..utils import bucket_pow2
+from ..utils import metrics as metrics_mod
+from ..utils.rpc import INVALID_ARGUMENT, NOT_FOUND, OK, PERMISSION_DENIED
+
+log = logging.getLogger("authorino_tpu.native_frontend")
+
+__all__ = ["NativeFrontend", "fast_lane_eligible"]
+
+# plan kinds — must match native/frontend.cpp PlanKind
+K_CONST, K_METHOD, K_PATH, K_URL_PATH, K_QUERY, K_HOST, K_SCHEME = range(7)
+K_PROTOCOL, K_SIZE, K_FRAGMENT, K_HEADER, K_CTX_EXT = range(7, 12)
+
+EV_TIMEOUT, EV_BATCH, EV_SNAP_RETIRED, EV_STOPPED = 0, 1, 3, 4
+
+_SIMPLE = {
+    ("request", "method"): (K_METHOD, ""),
+    ("request", "path"): (K_PATH, ""),
+    ("request", "url_path"): (K_URL_PATH, ""),
+    ("request", "query"): (K_QUERY, ""),
+    ("request", "host"): (K_HOST, ""),
+    ("request", "scheme"): (K_SCHEME, ""),
+    ("request", "protocol"): (K_PROTOCOL, ""),
+    ("request", "size"): (K_SIZE, ""),
+    ("request", "fragment"): (K_FRAGMENT, ""),
+    ("request", "referer"): (K_HEADER, "referer"),
+    ("request", "user_agent"): (K_HEADER, "user-agent"),
+}
+
+
+def _plan_for_selector(selector_str: str, const_doc: Dict[str, Any]):
+    """(kind, key) for a request-derived attr, ("const", value) for one that
+    resolves constantly (auth.* over the anonymous identity), or None when
+    the fast lane cannot encode it."""
+    if not selector_str or selector_str[0] in "{[":
+        return None
+    try:
+        segs = sel._parse_path(selector_str)
+    except Exception:
+        return None
+    if not all(s.kind == "key" for s in segs):
+        # gjson-extended selectors over the constant auth tree still resolve
+        # constantly; anything touching the request needs the full engine
+        keys0 = selector_str.split(".", 1)[0].split("|", 1)[0]
+        if keys0 == "auth":
+            res = sel.get(const_doc, selector_str)
+            return ("const", res)
+        return None
+    keys = tuple(s.key for s in segs)
+    if keys in _SIMPLE:
+        return _SIMPLE[keys]
+    if len(keys) == 3 and keys[:2] == ("request", "headers"):
+        return (K_HEADER, keys[2])
+    if len(keys) == 3 and keys[:2] == ("request", "context_extensions"):
+        return (K_CTX_EXT, keys[2])
+    # legacy context.* mirrors that share exact semantics with the wellknown
+    # forms (context_dict filters ""-valued scalar fields, so only the
+    # unfiltered maps are plannable)
+    if len(keys) == 5 and keys[:4] == ("context", "request", "http", "headers"):
+        return (K_HEADER, keys[4])
+    if len(keys) == 3 and keys[:2] == ("context", "context_extensions"):
+        return (K_CTX_EXT, keys[2])
+    if keys[0] == "auth":
+        return ("const", sel.get(const_doc, selector_str))
+    return None
+
+
+# the constant auth.* subtree of a fast-lane request (anonymous identity,
+# no metadata/authorization/response outputs at pattern-eval time — the
+# authorization phase reads the doc BEFORE its own results are stored)
+_CONST_AUTH_DOC = {
+    "auth": {
+        "identity": {"anonymous": True},
+        "metadata": {},
+        "authorization": {},
+        "response": {},
+        "callbacks": {},
+    }
+}
+
+
+def _static_value(v) -> bool:
+    return v is None or not getattr(v, "pattern", "")
+
+
+def _deny_with_static(dw: Optional[DenyWithValues]) -> bool:
+    if dw is None:
+        return True
+    if not _static_value(dw.message) or not _static_value(dw.body):
+        return False
+    return all(_static_value(h.value) for h in dw.headers)
+
+
+def fast_lane_eligible(entry, policy: CompiledPolicy) -> Optional[List[tuple]]:
+    """Returns the C++ encode-plan list when `entry`'s pipeline reduces to
+    the kernel verdict, else None.  Mirrors pipeline.evaluate() phase by
+    phase (ref pkg/service/auth_pipeline.go:451-502): every feature that
+    would need per-request Python work disqualifies."""
+    rt: Optional[RuntimeAuthConfig] = entry.runtime
+    if rt is None or entry.rules is None or policy is None:
+        return None
+    row = policy.config_ids.get(entry.rules.name)
+    if row is None:
+        return None
+    if rt.conditions is not None:
+        return None
+    if rt.metadata or rt.callbacks or rt.response:
+        return None
+    if len(rt.identity) != 1:
+        return None
+    idc = rt.identity[0]
+    if not isinstance(idc.evaluator, Noop):
+        return None
+    if idc.conditions is not None or idc.cache is not None or idc.extended_properties:
+        return None
+    if not rt.authorization or len(rt.authorization) != len(entry.rules.evaluators):
+        return None
+    for conf in rt.authorization:
+        if not isinstance(conf.evaluator, PatternMatching):
+            return None
+        if conf.evaluator.batched_provider is None:
+            return None
+        if conf.conditions is not None or conf.cache is not None:
+            return None
+        if conf.metrics:
+            return None
+    if metrics_mod.DEEP_METRICS_ENABLED:
+        return None
+    if not _deny_with_static(rt.deny_with.unauthorized):
+        return None
+
+    plans: List[tuple] = []
+    K = policy.members_k
+    for attr in policy.config_attrs[row]:
+        p = _plan_for_selector(policy.attr_selectors[attr], _CONST_AUTH_DOC)
+        if p is None:
+            return None
+        if p[0] == "const":
+            res = p[1]
+            from ..compiler.encode import _MISSING, _render
+
+            v = res.value if res.exists else _MISSING
+            rendered = _render(v)
+            vid = policy.interner.lookup(rendered)
+            missing = v is _MISSING or v is None
+            members: List[int] = []
+            if isinstance(v, list):
+                if len(v) > K:
+                    return None  # const membership overflow: host oracle only
+                members = [policy.interner.lookup(_render(e)) for e in v]
+            elif not missing:
+                members = [vid]
+            raw = rendered.encode("utf-8")
+            if int(policy.attr_byte_slot[attr]) >= 0 and (
+                len(raw) > DFA_VALUE_BYTES or 0 in raw
+            ):
+                return None  # const DFA operand the byte tensor can't hold
+            plans.append((attr, K_CONST, "", int(vid), missing, members, raw, False))
+        else:
+            kind, key = p
+            plans.append((attr, kind, key, 0, False, [], b"", False))
+    # per-request regex/tree oracles cannot run in C++
+    for leaf in policy.config_cpu_leaves[row]:
+        if int(policy.leaf_op[leaf]) in (OP_CPU, OP_TREE_CPU):
+            return None
+    return plans
+
+
+@dataclass
+class _SnapRec:
+    snap_id: int
+    policy: CompiledPolicy
+    params: Any
+    encoder: Any                       # NativeEncoder (owns the Policy capsule)
+    arrays: List[Dict[str, np.ndarray]] = field(default_factory=list)
+    keepalive: List[np.ndarray] = field(default_factory=list)
+    fc_rows: Optional[np.ndarray] = None
+
+
+class NativeFrontend:
+    """Owns the C++ server lifecycle + the dispatcher/slow-lane threads."""
+
+    def __init__(self, engine, port: int = 0, max_batch: int = 1024,
+                 window_us: int = 2000, slots: int = 16, slow_cap: int = 65536,
+                 dispatch_threads: int = 6):
+        self.engine = engine
+        self.port = port
+        self.max_batch = int(max_batch)
+        self.window_us = int(window_us)
+        self.slots = int(slots)
+        self.slow_cap = int(slow_cap)
+        # several dispatchers keep multiple batches in flight: jax dispatch
+        # is async, but the readback blocks — with one thread the device
+        # link RTT serializes batches (the engine's bench uses the same
+        # worker-thread overlap, bench.py run_pipelined)
+        self.dispatch_threads = int(dispatch_threads)
+        self._mod = None
+        self._snaps: Dict[int, _SnapRec] = {}
+        self._next_snap_id = 1
+        self._running = False
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def start(self) -> int:
+        from ..native import load_library
+
+        mod = load_library()
+        if mod is None:
+            raise RuntimeError("native library unavailable")
+        self._mod = mod
+        rc = mod.fe_start(self.port, self.max_batch, self.slots, self.window_us,
+                          self.slow_cap, self._health_bytes())
+        if rc != 0:
+            raise RuntimeError(f"native frontend failed to start (rc={rc}; "
+                               "is libnghttp2 present?)")
+        self._running = True
+        self.bound_port = mod.fe_port()
+        self._threads = [
+            threading.Thread(target=self._dispatch_loop,
+                             name=f"atpu-fe-dispatch-{i}", daemon=True)
+            for i in range(self.dispatch_threads)
+        ]
+        self._threads.append(
+            threading.Thread(target=self._slow_loop, name="atpu-fe-slow", daemon=True))
+        for t in self._threads:
+            t.start()
+        self.refresh()
+        self.engine.add_swap_listener(self.refresh)
+        return self.bound_port
+
+    def stop(self) -> None:
+        self._running = False
+        if self._mod is not None:
+            self.engine.remove_swap_listener(self.refresh)
+            self._mod.fe_stop()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def stats(self) -> Dict[str, int]:
+        return dict(self._mod.fe_stats()) if self._mod else {}
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _health_bytes() -> bytes:
+        from .. import protos
+
+        return protos.health_pb2.HealthCheckResponse(
+            status=protos.health_pb2.HealthCheckResponse.SERVING
+        ).SerializeToString()
+
+    @staticmethod
+    def _result_bytes(result: AuthResult) -> bytes:
+        from ..service.grpc_server import check_response_from_result
+
+        return check_response_from_result(result).SerializeToString()
+
+    def _deny_result(self, rt: RuntimeAuthConfig) -> AuthResult:
+        """Mirror of pipeline._customize_deny_with on the static denyWith
+        (ref pkg/service/auth_pipeline.go:581-608)."""
+        from ..authjson.value import stringify_json
+
+        result = AuthResult(code=PERMISSION_DENIED, message="Unauthorized")
+        deny = rt.deny_with.unauthorized
+        if deny is not None:
+            if deny.code:
+                result.status = deny.code
+            if deny.message is not None:
+                result.message = stringify_json(deny.message.resolve_for({}))
+            if deny.body is not None:
+                result.body = stringify_json(deny.body.resolve_for({}))
+            if deny.headers:
+                result.headers = [
+                    {h.name: stringify_json(h.value.resolve_for({}))}
+                    for h in deny.headers
+                ]
+        return result
+
+    # ------------------------------------------------------------------
+    def refresh(self) -> None:
+        """Rebuild the C++ snapshot from the engine's current one (called
+        after every engine.apply_snapshot — the reconcile-time swap)."""
+        engine = self.engine
+        snap = engine._snapshot
+        policy = snap.policy if snap is not None else None
+        mod = self._mod
+
+        with self._lock:  # concurrent reconciles must not mint duplicate ids
+            snap_id = self._next_snap_id
+            self._next_snap_id += 1
+
+        spec: Dict[str, Any] = {
+            "snap_id": snap_id,
+            "policy": None,
+            "A": 0, "M": 0, "K": 0, "C": 0, "NB": 0, "DVB": DFA_VALUE_BYTES,
+            "elem16": 0,
+            "has_wildcards": 0,
+            "fcs": [], "hosts": [], "slots": [],
+            "attr_dfas": [],
+            "dfa_R": 0, "dfa_S": 0,
+            "invalid": self._result_bytes(
+                AuthResult(code=INVALID_ARGUMENT, message="Invalid request")),
+            "notfound": self._result_bytes(
+                AuthResult(code=NOT_FOUND, message="Service not found")),
+            "health": self._health_bytes(),
+        }
+        rec = _SnapRec(snap_id=snap_id, policy=policy, params=None, encoder=None)
+
+        entries = list(snap.by_id.values()) if snap is not None else []
+        fcs: List[dict] = []
+        hosts: List[Tuple[str, int]] = []
+        has_wildcards = False
+        ok_bytes = self._result_bytes(AuthResult(code=OK, headers=[{}]))
+
+        if policy is not None:
+            from ..native.encoder import get_native_encoder
+            from ..ops.pattern_eval import to_device
+
+            enc = get_native_encoder(policy)
+            if enc is not None:
+                rec.encoder = enc
+                rec.params = snap.params if snap.params is not None else to_device(policy)
+                spec["policy"] = enc._handle
+                dt = wire_dtype(policy)
+                A, M, K = policy.n_attrs, policy.n_member_attrs, policy.members_k
+                C, NB = policy.n_cpu_leaves, max(policy.n_byte_attrs, 1)
+                spec.update(A=A, M=M, K=K, C=C, NB=NB,
+                            elem16=1 if dt == np.int16 else 0)
+                ams = np.ascontiguousarray(policy.member_attr_slot, dtype=np.int32)
+                abs_v = np.ascontiguousarray(policy.attr_byte_slot, dtype=np.int32)
+                rec.keepalive += [ams, abs_v]
+                spec["attr_member_slot_addr"] = ams.ctypes.data
+                spec["attr_byte_slot_addr"] = abs_v.ctypes.data
+                if policy.n_byte_attrs > 0 and policy.dfa_tables.size:
+                    dt_tr = np.ascontiguousarray(policy.dfa_tables, dtype=np.uint8)
+                    dt_ac = np.ascontiguousarray(policy.dfa_accept, dtype=np.uint8)
+                    rec.keepalive += [dt_tr, dt_ac]
+                    spec.update(dfa_R=int(dt_tr.shape[0]), dfa_S=int(dt_tr.shape[1]),
+                                dfa_trans_addr=dt_tr.ctypes.data,
+                                dfa_accept_addr=dt_ac.ctypes.data)
+                # per-attr DFA leaves → (dfa row, dense cpu column)
+                cpu_col = {int(l): i for i, l in enumerate(policy.cpu_leaf_list)}
+                attr_dfas: List[List[Tuple[int, int]]] = [[] for _ in range(A)]
+                for leaf in range(policy.n_leaves):
+                    if int(policy.leaf_op[leaf]) == OP_REGEX_DFA and leaf in cpu_col:
+                        attr_dfas[int(policy.leaf_attr[leaf])].append(
+                            (int(policy.leaf_dfa_row[leaf]), cpu_col[leaf]))
+                spec["attr_dfas"] = attr_dfas
+
+                # batch slots (numpy-owned; freed on SNAP_RETIRED)
+                B = self.max_batch
+                for _ in range(self.slots):
+                    a = {
+                        "attrs_val": np.zeros((B, A), dtype=dt),
+                        "members": np.full((B, M, K), PAD, dtype=dt),
+                        "cpu_dense": np.zeros((B, C), dtype=np.uint8),
+                        "config_id": np.zeros((B,), dtype=np.int32),
+                        "attr_bytes": np.zeros((B, NB, DFA_VALUE_BYTES), dtype=np.uint8),
+                        "byte_ovf": np.zeros((B, NB), dtype=np.uint8),
+                    }
+                    rec.arrays.append(a)
+                    spec["slots"].append({k: v.ctypes.data for k, v in a.items()})
+
+                fc_rows = []
+                fast_ids = set()
+                for entry in entries:
+                    plans = fast_lane_eligible(entry, policy)
+                    if plans is None:
+                        continue
+                    fast_ids.add(id(entry))
+                    row = policy.config_ids[entry.rules.name]
+                    fc_idx = len(fcs)
+                    fcs.append({
+                        "row": int(row),
+                        "ok": ok_bytes,
+                        "deny": self._result_bytes(self._deny_result(entry.runtime)),
+                        "plans": plans,
+                    })
+                    fc_rows.append(int(row))
+                    for host in entry.hosts:
+                        if "*" in host:
+                            has_wildcards = True
+                        else:
+                            hosts.append((host, fc_idx))
+                rec.fc_rows = np.asarray(fc_rows or [0], dtype=np.int64)
+            else:
+                fast_ids = set()
+        else:
+            fast_ids = set()
+
+        # non-fast hosts route to the Python pipeline (slow lane)
+        fast_hosts = {h for h, _ in hosts}
+        for entry in entries:
+            if id(entry) in fast_ids:
+                continue
+            for host in entry.hosts:
+                if "*" in host:
+                    has_wildcards = True
+                elif host not in fast_hosts:
+                    hosts.append((host, -1))
+        spec["fcs"] = fcs
+        spec["hosts"] = hosts
+        spec["has_wildcards"] = 1 if has_wildcards else 0
+
+        with self._lock:
+            self._snaps[snap_id] = rec
+            mod.fe_swap(spec)
+        log.info("native frontend snapshot %d: %d fast configs, %d hosts%s",
+                 snap_id, len(fcs), len(hosts),
+                 " (wildcards→slow)" if has_wildcards else "")
+
+    # ------------------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        mod = self._mod
+        while self._running:
+            kind, a, b, c = mod.fe_wait_batch(200)
+            if kind == EV_BATCH:
+                try:
+                    self._dispatch(int(a), int(b), int(c))
+                except Exception:
+                    log.exception("native batch dispatch failed")
+                    # fail closed: deny the whole batch
+                    rec = self._snaps.get(int(a))
+                    if rec is not None:
+                        deny = np.zeros(int(c), dtype=np.uint8)
+                        mod.fe_complete_batch(int(a), int(b), deny.ctypes.data)
+            elif kind == EV_SNAP_RETIRED:
+                with self._lock:
+                    self._snaps.pop(int(a), None)
+            elif kind == EV_STOPPED:
+                break
+
+    def _dispatch(self, snap_id: int, slot: int, count: int) -> None:
+        import jax.numpy as jnp
+
+        from ..ops.pattern_eval import eval_packed_jit
+
+        rec = self._snaps[snap_id]
+        a = rec.arrays[slot]
+        pad = min(bucket_pow2(count), self.max_batch)
+        has_dfa = rec.params["dfa_tables"] is not None
+        packed = np.asarray(eval_packed_jit(
+            rec.params,
+            jnp.asarray(a["attrs_val"][:pad]),
+            jnp.asarray(a["members"][:pad]),
+            jnp.asarray(a["cpu_dense"][:pad].view(bool)),
+            jnp.asarray(a["config_id"][:pad]),
+            jnp.asarray(_trim_bytes(a["attr_bytes"][:pad])) if has_dfa else None,
+            jnp.asarray(a["byte_ovf"][:pad].view(bool)) if has_dfa else None,
+        ))
+        verdict = np.ascontiguousarray(packed[:count, 0]).astype(np.uint8)
+        self._mod.fe_complete_batch(snap_id, slot, verdict.ctypes.data)
+        # aggregate request metrics, same counters the pipeline bumps
+        # (ref pkg/service/auth_pipeline.go:26-36); fast-lane configs carry
+        # no namespace/name labels — the engine corpus is keyed by config id
+        n_ok = int(verdict.sum())
+        metrics_mod.authconfig_total.labels("", "").inc(count)
+        metrics_mod.authconfig_response_status.labels("", "", "OK").inc(n_ok)
+        if count - n_ok:
+            metrics_mod.authconfig_response_status.labels(
+                "", "", "PERMISSION_DENIED").inc(count - n_ok)
+
+    # ------------------------------------------------------------------
+    def _slow_loop(self) -> None:
+        import asyncio
+
+        from .. import protos
+        from ..service.grpc_server import (
+            check_response_from_result,
+            request_model_from_proto,
+        )
+
+        mod = self._mod
+        engine = self.engine
+        external_auth_pb2 = protos.external_auth_pb2
+
+        async def handle(req_id: int, raw: bytes) -> None:
+            try:
+                req = external_auth_pb2.CheckRequest.FromString(raw)
+                model = request_model_from_proto(req)
+                if model is None:
+                    result = AuthResult(code=INVALID_ARGUMENT, message="Invalid request")
+                else:
+                    result = await engine.check(model)
+                mod.fe_complete_slow(
+                    req_id, check_response_from_result(result).SerializeToString(), 0)
+            except Exception:
+                log.exception("slow-lane request failed")
+                mod.fe_complete_slow(req_id, b"", 13)  # INTERNAL
+
+        async def main() -> None:
+            loop = asyncio.get_running_loop()
+            while self._running:
+                batch = await loop.run_in_executor(None, mod.fe_take_slow, 200, 256)
+                if batch:
+                    await asyncio.gather(*(handle(i, raw) for i, raw in batch))
+
+        asyncio.run(main())

@@ -16,6 +16,7 @@
 #include <Python.h>
 
 #include "encoder.cpp"
+#include "frontend.cpp"
 
 namespace {
 
@@ -267,10 +268,315 @@ PyObject* encode_json_py(PyObject*, PyObject* args) {
   return PyLong_FromLongLong(rc);
 }
 
+// ---------------------------------------------------------------------------
+// native gRPC frontend (native/frontend.cpp)
+// ---------------------------------------------------------------------------
+
+static long dict_int(PyObject* d, const char* k, long dflt = 0) {
+  PyObject* v = PyDict_GetItemString(d, k);
+  return v ? PyLong_AsLong(v) : dflt;
+}
+
+static unsigned long long dict_addr(PyObject* d, const char* k) {
+  PyObject* v = PyDict_GetItemString(d, k);
+  return v ? PyLong_AsUnsignedLongLong(v) : 0;
+}
+
+static bool dict_bytes(PyObject* d, const char* k, std::string& out) {
+  PyObject* v = PyDict_GetItemString(d, k);
+  if (v == nullptr || !PyBytes_Check(v)) return false;
+  out.assign(PyBytes_AS_STRING(v), (size_t)PyBytes_GET_SIZE(v));
+  return true;
+}
+
+// fe_start(port, bmax, nslots, window_us, slow_cap, health_bytes) -> 0
+PyObject* fe_start_py(PyObject*, PyObject* args) {
+  int port, bmax, nslots;
+  long window_us, slow_cap;
+  Py_buffer health;
+  if (!PyArg_ParseTuple(args, "iiilly*", &port, &bmax, &nslots, &window_us,
+                        &slow_cap, &health))
+    return nullptr;
+  if (fe::g_srv != nullptr) {
+    PyBuffer_Release(&health);
+    PyErr_SetString(PyExc_RuntimeError, "frontend already started");
+    return nullptr;
+  }
+  fe::Server* S = new fe::Server();
+  S->port = port;
+  S->bmax = bmax;
+  S->nslots = nslots;
+  S->window_us = window_us;
+  S->slow_cap = (size_t)slow_cap;
+  S->health_msg.assign((const char*)health.buf, (size_t)health.len);
+  PyBuffer_Release(&health);
+  int rc = fe::server_start(S);
+  if (rc != 0) {
+    delete S;
+    return PyLong_FromLong(rc);
+  }
+  fe::g_srv = S;
+  return PyLong_FromLong(0);
+}
+
+PyObject* fe_port_py(PyObject*, PyObject*) {
+  return PyLong_FromLong(fe::g_srv ? fe::g_srv->bound_port : -1);
+}
+
+PyObject* fe_stop_py(PyObject*, PyObject*) {
+  fe::Server* S = fe::g_srv;
+  if (S != nullptr) {
+    Py_BEGIN_ALLOW_THREADS
+    fe::server_stop(S);
+    Py_END_ALLOW_THREADS
+    fe::g_srv = nullptr;
+    // leak the Server struct intentionally: Python threads may still be
+    // inside fe_wait_* draining the final STOPPED event
+  }
+  Py_RETURN_NONE;
+}
+
+// fe_swap(spec_dict) -> 0; spec described in runtime/native_frontend.py
+PyObject* fe_swap_py(PyObject*, PyObject* args) {
+  PyObject* d;
+  if (!PyArg_ParseTuple(args, "O!", &PyDict_Type, &d)) return nullptr;
+  fe::Server* S = fe::g_srv;
+  if (S == nullptr) {
+    PyErr_SetString(PyExc_RuntimeError, "frontend not started");
+    return nullptr;
+  }
+  auto snap = std::make_shared<fe::Snapshot>();
+  snap->id = dict_int(d, "snap_id");
+  PyObject* cap = PyDict_GetItemString(d, "policy");
+  if (cap != nullptr && cap != Py_None) {
+    Policy* p = (Policy*)PyCapsule_GetPointer(cap, "atpu.Policy");
+    if (p == nullptr) return nullptr;
+    snap->interner = &p->interner;
+  }
+  snap->A = (int)dict_int(d, "A");
+  snap->M = (int)dict_int(d, "M");
+  snap->K = (int)dict_int(d, "K");
+  snap->C = (int)dict_int(d, "C");
+  snap->NB = (int)dict_int(d, "NB");
+  snap->DVB = (int)dict_int(d, "DVB");
+  snap->elem16 = dict_int(d, "elem16") != 0;
+  snap->has_wildcards = dict_int(d, "has_wildcards") != 0;
+  const int32_t* ams = (const int32_t*)dict_addr(d, "attr_member_slot_addr");
+  const int32_t* abs_v = (const int32_t*)dict_addr(d, "attr_byte_slot_addr");
+  if (snap->A > 0 && ams != nullptr)
+    snap->attr_member_slot.assign(ams, ams + snap->A);
+  if (snap->A > 0 && abs_v != nullptr)
+    snap->attr_byte_slot_v.assign(abs_v, abs_v + snap->A);
+  snap->attr_member_slot.resize(snap->A, -1);
+  snap->attr_byte_slot_v.resize(snap->A, -1);
+  long dfa_R = dict_int(d, "dfa_R");
+  snap->dfa_S = (int)dict_int(d, "dfa_S");
+  if (dfa_R > 0 && snap->dfa_S > 0) {
+    const uint8_t* tr = (const uint8_t*)dict_addr(d, "dfa_trans_addr");
+    const uint8_t* ac = (const uint8_t*)dict_addr(d, "dfa_accept_addr");
+    snap->dfa_trans.assign(tr, tr + (size_t)dfa_R * snap->dfa_S * 256);
+    snap->dfa_accept.assign(ac, ac + (size_t)dfa_R * snap->dfa_S);
+  }
+  snap->attr_dfas.resize(snap->A);
+  PyObject* adfas = PyDict_GetItemString(d, "attr_dfas");
+  if (adfas != nullptr) {
+    for (Py_ssize_t a = 0; a < PyList_GET_SIZE(adfas) && a < snap->A; ++a) {
+      PyObject* lst = PyList_GET_ITEM(adfas, a);
+      for (Py_ssize_t j = 0; j < PyList_GET_SIZE(lst); ++j) {
+        PyObject* t = PyList_GET_ITEM(lst, j);
+        snap->attr_dfas[a].push_back(
+            {(int32_t)PyLong_AsLong(PyTuple_GET_ITEM(t, 0)),
+             (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(t, 1))});
+      }
+    }
+  }
+  if (!dict_bytes(d, "invalid", snap->invalid_msg) ||
+      !dict_bytes(d, "notfound", snap->notfound_msg) ||
+      !dict_bytes(d, "health", snap->health_msg)) {
+    PyErr_SetString(PyExc_ValueError, "swap spec missing response templates");
+    return nullptr;
+  }
+  PyObject* fcs = PyDict_GetItemString(d, "fcs");
+  for (Py_ssize_t i = 0; fcs != nullptr && i < PyList_GET_SIZE(fcs); ++i) {
+    PyObject* f = PyList_GET_ITEM(fcs, i);
+    fe::FastConfig fc;
+    fc.row = (int32_t)dict_int(f, "row");
+    dict_bytes(f, "ok", fc.ok_msg);
+    dict_bytes(f, "deny", fc.deny_msg);
+    PyObject* plans = PyDict_GetItemString(f, "plans");
+    for (Py_ssize_t j = 0; plans != nullptr && j < PyList_GET_SIZE(plans); ++j) {
+      PyObject* t = PyList_GET_ITEM(plans, j);
+      fe::FastPlan pl;
+      pl.attr = (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(t, 0));
+      pl.kind = (int)PyLong_AsLong(PyTuple_GET_ITEM(t, 1));
+      Py_ssize_t kn;
+      const char* ks = PyUnicode_AsUTF8AndSize(PyTuple_GET_ITEM(t, 2), &kn);
+      if (ks == nullptr) return nullptr;
+      pl.key.assign(ks, (size_t)kn);
+      pl.const_vid = (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(t, 3));
+      pl.const_missing = PyObject_IsTrue(PyTuple_GET_ITEM(t, 4)) == 1;
+      PyObject* mems = PyTuple_GET_ITEM(t, 5);
+      for (Py_ssize_t m = 0; m < PyList_GET_SIZE(mems); ++m)
+        pl.const_members.push_back((int32_t)PyLong_AsLong(PyList_GET_ITEM(mems, m)));
+      PyObject* cb = PyTuple_GET_ITEM(t, 6);
+      pl.const_bytes.assign(PyBytes_AS_STRING(cb), (size_t)PyBytes_GET_SIZE(cb));
+      pl.const_byte_ovf = PyObject_IsTrue(PyTuple_GET_ITEM(t, 7)) == 1;
+      if (pl.kind == fe::K_URL_PATH || pl.kind == fe::K_QUERY) fc.needs_split = true;
+      fc.plans.push_back(std::move(pl));
+    }
+    snap->fcs.push_back(std::move(fc));
+  }
+  PyObject* hosts = PyDict_GetItemString(d, "hosts");
+  for (Py_ssize_t i = 0; hosts != nullptr && i < PyList_GET_SIZE(hosts); ++i) {
+    PyObject* t = PyList_GET_ITEM(hosts, i);
+    Py_ssize_t hn;
+    const char* hs = PyUnicode_AsUTF8AndSize(PyTuple_GET_ITEM(t, 0), &hn);
+    if (hs == nullptr) return nullptr;
+    snap->host_map[std::string(hs, (size_t)hn)] =
+        (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(t, 1));
+  }
+  PyObject* slots = PyDict_GetItemString(d, "slots");
+  for (Py_ssize_t i = 0; slots != nullptr && i < PyList_GET_SIZE(slots); ++i) {
+    PyObject* s = PyList_GET_ITEM(slots, i);
+    fe::Slot sl;
+    sl.attrs_val = (char*)dict_addr(s, "attrs_val");
+    sl.members = (char*)dict_addr(s, "members");
+    sl.cpu_dense = (uint8_t*)dict_addr(s, "cpu_dense");
+    sl.config_id = (int32_t*)dict_addr(s, "config_id");
+    sl.attr_bytes = (uint8_t*)dict_addr(s, "attr_bytes");
+    sl.byte_ovf = (uint8_t*)dict_addr(s, "byte_ovf");
+    snap->slots.push_back(sl);
+    snap->free_slots.push_back((int)i);
+  }
+  snap->slot_entries.resize(snap->slots.size());
+  snap->slot_count.resize(snap->slots.size(), 0);
+
+  std::vector<int64_t> retired;
+  {
+    std::lock_guard<std::mutex> lk(S->mu);
+    S->snaps[snap->id] = snap;
+    S->cur = snap;
+    fe::maybe_retire_locked(S, retired);
+  }
+  fe::emit_retired(S, retired);
+  return PyLong_FromLong(0);
+}
+
+// fe_wait_batch(timeout_ms) -> (kind, a, b, c)
+PyObject* fe_wait_batch_py(PyObject*, PyObject* args) {
+  long timeout_ms;
+  if (!PyArg_ParseTuple(args, "l", &timeout_ms)) return nullptr;
+  fe::Server* S = fe::g_srv;
+  if (S == nullptr) return Py_BuildValue("(iLLL)", (int)fe::EV_STOPPED, 0LL, 0LL, 0LL);
+  fe::Event ev = {fe::EV_TIMEOUT, 0, 0, 0};
+  Py_BEGIN_ALLOW_THREADS {
+    std::unique_lock<std::mutex> lk(S->batch_mu);
+    if (S->batch_events.empty())
+      S->batch_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                           [&] { return !S->batch_events.empty(); });
+    if (!S->batch_events.empty()) {
+      ev = S->batch_events.front();
+      S->batch_events.pop_front();
+    }
+  }
+  Py_END_ALLOW_THREADS
+  return Py_BuildValue("(iLLL)", ev.kind, (long long)ev.a, (long long)ev.b,
+                       (long long)ev.c);
+}
+
+// fe_take_slow(timeout_ms, max_n) -> list[(req_id, bytes)]
+PyObject* fe_take_slow_py(PyObject*, PyObject* args) {
+  long timeout_ms;
+  int max_n;
+  if (!PyArg_ParseTuple(args, "li", &timeout_ms, &max_n)) return nullptr;
+  fe::Server* S = fe::g_srv;
+  if (S == nullptr) return PyList_New(0);
+  std::vector<fe::SlowReq> reqs;
+  Py_BEGIN_ALLOW_THREADS {
+    std::unique_lock<std::mutex> lk(S->slow_mu);
+    if (S->slow_q.empty())
+      S->slow_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                          [&] { return !S->slow_q.empty() || !S->running.load(); });
+    while (!S->slow_q.empty() && (int)reqs.size() < max_n) {
+      reqs.push_back(std::move(S->slow_q.front()));
+      S->slow_q.pop_front();
+    }
+  }
+  Py_END_ALLOW_THREADS
+  PyObject* out = PyList_New((Py_ssize_t)reqs.size());
+  for (size_t i = 0; i < reqs.size(); ++i) {
+    PyObject* b = PyBytes_FromStringAndSize(reqs[i].bytes.data(),
+                                            (Py_ssize_t)reqs[i].bytes.size());
+    PyList_SET_ITEM(out, (Py_ssize_t)i,
+                    Py_BuildValue("(KN)", (unsigned long long)reqs[i].id, b));
+  }
+  return out;
+}
+
+// fe_complete_batch(snap_id, slot, verdict_addr)
+PyObject* fe_complete_batch_py(PyObject*, PyObject* args) {
+  long long snap_id;
+  int slot;
+  unsigned long long verdict_a;
+  if (!PyArg_ParseTuple(args, "LiK", &snap_id, &slot, &verdict_a)) return nullptr;
+  fe::Server* S = fe::g_srv;
+  if (S != nullptr) {
+    Py_BEGIN_ALLOW_THREADS
+    fe::complete_batch(S, snap_id, slot, (const uint8_t*)verdict_a);
+    Py_END_ALLOW_THREADS
+  }
+  Py_RETURN_NONE;
+}
+
+// fe_complete_slow(req_id, resp_bytes, grpc_status)
+PyObject* fe_complete_slow_py(PyObject*, PyObject* args) {
+  unsigned long long req_id;
+  Py_buffer resp;
+  int grpc_status;
+  if (!PyArg_ParseTuple(args, "Ky*i", &req_id, &resp, &grpc_status)) return nullptr;
+  fe::Server* S = fe::g_srv;
+  if (S != nullptr)
+    fe::complete_slow(S, req_id, (const char*)resp.buf, (size_t)resp.len, grpc_status);
+  PyBuffer_Release(&resp);
+  Py_RETURN_NONE;
+}
+
+PyObject* fe_stats_py(PyObject*, PyObject*) {
+  fe::Server* S = fe::g_srv;
+  PyObject* d = PyDict_New();
+  if (S == nullptr) return d;
+  auto put = [&](const char* k, uint64_t v) {
+    PyObject* o = PyLong_FromUnsignedLongLong(v);
+    PyDict_SetItemString(d, k, o);
+    Py_DECREF(o);
+  };
+  put("fast", S->n_fast.load());
+  put("slow", S->n_slow.load());
+  put("notfound", S->n_notfound.load());
+  put("invalid", S->n_invalid.load());
+  put("health", S->n_health.load());
+  put("allowed", S->n_allowed.load());
+  put("denied", S->n_denied.load());
+  put("dfa_overflow", S->n_dfa_ovf.load());
+  put("slow_shed", S->n_slow_shed.load());
+  put("parse_errors", S->n_parse_err.load());
+  put("connections", S->n_conns.load());
+  return d;
+}
+
 PyMethodDef methods[] = {
     {"policy_new", policy_new_py, METH_VARARGS, "build native policy tables"},
     {"encode_docs", encode_docs, METH_VARARGS, "encode a batch of dict docs"},
     {"encode_json", encode_json_py, METH_VARARGS, "encode a JSON-blob batch"},
+    {"fe_start", fe_start_py, METH_VARARGS, "start the native gRPC frontend"},
+    {"fe_stop", fe_stop_py, METH_NOARGS, "stop the native gRPC frontend"},
+    {"fe_port", fe_port_py, METH_NOARGS, "bound port of the frontend"},
+    {"fe_swap", fe_swap_py, METH_VARARGS, "swap the frontend snapshot"},
+    {"fe_wait_batch", fe_wait_batch_py, METH_VARARGS, "wait for a batch event"},
+    {"fe_take_slow", fe_take_slow_py, METH_VARARGS, "take queued slow-lane requests"},
+    {"fe_complete_batch", fe_complete_batch_py, METH_VARARGS, "complete a batch"},
+    {"fe_complete_slow", fe_complete_slow_py, METH_VARARGS, "complete a slow request"},
+    {"fe_stats", fe_stats_py, METH_NOARGS, "frontend counters"},
     {nullptr, nullptr, 0, nullptr},
 };
 

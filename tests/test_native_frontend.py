@@ -1,0 +1,327 @@
+"""Differential suite for the native C++ gRPC frontend (native/frontend.cpp +
+runtime/native_frontend.py): every response must match the Python grpc.aio
+server (service/grpc_server.py) field for field — same corpus, same
+requests, fast lane and slow lane both.
+
+The engine here is built with mesh=None so the single-corpus fast lane
+engages (the suite-wide conftest forces an 8-device virtual mesh, which
+routes everything to the slow lane — covered by its own test below)."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+import grpc
+import pytest
+
+from authorino_tpu import protos
+from authorino_tpu.compiler import ConfigRules
+from authorino_tpu.evaluators import (
+    AuthorizationConfig,
+    DenyWith,
+    DenyWithValues,
+    IdentityConfig,
+    RuntimeAuthConfig,
+)
+from authorino_tpu.authjson.value import JSONProperty, JSONValue
+from authorino_tpu.evaluators.authorization import PatternMatching
+from authorino_tpu.evaluators.credentials import AuthCredentials
+from authorino_tpu.evaluators.identity import APIKey, Noop
+from authorino_tpu.expressions import All, Any_, Operator, Pattern
+from authorino_tpu.k8s.client import LabelSelector, Secret
+from authorino_tpu.runtime import EngineEntry, PolicyEngine
+from authorino_tpu.runtime.native_frontend import NativeFrontend, fast_lane_eligible
+
+pb = protos.external_auth_pb2
+
+
+def _native_available() -> bool:
+    from authorino_tpu.native import load_library
+
+    mod = load_library()
+    return mod is not None and hasattr(mod, "fe_start")
+
+
+pytestmark = pytest.mark.skipif(
+    not _native_available(), reason="native frontend unavailable (no libnghttp2?)")
+
+
+# ---------------------------------------------------------------------------
+# corpus: a mix that exercises fast lane, slow lane, DFA, denyWith
+# ---------------------------------------------------------------------------
+
+def build_engine() -> PolicyEngine:
+    engine = PolicyEngine(max_batch=64, max_delay_s=0.0005, mesh=None)
+
+    def pattern_entry(i, cfg_id, hosts, rule, cond=None, deny_with=None):
+        pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
+                             evaluator_slot=0)
+        runtime = RuntimeAuthConfig(
+            identity=[IdentityConfig("anon", Noop())],
+            authorization=[AuthorizationConfig("rules", pm)],
+            deny_with=deny_with or DenyWith(),
+        )
+        return EngineEntry(id=cfg_id, hosts=hosts, runtime=runtime,
+                           rules=ConfigRules(name=cfg_id, evaluators=[(cond, rule)]))
+
+    entries = []
+    # fast: plain eq/neq/incl over request attrs
+    entries.append(pattern_entry(
+        0, "ns/fast-eq", ["fast-eq.test"],
+        All(Pattern("request.method", Operator.EQ, "GET"),
+            Pattern("request.headers.x-org", Operator.EQ, "acme"))))
+    # fast: compiled evaluator conditions (skipped ⇒ allow)
+    entries.append(pattern_entry(
+        1, "ns/fast-cond", ["fast-cond.test"],
+        Pattern("request.headers.x-role", Operator.EQ, "admin"),
+        cond=Pattern("request.method", Operator.EQ, "POST")))
+    # fast: device-DFA regex over url_path
+    entries.append(pattern_entry(
+        2, "ns/fast-rx", ["fast-rx.test"],
+        Pattern("request.url_path", Operator.MATCHES, r"^/api/v[0-9]+/ok")))
+    # fast: static denyWith customization
+    entries.append(pattern_entry(
+        3, "ns/fast-deny", ["fast-deny.test"],
+        Pattern("request.headers.x-pass", Operator.EQ, "yes"),
+        deny_with=DenyWith(unauthorized=DenyWithValues(
+            code=302,
+            message=JSONValue(static="moved"),
+            headers=[JSONProperty("Location", JSONValue(static="http://login.test"))],
+        ))))
+    # slow: API-key identity (per-request Python)
+    api_key = APIKey("friends", LabelSelector.from_spec({"matchLabels": {"g": "t"}}),
+                     credentials=AuthCredentials(key_selector="APIKEY"))
+    api_key.add_k8s_secret_based_identity(
+        Secret(namespace="ns", name="k1", labels={"g": "t"}, data={"api_key": b"sekret"}))
+    entries.append(EngineEntry(
+        id="ns/slow-key", hosts=["slow-key.test"],
+        runtime=RuntimeAuthConfig(
+            identity=[IdentityConfig("friends", api_key,
+                                     credentials=AuthCredentials(key_selector="APIKEY"))]),
+        rules=None))
+    # slow: wildcard host (radix walk stays in Python)
+    entries.append(pattern_entry(
+        5, "ns/slow-wild", ["*.wild.test"],
+        Pattern("request.method", Operator.NEQ, "DELETE")))
+    engine.apply_snapshot(entries)
+    return engine
+
+
+def make_req(host, method="GET", path="/", headers=None, ctx=None):
+    req = pb.CheckRequest()
+    http = req.attributes.request.http
+    http.method = method
+    http.path = path
+    http.host = host
+    for k, v in (headers or {}).items():
+        http.headers[k] = v
+    for k, v in (ctx or {}).items():
+        req.attributes.context_extensions[k] = v
+    return req
+
+
+REQUESTS = [
+    make_req("fast-eq.test", headers={"x-org": "acme"}),
+    make_req("fast-eq.test", headers={"x-org": "evil"}),
+    make_req("fast-eq.test", method="POST", headers={"x-org": "acme"}),
+    make_req("fast-eq.test"),                                    # header missing
+    make_req("fast-cond.test"),                                  # cond unmatched → allow
+    make_req("fast-cond.test", method="POST"),                   # cond matched → deny
+    make_req("fast-cond.test", method="POST", headers={"x-role": "admin"}),
+    make_req("fast-rx.test", path="/api/v2/ok?x=1"),
+    make_req("fast-rx.test", path="/api/nope"),
+    make_req("fast-rx.test", path="/api/v9/ok" + "a" * 100),     # > DFA_VALUE_BYTES
+    make_req("fast-deny.test", headers={"x-pass": "yes"}),
+    make_req("fast-deny.test", headers={"x-pass": "no"}),        # custom 302 deny
+    make_req("slow-key.test", headers={"authorization": "APIKEY sekret"}),
+    make_req("slow-key.test", headers={"authorization": "APIKEY wrong"}),
+    make_req("a.wild.test"),
+    make_req("a.wild.test", method="DELETE"),
+    make_req("unknown.test"),                                    # no config → 404... wildcard!
+    make_req("fast-eq.test:8080", headers={"x-org": "acme"}),    # port strip
+    make_req("other.test", headers={"x-org": "acme"}, ctx={"host": "fast-eq.test"}),
+]
+
+
+def response_key(resp: pb.CheckResponse):
+    kind = resp.WhichOneof("http_response")
+    headers = []
+    body = ""
+    status = 0
+    if kind == "denied_response":
+        d = resp.denied_response
+        status = d.status.code
+        headers = sorted((h.header.key, h.header.value) for h in d.headers)
+        body = d.body
+    elif kind == "ok_response":
+        headers = sorted((h.header.key, h.header.value) for h in resp.ok_response.headers)
+    return (resp.status.code, kind, status, headers, body)
+
+
+def grpc_call(port, req, path="/envoy.service.auth.v3.Authorization/Check"):
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        call = ch.unary_unary(path,
+                              request_serializer=pb.CheckRequest.SerializeToString,
+                              response_deserializer=pb.CheckResponse.FromString)
+        return call(req, timeout=10)
+
+
+def run_python_server(engine):
+    """The grpc.aio reference server on a background loop thread."""
+    from authorino_tpu.service.grpc_server import build_server
+
+    started = threading.Event()
+    holder = {}
+
+    def runner():
+        async def main():
+            server = build_server(engine, address="127.0.0.1:0")
+            await server.start()
+            holder["port"] = server.bound_port
+            holder["loop"] = asyncio.get_running_loop()
+            started.set()
+            await holder["stop"].wait()
+            await server.stop(0.2)
+
+        holder["stop"] = None
+
+        async def boot():
+            holder["stop"] = asyncio.Event()
+            await main()
+
+        asyncio.run(boot())
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    started.wait(30)
+    return holder, t
+
+
+def test_sharded_engine_routes_slow():
+    """With a mesh-sharded snapshot the fast lane has no packed single-corpus
+    params — every host must route to the Python pipeline and still answer
+    correctly.  (Runs FIRST: the C++ server is one-per-process, so this test
+    must finish before the module-scoped stack fixture starts its own.)"""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh="auto")
+    rule = Pattern("request.headers.x-org", Operator.EQ, "acme")
+    cfg_id = "ns/sharded"
+    pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
+                         evaluator_slot=0)
+    runtime = RuntimeAuthConfig(identity=[IdentityConfig("anon", Noop())],
+                                authorization=[AuthorizationConfig("rules", pm)])
+    engine.apply_snapshot([EngineEntry(id=cfg_id, hosts=["sharded.test"], runtime=runtime,
+                                       rules=ConfigRules(name=cfg_id,
+                                                         evaluators=[(None, rule)]))])
+    fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
+    port = fe.start()
+    try:
+        ok = grpc_call(port, make_req("sharded.test", headers={"x-org": "acme"}))
+        deny = grpc_call(port, make_req("sharded.test", headers={"x-org": "no"}))
+        assert ok.status.code == 0 and deny.status.code == 7
+        stats = fe.stats()
+        assert stats["fast"] == 0 and stats["slow"] >= 2
+    finally:
+        fe.stop()
+
+
+@pytest.fixture(scope="module")
+def stack():
+    engine = build_engine()
+    fe = NativeFrontend(engine, port=0, max_batch=64, window_us=500)
+    native_port = fe.start()
+    holder, t = run_python_server(engine)
+    yield engine, fe, native_port, holder["port"]
+    holder["loop"].call_soon_threadsafe(holder["stop"].set)
+    t.join(timeout=10)
+    fe.stop()
+
+
+def test_differential_vs_python_server(stack):
+    _, fe, native_port, py_port = stack
+    for i, req in enumerate(REQUESTS):
+        native = response_key(grpc_call(native_port, req))
+        python = response_key(grpc_call(py_port, req))
+        assert native == python, f"request #{i} diverged: {native} vs {python}"
+    stats = fe.stats()
+    assert stats["fast"] > 0, "fast lane never engaged"
+    assert stats["slow"] > 0, "slow lane never engaged"
+
+
+def test_fast_lane_classification(stack):
+    engine, _, _, _ = stack
+    snap = engine._snapshot
+    by_id = snap.by_id
+    policy = snap.policy
+    assert fast_lane_eligible(by_id["ns/fast-eq"], policy) is not None
+    assert fast_lane_eligible(by_id["ns/fast-cond"], policy) is not None
+    assert fast_lane_eligible(by_id["ns/fast-rx"], policy) is not None
+    assert fast_lane_eligible(by_id["ns/fast-deny"], policy) is not None
+    assert fast_lane_eligible(by_id["ns/slow-key"], policy) is None
+
+
+def test_dfa_overflow_rides_fast_lane(stack):
+    """Values longer than the device byte tensor run the same DFA on the
+    C++ host — still the fast lane, still exact."""
+    _, fe, native_port, py_port = stack
+    before = fe.stats()["dfa_overflow"]
+    req = make_req("fast-rx.test", path="/api/v1/ok" + "b" * 200)
+    assert response_key(grpc_call(native_port, req)) == response_key(grpc_call(py_port, req))
+    assert fe.stats()["dfa_overflow"] > before
+
+
+def test_health_and_unimplemented(stack):
+    _, _, native_port, _ = stack
+    hreq = protos.health_pb2.HealthCheckRequest()
+    with grpc.insecure_channel(f"127.0.0.1:{native_port}") as ch:
+        health = ch.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=hreq.SerializeToString,
+            response_deserializer=protos.health_pb2.HealthCheckResponse.FromString,
+        )(hreq, timeout=10)
+        assert health.status == protos.health_pb2.HealthCheckResponse.SERVING
+        with pytest.raises(grpc.RpcError) as err:
+            ch.unary_unary(
+                "/envoy.service.auth.v3.Authorization/Nope",
+                request_serializer=pb.CheckRequest.SerializeToString,
+                response_deserializer=pb.CheckResponse.FromString,
+            )(make_req("fast-eq.test"), timeout=10)
+        assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+
+def test_invalid_request(stack):
+    """CheckRequest without http attributes → INVALID_ARGUMENT CheckResponse
+    (ref pkg/service/auth.go:242-255)."""
+    _, _, native_port, py_port = stack
+    req = pb.CheckRequest()
+    assert response_key(grpc_call(native_port, req)) == response_key(grpc_call(py_port, req))
+
+
+def test_snapshot_swap_retires_old(stack):
+    engine, fe, native_port, _ = stack
+    rule = Pattern("request.headers.x-new", Operator.EQ, "v2")
+    cfg_id = "ns/swapped"
+    pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
+                         evaluator_slot=0)
+    runtime = RuntimeAuthConfig(identity=[IdentityConfig("anon", Noop())],
+                                authorization=[AuthorizationConfig("rules", pm)])
+    old_entries = list(engine._snapshot.by_id.values())
+    engine.apply_snapshot(old_entries + [
+        EngineEntry(id=cfg_id, hosts=["swapped.test"], runtime=runtime,
+                    rules=ConfigRules(name=cfg_id, evaluators=[(None, rule)]))])
+    resp = grpc_call(native_port, make_req("swapped.test", headers={"x-new": "v2"}))
+    assert resp.status.code == 0
+    resp = grpc_call(native_port, make_req("swapped.test", headers={"x-new": "v1"}))
+    assert resp.status.code == 7
+    # old snapshots retire once their batches drain
+    deadline = 50
+    while len(fe._snaps) > 1 and deadline:
+        import time
+
+        time.sleep(0.1)
+        deadline -= 1
+    assert len(fe._snaps) == 1
