@@ -363,6 +363,9 @@ async def translate_auth_config(
                     inline_rego=o.get("rego", ""),
                     external_source=external,
                     all_values=bool(o.get("allValues", False)),
+                    # extension: a static document tree served under data.*
+                    # (the embedded-OPA equivalent of loaded data documents)
+                    data=o.get("data"),
                 )
             except ValueError as e:
                 raise TranslationError(str(e))

@@ -56,10 +56,15 @@ class OPA:
         inline_rego: str = "",
         external_source: Optional[OPAExternalSource] = None,
         all_values: bool = False,
+        data: Optional[dict] = None,
     ):
+        """``data`` is the external document tree served under ``data.*``
+        (the embedded-OPA equivalent of loaded data documents; the module's
+        own package also mounts at data.<package> as a virtual doc)."""
         self.name = name
         self.all_values = all_values
         self.external_source = external_source
+        self.data = data
         self.policy_uid = hashlib.sha256(name.encode()).hexdigest()[:16]
         self._module: Optional[rego.RegoModule] = None
         self._refresher: Optional[Worker] = None
@@ -95,7 +100,7 @@ class OPA:
         if self._module is None:
             raise EvaluationError("opa policy not compiled")
         try:
-            results = self._module.evaluate(pipeline.authorization_json())
+            results = self._module.evaluate(pipeline.authorization_json(), data=self.data)
         except rego.RegoError as e:
             raise EvaluationError(f"failed to evaluate policy: {e}")
         if not results.get("allow"):

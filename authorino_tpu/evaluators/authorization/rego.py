@@ -206,6 +206,16 @@ class SomeDecl:
 
 
 @dataclass
+class WithExpr:
+    """``expr with input.path as term`` — input/data mocking: the wrapped
+    expression (and every rule it references) re-evaluates against the
+    overlaid documents (OPA `with` modifier)."""
+
+    expr: Any
+    mods: List[Tuple[Any, Any]]  # (target Ref/Var rooted at input|data, value term)
+
+
+@dataclass
 class Rule:
     name: str
     value: Any          # term producing the rule value (Const(True) default)
@@ -215,6 +225,22 @@ class Rule:
     # the rule document is the set of head values over ALL satisfying
     # bindings of ALL definitions (OPA sets serialize as arrays)
     is_set: bool = False
+    # `else [= v] { body }` chain: tried in order when the primary body has
+    # no satisfying binding (OPA else blocks — ordered evaluation)
+    else_chain: List[Tuple[Any, List[Any]]] = field(default_factory=list)
+
+
+@dataclass
+class FuncDef:
+    """User-defined function: ``f(x) = y { body }`` / ``f(x) { body }``.
+    Params are Var (bind) or Const (must unify) patterns; multiple
+    definitions are tried in order (OPA functions)."""
+
+    name: str
+    params: List[Any]
+    value: Any
+    body: List[Any]
+    else_chain: List[Tuple[Any, List[Any]]] = field(default_factory=list)
 
 
 @dataclass
@@ -222,11 +248,13 @@ class RegoModule:
     package: str
     rules: Dict[str, List[Rule]]
     defaults: Dict[str, Any]
+    funcs: Dict[str, List[FuncDef]] = field(default_factory=dict)
 
-    def evaluate(self, input_doc: Any) -> Dict[str, Any]:
-        """Evaluate every rule in the package against ``input`` and return
-        the package document (rule name → value)."""
-        ev = _Evaluator(self, input_doc)
+    def evaluate(self, input_doc: Any, data: Any = None) -> Dict[str, Any]:
+        """Evaluate every rule in the package against ``input`` (plus an
+        optional external ``data`` document tree) and return the package
+        document (rule name → value)."""
+        ev = _Evaluator(self, input_doc, data=data)
         out: Dict[str, Any] = {}
         for name in self.rules:
             v = ev.rule_value(name)
@@ -239,6 +267,26 @@ class RegoModule:
 
 
 _UNDEFINED = object()
+
+
+def _overlay(doc: Any, path: List[str], val: Any) -> Any:
+    """Copy-on-write deep-set for `with` document overlays."""
+    if not path:
+        return val
+    out = dict(doc) if isinstance(doc, dict) else {}
+    out[path[0]] = _overlay(out.get(path[0], {}), path[1:], val)
+    return out
+
+
+def _merge_docs(base: Any, over: Any) -> Any:
+    """Deep dict merge, ``over`` winning on conflicts (virtual docs shadow
+    external data, like OPA's base/virtual document layering)."""
+    if isinstance(base, dict) and isinstance(over, dict):
+        out = dict(base)
+        for k, v in over.items():
+            out[k] = _merge_docs(out[k], v) if k in out else v
+        return out
+    return over
 
 
 def _fold_const(term) -> Any:
@@ -330,11 +378,21 @@ class _Parser:
             self.skip_newlines()
         rules: Dict[str, List[Rule]] = {}
         defaults: Dict[str, Any] = {}
+        funcs: Dict[str, List[FuncDef]] = {}
         while self.peek().kind != "eof":
             self.skip_newlines()
             if self.peek().kind == "eof":
                 break
             rule = self._parse_rule()
+            if isinstance(rule, FuncDef):
+                if rule.name in rules or rule.name in defaults:
+                    raise RegoError(
+                        f"rego: {rule.name!r} defined as both rule and function")
+                funcs.setdefault(rule.name, []).append(rule)
+                continue
+            if rule.name in funcs:
+                raise RegoError(
+                    f"rego: {rule.name!r} defined as both rule and function")
             if rule.is_default:
                 defaults[rule.name] = rule.value
             else:
@@ -345,7 +403,7 @@ class _Parser:
                         "(complete vs partial set)"
                     )
                 defs.append(rule)
-        return RegoModule(package=package, rules=rules, defaults=defaults)
+        return RegoModule(package=package, rules=rules, defaults=defaults, funcs=funcs)
 
     def _parse_dotted_name(self) -> str:
         parts = [self.expect("name").value]
@@ -356,12 +414,10 @@ class _Parser:
 
     # ---- rules ----
 
-    def _parse_rule(self) -> Rule:
+    def _parse_rule(self) -> Union[Rule, "FuncDef"]:
         t = self.peek()
         if t.kind == "name" and t.value == "else":
-            # rule-level `else` chains are unsupported; parsing `else` as a
-            # rule named "else" would silently drop the chaining semantics
-            raise RegoError(f"rego: unsupported keyword 'else' at line {t.line}")
+            raise RegoError(f"rego: 'else' without a preceding rule body at line {t.line}")
         if t.kind == "name" and t.value == "default":
             self.next()
             name = self.expect("name").value
@@ -381,16 +437,31 @@ class _Parser:
         value: Any = Const(True)
         body: List[Any] = []
         is_set = False
+        params: Optional[List[Any]] = None
 
         t = self.peek()
+        # function rule head: `name(params)` — params are Var / Const patterns
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            params = []
+            while not (self.peek().kind == "op" and self.peek().value == ")"):
+                p = self._parse_term()
+                if not isinstance(p, (Var, Const)):
+                    raise RegoError(
+                        f"rego: unsupported function parameter pattern at line {t.line}")
+                params.append(p)
+                if self.peek().kind == "op" and self.peek().value == ",":
+                    self.next()
+            self.expect("op", ")")
+            t = self.peek()
         # partial set rules: `name contains term { body }` (v1) and
         # `name[term] { body }` (v0); a bodyless `name[term]` is always-member
-        if t.kind == "name" and t.value == "contains":
+        if params is None and t.kind == "name" and t.value == "contains":
             self.next()
             value = self._parse_term()
             is_set = True
             t = self.peek()
-        elif t.kind == "op" and t.value == "[":
+        elif params is None and t.kind == "op" and t.value == "[":
             self.next()
             value = self._parse_term()
             self.expect("op", "]")
@@ -422,7 +493,51 @@ class _Parser:
         ):
             # bare `name expr`? not supported
             raise RegoError(f"rego parse error at line {t.line}: expected rule body")
-        return Rule(name=name, value=value, body=body, is_set=is_set)
+        else_chain = self._parse_else_chain()
+        if else_chain and is_set:
+            raise RegoError("rego: 'else' is not allowed on partial set rules")
+        if params is not None:
+            return FuncDef(name=name, params=params, value=value, body=body,
+                           else_chain=else_chain)
+        return Rule(name=name, value=value, body=body, is_set=is_set,
+                    else_chain=else_chain)
+
+    def _parse_else_chain(self) -> List[Tuple[Any, List[Any]]]:
+        """``else [= term] [if] { body }`` elements after a rule body; the
+        trailing brace-less ``else := v`` (no body) is an unconditional
+        fallback (OPA else semantics)."""
+        chain: List[Tuple[Any, List[Any]]] = []
+        while True:
+            # `else` must follow the closing brace (same or next lines);
+            # it cannot start a rule, so lookahead across newlines is safe
+            j = 0
+            while self.peek(j).kind == "newline":
+                j += 1
+            t = self.peek(j)
+            if not (t.kind == "name" and t.value == "else"):
+                return chain
+            self.skip_newlines()
+            self.next()  # else
+            value: Any = Const(True)
+            t = self.peek()
+            if t.kind == "op" and t.value in ("=", ":="):
+                self.next()
+                value = self._parse_term()
+                t = self.peek()
+            if t.kind == "name" and t.value == "if":
+                self.next()
+                t = self.peek()
+                if not (t.kind == "op" and t.value == "{"):
+                    chain.append((value, [self._parse_expr()]))
+                    continue
+            if t.kind == "op" and t.value == "{":
+                self.next()
+                body = self._parse_body()
+                self.expect("op", "}")
+                chain.append((value, body))
+            else:
+                chain.append((value, []))  # unconditional fallback
+                return chain
 
     def _parse_body(self, end: str = "}") -> List[Any]:
         exprs: List[Any] = []
@@ -462,7 +577,7 @@ class _Parser:
             self.expect("op", "{")
             body = self._parse_body()
             self.expect("op", "}")
-            return EveryExpr(key=key, val=val, domain=domain, body=body)
+            return self._parse_with(EveryExpr(key=key, val=val, domain=domain, body=body))
         if t.kind == "name" and t.value == "some":
             self.next()
             names = [self.expect("name").value]
@@ -473,28 +588,43 @@ class _Parser:
             if self.peek().kind == "name" and self.peek().value == "in":
                 self.next()
                 haystack = self._parse_term()
-                return InExpr(Var(names[0]), haystack)
+                return self._parse_with(InExpr(Var(names[0]), haystack))
             return SomeDecl(names)
         left = self._parse_term()
         t = self.peek()
         if t.kind == "name" and t.value == "in":
             self.next()
-            return self._reject_with(InExpr(left, self._parse_term()))
+            return self._parse_with(InExpr(left, self._parse_term()))
         if t.kind == "op" and t.value in ("==", "!=", "<", "<=", ">", ">=", "=", ":="):
             op = self.next().value
             right = self._parse_term()
-            return self._reject_with(BinExpr(op, left, right))
-        return self._reject_with(left)
+            return self._parse_with(BinExpr(op, left, right))
+        return self._parse_with(left)
 
-    def _reject_with(self, expr: Any) -> Any:
-        """`with` (input/data mocking) is NOT supported — parsing past it
-        would silently change the policy's meaning (the trailing tokens
-        become separate body expressions).  Fail closed at compile on EVERY
-        expression exit, not just bare terms."""
-        t = self.peek()
-        if t.kind == "name" and t.value == "with":
-            raise RegoError(f"rego: unsupported keyword 'with' at line {t.line}")
-        return expr
+    def _parse_with(self, expr: Any) -> Any:
+        """Postfix ``with <input|data ref> as <term>`` modifiers (may chain);
+        targets outside input/data (builtin mocking) stay rejected — evaluating
+        past them would silently change the policy's meaning."""
+        mods: List[Tuple[Any, Any]] = []
+        while self.peek().kind == "name" and self.peek().value == "with":
+            line = self.next().line
+            target = self._parse_primary()
+            base = target.base if isinstance(target, Ref) else (
+                target.name if isinstance(target, Var) else None)
+            if base not in ("input", "data"):
+                raise RegoError(
+                    f"rego: unsupported 'with' target at line {line} "
+                    "(only input/data paths can be mocked)")
+            if isinstance(target, Ref) and not all(isinstance(s, str) for s in target.path):
+                raise RegoError(
+                    f"rego: 'with' target path must be static at line {line}")
+            a = self.expect("name")
+            if a.value != "as":
+                raise RegoError(f"rego parse error at line {a.line}: expected 'as'")
+            mods.append((target, self._parse_term()))
+        if not mods:
+            return expr
+        return WithExpr(expr, mods)
 
     def _parse_term(self) -> Any:
         # precedence: additive > multiplicative > unary > primary.
@@ -785,11 +915,13 @@ def _builtin(fn: str, args: List[Any]) -> Any:
 
 
 class _Evaluator:
-    def __init__(self, module: RegoModule, input_doc: Any):
+    def __init__(self, module: RegoModule, input_doc: Any, data: Any = None):
         self.module = module
         self.input = input_doc
+        self.data = data if data is not None else {}
         self._cache: Dict[str, Any] = {}
         self._in_progress: set = set()
+        self._func_depth = 0
 
     def rule_value(self, name: str) -> Any:
         if name in self._cache:
@@ -820,11 +952,7 @@ class _Evaluator:
                 self._cache[name] = out
                 return out
             for rule in defs:
-                for bindings in self._eval_body(rule.body, {}):
-                    vals = list(self._term_values(rule.value, bindings))
-                    if vals:
-                        result = vals[0]
-                        break
+                result = self._def_value(rule.value, rule.body, rule.else_chain)
                 if result is not _UNDEFINED:
                     break
             if result is _UNDEFINED and name in self.module.defaults:
@@ -833,6 +961,58 @@ class _Evaluator:
             return result
         finally:
             self._in_progress.discard(name)
+
+    def _def_value(self, value: Any, body: List[Any],
+                   else_chain: List[Tuple[Any, List[Any]]],
+                   bindings: Optional[Dict[str, Any]] = None) -> Any:
+        """One rule/function definition: the primary body's value, else the
+        first else-chain element whose body is satisfiable (OPA: else blocks
+        evaluate strictly in order)."""
+        for val, bd in [(value, body)] + else_chain:
+            for b in self._eval_body(bd, dict(bindings) if bindings else {}):
+                vals = list(self._term_values(val, b))
+                if vals:
+                    return vals[0]
+        return _UNDEFINED
+
+    def call_function(self, name: str, args: List[Any]) -> Any:
+        """User-defined function call: definitions tried in order; Var
+        params bind, Const params must unify (OPA functions).  Undefined
+        when no definition matches."""
+        defs = self.module.funcs.get(name)
+        if defs is None:
+            return _UNDEFINED
+        if self._func_depth > 64:
+            raise RegoError(f"rego: recursion in function {name!r}")
+        self._func_depth += 1
+        try:
+            for fd in defs:
+                if len(fd.params) != len(args):
+                    continue
+                bindings: Dict[str, Any] = {}
+                ok = True
+                for p, a in zip(fd.params, args):
+                    if isinstance(p, Var):
+                        if p.name == "_":
+                            continue
+                        if p.name in bindings:  # repeated param: must unify
+                            if bindings[p.name] != a:
+                                ok = False
+                                break
+                        else:
+                            bindings[p.name] = a
+                    elif isinstance(p, Const):
+                        if p.value != a:
+                            ok = False
+                            break
+                if not ok:
+                    continue
+                v = self._def_value(fd.value, fd.body, fd.else_chain, bindings)
+                if v is not _UNDEFINED:
+                    return v
+            return _UNDEFINED
+        finally:
+            self._func_depth -= 1
 
     # --- body evaluation: yields satisfying binding dicts (existential) ---
 
@@ -847,6 +1027,29 @@ class _Evaluator:
     def _eval_expr(self, expr: Any, bindings: Dict[str, Any]) -> Iterator[Dict[str, Any]]:
         if isinstance(expr, SomeDecl):
             yield bindings  # declaration only
+            return
+        if isinstance(expr, WithExpr):
+            # input/data mocking: overlay the documents and re-evaluate the
+            # wrapped expression in a FRESH evaluator — rules it references
+            # must recompute under the mocked docs (OPA `with` scoping)
+            new_input, new_data = self.input, self.data
+            for target, vterm in expr.mods:
+                val = next(self._term_values(vterm, bindings), _UNDEFINED)
+                if val is _UNDEFINED:
+                    return
+                path = list(target.path) if isinstance(target, Ref) else []
+                base = target.base if isinstance(target, Ref) else target.name
+                if base == "input":
+                    new_input = _overlay(new_input, path, val)
+                else:
+                    new_data = _overlay(new_data, path, val)
+            child = _Evaluator(self.module, new_input, data=new_data)
+            # the recursion guards span the whole with-chain: a cycle
+            # through mocked documents is still a cycle (OPA rejects
+            # recursion statically; we fail closed at eval)
+            child._in_progress = set(self._in_progress)
+            child._func_depth = self._func_depth
+            yield from child._eval_expr(expr.expr, bindings)
             return
         if isinstance(expr, NotExpr):
             # negation as failure: succeeds iff inner has no satisfying binding
@@ -1030,7 +1233,13 @@ class _Evaluator:
             arg_vals = [next(self._term_values(a, bindings), _UNDEFINED) for a in term.args]
             if _UNDEFINED in arg_vals:
                 return
-            result = _builtin(term.fn, arg_vals)
+            local = self._local_func_name(term.fn)
+            if local is not None:
+                result = self.call_function(local, arg_vals)
+                if result is _UNDEFINED:
+                    return  # no definition matched: the call is undefined
+            else:
+                result = _builtin(term.fn, arg_vals)
             if term.path:
                 yield from self._walk_path([result], term.path, bindings)
             else:
@@ -1044,6 +1253,16 @@ class _Evaluator:
         else:
             raise RegoError(f"rego: cannot evaluate term {term!r}")
 
+    def _local_func_name(self, fn: str) -> Optional[str]:
+        """Bare or data-qualified name of a user function, or None for
+        builtins/unknown."""
+        if fn in self.module.funcs:
+            return fn
+        prefix = "data." + self.module.package + "."
+        if fn.startswith(prefix) and fn[len(prefix):] in self.module.funcs:
+            return fn[len(prefix):]
+        return None
+
     def _ref_values(self, ref: Ref, bindings: Dict[str, Any]) -> Iterator[Any]:
         if ref.base == "input":
             roots = [self.input]
@@ -1053,11 +1272,59 @@ class _Evaluator:
             v = self.rule_value(ref.base)
             roots = [] if v is _UNDEFINED else [v]
         elif ref.base == "data":
-            roots = [{}]
+            yield from self._data_values(ref.path, bindings)
+            return
         else:
             raise RegoError(f"rego: unsafe variable {ref.base!r}")
 
         yield from self._walk_path(roots, ref.path, bindings)
+
+    def _package_document(self) -> Dict[str, Any]:
+        doc: Dict[str, Any] = {}
+        for name in self.module.rules:
+            v = self.rule_value(name)
+            if v is not _UNDEFINED:
+                doc[name] = v
+        for name, default in self.module.defaults.items():
+            if name not in doc:
+                doc[name] = _const_value(default)
+        return doc
+
+    def _data_values(self, path: List[Any], bindings: Dict[str, Any]) -> Iterator[Any]:
+        """``data.*`` resolution: the module's own package document mounts
+        at data.<package> (virtual document — rules re-evaluate on demand,
+        and it stays visible from ancestor refs like OPA's nested data
+        tree); everything else walks the external data tree handed to
+        evaluate() (the OPA embedded-library equivalent of compiled packages
+        + loaded data, ref pkg/evaluators/authorization/opa.go:86-141)."""
+        pkg = self.module.package.split(".")
+        n = len(pkg)
+        strs = [s for s in path if isinstance(s, str)]
+        if len(strs) == len(path) and len(path) >= n and path[:n] == pkg:
+            rest = path[n:]
+            if rest:
+                name = rest[0]
+                if name in self.module.rules or name in self.module.defaults:
+                    v = self.rule_value(name)
+                    if v is not _UNDEFINED:
+                        yield from self._walk_path([v], rest[1:], bindings)
+                    return
+            else:
+                yield self._package_document()
+                return
+        elif (len(strs) == len(path) and len(path) < n and pkg[:len(path)] == path):
+            # ancestor of the package path: nest the virtual document under
+            # the remaining package segments, merged over the external tree
+            # (virtual documents win on conflicts, like OPA)
+            doc: Any = self._package_document()
+            for part in reversed(pkg[len(path):]):
+                doc = {part: doc}
+            ext = next(self._walk_path([self.data], list(path), bindings), _UNDEFINED)
+            if isinstance(ext, dict):
+                doc = _merge_docs(ext, doc)
+            yield doc
+            return
+        yield from self._walk_path([self.data], path, bindings)
 
     def _walk_path(self, values: List[Any], path: List[Any],
                    bindings: Dict[str, Any]) -> Iterator[Any]:

@@ -320,15 +320,22 @@ class NativeFrontend:
     # ------------------------------------------------------------------
     def refresh(self) -> None:
         """Rebuild the C++ snapshot from the engine's current one (called
-        after every engine.apply_snapshot — the reconcile-time swap)."""
+        after every engine.apply_snapshot — the reconcile-time swap).
+        Serialized end-to-end under _lock: concurrent reconciles must not
+        mint duplicate ids OR install their C++ snapshots out of order
+        (fe_swap sets the serving snapshot unconditionally — a late older
+        swap would leave a stale corpus serving)."""
+        with self._lock:
+            self._refresh_locked()
+
+    def _refresh_locked(self) -> None:
         engine = self.engine
         snap = engine._snapshot
         policy = snap.policy if snap is not None else None
         mod = self._mod
 
-        with self._lock:  # concurrent reconciles must not mint duplicate ids
-            snap_id = self._next_snap_id
-            self._next_snap_id += 1
+        snap_id = self._next_snap_id
+        self._next_snap_id += 1
 
         spec: Dict[str, Any] = {
             "snap_id": snap_id,
@@ -443,9 +450,8 @@ class NativeFrontend:
         spec["hosts"] = hosts
         spec["has_wildcards"] = 1 if has_wildcards else 0
 
-        with self._lock:
-            self._snaps[snap_id] = rec
-            mod.fe_swap(spec)
+        self._snaps[snap_id] = rec  # caller holds _lock
+        mod.fe_swap(spec)
         log.info("native frontend snapshot %d: %d fast configs, %d hosts%s",
                  snap_id, len(fcs), len(hosts),
                  " (wildcards→slow)" if has_wildcards else "")

@@ -477,6 +477,8 @@ def run_native_mode(args):
 
             from authorino_tpu.ops.pattern_eval import eval_packed_jit
 
+            from authorino_tpu.compiler.pack import _trim_bytes
+
             a = snap_rec.arrays[0]
             pad = min(bucket_pow2(light_total), B)
             has_dfa = snap_rec.params["dfa_tables"] is not None
@@ -487,7 +489,9 @@ def run_native_mode(args):
                     jnp.asarray(a["attrs_val"][:pad]), jnp.asarray(a["members"][:pad]),
                     jnp.asarray(a["cpu_dense"][:pad].view(bool)),
                     jnp.asarray(a["config_id"][:pad]),
-                    jnp.asarray(a["attr_bytes"][:pad]) if has_dfa else None,
+                    # same byte-column trim as the serving dispatch — the RTT
+                    # must time the shape the service actually runs
+                    jnp.asarray(_trim_bytes(a["attr_bytes"][:pad])) if has_dfa else None,
                     jnp.asarray(a["byte_ovf"][:pad].view(bool)) if has_dfa else None,
                 ))
                 rtts.append(time.perf_counter() - t0)

@@ -405,7 +405,6 @@ struct Snapshot {
   std::vector<std::vector<Entry>> slot_entries;
   std::vector<int> slot_count;
   int pending_batches = 0;
-  bool retired_notified = false;
   // global response templates (pb2-built in Python for byte parity with the
   // Python gRPC server)
   std::string invalid_msg, notfound_msg, health_msg;
@@ -1223,14 +1222,19 @@ static void wake_epoll(Server* S) {
 }
 
 // retire check: emit SNAP_RETIRED for non-current snapshots with no pending
-// batches (Python then frees the slot arrays + params). Call under S->mu.
+// batches, and ERASE them from the registry — retired snapshots hold
+// dangling pointers (numpy slots, interner) once Python frees its side, and
+// an append-only map would leak a full corpus copy per reconcile.
+// Call under S->mu.
 static void maybe_retire_locked(Server* S, std::vector<int64_t>& retired) {
-  for (auto& kv : S->snaps) {
-    Snapshot* sn = kv.second.get();
-    if (kv.second != S->cur && sn->pending_batches == 0 && !sn->retired_notified &&
+  for (auto it = S->snaps.begin(); it != S->snaps.end();) {
+    Snapshot* sn = it->second.get();
+    if (it->second != S->cur && sn->pending_batches == 0 &&
         (S->fill_snap == nullptr || S->fill_snap.get() != sn)) {
-      sn->retired_notified = true;
       retired.push_back(sn->id);
+      it = S->snaps.erase(it);
+    } else {
+      ++it;
     }
   }
 }

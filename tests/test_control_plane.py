@@ -120,7 +120,7 @@ class TestTranslate:
                 translate_auth_config(
                     "x",
                     "ns",
-                    {"hosts": ["h"], "authorization": {"z": {"opa": {"rego": "f(x) = 1 { true }"}}}},
+                    {"hosts": ["h"], "authorization": {"z": {"opa": {"rego": "default z = input.y"}}}},
                 )
             )
 
@@ -213,7 +213,7 @@ class TestReconciler:
         async def body():
             engine = PolicyEngine()
             rec = AuthConfigReconciler(engine)
-            bad = resource(spec={"hosts": ["h.example.com"], "authorization": {"z": {"opa": {"rego": "f(x) = 1 { true }"}}}})
+            bad = resource(spec={"hosts": ["h.example.com"], "authorization": {"z": {"opa": {"rego": "default z = input.y"}}}})
             await rec.reconcile_all([bad])
             assert rec.status.get("tenant/ac").reason == STATUS_CACHING_ERROR
             assert not rec.ready()

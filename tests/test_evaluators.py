@@ -390,15 +390,176 @@ class TestRego:
         assert not m.evaluate({"tiers": ["silver"], "banned": False})["allow"]
 
     def test_unsupported_syntax_rejected(self):
-        # user functions, `with` mocking, and rule-level `else` chains are
-        # all outside the subset — and must fail CLOSED at compile, never
-        # be silently misparsed into a policy that means something else
+        # constructs outside the subset fail CLOSED at compile, never get
+        # silently misparsed into a policy that means something else
         with pytest.raises(RegoError):
-            compile_module("f(x) = 1 { true }")
+            compile_module("default x = input.y")  # non-constant default
         with pytest.raises(RegoError):
-            compile_module("allow { input.x with input as {} }")
+            # builtin/function mocking is not supported (only input/data)
+            compile_module("allow { f(1) with f as g }")
         with pytest.raises(RegoError):
-            compile_module('allow { input.x }\nelse = true { input.y }')
+            compile_module("else = true { input.y }")  # dangling else
+
+    def test_else_chain_ordered(self):
+        # OPA: else blocks evaluate strictly in order; the first definition
+        # whose body is satisfied supplies the value
+        m = compile_module(
+            """
+            default access = "none"
+            access = "admin" { input.user == "root" }
+            else = "write" { input.tier == "gold" }
+            else = "read" { input.known }
+            """
+        )
+        assert m.evaluate({"user": "root"})["access"] == "admin"
+        assert m.evaluate({"user": "u", "tier": "gold", "known": True})["access"] == "write"
+        assert m.evaluate({"user": "u", "known": True})["access"] == "read"
+        assert m.evaluate({"user": "u"})["access"] == "none"
+
+    def test_else_bare_value_and_v1_if(self):
+        # bare `else { body }` values true; `else := v if cond` (v1 sugar)
+        # and a trailing unconditional `else := v` fallback
+        m = compile_module(
+            """
+            allow { input.x == 1 }
+            else { input.y == 2 }
+            level := 3 if input.n > 10
+            else := 2 if input.n > 5
+            else := 1
+            """
+        )
+        assert m.evaluate({"x": 1})["allow"] is True
+        assert m.evaluate({"y": 2})["allow"] is True
+        assert m.evaluate({}).get("allow") is None  # undefined, no default
+        assert m.evaluate({"n": 20})["level"] == 3
+        assert m.evaluate({"n": 7})["level"] == 2
+        assert m.evaluate({"n": 1})["level"] == 1
+
+    def test_else_rejected_on_partial_set(self):
+        with pytest.raises(RegoError):
+            compile_module('s contains "a" { input.x }\nelse = true { input.y }')
+
+    def test_user_functions(self):
+        # OPA functions: computed head values, multiple definitions tried in
+        # order, Const params unify, undefined when no definition matches
+        m = compile_module(
+            """
+            default allow = false
+            double(x) = 2 * x
+            ext(name) = out { out := trim_suffix(name, ".json") }
+            classify(1) = "one"
+            classify(x) = "many" { x > 1 }
+            bool_fn(x) { x > 10 }
+            allow { double(input.n) == 6 }
+            kind := classify(input.n)
+            big { bool_fn(input.n) }
+            stripped := ext("a.json")
+            """
+        )
+        out = m.evaluate({"n": 3})
+        assert out["allow"] and out["kind"] == "many" and out["stripped"] == "a"
+        assert "big" not in out
+        assert m.evaluate({"n": 1})["kind"] == "one"
+        assert m.evaluate({"n": 11})["big"] is True
+        # no classify() definition matches 0 ("many" needs x > 1) → the
+        # call is undefined and the rule that uses it drops out
+        assert "kind" not in m.evaluate({"n": 0})
+
+    def test_user_function_else_and_recursion_guard(self):
+        m = compile_module(
+            """
+            f(x) = "big" { x > 10 } else = "small" { x > 0 } else = "neg"
+            v := f(input.n)
+            """
+        )
+        assert m.evaluate({"n": 11})["v"] == "big"
+        assert m.evaluate({"n": 3})["v"] == "small"
+        assert m.evaluate({"n": -1})["v"] == "neg"
+        rec = compile_module("f(x) = f(x) { true }\nv := f(1)")
+        with pytest.raises(RegoError):
+            rec.evaluate({})
+
+    def test_data_documents(self):
+        # external data tree under data.*, and the module's own package
+        # mounted at data.<package> as a virtual document
+        m = compile_module(
+            """
+            package acl
+            default allow = false
+            helper { input.x == 1 }
+            allow { input.user == data.admins[_] }
+            allow { data.acl.helper }
+            via_pkg := data.acl.limits.max
+            """,
+            package="acl",
+        )
+        assert m.evaluate({"user": "alice"}, data={"admins": ["alice", "bob"]})["allow"]
+        assert not m.evaluate({"user": "eve"}, data={"admins": ["alice"]})["allow"]
+        assert m.evaluate({"x": 1})["allow"]          # virtual self-reference
+        # data falls back to the external tree under non-rule names
+        out = m.evaluate({}, data={"acl": {"limits": {"max": 9}}})
+        assert out["via_pkg"] == 9
+        # a rule reading its own whole package document is recursive —
+        # OPA raises rego_recursion_error, we match (fail closed)
+        m2 = compile_module("package p\na := 1\nwhole := data.p", package="p")
+        with pytest.raises(RegoError):
+            m2.evaluate({})
+
+    def test_with_recursion_fails_closed(self):
+        # a cycle routed through `with` is still a cycle: the guard spans
+        # the whole with-chain (OPA rejects recursion statically)
+        m = compile_module('p { q with input.x as 1 }\nq { p }')
+        with pytest.raises(RegoError, match="recursive"):
+            m.evaluate({})
+
+    def test_repeated_function_params_unify(self):
+        # OPA: f(x, x) matches only when both arguments are equal
+        m = compile_module("f(x, x) = x { true }\nr := f(input.a, input.b)")
+        assert m.evaluate({"a": 2, "b": 2})["r"] == 2
+        assert "r" not in m.evaluate({"a": 1, "b": 2})
+
+    def test_with_on_some_in_and_every(self):
+        m = compile_module(
+            """
+            default a = false
+            default b = false
+            a { some x in input.xs; x == 9 with input.y as 1 }
+            b { every x in input.xs { x > input.min } with input.min as 0 }
+            """
+        )
+        assert m.evaluate({"xs": [9]})["a"] is True
+        assert m.evaluate({"xs": [1, 2], "min": 5})["b"] is True  # mocked min
+        assert not m.evaluate({"xs": [0], "min": 5})["b"]
+
+    def test_data_ancestor_prefix(self):
+        # referencing an ancestor of your own package pulls in the whole
+        # package document — including the referencing rule, which is a
+        # dependency cycle: OPA raises rego_recursion_error, we fail closed
+        m = compile_module("package a.b\nallow = true\nr := data.a", package="a.b")
+        with pytest.raises(RegoError, match="recursive"):
+            m.evaluate({}, data={"a": {"ext": 7}})
+        # non-package data paths keep walking the external tree
+        m2 = compile_module("package a.b\nr := data.other.k", package="a.b")
+        assert m2.evaluate({}, data={"other": {"k": 5}})["r"] == 5
+
+    def test_with_mocking(self):
+        # `with` overlays input/data for the wrapped expression AND the
+        # rules it references (OPA with modifier scoping)
+        m = compile_module(
+            """
+            default allow = false
+            inner { input.role == "admin" }
+            allow { inner with input.role as "admin" }
+            both { inner with input.role as input.alt }
+            listed { input.user in data.users }
+            mocked_data { listed with data.users as ["bob"] with input.user as "bob" }
+            """
+        )
+        out = m.evaluate({"role": "user"})
+        assert out["allow"] is True          # inner sees the mocked role
+        assert "both" not in out             # alt missing → mock value undefined
+        assert m.evaluate({"role": "u", "alt": "admin"})["both"] is True
+        assert m.evaluate({"user": "eve"}, data={"users": []})["mocked_data"] is True
 
 
 class TestRegoBuiltinsExtra:
@@ -540,16 +701,18 @@ class TestRegoBuiltinsExtra:
                 'x contains v { v := input.a }\nx { input.b }'
             )
 
-    def test_with_rejected_after_comparison_and_assignment(self):
+    def test_with_parses_on_every_expression_form(self):
+        # `with` is a postfix modifier on comparisons, assignments, and
+        # bare terms alike — all three shapes must overlay, not misparse
         from authorino_tpu.evaluators.authorization import rego
 
-        for src in [
-            "allow { input.x == 1 with input as {} }",
-            "allow { x := input.y with input as {} }",
-            "allow { input.x with input as {} }",
+        for src, want in [
+            ("allow { input.x == 1 with input as {\"x\": 1} }", True),
+            ("allow { x := input.y with input.y as 3; x == 3 }", True),
+            ("allow { input.x with input as {\"x\": true} }", True),
         ]:
-            with pytest.raises(rego.RegoError, match="with"):
-                rego.compile_module("default allow = false\n" + src)
+            m = rego.compile_module("default allow = false\n" + src)
+            assert m.evaluate({})["allow"] is want, src
 
     def test_object_comprehension_key_conflict_denies(self):
         from authorino_tpu.evaluators.authorization import rego
@@ -602,7 +765,17 @@ class TestOPAEvaluator:
 
     def test_invalid_rego_rejected_at_compile(self):
         with pytest.raises(ValueError, match="invalid rego"):
-            OPA("policy", inline_rego="f(x) = 1 { true }")
+            OPA("policy", inline_rego="default x = input.y")
+
+    def test_opa_data_documents(self):
+        opa = OPA("policy",
+                  inline_rego='allow { input.auth.identity.sub == data.admins[_] }',
+                  data={"admins": ["u1"]})
+        p = make_pipeline(identity={"sub": "u1"})
+        assert run(opa.call(p)) is True
+        p2 = make_pipeline(identity={"sub": "u2"})
+        with pytest.raises(EvaluationError, match="Unauthorized"):
+            run(opa.call(p2))
 
 
 class TestWristband:
