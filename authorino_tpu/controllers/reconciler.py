@@ -126,6 +126,20 @@ class AuthConfigReconciler:
             await self.delete(id_)
             return
         async with self._lock:
+            old = self._resources.get(id_)
+            rv = meta.get("resourceVersion")
+            report = self.status.get(id_)
+            if old is not None and rv and (
+                (old.get("metadata") or {}).get("resourceVersion") == rv
+            ) and report is not None and report.reason != STATUS_CACHING_ERROR:
+                # same resourceVersion: a resync replay, not a change — do
+                # not re-translate the world (informer-style dedup; watch
+                # re-lists after stream drops re-deliver every object).
+                # CachingError configs are exempt: their translate failure
+                # may be transient (Secret read, discovery) and resyncs are
+                # the retry mechanism.
+                self._resources[id_] = resource
+                return
             self._resources[id_] = resource
             self.status.set(id_, STATUS_RECONCILING)
             await self._rebuild()
@@ -137,10 +151,45 @@ class AuthConfigReconciler:
                 self.status.clear(id_)
                 await self._rebuild()
 
+    @staticmethod
+    def _rv_map(resources: Dict[str, dict]) -> Optional[Dict[str, str]]:
+        """id → resourceVersion, or None when any object lacks one (then
+        change detection is impossible and a rebuild is forced)."""
+        out: Dict[str, str] = {}
+        for id_, r in resources.items():
+            rv = (r.get("metadata") or {}).get("resourceVersion")
+            if not rv:
+                return None
+            out[id_] = rv
+        return out
+
     async def reconcile_all(self, resources: List[dict]) -> None:
         """Cold-start path: index deny-all for every host first (bootstrap
         safety, ref :638-693), then translate for real."""
         async with self._lock:
+            if self._bootstrapped:
+                new_map = {}
+                for r in resources:
+                    if not self.watched(r):
+                        continue
+                    meta = r.get("metadata") or {}
+                    new_map[f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"] = r
+                new_rvs = self._rv_map(new_map)
+                healthy = all(
+                    (r := self.status.get(id_)) is not None
+                    and r.reason != STATUS_CACHING_ERROR
+                    for id_ in new_map
+                )
+                if (healthy and new_rvs is not None
+                        and new_rvs == self._rv_map(self._resources)):
+                    # re-list after a watch drop delivered the exact state we
+                    # already serve, and nothing is in a (possibly transient)
+                    # translate-error state: skip the corpus rebuild (no
+                    # duplicate reconcile), keep the refreshed dicts.
+                    # CachingError configs force the rebuild — resyncs are
+                    # their retry path and /readyz stays 503 until they heal.
+                    self._resources = new_map
+                    return
             self._resources = {}
             deny_entries: List[EngineEntry] = []
             stale_ids = set(self.status.all())

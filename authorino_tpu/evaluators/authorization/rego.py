@@ -1310,7 +1310,14 @@ class _Evaluator:
                         yield from self._walk_path([v], rest[1:], bindings)
                     return
             else:
-                yield self._package_document()
+                # exact data.<package>: virtual doc layered over the
+                # external tree at the same path (same rule as ancestors)
+                doc = self._package_document()
+                ext = next(self._walk_path([self.data], list(path), bindings),
+                           _UNDEFINED)
+                if isinstance(ext, dict):
+                    doc = _merge_docs(ext, doc)
+                yield doc
                 return
         elif (len(strs) == len(path) and len(path) < n and pkg[:len(path)] == path):
             # ancestor of the package path: nest the virtual document under

@@ -29,7 +29,28 @@ from ..compiler.encode import EncodedBatch, encode_batch
 from ..compiler.pack import DeviceBatch, pack_batch
 from ..ops.pattern_eval import _eval_jit, forward, to_device
 
-__all__ = ["PolicyModel", "host_results"]
+__all__ = ["PolicyModel", "host_results", "apply_host_fallback"]
+
+
+def apply_host_fallback(decide, fb, own_rule, own_skipped, cap) -> None:
+    """Shared fallback policy for BOTH serving paths (single-corpus engine +
+    mesh ShardedPolicyModel — TestServingPathBitParity holds them identical):
+    re-decide up to ``cap`` membership-overflow rows via ``decide(r) ->
+    (rule_row, skipped_row)``; rows beyond the cap are denied fail-closed.
+    Meters auth_server_host_fallback_{total,shed_total}."""
+    from ..utils import metrics as metrics_mod
+
+    decided = fb if cap is None else fb[:cap]
+    shed = fb[len(decided):]
+    for r in decided:
+        own_rule[r], own_skipped[r] = decide(int(r))
+    for r in shed:
+        own_rule[r] = False
+        own_skipped[r] = False
+    if len(fb):
+        metrics_mod.host_fallback_total.inc(len(decided))
+        if len(shed):
+            metrics_mod.host_fallback_shed_total.inc(len(shed))
 
 
 def host_results(

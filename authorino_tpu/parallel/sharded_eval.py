@@ -286,20 +286,27 @@ class ShardedPolicyModel:
         return own, own_rule, own_skipped
 
     def run_full(
-        self, docs: Sequence[Any], config_names: Sequence[str], batch_pad: int = 0
+        self, docs: Sequence[Any], config_names: Sequence[str], batch_pad: int = 0,
+        max_fallback: Optional[int] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Serving entry (PolicyEngine._run_batch contract): per-request
         per-evaluator (rule_results [B, E], skipped [B, E]), with requests
-        the compact encoding cannot represent re-decided on host."""
-        from ..models.policy_model import host_results
+        the compact encoding cannot represent re-decided on host — at most
+        ``max_fallback`` of them per batch (beyond the cap: fail-closed
+        deny + auth_server_host_fallback_shed_total)."""
+        from ..models.policy_model import apply_host_fallback, host_results
 
         enc = self.encode(docs, config_names, batch_pad=batch_pad)
         _, own_rule, own_skipped = self.apply_full(enc)
-        for r in np.nonzero(enc.host_fallback[: len(docs)])[0]:
+
+        def decide(r: int):
             shard, row = self.locator[config_names[r]]
-            _, own_rule[r], own_skipped[r] = host_results(
-                self.shards[shard], docs[r], int(row)
-            )
+            return host_results(self.shards[shard], docs[r], int(row))[1:]
+
+        apply_host_fallback(
+            decide, np.nonzero(enc.host_fallback[: len(docs)])[0],
+            own_rule, own_skipped, max_fallback,
+        )
         return own_rule, own_skipped
 
     def decide(self, docs: Sequence[Any], config_names: Sequence[str]) -> List[bool]:

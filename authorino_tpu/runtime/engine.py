@@ -83,16 +83,25 @@ class PolicyEngine:
         timeout_s: Optional[float] = None,
         members_k: int = 16,
         mesh: Any = "auto",
+        max_fallback_per_batch: Optional[int] = None,
     ):
         """``mesh="auto"`` shards the rule corpus over all visible devices
         when more than one is present (dp × mp ShardedPolicyModel);
         ``mesh=None`` forces the single-corpus path; an explicit
-        ``jax.sharding.Mesh`` pins the layout."""
+        ``jax.sharding.Mesh`` pins the layout.
+
+        ``max_fallback_per_batch`` bounds the per-batch host-oracle work for
+        membership-overflow requests (an overload valve: beyond the cap,
+        fallback requests are DENIED fail-closed and counted in
+        auth_server_host_fallback_shed_total).  None = unbounded — safe by
+        default, since the compiled-closure oracle costs ~2µs/request,
+        cheaper than the reference's normal per-request path."""
         self.index: HostIndex[EngineEntry] = HostIndex()
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
         self.timeout_s = timeout_s
         self.members_k = members_k
+        self.max_fallback_per_batch = max_fallback_per_batch
         self._mesh = mesh
         self._snapshot: Optional[_Snapshot] = None
         self._swap_lock = threading.Lock()
@@ -225,6 +234,7 @@ class PolicyEngine:
                 [p.doc for p in batch],
                 [p.config_name for p in batch],
                 batch_pad=_bucket(len(batch)),
+                max_fallback=self.max_fallback_per_batch,
             )
         from ..compiler.pack import pack_batch
         from ..models.policy_model import host_results
@@ -250,11 +260,15 @@ class PolicyEngine:
         own_skipped = packed[:, 1 + E:1 + 2 * E].copy()
         if db.host_fallback.any():
             # compact payload was lossy for these rows (membership overflow):
-            # exact re-decision on host via the expression oracle
-            for r in np.nonzero(db.host_fallback[: len(batch)])[0]:
-                _, own_rule[r], own_skipped[r] = host_results(
-                    policy, batch[r].doc, rows[r]
-                )
+            # exact re-decision on host via the expression oracle, bounded
+            # by the fallback cap (beyond it: deny fail-closed + counter)
+            from ..models.policy_model import apply_host_fallback, host_results
+
+            apply_host_fallback(
+                lambda r: host_results(policy, batch[r].doc, rows[r])[1:],
+                np.nonzero(db.host_fallback[: len(batch)])[0],
+                own_rule, own_skipped, self.max_fallback_per_batch,
+            )
         return own_rule, own_skipped
 
 

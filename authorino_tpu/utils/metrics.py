@@ -100,3 +100,15 @@ response_status = _counter(
     "Status of HTTP response sent by the auth server.",
     ("status",),
 )
+host_fallback_total = _counter(
+    "auth_server_host_fallback_total",
+    "Requests re-decided by the host expression oracle because the compact "
+    "device payload was lossy for them (membership overflow past members_k).",
+    (),
+)
+host_fallback_shed_total = _counter(
+    "auth_server_host_fallback_shed_total",
+    "Fallback requests denied (fail closed) because the per-batch host "
+    "fallback cap was exceeded.",
+    (),
+)

@@ -335,3 +335,153 @@ class TestYamlSource:
             assert (await engine.check(req)).success()
 
         run(body())
+
+
+class AdversarialCluster:
+    """Scripted fake API server for K8sWatchSource: serves pre-planned
+    lists and watch streams that inject 410 Gone mid-watch, raw connection
+    drops, and re-lists replaying unchanged state — the failure modes a
+    real apiserver exhibits (envtest-style adversarial soak)."""
+
+    def __init__(self, lists, watches, swap_counter):
+        self.lists = list(lists)          # [(items, rv)]
+        self.watches = list(watches)      # [[("yield", type, obj)|("raise",)]]
+        self.swap_counter = swap_counter
+        self.list_params = []
+        self.watch_params = []
+        self.swaps_at_last_list = None
+        self.done = asyncio.Event()       # set when the last watch parks
+
+    def _ac_path(self, namespace=None, name=None):
+        return "/apis/authorino.kuadrant.io/v1beta1/authconfigs"
+
+    async def list_auth_configs_rv(self, selector):
+        self.list_params.append(selector)
+        items, rv = self.lists.pop(0) if self.lists else self.lists_last
+        self.lists_last = (items, rv)
+        if not self.lists:
+            # capture the swap count as the FINAL list is served: the
+            # unchanged re-list must not trigger another corpus swap
+            self.swaps_at_last_list = self.swap_counter[0]
+        return list(items), rv
+
+    async def watch(self, path, params=None):
+        self.watch_params.append(dict(params or {}))
+        if not self.watches:
+            self.done.set()
+            await asyncio.Event().wait()  # park forever
+        script = self.watches.pop(0)
+        for action in script:
+            if action[0] == "yield":
+                yield action[1], action[2]
+            elif action[0] == "raise":
+                raise RuntimeError("connection reset by peer")
+
+
+def v1_ac(name, rv, hosts):
+    return {
+        "apiVersion": "authorino.kuadrant.io/v1beta1",
+        "kind": "AuthConfig",
+        "metadata": {"namespace": "t", "name": name, "resourceVersion": rv},
+        "spec": {"hosts": hosts},
+    }
+
+
+class TestAdversarialWatch:
+    def test_gone_drops_and_stale_relists(self):
+        from authorino_tpu.controllers.sources import K8sWatchSource
+
+        async def body():
+            engine = PolicyEngine()
+            swaps = [0]
+            engine.add_swap_listener(lambda: swaps.__setitem__(0, swaps[0] + 1))
+            rec = AuthConfigReconciler(engine)
+
+            a1 = v1_ac("a", "1", ["a.test"])
+            a2 = v1_ac("a", "13", ["a2.test"])   # modified during outage 2
+            b = v1_ac("b", "2", ["b.test"])
+            c = v1_ac("c", "11", ["c.test"])
+            lists = [
+                ([a1, b], "10"),                  # L1: initial
+                ([a1, c], "12"),                  # L2: B deleted while down
+                ([a2, c], "14"),                  # L3: identical to live state
+            ]
+            watches = [
+                # W1: new object arrives, then the server ends the resume
+                # point with a 410 Gone ERROR status
+                [("yield", "ADDED", c),
+                 ("yield", "ERROR", {"kind": "Status", "code": 410})],
+                # W2: a modification lands, then the stream drops raw
+                [("yield", "MODIFIED", a2), ("raise",)],
+                # W3+: park (scripted by the cluster itself)
+            ]
+            cluster = AdversarialCluster(lists, watches, swaps)
+            src = K8sWatchSource(cluster, rec, resync_interval_s=0.01)
+            src.start()
+            await asyncio.wait_for(cluster.done.wait(), timeout=10)
+            await asyncio.sleep(0.1)  # let the final (no-op) re-list settle
+
+            # no missed delete: B disappeared during the first outage
+            assert engine.lookup("b.test") is None
+            assert rec.status.get("t/b") is None
+            # modification during the second outage is live
+            assert engine.lookup("a2.test") is not None
+            assert engine.lookup("a.test") is None
+            assert engine.lookup("c.test") is not None
+            # no duplicate reconcile: the unchanged re-list (L3) caused no
+            # further corpus swap
+            assert cluster.swaps_at_last_list is not None
+            assert swaps[0] == cluster.swaps_at_last_list
+            # resume-point continuity across failures: watch #1 resumes from
+            # the initial list, #2 from the post-410 re-list, #3 from the
+            # last delivered event / final list
+            rvs = [p.get("resourceVersion") for p in cluster.watch_params[:3]]
+            assert rvs == ["10", "12", "14"], rvs
+            # readiness: every surviving config reconciled
+            assert rec.ready()
+            assert rec.status.get("t/a").reason == STATUS_RECONCILED
+            assert rec.status.get("t/c").reason == STATUS_RECONCILED
+            await src.stop()
+
+        run(body())
+
+
+class TestResyncDedupRetry:
+    def test_caching_error_retried_on_identical_relist(self, monkeypatch):
+        """The resourceVersion dedup must NOT swallow retries of configs in
+        CachingError: resyncs are their self-heal path (a transient Secret/
+        discovery failure would otherwise wedge /readyz at 503 forever)."""
+        from authorino_tpu.controllers import reconciler as rec_mod
+
+        async def body():
+            engine = PolicyEngine()
+            rec = AuthConfigReconciler(engine)
+            calls = {"n": 0}
+            real = rec_mod.translate_auth_config
+
+            async def flaky(*a, **k):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise TranslationError("transient backend failure")
+                return await real(*a, **k)
+
+            monkeypatch.setattr(rec_mod, "translate_auth_config", flaky)
+            cr = {
+                "apiVersion": "authorino.kuadrant.io/v1beta2",
+                "kind": "AuthConfig",
+                "metadata": {"namespace": "t", "name": "x", "resourceVersion": "5"},
+                "spec": {"hosts": ["x.test"]},
+            }
+            await rec.reconcile_all([cr])
+            assert rec.status.get("t/x").reason == STATUS_CACHING_ERROR
+            # identical re-list (same resourceVersion): must retry, not skip
+            await rec.reconcile_all([dict(cr)])
+            assert rec.status.get("t/x").reason == STATUS_RECONCILED
+            assert rec.ready()
+            # now healthy + unchanged: the next identical re-list skips
+            swaps = [0]
+            engine.add_swap_listener(lambda: swaps.__setitem__(0, swaps[0] + 1))
+            await rec.reconcile_all([dict(cr)])
+            assert swaps[0] == 0
+
+        run(body())

@@ -806,3 +806,17 @@ class TestWristband:
         assert len(claims["sub"]) == 64  # sha256 hex
         cfg = json.loads(wb.openid_config())
         assert cfg["jwks_uri"].endswith("/openid-connect/certs")
+
+
+class TestRegoDataLayering:
+    def test_exact_package_ref_merges_external_tree(self):
+        # data.<package> exactly: virtual doc layered over the external
+        # subtree at the same path, consistent with leaf/ancestor refs
+        # (only reachable without recursion from outside a rule body, so
+        # exercised at the resolver level)
+        from authorino_tpu.evaluators.authorization import rego
+
+        m = compile_module("package p\na := 1", package="p")
+        ev = rego._Evaluator(m, {}, data={"p": {"ext": 7, "a": 99}})
+        vals = list(ev._data_values(["p"], {}))
+        assert vals == [{"ext": 7, "a": 1}]  # virtual wins on conflict

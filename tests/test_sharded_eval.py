@@ -195,3 +195,91 @@ def test_engine_serves_from_sharded_snapshot():
     got = [bool(rule[0]) for rule, _ in results]
     expected = [bool(exprs[n].matches(d)) for d, n in zip(docs, names)]
     assert got == expected == [True, True, False, True]
+
+
+class TestServingPathBitParity:
+    """VERDICT sweep: the mesh serving path and the single-corpus serving
+    path must produce IDENTICAL per-evaluator (rule, skipped) bits on a
+    corpus that exercises all three lanes — device-DFA regex rows (incl.
+    byte-tensor overflow), membership overflow (host-fallback lane), and
+    compiled evaluator conditions — across dp=1,2,4 mesh shapes."""
+
+    K = 4  # small members_k so overflow is easy to trigger
+
+    def corpus(self):
+        from authorino_tpu.expressions import All, Any_, Operator, Pattern
+
+        rx = Pattern("request.url_path", Operator.MATCHES, r"^/api/v[0-9]+/ok")
+        cond = Pattern("request.method", Operator.EQ, "GET")
+        gated = Pattern("request.path", Operator.EQ, "/gated")
+        mem = All(Pattern("auth.identity.roles", Operator.INCL, "admin"),
+                  Pattern("auth.identity.groups", Operator.EXCL, "banned"))
+        mix = Any_(rx, Pattern("auth.identity.roles", Operator.INCL, "root"))
+        return {
+            "cfg-rx": ConfigRules(name="cfg-rx", evaluators=[(None, rx), (cond, gated)]),
+            "cfg-mem": ConfigRules(name="cfg-mem", evaluators=[(None, mem)]),
+            "cfg-mix": ConfigRules(name="cfg-mix", evaluators=[(cond, mix)]),
+        }
+
+    def docs(self):
+        long_ok = "/api/v3/ok" + "x" * 120     # > DFA_VALUE_BYTES → byte overflow
+        long_no = "/nope/" + "y" * 120
+        many = [f"r{k}" for k in range(9)]     # > members_k → host fallback
+        return [
+            ({"request": {"url_path": "/api/v1/ok", "method": "GET", "path": "/gated"},
+              "auth": {"identity": {}}}, "cfg-rx"),
+            ({"request": {"url_path": "/api/x", "method": "POST", "path": "/other"},
+              "auth": {"identity": {}}}, "cfg-rx"),
+            ({"request": {"url_path": long_ok, "method": "GET", "path": "/other"},
+              "auth": {"identity": {}}}, "cfg-rx"),
+            ({"request": {"url_path": long_no, "method": "POST", "path": "/gated"},
+              "auth": {"identity": {}}}, "cfg-rx"),
+            ({"request": {}, "auth": {"identity": {"roles": many + ["admin"], "groups": []}}},
+             "cfg-mem"),
+            ({"request": {}, "auth": {"identity": {"roles": many, "groups": ["banned"]}}},
+             "cfg-mem"),
+            ({"request": {}, "auth": {"identity": {"roles": ["admin"], "groups": []}}},
+             "cfg-mem"),
+            ({"request": {"url_path": "/api/v9/ok", "method": "GET"},
+              "auth": {"identity": {"roles": many}}}, "cfg-mix"),
+            ({"request": {"url_path": "/zzz", "method": "POST"},
+              "auth": {"identity": {"roles": many + ["root"]}}}, "cfg-mix"),
+        ]
+
+    @pytest.mark.parametrize("dp", [1, 2, 4])
+    def test_bit_parity(self, dp):
+        import asyncio
+
+        from authorino_tpu.runtime import EngineEntry, PolicyEngine
+
+        corpus = self.corpus()
+
+        def engine_for(mesh):
+            e = PolicyEngine(max_batch=16, max_delay_s=0.0005, members_k=self.K,
+                             mesh=mesh)
+            e.apply_snapshot([EngineEntry(id=n, hosts=[n], runtime=None, rules=c)
+                              for n, c in corpus.items()])
+            return e
+
+        single = engine_for(None)
+        sharded = engine_for(build_mesh(n_devices=8, dp=dp))
+        assert sharded._snapshot.sharded is not None  # really on the mesh
+        assert single._snapshot.policy is not None
+
+        async def collect(engine):
+            outs = await asyncio.gather(
+                *(engine.submit(doc, name) for doc, name in self.docs()))
+            return [(tuple(map(bool, r)), tuple(map(bool, s))) for r, s in outs]
+
+        got_sharded = asyncio.run(collect(sharded))
+        got_single = asyncio.run(collect(single))
+        assert got_sharded == got_single
+
+        # both agree with the expression oracle per evaluator slot
+        for (doc, name), (rule_bits, skip_bits) in zip(self.docs(), got_single):
+            evs = corpus[name].evaluators
+            for e, (cond, rule) in enumerate(evs):
+                want_skip = cond is not None and not cond.matches(doc)
+                assert skip_bits[e] == want_skip, (name, e)
+                if not want_skip:
+                    assert rule_bits[e] == rule.matches(doc), (name, e)
