@@ -53,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--max-http-request-body-size", type=int, default=env_var("MAX_HTTP_REQUEST_BODY_SIZE", 1024 * 1024))
     s.add_argument("--batch-size", type=int, default=env_var("BATCH_SIZE", 256), help="Max micro-batch size for TPU dispatch")
     s.add_argument("--batch-window-us", type=int, default=env_var("BATCH_WINDOW_US", 500), help="Micro-batch window in microseconds")
+    s.add_argument("--native-frontend", choices=["auto", "on", "off"],
+                   default=env_var("NATIVE_FRONTEND", "auto"),
+                   help="Serve the ext_authz gRPC port from the C++ device-owner "
+                        "frontend (native/frontend.cpp): 'auto' uses it when the "
+                        "native library loads and TLS is not requested; 'on' "
+                        "requires it; 'off' uses the Python grpc.aio server")
     s.add_argument("--evaluator-cache-size", type=int, default=env_var("EVALUATOR_CACHE_SIZE", 4096))
     s.add_argument("--deep-metrics-enabled", action="store_true", default=env_var("DEEP_METRICS_ENABLED", False))
     s.add_argument("--auth-config-label-selector", default=env_var("AUTH_CONFIG_LABEL_SELECTOR", ""))
@@ -234,13 +240,52 @@ async def run_server(args) -> None:
     await web.TCPSite(oidc_runner, "0.0.0.0", args.oidc_http_port, ssl_context=oidc_ssl).start()
     log.info("oidc discovery listening on :%d (tls=%s)", args.oidc_http_port, bool(oidc_ssl))
 
-    # gRPC ext_authz
-    grpc_server = build_server(
-        engine, address=f"0.0.0.0:{args.ext_auth_grpc_port}",
-        tls_credentials=tls_credentials,
-    )
-    await grpc_server.start()
-    log.info("grpc ext_authz listening on :%d (tls=%s)", args.ext_auth_grpc_port, bool(tls_credentials))
+    # gRPC ext_authz: the C++ device-owner frontend when possible (fast-lane
+    # configs never touch Python per request; everything else rides the
+    # asyncio pipeline via its slow queue), else the Python grpc.aio server.
+    # The frontend has no TLS termination — TLS forces the Python server
+    # (or a TLS-terminating proxy in front of the native listener).
+    grpc_server = None
+    native_fe = None
+    native_mode = str(getattr(args, "native_frontend", "off")).lower()
+    if native_mode not in ("auto", "on", "off"):
+        # argparse validates choices only for CLI tokens, not env defaults —
+        # a NATIVE_FRONTEND typo must not silently serve the slow path
+        raise RuntimeError(f"invalid --native-frontend/NATIVE_FRONTEND value "
+                           f"{native_mode!r} (want auto|on|off)")
+    if native_mode in ("auto", "on") and tls_credentials is None:
+        try:
+            from .runtime.native_frontend import NativeFrontend
+
+            native_fe = NativeFrontend(
+                engine, port=args.ext_auth_grpc_port,
+                max_batch=max(args.batch_size, 64),
+                window_us=args.batch_window_us, bind_all=True,
+            )
+            native_fe.start()
+            log.info("native grpc ext_authz listening on :%d", args.ext_auth_grpc_port)
+        except Exception as e:
+            if native_fe is not None:
+                # start() may fail after the C++ socket bound — release the
+                # port or the grpc.aio fallback below cannot bind it
+                try:
+                    native_fe.stop()
+                except Exception:
+                    pass
+            native_fe = None
+            if native_mode == "on":
+                raise
+            log.warning("native frontend unavailable (%s); using grpc.aio", e)
+    elif native_mode == "on" and tls_credentials is not None:
+        raise RuntimeError("--native-frontend=on is incompatible with --tls-cert "
+                           "(terminate TLS in front of the native listener)")
+    if native_fe is None:
+        grpc_server = build_server(
+            engine, address=f"0.0.0.0:{args.ext_auth_grpc_port}",
+            tls_credentials=tls_credentials,
+        )
+        await grpc_server.start()
+        log.info("grpc ext_authz listening on :%d (tls=%s)", args.ext_auth_grpc_port, bool(tls_credentials))
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -255,7 +300,10 @@ async def run_server(args) -> None:
         await status_updater.stop()
     if source is not None:
         await source.stop()
-    await grpc_server.stop(2)
+    if native_fe is not None:
+        await asyncio.get_running_loop().run_in_executor(None, native_fe.stop)
+    if grpc_server is not None:
+        await grpc_server.stop(2)
     await runner.cleanup()
     await oidc_runner.cleanup()
 

@@ -266,7 +266,16 @@ class AuthConfigReconciler:
         # controllers/auth_config_controller.go:88-104); compile + device
         # upload run off the serving loop
         old_entries = self.engine.index.list()
-        await asyncio.to_thread(self.engine.apply_snapshot, entries, True)
+        try:
+            await asyncio.to_thread(self.engine.apply_snapshot, entries, True)
+        except Exception as e:
+            # the engine still serves the OLD corpus: statuses set above
+            # must not claim Reconciled, or the resourceVersion resync
+            # dedup would skip every retry and the engine never converges
+            for entry in entries:
+                self.status.set(entry.id, STATUS_CACHING_ERROR,
+                                f"corpus swap failed: {e}")
+            raise
         if old_entries:
             await self._clean_entries(old_entries)
 

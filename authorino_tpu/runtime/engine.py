@@ -237,7 +237,6 @@ class PolicyEngine:
                 max_fallback=self.max_fallback_per_batch,
             )
         from ..compiler.pack import pack_batch
-        from ..models.policy_model import host_results
         from ..ops.pattern_eval import eval_packed_jit
         import jax.numpy as jnp
 

@@ -223,9 +223,10 @@ class NativeFrontend:
 
     def __init__(self, engine, port: int = 0, max_batch: int = 1024,
                  window_us: int = 2000, slots: int = 16, slow_cap: int = 65536,
-                 dispatch_threads: int = 6):
+                 dispatch_threads: int = 6, bind_all: bool = False):
         self.engine = engine
         self.port = port
+        self.bind_all = bind_all
         self.max_batch = int(max_batch)
         self.window_us = int(window_us)
         self.slots = int(slots)
@@ -251,7 +252,8 @@ class NativeFrontend:
             raise RuntimeError("native library unavailable")
         self._mod = mod
         rc = mod.fe_start(self.port, self.max_batch, self.slots, self.window_us,
-                          self.slow_cap, self._health_bytes())
+                          self.slow_cap, self._health_bytes(),
+                          1 if self.bind_all else 0)
         if rc != 0:
             raise RuntimeError(f"native frontend failed to start (rc={rc}; "
                                "is libnghttp2 present?)")

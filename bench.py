@@ -10,31 +10,31 @@ RPS at p99 < 2ms on one v5e-1; the Go reference's full pipeline runs one
 request in 363.9 µs/op ≈ 2.7k sequential evals per core-second —
 BASELINE.md).  Extra detail goes to stderr.
 
-The default (pipelined) loop measures the *device capacity* of the serving
-path: a pool of worker threads each encodes a batch (native C++ encoder),
-dispatches the packed kernel, and blocks on one small readback — so many
-batches are in flight at once.  On this image the device sits behind a
-network tunnel (~100 ms RTT, ~25 MB/s); a strictly serial loop measures the
-tunnel, not the system, and concurrent in-flight batches are exactly how
-the serving engine hides that latency (runtime/engine.py dispatches each
-micro-batch from a thread).  Per-batch latency is reported honestly — it
-includes the tunnel RTT that a co-located chip would not pay.
+The default mode (native) measures the FULL service: real CheckRequest
+protobufs over real loopback HTTP/2 gRPC into the C++ device-owner frontend
+(native/frontend.cpp), which encodes fast-lane configs straight into the
+packed kernel operands and touches Python once per micro-batch for the JAX
+dispatch; a raw-frame C++ load generator (native/loadgen.cpp) drives it.
+This is the unit the north star counts — Check() through the wire — and it
+records 117k req/s on this image (best-of-trials; the device tunnel swings
+multi-x in bandwidth minute to minute).
 
-Two service-level modes measure the full stack:
-  --mode engine  drives PolicyEngine.submit (micro-batch queue, double-
-                 buffered snapshot) under a sliding-window load.  One
-                 Python process tops out around ~16-20k RPS — the asyncio
-                 per-request task machinery (~45µs/request) saturates the
-                 event loop long before the device does (the pipelined
-                 number is the device+encode capacity).  Scaling past one
-                 process means replicas (each with its own chip, like the
-                 reference's replica scaling) or a native frontend feeding
-                 one device-owner process — TPUs are process-exclusive, so
-                 N Python frontends cannot share one chip directly.
-  --mode grpc    full-wire Check() over a local grpc.aio server — adds the
-                 Python gRPC tax (~1.2k RPS/process); the reference's Go
-                 wire is far cheaper, which is why the C++ frontend remains
-                 on the roadmap (SURVEY §2 note).
+Latency accounting: on this image every batch pays a ~100-130 ms network
+tunnel round trip to the device that a co-located chip would not (device
+compute itself is ~0.1 ms/batch).  The JSON line therefore carries the
+saturation percentiles, a light-load run's percentiles, the measured
+per-batch device RTT at the same shapes, and the light-load p99 net of that
+RTT — the on-box share (queue window + encode + response build).
+
+Other modes:
+  --mode pipelined  model-level device+encode capacity (worker threads
+                    overlap encode + dispatch; no wire)
+  --mode engine     PolicyEngine.submit micro-batch queue (asyncio path,
+                    ~16-20k RPS/process — the event loop, not the device,
+                    is the ceiling)
+  --mode grpc       full wire over the PYTHON grpc.aio server (~1.2k
+                    RPS/process — the gap the native frontend closes)
+  --mode serial     strictly serial encode→apply loop (tunnel-dominated)
 
 Run on the real chip (default platform); CPU fallback works for smoke runs:
   JAX_PLATFORMS=cpu python bench.py --seconds 3

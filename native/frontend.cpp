@@ -460,6 +460,7 @@ struct Server {
   // config
   int port = 0;
   int bound_port = 0;
+  bool any_addr = false;  // bind 0.0.0.0 (servers) vs loopback (bench/tests)
   int bmax = 1024;
   int nslots = 8;
   long window_us = 2000;
@@ -1183,10 +1184,14 @@ static int server_start(Server* S) {
   struct sockaddr_in addr;
   memset(&addr, 0, sizeof addr);
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_addr.s_addr = htonl(S->any_addr ? INADDR_ANY : INADDR_LOOPBACK);
   addr.sin_port = htons((uint16_t)S->port);
-  if (bind(S->listen_fd, (struct sockaddr*)&addr, sizeof addr) < 0) return -3;
-  if (listen(S->listen_fd, 1024) < 0) return -4;
+  if (bind(S->listen_fd, (struct sockaddr*)&addr, sizeof addr) < 0 ||
+      listen(S->listen_fd, 1024) < 0) {
+    close(S->listen_fd);  // error paths must not leak the socket
+    S->listen_fd = -1;
+    return -3;
+  }
   socklen_t alen = sizeof addr;
   getsockname(S->listen_fd, (struct sockaddr*)&addr, &alen);
   S->bound_port = ntohs(addr.sin_port);

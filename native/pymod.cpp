@@ -289,13 +289,13 @@ static bool dict_bytes(PyObject* d, const char* k, std::string& out) {
   return true;
 }
 
-// fe_start(port, bmax, nslots, window_us, slow_cap, health_bytes) -> 0
+// fe_start(port, bmax, nslots, window_us, slow_cap, health_bytes, any_addr) -> 0
 PyObject* fe_start_py(PyObject*, PyObject* args) {
-  int port, bmax, nslots;
+  int port, bmax, nslots, any_addr = 0;
   long window_us, slow_cap;
   Py_buffer health;
-  if (!PyArg_ParseTuple(args, "iiilly*", &port, &bmax, &nslots, &window_us,
-                        &slow_cap, &health))
+  if (!PyArg_ParseTuple(args, "iiilly*|i", &port, &bmax, &nslots, &window_us,
+                        &slow_cap, &health, &any_addr))
     return nullptr;
   if (fe::g_srv != nullptr) {
     PyBuffer_Release(&health);
@@ -304,6 +304,7 @@ PyObject* fe_start_py(PyObject*, PyObject* args) {
   }
   fe::Server* S = new fe::Server();
   S->port = port;
+  S->any_addr = any_addr != 0;
   S->bmax = bmax;
   S->nslots = nslots;
   S->window_us = window_us;

@@ -303,6 +303,57 @@ async def main() -> int:
             failures += 1
             print(f"[FAIL] live corpus recompile (evil={evil_now}, rogue={rogue_now})")
 
+    # ---- gRPC ext_authz listener (the native C++ frontend when available,
+    # grpc.aio otherwise — same assertions either way)
+    def grpc_checks():
+        import grpc
+
+        from authorino_tpu import protos
+
+        pb = protos.external_auth_pb2
+        key = b"friend-secret-2" if rotated else b"friend-secret-1"
+
+        def req(host, auth=None):
+            r = pb.CheckRequest()
+            http = r.attributes.request.http
+            http.method = "GET"
+            http.path = "/hello"
+            http.host = host
+            if auth:
+                http.headers["authorization"] = auth
+            return r
+
+        out = []
+        with grpc.insecure_channel(f"127.0.0.1:{GRPC_PORT}") as ch:
+            call = ch.unary_unary(
+                "/envoy.service.auth.v3.Authorization/Check",
+                request_serializer=pb.CheckRequest.SerializeToString,
+                response_deserializer=pb.CheckResponse.FromString,
+            )
+            ok = call(req(H, f"APIKEY {key.decode()}"), timeout=10)
+            out.append(("grpc Check allow", ok.status.code, 0))
+            deny = call(req(H, "APIKEY wrong"), timeout=10)
+            out.append(("grpc Check deny", deny.status.code, 16))
+            nf = call(req("nope.example.com"), timeout=10)
+            out.append(("grpc Check unknown host", nf.denied_response.status.code, 404))
+            health = ch.unary_unary(
+                "/grpc.health.v1.Health/Check",
+                request_serializer=protos.health_pb2.HealthCheckRequest.SerializeToString,
+                response_deserializer=protos.health_pb2.HealthCheckResponse.FromString,
+            )(protos.health_pb2.HealthCheckRequest(), timeout=10)
+            out.append(("grpc health SERVING", health.status, 1))
+        return out
+
+    try:
+        for desc, got, want in await asyncio.to_thread(grpc_checks):
+            mark = "PASS" if got == want else "FAIL"
+            if got != want:
+                failures += 1
+            print(f"[{mark}] {desc}: {got} (want {want})")
+    except Exception as e:
+        failures += 4
+        print(f"[FAIL] grpc listener checks: {e}")
+
     server_task.cancel()
     try:
         await server_task
@@ -312,7 +363,7 @@ async def main() -> int:
     from authorino_tpu.utils.http import close_sessions
 
     await close_sessions()
-    n_assertions = len(TABLE) + 3  # + wristband + rotation + recompile
+    n_assertions = len(TABLE) + 3 + 4  # + wristband + rotation + recompile + grpc
     print(f"\n{'OK' if failures == 0 else 'FAILED'}: {n_assertions - failures}/{n_assertions} assertions passed")
     return 1 if failures else 0
 
