@@ -306,6 +306,9 @@ async def run_server(args) -> None:
         await grpc_server.stop(2)
     await runner.cleanup()
     await oidc_runner.cleanup()
+    from .utils.tracing import shutdown_tracing
+
+    await shutdown_tracing()  # flush the last spans to the collector
 
 
 def main(argv=None) -> int:

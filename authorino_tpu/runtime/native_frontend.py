@@ -362,6 +362,15 @@ class NativeFrontend:
         has_wildcards = False
         ok_bytes = self._result_bytes(AuthResult(code=OK, headers=[{}]))
 
+        # active span export needs a per-request Python span (W3C inject into
+        # outbound calls + Check span export, ref pkg/trace/trace.go:20-27);
+        # the fast lane never touches Python per request, so it defers to the
+        # slow lane while tracing is on
+        from ..utils.tracing import tracing_active
+
+        if tracing_active():
+            policy = None
+
         if policy is not None:
             from ..native.encoder import get_native_encoder
             from ..ops.pattern_eval import to_device
@@ -523,6 +532,8 @@ class NativeFrontend:
         engine = self.engine
         external_auth_pb2 = protos.external_auth_pb2
 
+        from ..utils.tracing import RequestSpan
+
         async def handle(req_id: int, raw: bytes) -> None:
             try:
                 req = external_auth_pb2.CheckRequest.FromString(raw)
@@ -530,7 +541,14 @@ class NativeFrontend:
                 if model is None:
                     result = AuthResult(code=INVALID_ARGUMENT, message="Invalid request")
                 else:
-                    result = await engine.check(model)
+                    # same span lifecycle as the Python gRPC server
+                    # (service/grpc_server.py check): W3C context in,
+                    # propagation into evaluator calls, Check span out
+                    span = RequestSpan.from_headers(model.http.headers, model.http.id)
+                    try:
+                        result = await engine.check(model, span=span)
+                    finally:
+                        span.end()
                 mod.fe_complete_slow(
                     req_id, check_response_from_result(result).SerializeToString(), 0)
             except Exception:

@@ -1,14 +1,18 @@
-"""Tracing: W3C TraceContext propagation end-to-end + optional OpenTelemetry
-SDK export (semantics: ref pkg/trace/exporter.go:26-117, trace.go:20-27 —
-request spans carry authorino.request_id and propagate x-request-id; W3C
-headers are injected into every outbound evaluator HTTP call).
+"""Tracing: W3C TraceContext propagation end-to-end + OTLP span export
+(semantics: ref pkg/trace/exporter.go:26-117, trace.go:20-27 — request spans
+carry authorino.request_id and propagate x-request-id; W3C headers are
+injected into every outbound evaluator HTTP call).
 
-The image ships only the OTel *API*; when an SDK + OTLP exporter are
-installed, ``setup_tracing`` wires a real provider (endpoint URL semantics
-like the reference: ``rpc://host:port`` → gRPC OTLP, ``http(s)://`` → HTTP
-OTLP, basic-auth from URL userinfo).  Without the SDK, spans are lightweight
-native objects and propagation still works — the part that affects request
-correctness."""
+Export has two backends, preferred in order:
+  1. the OpenTelemetry SDK + OTLP exporter when installed (endpoint URL
+     semantics like the reference: ``rpc://host:port`` → gRPC OTLP,
+     ``http(s)://`` → HTTP OTLP, basic-auth from URL userinfo);
+  2. a built-in OTLP/HTTP JSON exporter (this module) — the OTLP JSON
+     mapping needs no SDK, so ``http(s)://`` endpoints export even on
+     images that ship only the OTel API (exercised against a fake
+     collector in tests/test_tracing.py).
+Propagation always works regardless — that is the part that affects
+request correctness."""
 
 from __future__ import annotations
 
@@ -28,27 +32,116 @@ _TRACEPARENT_RE = re.compile(
 )
 
 _otel_tracer = None
+_native_exporter: Optional["NativeOtlpExporter"] = None
+
+
+class NativeOtlpExporter:
+    """SDK-free OTLP/HTTP JSON exporter: finished spans batch into
+    ExportTraceServiceRequest JSON (trace/span ids hex per the OTLP JSON
+    mapping) POSTed to ``<endpoint>/v1/traces``."""
+
+    def __init__(self, endpoint: str, headers: Dict[str, str],
+                 service_name: str = "authorino-tpu",
+                 flush_interval_s: float = 2.0, max_queue: int = 4096):
+        url = endpoint.rstrip("/")
+        self.url = url if url.endswith("/v1/traces") else url + "/v1/traces"
+        self.headers = {"content-type": "application/json", **headers}
+        self.service_name = service_name
+        self.flush_interval_s = flush_interval_s
+        self.max_queue = max_queue
+        self._queue: list = []
+        self._task: Any = None
+
+    def enqueue(self, span: dict) -> None:
+        if len(self._queue) >= self.max_queue:
+            return  # shed rather than grow unbounded (collector outage)
+        self._queue.append(span)
+        if self._task is None or self._task.done():
+            import asyncio
+
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return  # no loop (sync caller): exported on the next flush
+            self._task = loop.create_task(self._run())
+
+    def _payload(self, spans: list) -> dict:
+        return {
+            "resourceSpans": [{
+                "resource": {"attributes": [{
+                    "key": "service.name",
+                    "value": {"stringValue": self.service_name},
+                }]},
+                "scopeSpans": [{
+                    "scope": {"name": "authorino-tpu"},
+                    "spans": spans,
+                }],
+            }]
+        }
+
+    async def flush(self) -> None:
+        if not self._queue:
+            return
+        spans, self._queue = self._queue, []
+        from .http import get_session
+
+        session = get_session()
+        try:
+            async with session.post(self.url, json=self._payload(spans),
+                                    headers=self.headers) as resp:
+                await resp.read()
+                if resp.status >= 400:
+                    log.warning("OTLP export rejected: HTTP %d", resp.status)
+        except Exception as e:
+            log.warning("OTLP export failed: %s", e)
+
+    async def _run(self) -> None:
+        import asyncio
+
+        while self._queue:
+            await asyncio.sleep(self.flush_interval_s)
+            await self.flush()
+
+
+def tracing_active() -> bool:
+    """True when spans are exported (SDK provider or built-in exporter) —
+    serving paths that cannot mint per-request spans (the native fast lane)
+    must defer to the Python pipeline while this holds."""
+    return _otel_tracer is not None or _native_exporter is not None
+
+
+async def shutdown_tracing() -> None:
+    """Flush the built-in exporter on shutdown (the SDK path gets this via
+    BatchSpanProcessor's own shutdown)."""
+    if _native_exporter is not None:
+        task = _native_exporter._task
+        if task is not None and not task.done():
+            task.cancel()
+        await _native_exporter.flush()
 
 
 def setup_tracing(endpoint: str, insecure: bool = False, service_name: str = "authorino-tpu") -> bool:
-    """Configure a real OTel provider when the SDK is available.
-    Returns True when exporting is active (ref: CreateTraceProvider)."""
-    global _otel_tracer
+    """Configure a real OTel provider when the SDK is available, else the
+    built-in OTLP/HTTP JSON exporter.  Returns True when exporting is
+    active (ref: CreateTraceProvider)."""
+    global _otel_tracer, _native_exporter
     if not endpoint:
         return False
+    # endpoint userinfo → basic-auth header, shared by both backends
+    split = urlsplit(endpoint)
+    headers: Dict[str, str] = {}
+    if split.username:
+        import base64 as b64
+
+        cred = f"{split.username}:{split.password or ''}"
+        headers["authorization"] = "Basic " + b64.b64encode(cred.encode()).decode()
+        endpoint = endpoint.replace(f"{split.username}:{split.password or ''}@", "", 1)
     try:
         from opentelemetry import trace as otel_trace
         from opentelemetry.sdk.resources import Resource  # type: ignore
         from opentelemetry.sdk.trace import TracerProvider  # type: ignore
         from opentelemetry.sdk.trace.export import BatchSpanProcessor  # type: ignore
 
-        split = urlsplit(endpoint)
-        headers = {}
-        if split.username:
-            import base64 as b64
-
-            cred = f"{split.username}:{split.password or ''}"
-            headers["authorization"] = "Basic " + b64.b64encode(cred.encode()).decode()
         if split.scheme in ("rpc", "grpc"):
             from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (  # type: ignore
                 OTLPSpanExporter,
@@ -71,12 +164,18 @@ def setup_tracing(endpoint: str, insecure: bool = False, service_name: str = "au
         _otel_tracer = otel_trace.get_tracer("authorino-tpu")
         return True
     except ImportError as e:
-        log.warning(
-            "tracing endpoint configured but the OpenTelemetry SDK/exporter is "
-            "not installed (%s); spans propagate W3C context but are not exported",
-            e,
-        )
-        return False
+        if split.scheme in ("rpc", "grpc"):
+            log.warning(
+                "tracing endpoint %s needs the OTel gRPC exporter, which is not "
+                "installed (%s); spans propagate W3C context but are not exported "
+                "(use an http(s):// endpoint for the built-in OTLP/JSON exporter)",
+                endpoint, e,
+            )
+            return False
+        _native_exporter = NativeOtlpExporter(endpoint, headers, service_name)
+        log.info("OTel SDK not installed; using the built-in OTLP/HTTP JSON "
+                 "exporter → %s", _native_exporter.url)
+        return True
 
 
 @dataclass
@@ -89,6 +188,7 @@ class RequestSpan:
     sampled: bool = True
     request_id: str = ""
     start: float = field(default_factory=time.monotonic)
+    start_ns: int = field(default_factory=time.time_ns)  # wall clock for OTLP
     _otel_span: Any = None
 
     @classmethod
@@ -136,3 +236,19 @@ class RequestSpan:
                 self._otel_span.end()
             except Exception:
                 pass
+        elif _native_exporter is not None and self.sampled:
+            span = {
+                "traceId": self.trace_id,
+                "spanId": self.span_id,
+                "name": "Check",
+                "kind": 2,  # SERVER
+                "startTimeUnixNano": str(self.start_ns),
+                "endTimeUnixNano": str(
+                    self.start_ns + int((time.monotonic() - self.start) * 1e9)),
+                "attributes": [{
+                    "key": "authorino.request_id",
+                    "value": {"stringValue": self.request_id},
+                }],
+                "status": {"code": 2, "message": error} if error else {},
+            }
+            _native_exporter.enqueue(span)

@@ -420,7 +420,7 @@ def run_native_mode(args):
     engine.apply_snapshot(build_wire_entries(args, engine.provider_for))
     B = min(args.batch, 4096)
     fe = NativeFrontend(engine, port=0, max_batch=B, window_us=args.window_us,
-                        slots=16, dispatch_threads=8)
+                        slots=24, dispatch_threads=10)
     port = fe.start()
     log(f"native frontend on :{port} (fast configs: see stats below)")
 
