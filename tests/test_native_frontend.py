@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 
 import grpc
 import pytest
@@ -142,6 +143,14 @@ REQUESTS = [
     make_req("fast-eq.test:8080", headers={"x-org": "acme"}),    # port strip
     make_req("other.test", headers={"x-org": "acme"}, ctx={"host": "fast-eq.test"}),
 ]
+
+
+def wait_for_snap_retire(fe, timeout_s: float = 30.0) -> None:
+    """Poll until every superseded snapshot drained and retired."""
+    deadline = time.monotonic() + timeout_s
+    while len(fe._snaps) > 1 and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert len(fe._snaps) == 1
 
 
 def response_key(resp: pb.CheckResponse):
@@ -318,13 +327,7 @@ def test_snapshot_swap_retires_old(stack):
     resp = grpc_call(native_port, make_req("swapped.test", headers={"x-new": "v1"}))
     assert resp.status.code == 7
     # old snapshots retire once their batches drain
-    deadline = 50
-    while len(fe._snaps) > 1 and deadline:
-        import time
-
-        time.sleep(0.1)
-        deadline -= 1
-    assert len(fe._snaps) == 1
+    wait_for_snap_retire(fe)
 
 
 def test_swap_storm_under_load(stack):
@@ -332,8 +335,6 @@ def test_swap_storm_under_load(stack):
     wire traffic: fire concurrent Check()s at a config that is identical in
     every snapshot while the engine swaps corpora repeatedly; every
     response must stay deterministic and old snapshots must all retire."""
-    import time
-
     engine, fe, native_port, _ = stack
     base_entries = list(engine._snapshot.by_id.values())
 
@@ -388,8 +389,4 @@ def test_swap_storm_under_load(stack):
     assert not errors, errors[:5]
     assert counts["ok"] > 5 and counts["deny"] > 5, counts
     # every superseded snapshot drains and retires
-    deadline = 300
-    while len(fe._snaps) > 1 and deadline:
-        time.sleep(0.1)
-        deadline -= 1
-    assert len(fe._snaps) == 1
+    wait_for_snap_retire(fe)
