@@ -39,9 +39,12 @@ RUN SITE=$(python -c "import sysconfig; print(sysconfig.get_paths()['purelib'])"
 
 FROM ${BASE_IMAGE}
 # libnghttp2 backs the native gRPC frontend (native/frontend.cpp dlopens
-# it); absent, the server falls back to the Python grpc.aio listener
-RUN apt-get update && apt-get install -y --no-install-recommends libnghttp2-14 \
-    && rm -rf /var/lib/apt/lists/* \
+# it); absent, the server falls back to the Python grpc.aio listener — so
+# the install is best-effort to keep non-apt BASE_IMAGEs buildable
+RUN if command -v apt-get >/dev/null; then \
+        apt-get update && apt-get install -y --no-install-recommends libnghttp2-14 \
+        && rm -rf /var/lib/apt/lists/*; \
+    fi \
     && groupadd -r authorino && useradd -r -g authorino -u 1001 authorino
 COPY --from=build /staged /staged
 RUN python -c "import shutil, sysconfig; \

@@ -344,7 +344,6 @@ class NativeFrontend:
             "policy": None,
             "A": 0, "M": 0, "K": 0, "C": 0, "NB": 0, "DVB": DFA_VALUE_BYTES,
             "elem16": 0,
-            "has_wildcards": 0,
             "fcs": [], "hosts": [], "slots": [],
             "attr_dfas": [],
             "dfa_R": 0, "dfa_S": 0,
@@ -358,8 +357,10 @@ class NativeFrontend:
 
         entries = list(snap.by_id.values()) if snap is not None else []
         fcs: List[dict] = []
+        # exact hosts AND "*.suffix" wildcard keys — the C++ side replicates
+        # the index's wildcard walk-up, so misses resolve to NOT_FOUND
+        # natively (ref pkg/index/index.go:153-174)
         hosts: List[Tuple[str, int]] = []
-        has_wildcards = False
         ok_bytes = self._result_bytes(AuthResult(code=OK, headers=[{}]))
 
         # active span export needs a per-request Python span (W3C inject into
@@ -437,10 +438,7 @@ class NativeFrontend:
                     })
                     fc_rows.append(int(row))
                     for host in entry.hosts:
-                        if "*" in host:
-                            has_wildcards = True
-                        else:
-                            hosts.append((host, fc_idx))
+                        hosts.append((host, fc_idx))
                 rec.fc_rows = np.asarray(fc_rows or [0], dtype=np.int64)
             else:
                 fast_ids = set()
@@ -453,19 +451,15 @@ class NativeFrontend:
             if id(entry) in fast_ids:
                 continue
             for host in entry.hosts:
-                if "*" in host:
-                    has_wildcards = True
-                elif host not in fast_hosts:
+                if host not in fast_hosts:
                     hosts.append((host, -1))
         spec["fcs"] = fcs
         spec["hosts"] = hosts
-        spec["has_wildcards"] = 1 if has_wildcards else 0
 
         self._snaps[snap_id] = rec  # caller holds _lock
         mod.fe_swap(spec)
-        log.info("native frontend snapshot %d: %d fast configs, %d hosts%s",
-                 snap_id, len(fcs), len(hosts),
-                 " (wildcards→slow)" if has_wildcards else "")
+        log.info("native frontend snapshot %d: %d fast configs, %d host keys",
+                 snap_id, len(fcs), len(hosts))
 
     # ------------------------------------------------------------------
     def _dispatch_loop(self) -> None:

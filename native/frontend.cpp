@@ -396,8 +396,8 @@ struct Snapshot {
   std::vector<uint8_t> dfa_trans;  // [R, S, 256]
   std::vector<uint8_t> dfa_accept; // [R, S]
   int dfa_S = 0;
-  std::unordered_map<std::string, int32_t> host_map;  // → fc idx, -1 = slow
-  bool has_wildcards = false;
+  // host / "*.suffix" wildcard → fc idx, -1 = slow lane
+  std::unordered_map<std::string, int32_t> host_map;
   std::vector<FastConfig> fcs;
   // batch slots (numpy arrays owned by Python until retirement)
   std::vector<Slot> slots;
@@ -815,6 +815,29 @@ static Slot* ensure_fill(Server* S, std::shared_ptr<Snapshot>& snap_out) {
 
 // ---- request processing (epoll thread) ------------------------------------
 
+// Host resolution with wildcard walk-up (ref pkg/index/index.go:153-174;
+// mirrors index/index.py::_get_node): exact hit first, then "*."-prefixed
+// suffixes deepest-first — "*.example.com" matches a.example.com,
+// b.a.example.com AND example.com itself — then a bare "*".
+static bool resolve_host(Snapshot* snap, const std::string& host, int32_t& out) {
+  auto it = snap->host_map.find(host);
+  if (it != snap->host_map.end()) { out = it->second; return true; }
+  size_t pos = 0;
+  std::string cand;
+  for (;;) {
+    cand.assign("*.");
+    cand.append(host, pos, std::string::npos);
+    auto w = snap->host_map.find(cand);
+    if (w != snap->host_map.end()) { out = w->second; return true; }
+    size_t dot = host.find('.', pos);
+    if (dot == std::string::npos) break;
+    pos = dot + 1;
+  }
+  auto b = snap->host_map.find("*");
+  if (b != snap->host_map.end()) { out = b->second; return true; }
+  return false;
+}
+
 static void push_slow(Server* S, Conn* c, int32_t stream_id, const char* msg, size_t n) {
   uint64_t id;
   bool shed = false;
@@ -870,21 +893,21 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
   // port-strip retry (ref pkg/service/auth.go:270-289)
   const PbView* ov = map_get(rv.ctx_ext, "host", 4);
   std::string host = ov ? ov->str() : rv.host.str();
-  auto it = snap->host_map.find(host);
-  if (it == snap->host_map.end()) {
+  int32_t fc_idx;
+  bool found = resolve_host(snap.get(), host, fc_idx);
+  if (!found) {
     size_t colon = host.rfind(':');
     if (colon != std::string::npos)
-      it = snap->host_map.find(host.substr(0, colon));
+      found = resolve_host(snap.get(), host.substr(0, colon), fc_idx);
   }
-  if (it == snap->host_map.end()) {
-    if (snap->has_wildcards) { push_slow(S, c, stream_id, msg, mlen); return; }
+  if (!found) {
     S->n_notfound.fetch_add(1, std::memory_order_relaxed);
     submit_grpc_response(c, stream_id, snap->notfound_msg);
     return;
   }
-  if (it->second < 0) { push_slow(S, c, stream_id, msg, mlen); return; }
+  if (fc_idx < 0) { push_slow(S, c, stream_id, msg, mlen); return; }
 
-  const FastConfig& fc = snap->fcs[it->second];
+  const FastConfig& fc = snap->fcs[fc_idx];
   std::shared_ptr<Snapshot> fsnap;
   Slot* sl = ensure_fill(S, fsnap);
   if (sl == nullptr) {
@@ -904,7 +927,7 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
     push_slow(S, c, stream_id, msg, mlen);
     return;
   }
-  snap->slot_entries[S->fill_slot].push_back({c->id, stream_id, it->second});
+  snap->slot_entries[S->fill_slot].push_back({c->id, stream_id, fc_idx});
   S->fill_count++;
   S->n_fast.fetch_add(1, std::memory_order_relaxed);
   if (S->fill_count >= S->bmax) flush_batch(S);

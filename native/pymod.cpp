@@ -361,7 +361,6 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
   snap->NB = (int)dict_int(d, "NB");
   snap->DVB = (int)dict_int(d, "DVB");
   snap->elem16 = dict_int(d, "elem16") != 0;
-  snap->has_wildcards = dict_int(d, "has_wildcards") != 0;
   const int32_t* ams = (const int32_t*)dict_addr(d, "attr_member_slot_addr");
   const int32_t* abs_v = (const int32_t*)dict_addr(d, "attr_byte_slot_addr");
   if (snap->A > 0 && ams != nullptr)

@@ -101,9 +101,10 @@ def build_engine() -> PolicyEngine:
             identity=[IdentityConfig("friends", api_key,
                                      credentials=AuthCredentials(key_selector="APIKEY"))]),
         rules=None))
-    # slow: wildcard host (radix walk stays in Python)
+    # wildcard host: pattern-only, so it rides the FAST lane — the C++
+    # side replicates the index's wildcard walk-up
     entries.append(pattern_entry(
-        5, "ns/slow-wild", ["*.wild.test"],
+        5, "ns/fast-wild", ["*.wild.test"],
         Pattern("request.method", Operator.NEQ, "DELETE")))
     engine.apply_snapshot(entries)
     return engine
@@ -139,7 +140,10 @@ REQUESTS = [
     make_req("slow-key.test", headers={"authorization": "APIKEY wrong"}),
     make_req("a.wild.test"),
     make_req("a.wild.test", method="DELETE"),
-    make_req("unknown.test"),                                    # no config → 404... wildcard!
+    make_req("deep.a.wild.test"),            # wildcard matches any depth
+    make_req("wild.test"),                   # walk-up matches the base itself
+    make_req("a.wild.test:8443"),            # port strip before wildcard
+    make_req("unknown.test"),                # exact+wildcard miss → 404
     make_req("fast-eq.test:8080", headers={"x-org": "acme"}),    # port strip
     make_req("other.test", headers={"x-org": "acme"}, ctx={"host": "fast-eq.test"}),
 ]
