@@ -216,6 +216,7 @@ class _SnapRec:
     arrays: List[Dict[str, np.ndarray]] = field(default_factory=list)
     keepalive: List[np.ndarray] = field(default_factory=list)
     fc_rows: Optional[np.ndarray] = None
+    row_labels: Dict[int, Tuple[str, str]] = field(default_factory=dict)
 
 
 class NativeFrontend:
@@ -437,6 +438,13 @@ class NativeFrontend:
                         "plans": plans,
                     })
                     fc_rows.append(int(row))
+                    # per-authconfig metric labels — EXACTLY the pipeline's
+                    # scheme (ref pkg/service/auth_pipeline.go:26-36; translate
+                    # injects namespace/name into runtime labels), so a
+                    # config's fast- and slow-lane traffic lands on one series
+                    lbl = entry.runtime.labels or {}
+                    rec.row_labels[int(row)] = (
+                        lbl.get("namespace", ""), lbl.get("name", ""))
                     for host in entry.hosts:
                         hosts.append((host, fc_idx))
                 rec.fc_rows = np.asarray(fc_rows or [0], dtype=np.int64)
@@ -501,16 +509,23 @@ class NativeFrontend:
             jnp.asarray(a["byte_ovf"][:pad].view(bool)) if has_dfa else None,
         ))
         verdict = np.ascontiguousarray(packed[:count, 0]).astype(np.uint8)
+        # copy BEFORE completing: fe_complete_batch frees the slot, and the
+        # C++ encoder may refill config_id while we're still attributing
+        rows = a["config_id"][:count].copy()
         self._mod.fe_complete_batch(snap_id, slot, verdict.ctypes.data)
-        # aggregate request metrics, same counters the pipeline bumps
-        # (ref pkg/service/auth_pipeline.go:26-36); fast-lane configs carry
-        # no namespace/name labels — the engine corpus is keyed by config id
-        n_ok = int(verdict.sum())
-        metrics_mod.authconfig_total.labels("", "").inc(count)
-        metrics_mod.authconfig_response_status.labels("", "", "OK").inc(n_ok)
-        if count - n_ok:
-            metrics_mod.authconfig_response_status.labels(
-                "", "", "PERMISSION_DENIED").inc(count - n_ok)
+        # per-authconfig request metrics, same counters + labels the
+        # pipeline bumps (ref pkg/service/auth_pipeline.go:26-36)
+        n_per_row = np.bincount(rows)
+        ok_per_row = np.bincount(rows, weights=verdict).astype(np.int64)
+        for row in np.nonzero(n_per_row)[0]:
+            n, n_ok = int(n_per_row[row]), int(ok_per_row[row])
+            ns, name = rec.row_labels.get(int(row), ("", ""))
+            metrics_mod.authconfig_total.labels(ns, name).inc(n)
+            if n_ok:
+                metrics_mod.authconfig_response_status.labels(ns, name, "OK").inc(n_ok)
+            if n - n_ok:
+                metrics_mod.authconfig_response_status.labels(
+                    ns, name, "PERMISSION_DENIED").inc(n - n_ok)
 
     # ------------------------------------------------------------------
     def _slow_loop(self) -> None:

@@ -58,7 +58,9 @@ def build_engine() -> PolicyEngine:
     def pattern_entry(i, cfg_id, hosts, rule, cond=None, deny_with=None):
         pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
                              evaluator_slot=0)
+        ns, _, nm = cfg_id.partition("/")
         runtime = RuntimeAuthConfig(
+            labels={"namespace": ns, "name": nm},  # like translate injects
             identity=[IdentityConfig("anon", Noop())],
             authorization=[AuthorizationConfig("rules", pm)],
             deny_with=deny_with or DenyWith(),
@@ -394,3 +396,31 @@ def test_swap_storm_under_load(stack):
     assert counts["ok"] > 5 and counts["deny"] > 5, counts
     # every superseded snapshot drains and retires
     wait_for_snap_retire(fe)
+
+
+def test_fast_lane_metrics_labeled_per_config(stack):
+    """Fast-lane decisions bump auth_server_authconfig_* with the SAME
+    namespace/name labels the pipeline uses (ref auth_pipeline.go:26-36)."""
+    prom = pytest.importorskip("prometheus_client")
+
+    def sample(name, labels):
+        v = prom.REGISTRY.get_sample_value(name, labels)
+        return v or 0.0
+
+    _, _, native_port, _ = stack
+    base_total = sample("auth_server_authconfig_total",
+                        {"namespace": "ns", "authconfig": "fast-eq"})
+    base_ok = sample("auth_server_authconfig_response_status_total",
+                     {"namespace": "ns", "authconfig": "fast-eq", "status": "OK"})
+    base_deny = sample("auth_server_authconfig_response_status_total",
+                       {"namespace": "ns", "authconfig": "fast-eq",
+                        "status": "PERMISSION_DENIED"})
+    for org in ("acme", "evil", "acme"):
+        grpc_call(native_port, make_req("fast-eq.test", headers={"x-org": org}))
+    assert sample("auth_server_authconfig_total",
+                  {"namespace": "ns", "authconfig": "fast-eq"}) == base_total + 3
+    assert sample("auth_server_authconfig_response_status_total",
+                  {"namespace": "ns", "authconfig": "fast-eq", "status": "OK"}) == base_ok + 2
+    assert sample("auth_server_authconfig_response_status_total",
+                  {"namespace": "ns", "authconfig": "fast-eq",
+                   "status": "PERMISSION_DENIED"}) == base_deny + 1
