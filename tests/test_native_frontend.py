@@ -424,3 +424,52 @@ def test_fast_lane_metrics_labeled_per_config(stack):
     assert sample("auth_server_authconfig_response_status_total",
                   {"namespace": "ns", "authconfig": "fast-eq",
                    "status": "PERMISSION_DENIED"}) == base_deny + 1
+
+
+def test_hostile_wire_input(stack):
+    """A hand-rolled wire must survive hostile bytes: raw garbage, a valid
+    preface followed by junk, truncated frames, an abortive RST close, and
+    a well-formed stream carrying a corrupt protobuf — all without taking
+    the server down or wedging later traffic."""
+    import socket
+    import struct
+
+    _, fe, native_port, _ = stack
+
+    def tcp(payload, linger=0.2, rst=False):
+        s = socket.create_connection(("127.0.0.1", native_port), timeout=5)
+        try:
+            s.sendall(payload)
+            time.sleep(linger)
+            if rst:  # abortive close: RST instead of FIN
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             struct.pack("ii", 1, 0))
+        finally:
+            s.close()
+
+    parse_errors_before = fe.stats()["parse_errors"]
+    preface = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+    tcp(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")          # not HTTP/2 at all
+    tcp(preface + b"\x00\x00\x00\x04\x00\x00\x00\x00\x00", rst=True)  # RST mid-session
+    tcp(b"\x00" * 64)                                   # binary garbage
+    tcp(preface + b"\xff" * 32)                         # preface then junk
+    tcp(preface + b"\x00\x00\x04\x04\x00\x00\x00\x00")  # truncated SETTINGS
+    # valid h2 session carrying a corrupt gRPC message: hand-rolled HEADERS
+    # (literal :path to Check) + DATA with a non-protobuf body
+    hp = (b"\x83\x86"                                    # :method POST, :scheme http
+          + b"\x04" + bytes([len(b"/envoy.service.auth.v3.Authorization/Check")])
+          + b"/envoy.service.auth.v3.Authorization/Check"
+          + b"\x01\x01a")                                # :authority "a"
+    frames = (preface
+              + b"\x00\x00\x00\x04\x00\x00\x00\x00\x00"  # empty SETTINGS
+              + len(hp).to_bytes(3, "big") + b"\x01\x04" + (1).to_bytes(4, "big") + hp
+              + (10).to_bytes(3, "big") + b"\x00\x01" + (1).to_bytes(4, "big")
+              + b"\x00" + (5).to_bytes(4, "big") + b"\xde\xad\xbe\xef\x99")
+    tcp(frames, linger=0.5)
+
+    # the corrupt protobuf actually reached the decoder (else this test
+    # silently stops covering its key scenario)
+    assert fe.stats()["parse_errors"] > parse_errors_before
+    # the server still answers correctly afterwards
+    resp = grpc_call(native_port, make_req("fast-eq.test", headers={"x-org": "acme"}))
+    assert resp.status.code == 0
