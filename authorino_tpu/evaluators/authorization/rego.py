@@ -830,6 +830,47 @@ def _regex_match(pattern: str, value: str) -> bool:
     return bool(accept[state])
 
 
+_GLOB_CACHE: Dict[Tuple[str, Tuple[str, ...]], Any] = {}
+
+
+def _glob_match(pattern: str, delimiters: Any, value: str) -> bool:
+    """OPA glob.match (wraps gobwas/glob): ``*`` spans within a delimiter
+    segment, ``**`` spans across, ``?`` is one non-delimiter character.
+    ``null`` delimiters mean NO delimiters; an EMPTY array defaults to
+    ``["."]`` (OPA >= 0.43 semantics)."""
+    if isinstance(delimiters, list):
+        delims = [str(d) for d in delimiters] or ["."]
+    else:
+        delims = []  # null: no delimiters — '*' spans everything
+    key = (pattern, tuple(delims))
+    rx = _GLOB_CACHE.get(key)
+    if rx is None:
+        delim_cls = "".join(re.escape(d) for d in delims)
+        any_one = f"[^{delim_cls}]" if delim_cls else "."
+        out = []
+        i = 0
+        while i < len(pattern):
+            ch = pattern[i]
+            if ch == "*":
+                if i + 1 < len(pattern) and pattern[i + 1] == "*":
+                    out.append(".*")
+                    i += 2
+                else:
+                    out.append(f"{any_one}*")
+                    i += 1
+            elif ch == "?":
+                out.append(any_one)
+                i += 1
+            else:
+                out.append(re.escape(ch))
+                i += 1
+        # DOTALL: gobwas matches newlines wherever delimiters allow
+        rx = re.compile("".join(out), re.S)
+        if len(_GLOB_CACHE) < 4096:
+            _GLOB_CACHE[key] = rx
+    return rx.fullmatch(value) is not None
+
+
 def _builtin(fn: str, args: List[Any]) -> Any:
     try:
         if fn == "count":
@@ -907,6 +948,64 @@ def _builtin(fn: str, args: List[Any]) -> Any:
             return isinstance(args[0], list)
         if fn == "is_object":
             return isinstance(args[0], dict)
+        if fn == "object.keys":
+            # OPA returns a set; sets serialize as deduped arrays here
+            return list(args[0].keys())
+        if fn == "object.union":
+            return _merge_docs(args[0], args[1])
+        if fn == "object.remove":
+            drop = set(args[1]) if isinstance(args[1], list) else set(args[1].keys())
+            return {k: v for k, v in args[0].items() if k not in drop}
+        if fn == "object.filter":
+            keep = set(args[1]) if isinstance(args[1], list) else set(args[1].keys())
+            return {k: v for k, v in args[0].items() if k in keep}
+        if fn == "numbers.range":
+            for x in args[:2]:
+                if isinstance(x, bool) or not (
+                    isinstance(x, int) or (isinstance(x, float) and x.is_integer())
+                ):
+                    raise RegoError("numbers.range: operands must be integers")
+            a, b = int(args[0]), int(args[1])
+            step = 1 if b >= a else -1
+            return list(range(a, b + step, step))  # OPA: inclusive both ends
+        if fn == "array.slice":
+            arr, lo, hi = list(args[0]), int(args[1]), int(args[2])
+            # OPA clamps out-of-range indexes instead of erroring
+            lo, hi = max(lo, 0), min(hi, len(arr))
+            return arr[lo:hi] if hi > lo else []
+        if fn == "array.reverse":
+            return list(reversed(args[0]))
+        if fn == "strings.reverse":
+            return str(args[0])[::-1]
+        if fn == "format_int":
+            base = int(args[1])
+            digs = {2: "{0:b}", 8: "{0:o}", 10: "{0:d}", 16: "{0:x}"}.get(base)
+            if digs is None:
+                raise RegoError(f"format_int: unsupported base {base}")
+            return digs.format(int(args[0]))
+        if fn == "union":
+            out, seen = [], set()
+            for coll in args[0]:
+                for v in coll:
+                    k = _set_key(v)
+                    if k not in seen:
+                        seen.add(k)
+                        out.append(v)
+            return out
+        if fn == "intersection":
+            colls = list(args[0])
+            if not colls:
+                return []
+            keys = set.intersection(*[{_set_key(v) for v in c} for c in colls])
+            out, seen = [], set()
+            for v in colls[0]:
+                k = _set_key(v)
+                if k in keys and k not in seen:
+                    seen.add(k)
+                    out.append(v)
+            return out
+        if fn == "glob.match":
+            return _glob_match(str(args[0]), args[1], str(args[2]))
     except RegoError:
         raise
     except Exception as e:

@@ -820,3 +820,56 @@ class TestRegoDataLayering:
         ev = rego._Evaluator(m, {}, data={"p": {"ext": 7, "a": 99}})
         vals = list(ev._data_values(["p"], {}))
         assert vals == [{"ext": 7, "a": 1}]  # virtual wins on conflict
+
+
+class TestRegoBuiltinsRound3:
+    def _val(self, expr, input_doc=None, data=None):
+        from authorino_tpu.evaluators.authorization import rego
+
+        m = rego.compile_module(f"package t\nv := {expr}", package="t")
+        return m.evaluate(input_doc or {}, data=data).get("v")
+
+    def test_object_builtins(self):
+        assert sorted(self._val('object.keys({"a": 1, "b": 2})')) == ["a", "b"]
+        assert self._val('object.union({"a": {"x": 1}}, {"a": {"y": 2}})') == \
+            {"a": {"x": 1, "y": 2}}
+        assert self._val('object.remove({"a": 1, "b": 2}, ["a"])') == {"b": 2}
+        assert self._val('object.filter({"a": 1, "b": 2}, ["a"])') == {"a": 1}
+
+    def test_array_and_number_builtins(self):
+        assert self._val("numbers.range(1, 4)") == [1, 2, 3, 4]   # inclusive
+        assert self._val("numbers.range(3, 1)") == [3, 2, 1]      # descending
+        assert self._val("array.slice([1, 2, 3, 4], 1, 3)") == [2, 3]
+        assert self._val("array.slice([1, 2], -5, 99)") == [1, 2]  # clamped
+        assert self._val("array.reverse([1, 2, 3])") == [3, 2, 1]
+        assert self._val('strings.reverse("abc")') == "cba"
+        assert self._val("format_int(255, 16)") == "ff"
+
+    def test_set_builtins(self):
+        # sets are represented as deduped arrays throughout this interpreter
+        assert self._val("union([[1, 2], [2, 3]])") == [1, 2, 3]
+        assert self._val("intersection([[1, 2, 3], [2, 3, 4]])") == [2, 3]
+
+    def test_glob_match(self):
+        # OPA >= 0.43: null delimiters = NO delimiters (* spans everything);
+        # an EMPTY array defaults to ["."] (* stays within one label)
+        assert self._val('glob.match("*.github.com", null, "a.b.github.com")') is True
+        assert self._val('glob.match("*.github.com", [], "api.github.com")') is True
+        assert self._val('glob.match("*.github.com", [], "a.b.github.com")') is False
+        assert self._val('glob.match("*.github.com", ["."], "a.b.github.com")') is False
+        # ** spans delimiters even with them set
+        assert self._val('glob.match("**.github.com", ["."], "a.b.github.com")') is True
+        assert self._val('glob.match("api-?.acme.com", ["."], "api-1.acme.com")') is True
+        assert self._val('glob.match("api-?.acme.com", ["."], "api-12.acme.com")') is False
+        # gobwas matches newlines where delimiters allow (DOTALL)
+        assert self._val('glob.match("a**b", null, input.s)', {"s": "a\nb"}) is True
+
+    def test_numbers_range_type_errors(self):
+        from authorino_tpu.evaluators.authorization import rego
+
+        m = rego.compile_module("package t\nv := numbers.range(x, 3)\nx := input.n",
+                                package="t")
+        assert m.evaluate({"n": 1})["v"] == [1, 2, 3]
+        assert m.evaluate({"n": 1.0})["v"] == [1, 2, 3]   # integral float ok
+        with pytest.raises(rego.RegoError):
+            m.evaluate({"n": 1.5})
