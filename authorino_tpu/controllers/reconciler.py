@@ -317,14 +317,21 @@ class SecretReconciler:
                     yield ev
 
     def on_event(self, kind: str, secret: Secret) -> None:
+        changed = False
         if kind == "delete" or not self.secret_label_selector.matches(secret.labels):
             # deleted or unlabeled → revoke everywhere (ref :49-53)
             for ev in self._k8s_secret_based_evaluators():
-                ev.revoke_k8s_secret_based_identity(secret.namespace, secret.name)
-            return
-        for ev in self._k8s_secret_based_evaluators():
-            # per-evaluator selector match → add or revoke (ref :55-60, :108-130)
-            if ev.get_k8s_secret_label_selectors().matches(secret.labels):
-                ev.add_k8s_secret_based_identity(secret)
-            else:
-                ev.revoke_k8s_secret_based_identity(secret.namespace, secret.name)
+                changed |= bool(
+                    ev.revoke_k8s_secret_based_identity(secret.namespace, secret.name))
+        else:
+            for ev in self._k8s_secret_based_evaluators():
+                # per-evaluator selector match → add or revoke (ref :55-60, :108-130)
+                if ev.get_k8s_secret_label_selectors().matches(secret.labels):
+                    changed |= bool(ev.add_k8s_secret_based_identity(secret))
+                else:
+                    changed |= bool(
+                        ev.revoke_k8s_secret_based_identity(secret.namespace, secret.name))
+        if changed:
+            # the native frontend compiles credential→plan variants at
+            # refresh time; rotation must rebuild them (no corpus swap)
+            self.engine.notify_swap_listeners()

@@ -55,14 +55,24 @@ class APIKey:
             raise EvaluationError(INVALID_API_KEY_MSG)
         return secret.to_identity_object()
 
+    def snapshot_secrets(self) -> Dict[str, Secret]:
+        """Point-in-time copy of the key→Secret map — the native frontend
+        resolves each key's ``auth.identity.*`` pattern operands to constants
+        at refresh time (the fast-lane analog of the per-request map lookup,
+        ref :72-93)."""
+        with self._lock:
+            return dict(self._secrets)
+
     # --- K8sSecretBasedIdentity (ref :95-140) ---
 
     def get_k8s_secret_label_selectors(self) -> LabelSelector:
         return self.label_selector
 
-    def add_k8s_secret_based_identity(self, new: Secret) -> None:
+    def add_k8s_secret_based_identity(self, new: Secret) -> bool:
+        """Returns True when the key map actually changed (the reconciler
+        notifies the native frontend only on real mutations)."""
         if not self._within_scope(new.namespace):
-            return
+            return False
         with self._lock:
             new_value = new.data.get(API_KEY_SELECTOR, b"").decode()
             for old_value, current in list(self._secrets.items()):
@@ -70,17 +80,23 @@ class APIKey:
                     if old_value != new_value:
                         self._append(new)
                         del self._secrets[old_value]
-                    return
-            self._append(new)
+                        return True
+                    # same key value: refresh the stored Secret (labels/
+                    # annotations feed auth.identity.* constants)
+                    changed = current.to_identity_object() != new.to_identity_object()
+                    self._secrets[old_value] = new
+                    return changed
+            return self._append(new)
 
-    def revoke_k8s_secret_based_identity(self, namespace: str, name: str) -> None:
+    def revoke_k8s_secret_based_identity(self, namespace: str, name: str) -> bool:
         if not self._within_scope(namespace):
-            return
+            return False
         with self._lock:
             for key, secret in list(self._secrets.items()):
                 if secret.namespace == namespace and secret.name == name:
                     del self._secrets[key]
-                    return
+                    return True
+        return False
 
     def _within_scope(self, namespace: str) -> bool:
         return not self.namespace or self.namespace == namespace

@@ -111,17 +111,19 @@ class MTLS:
     def get_k8s_secret_label_selectors(self) -> LabelSelector:
         return self.label_selector
 
-    def add_k8s_secret_based_identity(self, new: Secret) -> None:
+    def add_k8s_secret_based_identity(self, new: Secret) -> bool:
         if self.namespace and new.namespace != self.namespace:
-            return
+            return False
         with self._lock:
+            before = self._cas.get(new.key)
             self._append(new)
+            return self._cas.get(new.key) is not before
 
-    def revoke_k8s_secret_based_identity(self, namespace: str, name: str) -> None:
+    def revoke_k8s_secret_based_identity(self, namespace: str, name: str) -> bool:
         if self.namespace and namespace != self.namespace:
-            return
+            return False
         with self._lock:
-            self._cas.pop((namespace, name), None)
+            return self._cas.pop((namespace, name), None) is not None
 
     def _append(self, secret: Secret) -> None:
         for key in CA_KEYS:

@@ -121,6 +121,15 @@ class PolicyEngine:
         if cb in self._swap_listeners:
             self._swap_listeners.remove(cb)
 
+    def notify_swap_listeners(self) -> None:
+        """Fire swap listeners without a corpus swap — used by the secret
+        reconciler after in-place API-key/mTLS rotation, so the native
+        frontend rebuilds its credential→plan variants
+        (ref controllers/secret_controller.go:40-130 mutates evaluators in
+        place; the fast lane's compiled view must follow)."""
+        for cb in list(self._swap_listeners):
+            cb()
+
     # ---- control plane ---------------------------------------------------
 
     def _resolve_mesh(self):
@@ -144,8 +153,7 @@ class PolicyEngine:
         with self._swap_lock:
             self._snapshot = snap
             self.index = new_index
-        for cb in list(self._swap_listeners):
-            cb()
+        self.notify_swap_listeners()
 
     def snapshot_policy(self) -> Optional[CompiledPolicy]:
         snap = self._snapshot

@@ -36,17 +36,25 @@ from ..compiler.compile import (
 )
 from ..compiler.intern import PAD
 from ..compiler.pack import _trim_bytes, wire_dtype
+from ..evaluators import credentials as cred_mod
 from ..evaluators.base import DenyWithValues, RuntimeAuthConfig
 from ..evaluators.authorization import PatternMatching
-from ..evaluators.identity import Noop
+from ..evaluators.identity import APIKey, Noop
+from ..evaluators.identity.api_key import INVALID_API_KEY_MSG
 from ..pipeline.pipeline import AuthResult
 from ..utils import bucket_pow2
 from ..utils import metrics as metrics_mod
-from ..utils.rpc import INVALID_ARGUMENT, NOT_FOUND, OK, PERMISSION_DENIED
+from ..utils.rpc import (
+    INVALID_ARGUMENT,
+    NOT_FOUND,
+    OK,
+    PERMISSION_DENIED,
+    UNAUTHENTICATED,
+)
 
 log = logging.getLogger("authorino_tpu.native_frontend")
 
-__all__ = ["NativeFrontend", "fast_lane_eligible"]
+__all__ = ["NativeFrontend", "fast_lane_eligible", "FastLaneSpec"]
 
 # plan kinds — must match native/frontend.cpp PlanKind
 K_CONST, K_METHOD, K_PATH, K_URL_PATH, K_QUERY, K_HOST, K_SCHEME = range(7)
@@ -69,10 +77,10 @@ _SIMPLE = {
 }
 
 
-def _plan_for_selector(selector_str: str, const_doc: Dict[str, Any]):
-    """(kind, key) for a request-derived attr, ("const", value) for one that
-    resolves constantly (auth.* over the anonymous identity), or None when
-    the fast lane cannot encode it."""
+def _classify_selector(selector_str: str):
+    """("req", kind, key) for a request-derived attr, ("auth",) for one that
+    resolves over the identity-dependent ``auth.*`` subtree (constant per
+    identity outcome), or None when the fast lane cannot encode it."""
     if not selector_str or selector_str[0] in "{[":
         return None
     try:
@@ -80,30 +88,58 @@ def _plan_for_selector(selector_str: str, const_doc: Dict[str, Any]):
     except Exception:
         return None
     if not all(s.kind == "key" for s in segs):
-        # gjson-extended selectors over the constant auth tree still resolve
-        # constantly; anything touching the request needs the full engine
+        # gjson-extended selectors over the auth tree still resolve
+        # constantly per identity; anything touching the request needs the
+        # full engine
         keys0 = selector_str.split(".", 1)[0].split("|", 1)[0]
         if keys0 == "auth":
-            res = sel.get(const_doc, selector_str)
-            return ("const", res)
+            return ("auth",)
         return None
     keys = tuple(s.key for s in segs)
     if keys in _SIMPLE:
-        return _SIMPLE[keys]
+        kind, key = _SIMPLE[keys]
+        return ("req", kind, key)
     if len(keys) == 3 and keys[:2] == ("request", "headers"):
-        return (K_HEADER, keys[2])
+        return ("req", K_HEADER, keys[2])
     if len(keys) == 3 and keys[:2] == ("request", "context_extensions"):
-        return (K_CTX_EXT, keys[2])
+        return ("req", K_CTX_EXT, keys[2])
     # legacy context.* mirrors that share exact semantics with the wellknown
     # forms (context_dict filters ""-valued scalar fields, so only the
     # unfiltered maps are plannable)
     if len(keys) == 5 and keys[:4] == ("context", "request", "http", "headers"):
-        return (K_HEADER, keys[4])
+        return ("req", K_HEADER, keys[4])
     if len(keys) == 3 and keys[:2] == ("context", "context_extensions"):
-        return (K_CTX_EXT, keys[2])
+        return ("req", K_CTX_EXT, keys[2])
     if keys[0] == "auth":
-        return ("const", sel.get(const_doc, selector_str))
+        return ("auth",)
     return None
+
+
+def _const_plan(policy: CompiledPolicy, attr: int, const_doc: Dict[str, Any]):
+    """K_CONST plan tuple for `attr` resolved against a constant auth doc,
+    or None when the compact device payload can't hold the value (membership
+    overflow / DFA byte-tensor unfit) — which disqualifies the config."""
+    from ..compiler.encode import _MISSING, _render
+
+    res = sel.get(const_doc, policy.attr_selectors[attr])
+    K = policy.members_k
+    v = res.value if res.exists else _MISSING
+    rendered = _render(v)
+    vid = policy.interner.lookup(rendered)
+    missing = v is _MISSING or v is None
+    members: List[int] = []
+    if isinstance(v, list):
+        if len(v) > K:
+            return None  # const membership overflow: host oracle only
+        members = [policy.interner.lookup(_render(e)) for e in v]
+    elif not missing:
+        members = [vid]
+    raw = rendered.encode("utf-8")
+    if int(policy.attr_byte_slot[attr]) >= 0 and (
+        len(raw) > DFA_VALUE_BYTES or 0 in raw
+    ):
+        return None  # const DFA operand the byte tensor can't hold
+    return (int(attr), K_CONST, "", int(vid), missing, members, raw, False)
 
 
 # the constant auth.* subtree of a fast-lane request (anonymous identity,
@@ -132,16 +168,43 @@ def _deny_with_static(dw: Optional[DenyWithValues]) -> bool:
     return all(_static_value(h.value) for h in dw.headers)
 
 
-def fast_lane_eligible(entry, policy: CompiledPolicy) -> Optional[List[tuple]]:
-    """Returns the C++ encode-plan list when `entry`'s pipeline reduces to
-    the kernel verdict, else None.  Mirrors pipeline.evaluate() phase by
-    phase (ref pkg/service/auth_pipeline.go:451-502): every feature that
-    would need per-request Python work disqualifies."""
+# AuthCredentials location → C++ CredKind (native/frontend.cpp)
+_CRED_KINDS = {
+    cred_mod.LOCATION_AUTH_HEADER: 1,
+    cred_mod.LOCATION_CUSTOM_HEADER: 2,
+    cred_mod.LOCATION_COOKIE: 3,
+    cred_mod.LOCATION_QUERY: 4,
+}
+
+
+@dataclass
+class FastLaneSpec:
+    """Everything the C++ frontend needs to serve one AuthConfig natively.
+
+    ``has_batch`` configs evaluate pattern authorization through the kernel;
+    configs without authorization (identity-only) decide entirely in C++.
+    ``cred_kind != 0`` configs (API-key identity,
+    ref pkg/evaluators/identity/api_key.go:72-93) carry a credential
+    extraction spec plus per-key plan variants: each known key's
+    ``auth.identity.*`` operands are resolved to constants at refresh time;
+    unknown/missing credentials answer with the static UNAUTHENTICATED
+    templates built in NativeFrontend._refresh_locked."""
+
+    plans: List[tuple] = field(default_factory=list)
+    has_batch: bool = False
+    cred_kind: int = 0
+    cred_key: str = ""
+    variants: List[Tuple[bytes, List[tuple]]] = field(default_factory=list)
+
+
+def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[FastLaneSpec]:
+    """Returns a FastLaneSpec when `entry`'s pipeline reduces to a native
+    decision (kernel verdict and/or credential map lookup), else None.
+    Mirrors pipeline.evaluate() phase by phase
+    (ref pkg/service/auth_pipeline.go:451-502): every feature that would
+    need per-request Python work disqualifies."""
     rt: Optional[RuntimeAuthConfig] = entry.runtime
-    if rt is None or entry.rules is None or policy is None:
-        return None
-    row = policy.config_ids.get(entry.rules.name)
-    if row is None:
+    if rt is None:
         return None
     if rt.conditions is not None:
         return None
@@ -150,61 +213,95 @@ def fast_lane_eligible(entry, policy: CompiledPolicy) -> Optional[List[tuple]]:
     if len(rt.identity) != 1:
         return None
     idc = rt.identity[0]
-    if not isinstance(idc.evaluator, Noop):
-        return None
     if idc.conditions is not None or idc.cache is not None or idc.extended_properties:
         return None
-    if not rt.authorization or len(rt.authorization) != len(entry.rules.evaluators):
+    if idc.metrics or metrics_mod.DEEP_METRICS_ENABLED:
+        return None  # deep per-evaluator series need the pipeline
+    ident = idc.evaluator
+    is_noop = isinstance(ident, Noop)
+    is_key = isinstance(ident, APIKey)
+    if not is_noop and not is_key:
         return None
-    for conf in rt.authorization:
-        if not isinstance(conf.evaluator, PatternMatching):
+    cred_kind = 0
+    if is_key:
+        cred_kind = _CRED_KINDS.get(ident.credentials.location, 0)
+        if cred_kind == 0:
             return None
-        if conf.evaluator.batched_provider is None:
+        # missing/unknown credentials answer from a static template — the
+        # identity-failure denyWith must resolve without a request doc
+        if not _deny_with_static(rt.deny_with.unauthenticated):
             return None
-        if conf.conditions is not None or conf.cache is not None:
-            return None
-        if conf.metrics:
-            return None
-    if metrics_mod.DEEP_METRICS_ENABLED:
-        return None
-    if not _deny_with_static(rt.deny_with.unauthorized):
-        return None
 
     plans: List[tuple] = []
-    K = policy.members_k
-    for attr in policy.config_attrs[row]:
-        p = _plan_for_selector(policy.attr_selectors[attr], _CONST_AUTH_DOC)
-        if p is None:
+    auth_attrs: List[int] = []
+    has_batch = False
+    if rt.authorization:
+        if entry.rules is None or policy is None:
             return None
-        if p[0] == "const":
-            res = p[1]
-            from ..compiler.encode import _MISSING, _render
+        row = policy.config_ids.get(entry.rules.name)
+        if row is None:
+            return None
+        if len(rt.authorization) != len(entry.rules.evaluators):
+            return None
+        for conf in rt.authorization:
+            if not isinstance(conf.evaluator, PatternMatching):
+                return None
+            if conf.evaluator.batched_provider is None:
+                return None
+            if conf.conditions is not None or conf.cache is not None:
+                return None
+            if conf.metrics:
+                return None
+        if not _deny_with_static(rt.deny_with.unauthorized):
+            return None
+        # per-request regex/tree oracles cannot run in C++
+        for leaf in policy.config_cpu_leaves[row]:
+            if int(policy.leaf_op[leaf]) in (OP_CPU, OP_TREE_CPU):
+                return None
+        has_batch = True
+        for attr in policy.config_attrs[row]:
+            c = _classify_selector(policy.attr_selectors[attr])
+            if c is None:
+                return None
+            if c[0] == "req":
+                plans.append((int(attr), c[1], c[2], 0, False, [], b"", False))
+            else:
+                auth_attrs.append(int(attr))
+    elif entry.rules is not None and entry.rules.evaluators:
+        return None  # compiled rules without runtime authz configs: engine bug
 
-            v = res.value if res.exists else _MISSING
-            rendered = _render(v)
-            vid = policy.interner.lookup(rendered)
-            missing = v is _MISSING or v is None
-            members: List[int] = []
-            if isinstance(v, list):
-                if len(v) > K:
-                    return None  # const membership overflow: host oracle only
-                members = [policy.interner.lookup(_render(e)) for e in v]
-            elif not missing:
-                members = [vid]
-            raw = rendered.encode("utf-8")
-            if int(policy.attr_byte_slot[attr]) >= 0 and (
-                len(raw) > DFA_VALUE_BYTES or 0 in raw
-            ):
-                return None  # const DFA operand the byte tensor can't hold
-            plans.append((attr, K_CONST, "", int(vid), missing, members, raw, False))
-        else:
-            kind, key = p
-            plans.append((attr, kind, key, 0, False, [], b"", False))
-    # per-request regex/tree oracles cannot run in C++
-    for leaf in policy.config_cpu_leaves[row]:
-        if int(policy.leaf_op[leaf]) in (OP_CPU, OP_TREE_CPU):
-            return None
-    return plans
+    spec = FastLaneSpec(plans=plans, has_batch=has_batch, cred_kind=cred_kind)
+    if is_noop:
+        for attr in auth_attrs:
+            p = _const_plan(policy, attr, _CONST_AUTH_DOC)
+            if p is None:
+                return None
+            spec.plans.append(p)
+        return spec
+    # API key: resolve each known key's auth.* operands to constants
+    # (the fast-lane analog of precompile-at-reconcile,
+    # ref pkg/evaluators/authorization/opa.go:141)
+    key_sel = ident.credentials.key_selector
+    spec.cred_key = key_sel.lower() if cred_kind == 2 else key_sel
+    for key, secret in ident.snapshot_secrets().items():
+        vplans: List[tuple] = []
+        if auth_attrs:
+            doc = {
+                "auth": {
+                    "identity": secret.to_identity_object(),
+                    "metadata": {},
+                    "authorization": {},
+                    "response": {},
+                    "callbacks": {},
+                }
+            }
+            for attr in auth_attrs:
+                p = _const_plan(policy, attr, doc)
+                if p is None:
+                    return None
+                vplans.append(p)
+        spec.variants.append((key.encode("utf-8"), vplans))
+    return spec
 
 
 @dataclass
@@ -299,13 +396,15 @@ class NativeFrontend:
 
         return check_response_from_result(result).SerializeToString()
 
-    def _deny_result(self, rt: RuntimeAuthConfig) -> AuthResult:
-        """Mirror of pipeline._customize_deny_with on the static denyWith
-        (ref pkg/service/auth_pipeline.go:581-608)."""
+    @staticmethod
+    def _static_deny(code: int, message: str, headers: List[Dict[str, str]],
+                     deny: Optional[DenyWithValues]) -> AuthResult:
+        """Static mirror of pipeline._customize_deny_with
+        (ref pkg/service/auth_pipeline.go:581-608): the denyWith values are
+        pre-checked static, so they resolve against an empty doc."""
         from ..authjson.value import stringify_json
 
-        result = AuthResult(code=PERMISSION_DENIED, message="Unauthorized")
-        deny = rt.deny_with.unauthorized
+        result = AuthResult(code=code, message=message, headers=headers)
         if deny is not None:
             if deny.code:
                 result.status = deny.code
@@ -319,6 +418,19 @@ class NativeFrontend:
                     for h in deny.headers
                 ]
         return result
+
+    def _deny_result(self, rt: RuntimeAuthConfig) -> AuthResult:
+        """Authorization-failure template (ref pkg/service/auth_pipeline.go:478-481)."""
+        return self._static_deny(
+            PERMISSION_DENIED, "Unauthorized", [], rt.deny_with.unauthorized)
+
+    def _unauth_result(self, rt: RuntimeAuthConfig, message: str) -> AuthResult:
+        """Identity-failure template for a single-identity config:
+        UNAUTHENTICATED + WWW-Authenticate challenges + static
+        denyWith.unauthenticated (ref pkg/service/auth_pipeline.go:468-472)."""
+        return self._static_deny(
+            UNAUTHENTICATED, message, rt.challenge_headers(),
+            rt.deny_with.unauthenticated)
 
     # ------------------------------------------------------------------
     def refresh(self) -> None:
@@ -370,9 +482,11 @@ class NativeFrontend:
         # slow lane while tracing is on
         from ..utils.tracing import tracing_active
 
-        if tracing_active():
+        allow_fast = not tracing_active()
+        if not allow_fast:
             policy = None
 
+        enc = None
         if policy is not None:
             from ..native.encoder import get_native_encoder
             from ..ops.pattern_eval import to_device
@@ -422,36 +536,55 @@ class NativeFrontend:
                     rec.arrays.append(a)
                     spec["slots"].append({k: v.ctypes.data for k, v in a.items()})
 
-                fc_rows = []
-                fast_ids = set()
-                for entry in entries:
-                    plans = fast_lane_eligible(entry, policy)
-                    if plans is None:
-                        continue
-                    fast_ids.add(id(entry))
-                    row = policy.config_ids[entry.rules.name]
-                    fc_idx = len(fcs)
-                    fcs.append({
-                        "row": int(row),
-                        "ok": ok_bytes,
-                        "deny": self._result_bytes(self._deny_result(entry.runtime)),
-                        "plans": plans,
-                    })
-                    fc_rows.append(int(row))
-                    # per-authconfig metric labels — EXACTLY the pipeline's
-                    # scheme (ref pkg/service/auth_pipeline.go:26-36; translate
-                    # injects namespace/name into runtime labels), so a
-                    # config's fast- and slow-lane traffic lands on one series
-                    lbl = entry.runtime.labels or {}
-                    rec.row_labels[int(row)] = (
-                        lbl.get("namespace", ""), lbl.get("name", ""))
-                    for host in entry.hosts:
-                        hosts.append((host, fc_idx))
-                rec.fc_rows = np.asarray(fc_rows or [0], dtype=np.int64)
             else:
-                fast_ids = set()
-        else:
-            fast_ids = set()
+                policy = None  # no native encoder → kernel fast lane off
+
+        fast_ids = set()
+        fc_rows: List[int] = []
+        if allow_fast:
+            for entry in entries:
+                spec_fl = fast_lane_eligible(entry, policy)
+                if spec_fl is None:
+                    continue
+                fast_ids.add(id(entry))
+                fc_idx = len(fcs)
+                # per-authconfig metric labels — EXACTLY the pipeline's
+                # scheme (ref pkg/service/auth_pipeline.go:26-36; translate
+                # injects namespace/name into runtime labels), so a
+                # config's fast- and slow-lane traffic lands on one series
+                lbl = entry.runtime.labels or {}
+                ns_l, nm_l = lbl.get("namespace", ""), lbl.get("name", "")
+                fc = {
+                    "row": 0,
+                    "has_batch": 1 if spec_fl.has_batch else 0,
+                    "ok": ok_bytes,
+                    "deny": self._result_bytes(self._deny_result(entry.runtime)),
+                    "plans": spec_fl.plans,
+                    "cred_kind": spec_fl.cred_kind,
+                    "cred_key": spec_fl.cred_key,
+                    "variants": spec_fl.variants,
+                    "unauth_missing": b"",
+                    "unauth_invalid": b"",
+                    "ns": ns_l,
+                    "name": nm_l,
+                }
+                if spec_fl.has_batch:
+                    row = policy.config_ids[entry.rules.name]
+                    fc["row"] = int(row)
+                    fc_rows.append(int(row))
+                    rec.row_labels[int(row)] = (ns_l, nm_l)
+                if spec_fl.cred_kind:
+                    # static identity-failure templates, byte-exact with the
+                    # pipeline's UNAUTHENTICATED + challenges + denyWith path
+                    # (ref pkg/service/auth_pipeline.go:468-472)
+                    fc["unauth_missing"] = self._result_bytes(
+                        self._unauth_result(entry.runtime, "credential not found"))
+                    fc["unauth_invalid"] = self._result_bytes(
+                        self._unauth_result(entry.runtime, INVALID_API_KEY_MSG))
+                fcs.append(fc)
+                for host in entry.hosts:
+                    hosts.append((host, fc_idx))
+        rec.fc_rows = np.asarray(fc_rows or [0], dtype=np.int64)
 
         # non-fast hosts route to the Python pipeline (slow lane)
         fast_hosts = {h for h, _ in hosts}
@@ -470,10 +603,23 @@ class NativeFrontend:
                  snap_id, len(fcs), len(hosts))
 
     # ------------------------------------------------------------------
+    def _fold_fc_counts(self) -> None:
+        """Fold C++-side direct decisions (identity-only OKs, credential
+        denials) into the same per-authconfig Prometheus series the pipeline
+        bumps (ref pkg/service/auth_pipeline.go:26-36)."""
+        for ns, name, ok, missing, invalid in self._mod.fe_drain_fc_counts():
+            metrics_mod.authconfig_total.labels(ns, name).inc(ok + missing + invalid)
+            if ok:
+                metrics_mod.authconfig_response_status.labels(ns, name, "OK").inc(ok)
+            if missing or invalid:
+                metrics_mod.authconfig_response_status.labels(
+                    ns, name, "UNAUTHENTICATED").inc(missing + invalid)
+
     def _dispatch_loop(self) -> None:
         mod = self._mod
         while self._running:
             kind, a, b, c = mod.fe_wait_batch(200)
+            self._fold_fc_counts()
             if kind == EV_BATCH:
                 try:
                     self._dispatch(int(a), int(b), int(c))

@@ -40,7 +40,9 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <array>
 #include <atomic>
+#include <cctype>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -363,9 +365,19 @@ struct FastPlan {
 
 struct FastConfig {
   int32_t row = 0;
+  bool has_batch = true;        // false → identity-only: decide entirely here
   std::vector<FastPlan> plans;
   bool needs_split = false;     // any K_URL_PATH / K_QUERY plan
   std::string ok_msg, deny_msg; // CheckResponse payloads (pb2-built in Python)
+  // credential-bearing identity (API key, ref pkg/evaluators/identity/
+  // api_key.go:72-93): extraction spec + per-key plan variants whose
+  // auth.identity.* operands were resolved to constants at refresh time
+  int cred_kind = 0;            // 0 none, 1 auth header, 2 custom header, 3 cookie, 4 query
+  std::string cred_key;
+  std::unordered_map<std::string, int32_t> variants;  // key → var_plans idx
+  std::vector<std::vector<FastPlan>> var_plans;
+  std::string unauth_missing_msg, unauth_invalid_msg;
+  std::string ns, name;         // per-authconfig metric labels
 };
 
 struct DfaRef { int32_t row; int32_t col; };  // dfa table row, cpu_dense column
@@ -408,6 +420,10 @@ struct Snapshot {
   // global response templates (pb2-built in Python for byte parity with the
   // Python gRPC server)
   std::string invalid_msg, notfound_msg, health_msg;
+  // per-fc direct-decision counters [ok, unauth_missing, unauth_invalid] —
+  // decisions that never enter a batch; the Python dispatcher folds them
+  // into the pipeline's Prometheus series (fe_drain_fc_counts)
+  std::unique_ptr<std::atomic<uint64_t>[]> fc_counts;
 };
 
 // ---------------------------------------------------------------------------
@@ -478,6 +494,10 @@ struct Server {
   uint32_t next_conn_id = 1;
   std::shared_ptr<Snapshot> cur;                      // swapped under mu
   std::unordered_map<int64_t, std::shared_ptr<Snapshot>> snaps;
+  // snapshot the epoll thread is mid-request on (under mu): retirement
+  // must skip it so direct-decision counter bumps are never lost to an
+  // already-drained, erased snapshot
+  Snapshot* epoll_pin = nullptr;
   // current filling batch (epoll thread only, but slot recycle under mu)
   int fill_slot = -1;
   int fill_count = 0;
@@ -499,7 +519,10 @@ struct Server {
   // stats
   std::atomic<uint64_t> n_fast{0}, n_slow{0}, n_notfound{0}, n_invalid{0},
       n_health{0}, n_allowed{0}, n_denied{0}, n_dfa_ovf{0}, n_slow_shed{0},
-      n_parse_err{0}, n_conns{0};
+      n_parse_err{0}, n_conns{0}, n_unauth{0}, n_direct_ok{0};
+  // fc counters of retired snapshots not yet drained (key ns+'\x1f'+name;
+  // under mu)
+  std::unordered_map<std::string, std::array<uint64_t, 3>> fc_leftover;
 };
 
 static Server* g_srv = nullptr;
@@ -596,10 +619,73 @@ static void render_i64(int64_t v, std::string& out) {
   out.assign(buf, (size_t)n);
 }
 
+// mirror of evaluators/credentials.py AuthCredentials.extract
+// (ref pkg/auth/credentials.go:62-75); false → credential not found
+static bool extract_cred(const FastConfig& fc, const ReqView& rv, std::string& cred) {
+  const size_t kl = fc.cred_key.size();
+  switch (fc.cred_kind) {
+    case 1: {  // authorization header: "<key_selector> <cred>"
+      const PbView* h = map_get(rv.headers, "authorization", 13);
+      if (!h) return false;
+      if (h->n < kl + 1 || memcmp(h->p, fc.cred_key.data(), kl) != 0 ||
+          h->p[kl] != ' ')
+        return false;
+      cred.assign(h->p + kl + 1, h->n - kl - 1);
+      return true;
+    }
+    case 2: {  // custom header (name pre-lowercased in Python)
+      const PbView* h = map_get(rv.headers, fc.cred_key.data(), kl);
+      if (!h) return false;
+      cred.assign(h->p, h->n);
+      return true;
+    }
+    case 3: {  // cookie: split on ';', strip, "<key>=<cred>"
+      const PbView* h = map_get(rv.headers, "cookie", 6);
+      if (!h) return false;
+      const char* p = h->p;
+      const char* end = p + h->n;
+      while (p < end) {
+        const char* semi = (const char*)memchr(p, ';', (size_t)(end - p));
+        const char* pe = semi ? semi : end;
+        const char* a = p;
+        const char* b = pe;
+        while (a < b && isspace((unsigned char)*a)) ++a;
+        while (b > a && isspace((unsigned char)b[-1])) --b;
+        if ((size_t)(b - a) >= kl + 1 && memcmp(a, fc.cred_key.data(), kl) == 0 &&
+            a[kl] == '=') {
+          cred.assign(a + kl + 1, (size_t)(b - a) - kl - 1);
+          return true;
+        }
+        if (!semi) break;
+        p = semi + 1;
+      }
+      return false;
+    }
+    case 4: {  // query param in the raw path: [?&]<key>=([^&]*)
+      if (!rv.path.set) return false;
+      const char* p = rv.path.p;
+      const size_t n = rv.path.n;
+      for (size_t i = 0; i + kl + 2 <= n; ++i) {
+        if ((p[i] == '?' || p[i] == '&') &&
+            memcmp(p + i + 1, fc.cred_key.data(), kl) == 0 && p[i + 1 + kl] == '=') {
+          const char* vs = p + i + 2 + kl;
+          const char* ve = (const char*)memchr(vs, '&', (size_t)(p + n - vs));
+          cred.assign(vs, ve ? (size_t)(ve - vs) : (size_t)(p + n - vs));
+          return true;
+        }
+      }
+      return false;
+    }
+  }
+  return false;
+}
+
 // encode one request into row b of the filling slot; returns false when the
-// request needs the slow lane after all (odd path shapes)
+// request needs the slow lane after all (odd path shapes).  `extra` carries
+// the per-credential K_CONST plan variant (API-key identity), if any.
 static bool encode_fast(Server* S, Snapshot* snap, Slot& sl, int b,
-                        const FastConfig& fc, const ReqView& rv) {
+                        const FastConfig& fc, const std::vector<FastPlan>* extra,
+                        const ReqView& rv) {
   // pre-split path once if any plan needs url_path/query (urlsplit parity
   // only holds for origin-form paths; anything else → slow lane)
   PbView url_path, qpart;
@@ -621,7 +707,10 @@ static bool encode_fast(Server* S, Snapshot* snap, Slot& sl, int b,
 
   const int A = snap->A, K = snap->K, NB = snap->NB, DVB = snap->DVB;
   std::string tmp;
-  for (const FastPlan& pl : fc.plans) {
+  const std::vector<FastPlan>* lists[2] = {&fc.plans, extra};
+  for (int li = 0; li < 2; ++li) {
+  if (lists[li] == nullptr) continue;
+  for (const FastPlan& pl : *lists[li]) {
     const int32_t attr = pl.attr;
     int32_t vid;
     const char* vp = nullptr;
@@ -694,6 +783,7 @@ static bool encode_fast(Server* S, Snapshot* snap, Slot& sl, int b,
         memcpy(sl.attr_bytes + ((int64_t)b * NB + bslot) * DVB, vp, vn);
       }
     }
+  }
   }
   sl.config_id[b] = fc.row;
   return true;
@@ -875,7 +965,22 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
   {
     std::lock_guard<std::mutex> lk(S->mu);
     snap = S->cur;
+    S->epoll_pin = snap.get();
   }
+  // unpin at every exit; a swap may have been waiting on the pin, so run
+  // the retire check the moment it clears
+  struct PinGuard {
+    Server* S;
+    ~PinGuard() {
+      std::vector<int64_t> retired;
+      {
+        std::lock_guard<std::mutex> lk(S->mu);
+        S->epoll_pin = nullptr;
+        maybe_retire_locked(S, retired);
+      }
+      emit_retired(S, retired);
+    }
+  } pin_guard{S};
   if (!snap) { push_slow(S, c, stream_id, msg, mlen); return; }
 
   ReqView rv;
@@ -908,6 +1013,40 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
   if (fc_idx < 0) { push_slow(S, c, stream_id, msg, mlen); return; }
 
   const FastConfig& fc = snap->fcs[fc_idx];
+  const std::vector<FastPlan>* extra = nullptr;
+  if (fc.cred_kind != 0) {
+    // API-key identity: map lookup selects the per-key plan variant;
+    // missing/unknown credentials answer from the static UNAUTHENTICATED
+    // templates (ref pkg/service/auth_pipeline.go:468-472)
+    std::string cred;
+    if (!extract_cred(fc, rv, cred)) {
+      snap->fc_counts[3 * (size_t)fc_idx + 1].fetch_add(1, std::memory_order_relaxed);
+      S->n_fast.fetch_add(1, std::memory_order_relaxed);
+      S->n_unauth.fetch_add(1, std::memory_order_relaxed);
+      S->n_denied.fetch_add(1, std::memory_order_relaxed);
+      submit_grpc_response(c, stream_id, fc.unauth_missing_msg);
+      return;
+    }
+    auto vit = fc.variants.find(cred);
+    if (vit == fc.variants.end()) {
+      snap->fc_counts[3 * (size_t)fc_idx + 2].fetch_add(1, std::memory_order_relaxed);
+      S->n_fast.fetch_add(1, std::memory_order_relaxed);
+      S->n_unauth.fetch_add(1, std::memory_order_relaxed);
+      S->n_denied.fetch_add(1, std::memory_order_relaxed);
+      submit_grpc_response(c, stream_id, fc.unauth_invalid_msg);
+      return;
+    }
+    extra = &fc.var_plans[vit->second];
+  }
+  if (!fc.has_batch) {
+    // identity-only config: authenticated → OK, no kernel involvement
+    snap->fc_counts[3 * (size_t)fc_idx].fetch_add(1, std::memory_order_relaxed);
+    S->n_fast.fetch_add(1, std::memory_order_relaxed);
+    S->n_direct_ok.fetch_add(1, std::memory_order_relaxed);
+    S->n_allowed.fetch_add(1, std::memory_order_relaxed);
+    submit_grpc_response(c, stream_id, fc.ok_msg);
+    return;
+  }
   std::shared_ptr<Snapshot> fsnap;
   Slot* sl = ensure_fill(S, fsnap);
   if (sl == nullptr) {
@@ -923,7 +1062,7 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
   }
   int b = S->fill_count;
   zero_row(snap.get(), *sl, b);
-  if (!encode_fast(S, snap.get(), *sl, b, fc, rv)) {
+  if (!encode_fast(S, snap.get(), *sl, b, fc, extra, rv)) {
     push_slow(S, c, stream_id, msg, mlen);
     return;
   }
@@ -1257,14 +1396,55 @@ static void wake_epoll(Server* S) {
 static void maybe_retire_locked(Server* S, std::vector<int64_t>& retired) {
   for (auto it = S->snaps.begin(); it != S->snaps.end();) {
     Snapshot* sn = it->second.get();
-    if (it->second != S->cur && sn->pending_batches == 0 &&
+    if (it->second != S->cur && sn->pending_batches == 0 && sn != S->epoll_pin &&
         (S->fill_snap == nullptr || S->fill_snap.get() != sn)) {
+      // undrained direct-decision counters survive retirement in the
+      // leftover map so no metric increment is lost
+      for (size_t f = 0; sn->fc_counts && f < sn->fcs.size(); ++f) {
+        uint64_t ok = sn->fc_counts[3 * f].exchange(0);
+        uint64_t mi = sn->fc_counts[3 * f + 1].exchange(0);
+        uint64_t inv = sn->fc_counts[3 * f + 2].exchange(0);
+        if (ok | mi | inv) {
+          auto& agg = S->fc_leftover[sn->fcs[f].ns + '\x1f' + sn->fcs[f].name];
+          agg[0] += ok;
+          agg[1] += mi;
+          agg[2] += inv;
+        }
+      }
       retired.push_back(sn->id);
       it = S->snaps.erase(it);
     } else {
       ++it;
     }
   }
+}
+
+// drain per-authconfig direct-decision counters (all live snapshots + the
+// leftovers of retired ones) into `out`, keyed ns+'\x1f'+name
+static void drain_fc_counts(
+    Server* S, std::unordered_map<std::string, std::array<uint64_t, 3>>& out) {
+  std::lock_guard<std::mutex> lk(S->mu);
+  for (auto& kv : S->snaps) {
+    Snapshot* sn = kv.second.get();
+    for (size_t f = 0; sn->fc_counts && f < sn->fcs.size(); ++f) {
+      uint64_t ok = sn->fc_counts[3 * f].exchange(0);
+      uint64_t mi = sn->fc_counts[3 * f + 1].exchange(0);
+      uint64_t inv = sn->fc_counts[3 * f + 2].exchange(0);
+      if (ok | mi | inv) {
+        auto& agg = out[sn->fcs[f].ns + '\x1f' + sn->fcs[f].name];
+        agg[0] += ok;
+        agg[1] += mi;
+        agg[2] += inv;
+      }
+    }
+  }
+  for (auto& kv : S->fc_leftover) {
+    auto& agg = out[kv.first];
+    agg[0] += kv.second[0];
+    agg[1] += kv.second[1];
+    agg[2] += kv.second[2];
+  }
+  S->fc_leftover.clear();
 }
 
 static void emit_retired(Server* S, const std::vector<int64_t>& retired) {

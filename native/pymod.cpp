@@ -289,6 +289,43 @@ static bool dict_bytes(PyObject* d, const char* k, std::string& out) {
   return true;
 }
 
+static bool dict_str(PyObject* d, const char* k, std::string& out) {
+  PyObject* v = PyDict_GetItemString(d, k);
+  if (v == nullptr || !PyUnicode_Check(v)) return false;
+  Py_ssize_t n;
+  const char* s = PyUnicode_AsUTF8AndSize(v, &n);
+  if (s == nullptr) return false;
+  out.assign(s, (size_t)n);
+  return true;
+}
+
+// plan tuple list (runtime/native_frontend.py plan format) → FastPlan vector
+static bool parse_plans(PyObject* plans, std::vector<fe::FastPlan>& out,
+                        bool* needs_split) {
+  for (Py_ssize_t j = 0; plans != nullptr && j < PyList_GET_SIZE(plans); ++j) {
+    PyObject* t = PyList_GET_ITEM(plans, j);
+    fe::FastPlan pl;
+    pl.attr = (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(t, 0));
+    pl.kind = (int)PyLong_AsLong(PyTuple_GET_ITEM(t, 1));
+    Py_ssize_t kn;
+    const char* ks = PyUnicode_AsUTF8AndSize(PyTuple_GET_ITEM(t, 2), &kn);
+    if (ks == nullptr) return false;
+    pl.key.assign(ks, (size_t)kn);
+    pl.const_vid = (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(t, 3));
+    pl.const_missing = PyObject_IsTrue(PyTuple_GET_ITEM(t, 4)) == 1;
+    PyObject* mems = PyTuple_GET_ITEM(t, 5);
+    for (Py_ssize_t m = 0; m < PyList_GET_SIZE(mems); ++m)
+      pl.const_members.push_back((int32_t)PyLong_AsLong(PyList_GET_ITEM(mems, m)));
+    PyObject* cb = PyTuple_GET_ITEM(t, 6);
+    pl.const_bytes.assign(PyBytes_AS_STRING(cb), (size_t)PyBytes_GET_SIZE(cb));
+    pl.const_byte_ovf = PyObject_IsTrue(PyTuple_GET_ITEM(t, 7)) == 1;
+    if (needs_split && (pl.kind == fe::K_URL_PATH || pl.kind == fe::K_QUERY))
+      *needs_split = true;
+    out.push_back(std::move(pl));
+  }
+  return true;
+}
+
 // fe_start(port, bmax, nslots, window_us, slow_cap, health_bytes, any_addr) -> 0
 PyObject* fe_start_py(PyObject*, PyObject* args) {
   int port, bmax, nslots, any_addr = 0;
@@ -401,31 +438,35 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
     PyObject* f = PyList_GET_ITEM(fcs, i);
     fe::FastConfig fc;
     fc.row = (int32_t)dict_int(f, "row");
+    fc.has_batch = dict_int(f, "has_batch", 1) != 0;
     dict_bytes(f, "ok", fc.ok_msg);
     dict_bytes(f, "deny", fc.deny_msg);
-    PyObject* plans = PyDict_GetItemString(f, "plans");
-    for (Py_ssize_t j = 0; plans != nullptr && j < PyList_GET_SIZE(plans); ++j) {
-      PyObject* t = PyList_GET_ITEM(plans, j);
-      fe::FastPlan pl;
-      pl.attr = (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(t, 0));
-      pl.kind = (int)PyLong_AsLong(PyTuple_GET_ITEM(t, 1));
-      Py_ssize_t kn;
-      const char* ks = PyUnicode_AsUTF8AndSize(PyTuple_GET_ITEM(t, 2), &kn);
-      if (ks == nullptr) return nullptr;
-      pl.key.assign(ks, (size_t)kn);
-      pl.const_vid = (int32_t)PyLong_AsLong(PyTuple_GET_ITEM(t, 3));
-      pl.const_missing = PyObject_IsTrue(PyTuple_GET_ITEM(t, 4)) == 1;
-      PyObject* mems = PyTuple_GET_ITEM(t, 5);
-      for (Py_ssize_t m = 0; m < PyList_GET_SIZE(mems); ++m)
-        pl.const_members.push_back((int32_t)PyLong_AsLong(PyList_GET_ITEM(mems, m)));
-      PyObject* cb = PyTuple_GET_ITEM(t, 6);
-      pl.const_bytes.assign(PyBytes_AS_STRING(cb), (size_t)PyBytes_GET_SIZE(cb));
-      pl.const_byte_ovf = PyObject_IsTrue(PyTuple_GET_ITEM(t, 7)) == 1;
-      if (pl.kind == fe::K_URL_PATH || pl.kind == fe::K_QUERY) fc.needs_split = true;
-      fc.plans.push_back(std::move(pl));
+    if (!parse_plans(PyDict_GetItemString(f, "plans"), fc.plans, &fc.needs_split))
+      return nullptr;
+    fc.cred_kind = (int)dict_int(f, "cred_kind", 0);
+    dict_str(f, "cred_key", fc.cred_key);
+    dict_str(f, "ns", fc.ns);
+    dict_str(f, "name", fc.name);
+    dict_bytes(f, "unauth_missing", fc.unauth_missing_msg);
+    dict_bytes(f, "unauth_invalid", fc.unauth_invalid_msg);
+    PyObject* vars = PyDict_GetItemString(f, "variants");
+    for (Py_ssize_t j = 0; vars != nullptr && j < PyList_GET_SIZE(vars); ++j) {
+      PyObject* kv = PyList_GET_ITEM(vars, j);
+      PyObject* kb = PyTuple_GET_ITEM(kv, 0);
+      if (!PyBytes_Check(kb)) {
+        PyErr_SetString(PyExc_TypeError, "variant key must be bytes");
+        return nullptr;
+      }
+      std::vector<fe::FastPlan> vp;
+      if (!parse_plans(PyTuple_GET_ITEM(kv, 1), vp, nullptr)) return nullptr;
+      int32_t vid = (int32_t)fc.var_plans.size();
+      fc.var_plans.push_back(std::move(vp));
+      fc.variants[std::string(PyBytes_AS_STRING(kb),
+                              (size_t)PyBytes_GET_SIZE(kb))] = vid;
     }
     snap->fcs.push_back(std::move(fc));
   }
+  snap->fc_counts.reset(new std::atomic<uint64_t>[snap->fcs.size() * 3 + 1]());
   PyObject* hosts = PyDict_GetItemString(d, "hosts");
   for (Py_ssize_t i = 0; hosts != nullptr && i < PyList_GET_SIZE(hosts); ++i) {
     PyObject* t = PyList_GET_ITEM(hosts, i);
@@ -541,6 +582,32 @@ PyObject* fe_complete_slow_py(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// fe_drain_fc_counts() -> list[(ns, name, ok, unauth_missing, unauth_invalid)]
+// — per-authconfig direct decisions since the last drain (the dispatcher
+// folds them into the pipeline's Prometheus series)
+PyObject* fe_drain_fc_counts_py(PyObject*, PyObject*) {
+  fe::Server* S = fe::g_srv;
+  PyObject* out = PyList_New(0);
+  if (S == nullptr || out == nullptr) return out;
+  std::unordered_map<std::string, std::array<uint64_t, 3>> agg;
+  Py_BEGIN_ALLOW_THREADS
+  fe::drain_fc_counts(S, agg);
+  Py_END_ALLOW_THREADS
+  for (auto& kv : agg) {
+    size_t sep = kv.first.find('\x1f');
+    if (sep == std::string::npos) continue;
+    PyObject* t = Py_BuildValue(
+        "(s#s#KKK)", kv.first.data(), (Py_ssize_t)sep, kv.first.data() + sep + 1,
+        (Py_ssize_t)(kv.first.size() - sep - 1),
+        (unsigned long long)kv.second[0], (unsigned long long)kv.second[1],
+        (unsigned long long)kv.second[2]);
+    if (t == nullptr) { Py_DECREF(out); return nullptr; }
+    PyList_Append(out, t);
+    Py_DECREF(t);
+  }
+  return out;
+}
+
 PyObject* fe_stats_py(PyObject*, PyObject*) {
   fe::Server* S = fe::g_srv;
   PyObject* d = PyDict_New();
@@ -561,6 +628,8 @@ PyObject* fe_stats_py(PyObject*, PyObject*) {
   put("slow_shed", S->n_slow_shed.load());
   put("parse_errors", S->n_parse_err.load());
   put("connections", S->n_conns.load());
+  put("unauth", S->n_unauth.load());
+  put("direct_ok", S->n_direct_ok.load());
   return d;
 }
 
@@ -577,6 +646,8 @@ PyMethodDef methods[] = {
     {"fe_complete_batch", fe_complete_batch_py, METH_VARARGS, "complete a batch"},
     {"fe_complete_slow", fe_complete_slow_py, METH_VARARGS, "complete a slow request"},
     {"fe_stats", fe_stats_py, METH_NOARGS, "frontend counters"},
+    {"fe_drain_fc_counts", fe_drain_fc_counts_py, METH_NOARGS,
+     "drain per-authconfig direct-decision counters"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -92,17 +92,65 @@ def build_engine() -> PolicyEngine:
             message=JSONValue(static="moved"),
             headers=[JSONProperty("Location", JSONValue(static="http://login.test"))],
         ))))
-    # slow: API-key identity (per-request Python)
+    # fast (round 4): API-key identity-only — credential map lookup, pure
+    # C++ decision, no kernel involvement
     api_key = APIKey("friends", LabelSelector.from_spec({"matchLabels": {"g": "t"}}),
                      credentials=AuthCredentials(key_selector="APIKEY"))
     api_key.add_k8s_secret_based_identity(
         Secret(namespace="ns", name="k1", labels={"g": "t"}, data={"api_key": b"sekret"}))
     entries.append(EngineEntry(
-        id="ns/slow-key", hosts=["slow-key.test"],
+        id="ns/fast-keyonly", hosts=["slow-key.test"],
         runtime=RuntimeAuthConfig(
+            labels={"namespace": "ns", "name": "fast-keyonly"},
             identity=[IdentityConfig("friends", api_key,
                                      credentials=AuthCredentials(key_selector="APIKEY"))]),
         rules=None))
+    # fast (round 4): API-key identity + patterns over auth.identity.* —
+    # per-key plan variants resolved at refresh time
+    api_key2 = APIKey(
+        "team", LabelSelector.from_spec({"matchLabels": {"g": "t2"}}),
+        credentials=AuthCredentials(key_selector="X-API-KEY", location="custom_header"))
+    api_key2.add_k8s_secret_based_identity(Secret(
+        namespace="ns", name="adm", labels={"g": "t2"},
+        annotations={"role": "admin"}, data={"api_key": b"adminkey"}))
+    api_key2.add_k8s_secret_based_identity(Secret(
+        namespace="ns", name="usr", labels={"g": "t2"},
+        annotations={"role": "user"}, data={"api_key": b"userkey"}))
+    rule_role = Pattern("auth.identity.metadata.annotations.role", Operator.EQ, "admin")
+    pm_role = PatternMatching(rule_role, batched_provider=engine.provider_for("ns/fast-key"),
+                              evaluator_slot=0)
+    entries.append(EngineEntry(
+        id="ns/fast-key", hosts=["fast-key.test"],
+        runtime=RuntimeAuthConfig(
+            labels={"namespace": "ns", "name": "fast-key"},
+            identity=[IdentityConfig(
+                "team", api_key2,
+                credentials=AuthCredentials(key_selector="X-API-KEY",
+                                            location="custom_header"))],
+            authorization=[AuthorizationConfig("rules", pm_role)]),
+        rules=ConfigRules(name="ns/fast-key", evaluators=[(None, rule_role)])))
+    # fast (round 4): remaining credential locations (cookie / query)
+    for host, loc, sel in (("cookie-key.test", "cookie", "ses"),
+                           ("query-key.test", "query", "tok")):
+        ak = APIKey(f"k-{loc}", LabelSelector.from_spec({"matchLabels": {"g": loc}}),
+                    credentials=AuthCredentials(key_selector=sel, location=loc))
+        ak.add_k8s_secret_based_identity(Secret(
+            namespace="ns", name=f"s-{loc}", labels={"g": loc},
+            data={"api_key": b"c0ffee"}))
+        entries.append(EngineEntry(
+            id=f"ns/fast-{loc}", hosts=[host],
+            runtime=RuntimeAuthConfig(
+                labels={"namespace": "ns", "name": f"fast-{loc}"},
+                identity=[IdentityConfig(
+                    f"k-{loc}", ak,
+                    credentials=AuthCredentials(key_selector=sel, location=loc))]),
+            rules=None))
+    # slow: templated denyWith needs per-request resolution
+    entries.append(pattern_entry(
+        7, "ns/slow-tmpl", ["slow-tmpl.test"],
+        Pattern("request.method", Operator.EQ, "GET"),
+        deny_with=DenyWith(unauthorized=DenyWithValues(
+            message=JSONValue(pattern="request.path")))))
     # wildcard host: pattern-only, so it rides the FAST lane — the C++
     # side replicates the index's wildcard walk-up
     entries.append(pattern_entry(
@@ -140,6 +188,19 @@ REQUESTS = [
     make_req("fast-deny.test", headers={"x-pass": "no"}),        # custom 302 deny
     make_req("slow-key.test", headers={"authorization": "APIKEY sekret"}),
     make_req("slow-key.test", headers={"authorization": "APIKEY wrong"}),
+    make_req("slow-key.test"),                                   # credential missing
+    make_req("slow-key.test", headers={"authorization": "Bearer sekret"}),  # wrong scheme
+    make_req("fast-key.test", headers={"x-api-key": "adminkey"}),  # identity const allows
+    make_req("fast-key.test", headers={"x-api-key": "userkey"}),   # identity const denies
+    make_req("fast-key.test", headers={"x-api-key": "nope"}),      # unknown key
+    make_req("fast-key.test"),                                     # header missing
+    make_req("slow-tmpl.test", method="POST", path="/here"),       # templated deny → slow
+    make_req("cookie-key.test", headers={"cookie": "a=1; ses=c0ffee; b=2"}),
+    make_req("cookie-key.test", headers={"cookie": "ses=wrong"}),
+    make_req("cookie-key.test", headers={"cookie": "other=1"}),    # cred missing
+    make_req("query-key.test", path="/hello?x=1&tok=c0ffee&y=2"),
+    make_req("query-key.test", path="/hello?tok=bad"),
+    make_req("query-key.test", path="/hello"),                     # cred missing
     make_req("a.wild.test"),
     make_req("a.wild.test", method="DELETE"),
     make_req("deep.a.wild.test"),            # wildcard matches any depth
@@ -276,7 +337,41 @@ def test_fast_lane_classification(stack):
     assert fast_lane_eligible(by_id["ns/fast-cond"], policy) is not None
     assert fast_lane_eligible(by_id["ns/fast-rx"], policy) is not None
     assert fast_lane_eligible(by_id["ns/fast-deny"], policy) is not None
-    assert fast_lane_eligible(by_id["ns/slow-key"], policy) is None
+    # API-key identity-only: pure credential-map decision, no kernel
+    spec = fast_lane_eligible(by_id["ns/fast-keyonly"], policy)
+    assert spec is not None and spec.cred_kind == 1 and not spec.has_batch
+    assert any(k == b"sekret" for k, _ in spec.variants)
+    # API-key + auth.identity.* patterns: per-key K_CONST plan variants
+    spec2 = fast_lane_eligible(by_id["ns/fast-key"], policy)
+    assert spec2 is not None and spec2.has_batch and spec2.cred_kind == 2
+    assert spec2.cred_key == "x-api-key"
+    assert len(spec2.variants) == 2
+    assert all(vplans for _, vplans in spec2.variants)
+    # templated denyWith: per-request resolution → slow lane
+    assert fast_lane_eligible(by_id["ns/slow-tmpl"], policy) is None
+
+
+def test_api_key_rotation_rebuilds_fast_lane(stack):
+    """Live add/revoke of an API key (the secret reconciler's in-place
+    mutation, ref controllers/secret_controller.go:108-130) must rebuild the
+    C++ credential variants via the swap-listener notification."""
+    engine, fe, native_port, _ = stack
+    ev = engine._snapshot.by_id["ns/fast-keyonly"].runtime.identity[0].evaluator
+    ev.add_k8s_secret_based_identity(Secret(
+        namespace="ns", name="k2", labels={"g": "t"}, data={"api_key": b"fresh"}))
+    engine.notify_swap_listeners()
+    wait_for_snap_retire(fe)
+    ok = grpc_call(native_port,
+                   make_req("slow-key.test", headers={"authorization": "APIKEY fresh"}))
+    assert ok.status.code == 0
+    ev.revoke_k8s_secret_based_identity("ns", "k2")
+    engine.notify_swap_listeners()
+    wait_for_snap_retire(fe)
+    deny = grpc_call(native_port,
+                     make_req("slow-key.test", headers={"authorization": "APIKEY fresh"}))
+    assert deny.status.code == 16  # UNAUTHENTICATED
+    stats = fe.stats()
+    assert stats["direct_ok"] > 0 and stats["unauth"] > 0
 
 
 def test_dfa_overflow_rides_fast_lane(stack):
