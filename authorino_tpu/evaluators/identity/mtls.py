@@ -60,6 +60,7 @@ class MTLS:
         self.credentials = credentials or AuthCredentials()
         self.cluster = cluster
         self._cas: Dict[tuple, x509.Certificate] = {}  # (ns, name) → CA cert
+        self._pems: Dict[tuple, bytes] = {}            # (ns, name) → raw PEM
         self._lock = threading.RLock()
 
     async def load_secrets(self) -> None:
@@ -112,17 +113,21 @@ class MTLS:
         return self.label_selector
 
     def add_k8s_secret_based_identity(self, new: Secret) -> bool:
+        """True only when the CA pool actually changed (PEM-byte compare —
+        informer resyncs of unchanged secrets must not trigger the native
+        frontend's snapshot rebuild)."""
         if self.namespace and new.namespace != self.namespace:
             return False
         with self._lock:
-            before = self._cas.get(new.key)
+            before = self._pems.get(new.key)
             self._append(new)
-            return self._cas.get(new.key) is not before
+            return self._pems.get(new.key) != before
 
     def revoke_k8s_secret_based_identity(self, namespace: str, name: str) -> bool:
         if self.namespace and namespace != self.namespace:
             return False
         with self._lock:
+            self._pems.pop((namespace, name), None)
             return self._cas.pop((namespace, name), None) is not None
 
     def _append(self, secret: Secret) -> None:
@@ -132,6 +137,7 @@ class MTLS:
                 continue
             try:
                 self._cas[secret.key] = x509.load_pem_x509_certificate(pem)
+                self._pems[secret.key] = pem
                 return
             except Exception:
                 continue

@@ -314,6 +314,13 @@ class _SnapRec:
     keepalive: List[np.ndarray] = field(default_factory=list)
     fc_rows: Optional[np.ndarray] = None
     row_labels: Dict[int, Tuple[str, str]] = field(default_factory=dict)
+    # jit bucket variants already compiled for this snapshot's params:
+    # (batch_pad, byte_eff) pairs; 0 byte_eff = no DFA lane.  _dispatch only
+    # uses warmed shapes (rounding up) so XLA compiles never land on live
+    # requests (the precompile-at-reconcile discipline,
+    # ref pkg/evaluators/authorization/opa.go:141)
+    warm: set = field(default_factory=set)
+    warm_done: threading.Event = field(default_factory=threading.Event)
 
 
 class NativeFrontend:
@@ -431,6 +438,92 @@ class NativeFrontend:
         return self._static_deny(
             UNAUTHENTICATED, message, rt.challenge_headers(),
             rt.deny_with.unauthenticated)
+
+    # ---- jit pre-warm (compiles must never land on live requests) ----
+
+    def _bucket_grid(self, rec: _SnapRec) -> List[Tuple[int, int]]:
+        """Every (batch_pad, byte_eff) jit variant _dispatch can produce,
+        largest first (the largest combo is the universal round-up target)."""
+        pads: List[int] = []
+        p = min(bucket_pow2(self.max_batch), self.max_batch)
+        while p >= 16:
+            pads.append(p)
+            p //= 2
+        has_dfa = rec.params is not None and rec.params["dfa_tables"] is not None
+        effs: List[int] = [0]
+        if has_dfa:
+            effs = []
+            e = 16
+            while e < DFA_VALUE_BYTES:
+                effs.append(e)
+                e *= 2
+            effs.append(DFA_VALUE_BYTES)
+            effs.reverse()
+        return [(p, e) for p in pads for e in effs]
+
+    def _warm_one(self, rec: _SnapRec, pad: int, eff: int) -> None:
+        """Compile (and cache) the jit variant for one bucket shape using
+        throwaway zero operands."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.pattern_eval import eval_packed_jit
+
+        policy = rec.policy
+        dt = wire_dtype(policy)
+        A, M, K = policy.n_attrs, policy.n_member_attrs, policy.members_k
+        C, NB = policy.n_cpu_leaves, max(policy.n_byte_attrs, 1)
+        out = eval_packed_jit(
+            rec.params,
+            jnp.asarray(np.zeros((pad, A), dtype=dt)),
+            jnp.asarray(np.full((pad, M, K), PAD, dtype=dt)),
+            jnp.asarray(np.zeros((pad, C), dtype=bool)),
+            jnp.asarray(np.zeros((pad,), dtype=np.int32)),
+            jnp.asarray(np.zeros((pad, NB, eff), dtype=np.uint8)) if eff else None,
+            jnp.asarray(np.zeros((pad, NB), dtype=bool)) if eff else None,
+        )
+        jax.block_until_ready(out)
+        rec.warm.add((pad, eff))
+
+    def _prewarm_rest(self, rec: _SnapRec, grid: List[Tuple[int, int]]) -> None:
+        try:
+            for pad, eff in grid:
+                # bail once superseded: a draining snapshot never sees new
+                # shapes, and its compiles would contend with the successor's
+                # swap-gate compile for the single core
+                if (not self._running or rec.snap_id not in self._snaps
+                        or rec.snap_id != self._next_snap_id - 1):
+                    return
+                if (pad, eff) in rec.warm:
+                    continue
+                self._warm_one(rec, pad, eff)
+        except Exception:
+            log.exception("jit pre-warm failed")
+        finally:
+            rec.warm_done.set()
+
+    def _pick_warm_shape(self, rec: _SnapRec, count: int, eff: int) -> Tuple[int, int]:
+        """Smallest warmed (pad ≥ count, eff' ≥ eff); falls back to the
+        exact bucket shape (inline compile) only when nothing fits — i.e.
+        cold start before the first variant finished compiling."""
+        pad = min(bucket_pow2(count), self.max_batch)
+        if (pad, eff) in rec.warm:
+            return pad, eff
+        best: Optional[Tuple[int, int]] = None
+        for p, e in tuple(rec.warm):  # snapshot: the prewarm thread appends
+            if p >= count and e >= eff and (best is None or (p, e) < best):
+                best = (p, e)
+        return best if best is not None else (pad, eff)
+
+    def wait_warm(self, timeout_s: float = 600.0) -> bool:
+        """Block until every jit bucket variant of the newest snapshot is
+        compiled (bench/CLI call this after start() so no XLA compile lands
+        on live traffic)."""
+        with self._lock:
+            rec = self._snaps.get(self._next_snap_id - 1)
+        if rec is None:
+            return True
+        return rec.warm_done.wait(timeout_s)
 
     # ------------------------------------------------------------------
     def refresh(self) -> None:
@@ -598,7 +691,22 @@ class NativeFrontend:
         spec["hosts"] = hosts
 
         self._snaps[snap_id] = rec  # caller holds _lock
+        grid: List[Tuple[int, int]] = []
+        if rec.params is not None and rec.arrays:
+            grid = self._bucket_grid(rec)
+            try:
+                # the largest combo compiles BEFORE the swap goes live: the
+                # previous snapshot keeps serving meanwhile, and once this
+                # one is current every batch shape can round up to it
+                self._warm_one(rec, *grid[0])
+            except Exception:
+                log.exception("jit pre-warm (swap gate) failed")
         mod.fe_swap(spec)
+        if grid:
+            threading.Thread(target=self._prewarm_rest, args=(rec, grid),
+                             name="atpu-fe-prewarm", daemon=True).start()
+        else:
+            rec.warm_done.set()
         log.info("native frontend snapshot %d: %d fast configs, %d host keys",
                  snap_id, len(fcs), len(hosts))
 
@@ -631,8 +739,10 @@ class NativeFrontend:
                         deny = np.zeros(int(c), dtype=np.uint8)
                         mod.fe_complete_batch(int(a), int(b), deny.ctypes.data)
             elif kind == EV_SNAP_RETIRED:
-                with self._lock:
-                    self._snaps.pop(int(a), None)
+                # GIL-atomic pop, deliberately NOT under _lock: refresh holds
+                # _lock across its swap-gate jit compile, and blocking here
+                # would stall every batch completion queued behind this event
+                self._snaps.pop(int(a), None)
             elif kind == EV_STOPPED:
                 break
 
@@ -643,15 +753,20 @@ class NativeFrontend:
 
         rec = self._snaps[snap_id]
         a = rec.arrays[slot]
-        pad = min(bucket_pow2(count), self.max_batch)
         has_dfa = rec.params["dfa_tables"] is not None
+        eff = _trim_bytes(a["attr_bytes"][:count]).shape[-1] if has_dfa else 0
+        # round the batch/byte buckets up to an already-compiled variant so
+        # XLA compiles never land on live requests (rows past `count` carry
+        # stale bytes from earlier batches; their results are discarded)
+        pad, eff = self._pick_warm_shape(rec, count, eff)
         packed = np.asarray(eval_packed_jit(
             rec.params,
             jnp.asarray(a["attrs_val"][:pad]),
             jnp.asarray(a["members"][:pad]),
             jnp.asarray(a["cpu_dense"][:pad].view(bool)),
             jnp.asarray(a["config_id"][:pad]),
-            jnp.asarray(_trim_bytes(a["attr_bytes"][:pad])) if has_dfa else None,
+            jnp.asarray(np.ascontiguousarray(a["attr_bytes"][:pad, :, :eff]))
+            if has_dfa else None,
             jnp.asarray(a["byte_ovf"][:pad].view(bool)) if has_dfa else None,
         ))
         verdict = np.ascontiguousarray(packed[:count, 0]).astype(np.uint8)

@@ -52,21 +52,25 @@ pytestmark = pytest.mark.skipif(
 # corpus: a mix that exercises fast lane, slow lane, DFA, denyWith
 # ---------------------------------------------------------------------------
 
+def make_pattern_entry(engine, cfg_id, hosts, rule, cond=None, deny_with=None):
+    pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
+                         evaluator_slot=0)
+    ns, _, nm = cfg_id.partition("/")
+    runtime = RuntimeAuthConfig(
+        labels={"namespace": ns, "name": nm},  # like translate injects
+        identity=[IdentityConfig("anon", Noop())],
+        authorization=[AuthorizationConfig("rules", pm)],
+        deny_with=deny_with or DenyWith(),
+    )
+    return EngineEntry(id=cfg_id, hosts=hosts, runtime=runtime,
+                       rules=ConfigRules(name=cfg_id, evaluators=[(cond, rule)]))
+
+
 def build_engine() -> PolicyEngine:
     engine = PolicyEngine(max_batch=64, max_delay_s=0.0005, mesh=None)
 
     def pattern_entry(i, cfg_id, hosts, rule, cond=None, deny_with=None):
-        pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
-                             evaluator_slot=0)
-        ns, _, nm = cfg_id.partition("/")
-        runtime = RuntimeAuthConfig(
-            labels={"namespace": ns, "name": nm},  # like translate injects
-            identity=[IdentityConfig("anon", Noop())],
-            authorization=[AuthorizationConfig("rules", pm)],
-            deny_with=deny_with or DenyWith(),
-        )
-        return EngineEntry(id=cfg_id, hosts=hosts, runtime=runtime,
-                           rules=ConfigRules(name=cfg_id, evaluators=[(cond, rule)]))
+        return make_pattern_entry(engine, cfg_id, hosts, rule, cond, deny_with)
 
     entries = []
     # fast: plain eq/neq/incl over request attrs
@@ -351,6 +355,83 @@ def test_fast_lane_classification(stack):
     assert fast_lane_eligible(by_id["ns/slow-tmpl"], policy) is None
 
 
+def test_prewarm_covers_bucket_grid(stack):
+    """Every (batch_pad, byte_eff) jit variant compiles off the serving
+    path at swap time (VERDICT r3 weak #1)."""
+    _, fe, _, _ = stack
+    assert fe.wait_warm(180)
+    with fe._lock:
+        rec = fe._snaps[fe._next_snap_id - 1]
+    assert rec.params is not None
+    assert set(fe._bucket_grid(rec)) <= rec.warm
+
+
+def test_swap_under_load_never_compiles_on_live_requests(stack):
+    """Reconcile swaps with NEW corpus shapes must keep serving from
+    warmed jit variants only: the previous snapshot serves until the new
+    one's largest bucket is compiled, then dispatch rounds up to warmed
+    shapes.  A pick outside rec.warm would be an inline XLA compile on a
+    live request — the exact source of BENCH_r03 trial 1's 3.3s p99."""
+    engine, fe, native_port, _ = stack
+    assert fe.wait_warm(180)
+    base_entries = list(engine._snapshot.by_id.values())
+
+    picked_unwarmed = []
+    orig = fe._pick_warm_shape
+
+    def spy(rec, count, eff):
+        out = orig(rec, count, eff)
+        if rec.warm and out not in rec.warm:
+            picked_unwarmed.append(out)
+        return out
+
+    fe._pick_warm_shape = spy
+    stop = threading.Event()
+    errs, lat = [], []
+
+    def loader():
+        with grpc.insecure_channel(f"127.0.0.1:{native_port}") as ch:
+            call = ch.unary_unary(
+                "/envoy.service.auth.v3.Authorization/Check",
+                request_serializer=pb.CheckRequest.SerializeToString,
+                response_deserializer=pb.CheckResponse.FromString)
+            req = make_req("fast-eq.test", headers={"x-org": "acme"})
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    call(req, timeout=60)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+                    return
+                lat.append(time.monotonic() - t0)
+
+    t = threading.Thread(target=loader)
+    t.start()
+    try:
+        time.sleep(0.3)
+        for i in range(2):
+            # a brand-new selector changes the operand shapes → the swap
+            # gate must compile the new variants before going live
+            extra = make_pattern_entry(
+                engine, f"ns/extra-{i}", [f"extra-{i}.test"],
+                Pattern(f"request.headers.x-fresh-{i}", Operator.EQ, "v"))
+            engine.apply_snapshot(base_entries + [extra])
+            time.sleep(0.3)
+        assert fe.wait_warm(180)
+        time.sleep(0.5)
+    finally:
+        stop.set()
+        t.join(20)
+        fe._pick_warm_shape = orig
+        engine.apply_snapshot(base_entries)  # restore the module corpus
+        wait_for_snap_retire(fe)
+    assert not errs
+    assert len(lat) > 20
+    assert not picked_unwarmed, f"inline compiles on live requests: {picked_unwarmed}"
+    lat.sort()
+    assert lat[int(len(lat) * 0.99)] < 5.0
+
+
 def test_api_key_rotation_rebuilds_fast_lane(stack):
     """Live add/revoke of an API key (the secret reconciler's in-place
     mutation, ref controllers/secret_controller.go:108-130) must rebuild the
@@ -512,6 +593,13 @@ def test_fast_lane_metrics_labeled_per_config(stack):
                         "status": "PERMISSION_DENIED"})
     for org in ("acme", "evil", "acme"):
         grpc_call(native_port, make_req("fast-eq.test", headers={"x-org": org}))
+    # the dispatcher folds metrics after completing the batch — the last
+    # response can reach the client a beat before its own increment lands
+    deadline = time.monotonic() + 10
+    while (sample("auth_server_authconfig_total",
+                  {"namespace": "ns", "authconfig": "fast-eq"}) < base_total + 3
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
     assert sample("auth_server_authconfig_total",
                   {"namespace": "ns", "authconfig": "fast-eq"}) == base_total + 3
     assert sample("auth_server_authconfig_response_status_total",
