@@ -34,6 +34,17 @@ class OIDC:
         self.jwks: List[Dict[str, Any]] = []
         self._refresher: Optional[Worker] = None
         self._load_lock = asyncio.Lock()
+        # fired when a refresh actually changes the key set / discovery doc
+        # (the native frontend drops its verified-token cache on rotation)
+        self._change_listeners: List[Any] = []
+
+    def add_change_listener(self, cb) -> None:
+        if cb not in self._change_listeners:
+            self._change_listeners.append(cb)
+
+    def remove_change_listener(self, cb) -> None:
+        if cb in self._change_listeners:
+            self._change_listeners.remove(cb)
 
     # --- discovery (ref :41-103) ---
 
@@ -52,10 +63,17 @@ class OIDC:
             async with sess.get(jwks_uri) as resp:
                 payload = await http_util.parse_response(resp)
             jwks = payload.get("keys", []) if isinstance(payload, dict) else []
+        changed = bool(self.config) and (config != self.config or jwks != self.jwks)
         self.config = config
         self.jwks = jwks
         if self.ttl_s and self._refresher is None:
             self._refresher = Worker(self.ttl_s, self.refresh).start()
+        if changed:
+            for cb in list(self._change_listeners):
+                try:
+                    cb()
+                except Exception:
+                    log.exception("OIDC change listener failed")
 
     async def _ensure_loaded(self) -> None:
         if self.config:

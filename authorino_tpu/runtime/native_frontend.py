@@ -41,7 +41,8 @@ from ..evaluators.base import DenyWithValues, RuntimeAuthConfig
 from ..evaluators.authorization import PatternMatching
 from ..evaluators.identity import APIKey, Noop
 from ..evaluators.identity.api_key import INVALID_API_KEY_MSG
-from ..pipeline.pipeline import AuthResult
+from ..evaluators.identity.oidc import OIDC
+from ..pipeline.pipeline import AuthPipeline, AuthResult
 from ..utils import bucket_pow2
 from ..utils import metrics as metrics_mod
 from ..utils.rpc import (
@@ -188,13 +189,23 @@ class FastLaneSpec:
     extraction spec plus per-key plan variants: each known key's
     ``auth.identity.*`` operands are resolved to constants at refresh time;
     unknown/missing credentials answer with the static UNAUTHENTICATED
-    templates built in NativeFrontend._refresh_locked."""
+    templates built in NativeFrontend._refresh_locked.
+
+    ``dyn`` configs (OIDC/JWT identity, ref pkg/evaluators/identity/
+    oidc.go:41-103) have no key set known at refresh time: the C++ variant
+    map becomes a verified-token cache.  Unknown/expired tokens route to
+    the slow lane, which runs the full pipeline (JWT verification included)
+    and registers the token's resolved ``auth.*`` operands as a plan
+    variant with TTL = min(token exp, dyn_ttl); ``auth_attrs`` carries the
+    attr rows the registration must resolve per token."""
 
     plans: List[tuple] = field(default_factory=list)
     has_batch: bool = False
     cred_kind: int = 0
     cred_key: str = ""
     variants: List[Tuple[bytes, List[tuple]]] = field(default_factory=list)
+    dyn: bool = False
+    auth_attrs: List[int] = field(default_factory=list)
 
 
 def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[FastLaneSpec]:
@@ -220,14 +231,15 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
     ident = idc.evaluator
     is_noop = isinstance(ident, Noop)
     is_key = isinstance(ident, APIKey)
-    if not is_noop and not is_key:
+    is_oidc = isinstance(ident, OIDC)
+    if not is_noop and not is_key and not is_oidc:
         return None
     cred_kind = 0
-    if is_key:
+    if is_key or is_oidc:
         cred_kind = _CRED_KINDS.get(ident.credentials.location, 0)
         if cred_kind == 0:
             return None
-        # missing/unknown credentials answer from a static template — the
+        # missing credentials answer from a static template — the
         # identity-failure denyWith must resolve without a request doc
         if not _deny_with_static(rt.deny_with.unauthenticated):
             return None
@@ -278,6 +290,15 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
                 return None
             spec.plans.append(p)
         return spec
+    if is_oidc:
+        # verified-token cache: variants registered at runtime by the slow
+        # lane (NativeFrontend._register_dyn); auth.* operands resolve per
+        # token, so their attr rows ride along for registration time
+        spec.dyn = True
+        spec.auth_attrs = auth_attrs
+        key_sel = ident.credentials.key_selector
+        spec.cred_key = key_sel.lower() if cred_kind == 2 else key_sel
+        return spec
     # API key: resolve each known key's auth.* operands to constants
     # (the fast-lane analog of precompile-at-reconcile,
     # ref pkg/evaluators/authorization/opa.go:141)
@@ -321,6 +342,9 @@ class _SnapRec:
     # ref pkg/evaluators/authorization/opa.go:141)
     warm: set = field(default_factory=set)
     warm_done: threading.Event = field(default_factory=threading.Event)
+    # dyn (OIDC) configs: entry.id → (fc_idx, auth_attrs) — the slow lane
+    # registers verified-token plan variants against this snapshot
+    dyn_regs: Dict[str, Tuple[int, List[int]]] = field(default_factory=dict)
 
 
 class NativeFrontend:
@@ -328,8 +352,12 @@ class NativeFrontend:
 
     def __init__(self, engine, port: int = 0, max_batch: int = 1024,
                  window_us: int = 2000, slots: int = 16, slow_cap: int = 65536,
-                 dispatch_threads: int = 6, bind_all: bool = False):
+                 dispatch_threads: int = 6, bind_all: bool = False,
+                 dyn_ttl_s: float = 600.0):
         self.engine = engine
+        # verified-token cache entries live at most this long (and never
+        # past the token's own exp claim)
+        self.dyn_ttl_s = float(dyn_ttl_s)
         self.port = port
         self.bind_all = bind_all
         self.max_batch = int(max_batch)
@@ -347,6 +375,9 @@ class NativeFrontend:
         self._running = False
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
+        # newest snapshot record — the slow lane registers verified-token
+        # variants against it (GIL-atomic pointer read)
+        self._cur_rec: Optional[_SnapRec] = None
 
     # ------------------------------------------------------------------
     def start(self) -> int:
@@ -449,6 +480,8 @@ class NativeFrontend:
         while p >= 16:
             pads.append(p)
             p //= 2
+        if not pads:  # max_batch < 16: one pad, or refresh would warm nothing
+            pads.append(min(bucket_pow2(self.max_batch), self.max_batch))
         has_dfa = rec.params is not None and rec.params["dfa_tables"] is not None
         effs: List[int] = [0]
         if has_dfa:
@@ -656,11 +689,23 @@ class NativeFrontend:
                     "cred_kind": spec_fl.cred_kind,
                     "cred_key": spec_fl.cred_key,
                     "variants": spec_fl.variants,
+                    "dyn": 1 if spec_fl.dyn else 0,
                     "unauth_missing": b"",
                     "unauth_invalid": b"",
                     "ns": ns_l,
                     "name": nm_l,
                 }
+                if spec_fl.dyn:
+                    rec.dyn_regs[entry.id] = (fc_idx, spec_fl.auth_attrs)
+                    # a JWKS rotation invalidates every cached token: swap
+                    # in a fresh snapshot (empty variant map) when the
+                    # provider's key set actually changes (add_change_listener
+                    # dedups, so re-wiring on every refresh is safe — and a
+                    # reconcile-minted evaluator gets wired the first time)
+                    ev = entry.runtime.identity[0].evaluator
+                    add_listener = getattr(ev, "add_change_listener", None)
+                    if add_listener is not None:
+                        add_listener(self._on_oidc_change)
                 if spec_fl.has_batch:
                     row = policy.config_ids[entry.rules.name]
                     fc["row"] = int(row)
@@ -691,6 +736,7 @@ class NativeFrontend:
         spec["hosts"] = hosts
 
         self._snaps[snap_id] = rec  # caller holds _lock
+        self._cur_rec = rec
         grid: List[Tuple[int, int]] = []
         if rec.params is not None and rec.arrays:
             grid = self._bucket_grid(rec)
@@ -709,6 +755,71 @@ class NativeFrontend:
             rec.warm_done.set()
         log.info("native frontend snapshot %d: %d fast configs, %d host keys",
                  snap_id, len(fcs), len(hosts))
+
+    def _on_oidc_change(self) -> None:
+        """JWKS rotation: rebuild the C++ snapshot (fresh, empty variant
+        map) so tokens verified under retired keys stop being served fast.
+        Runs on its own thread — the notifier is an asyncio worker and
+        refresh() blocks on the swap-gate jit compile."""
+        if not self._running:
+            return
+        threading.Thread(target=self.refresh, name="atpu-fe-oidc-refresh",
+                         daemon=True).start()
+
+    def _register_dyn(self, rec, entry, pipeline, model) -> None:
+        """After a slow-lane pipeline run: if the config is dyn-eligible and
+        identity resolved, cache this token's plan variant in C++ so the
+        next request with it never touches Python (the fast-lane analog of
+        the reference's per-evaluator TTL cache keyed by access token,
+        ref pkg/evaluators/evaluator.go caching + opa.go:141 precompile).
+
+        ``rec`` is the snapshot record captured BEFORE the pipeline ran: a
+        JWKS rotation that rebuilds the snapshot mid-verification makes the
+        registration land on the superseded (no longer serving) snapshot
+        instead of re-caching a retired-key token into the fresh one."""
+        if rec is None or rec is not self._cur_rec:
+            return
+        reg = rec.dyn_regs.get(entry.id)
+        if reg is None:
+            return
+        fc_idx, auth_attrs = reg
+        idc = entry.runtime.identity[0]
+        conf, obj = pipeline.resolved_identity()
+        if obj is None or conf is not idc:
+            return
+        try:
+            token = idc.evaluator.credentials.extract(model.http)
+        except Exception:
+            return
+        import time as _time
+
+        now = _time.time()
+        deadline = now + self.dyn_ttl_s
+        exp = obj.get("exp") if isinstance(obj, dict) else None
+        if isinstance(exp, (int, float)) and not isinstance(exp, bool):
+            deadline = min(deadline, float(exp))
+        if deadline <= now:
+            return
+        vplans: List[tuple] = []
+        if auth_attrs:
+            if rec.policy is None:
+                return
+            doc = {
+                "auth": {
+                    "identity": obj,
+                    "metadata": {},
+                    "authorization": {},
+                    "response": {},
+                    "callbacks": {},
+                }
+            }
+            for attr in auth_attrs:
+                p = _const_plan(rec.policy, attr, doc)
+                if p is None:
+                    return  # this token's values don't fit the compact payload
+                vplans.append(p)
+        self._mod.fe_add_variant(rec.snap_id, fc_idx, token.encode("utf-8"),
+                                 vplans, int(deadline * 1e9))
 
     # ------------------------------------------------------------------
     def _fold_fc_counts(self) -> None:
@@ -811,12 +922,28 @@ class NativeFrontend:
                 if model is None:
                     result = AuthResult(code=INVALID_ARGUMENT, message="Invalid request")
                 else:
-                    # same span lifecycle as the Python gRPC server
-                    # (service/grpc_server.py check): W3C context in,
-                    # propagation into evaluator calls, Check span out
+                    # same flow as engine.check (host lookup + pipeline),
+                    # inlined so the pipeline object is reachable for
+                    # verified-token registration; same span lifecycle as
+                    # the Python gRPC server (service/grpc_server.py check)
                     span = RequestSpan.from_headers(model.http.headers, model.http.id)
                     try:
-                        result = await engine.check(model, span=span)
+                        entry = engine.lookup(model.host())
+                        if entry is None:
+                            result = AuthResult(code=NOT_FOUND,
+                                                message="Service not found")
+                        else:
+                            # snapshot BEFORE verification: registration is
+                            # dropped when a JWKS rotation swaps it mid-run
+                            rec = self._cur_rec
+                            pipeline = AuthPipeline(model, entry.runtime,
+                                                    timeout=engine.timeout_s,
+                                                    span=span)
+                            result = await pipeline.evaluate()
+                            # register BEFORE completing: once the client
+                            # sees this response, a repeat of the same
+                            # token must already be servable fast
+                            self._register_dyn(rec, entry, pipeline, model)
                     finally:
                         span.end()
                 mod.fe_complete_slow(

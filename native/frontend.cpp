@@ -363,6 +363,11 @@ struct FastPlan {
   bool const_byte_ovf = false;
 };
 
+struct VarEnt {
+  int32_t idx;                  // var_plans index
+  int64_t exp_ns;               // CLOCK_REALTIME expiry; INT64_MAX = static
+};
+
 struct FastConfig {
   int32_t row = 0;
   bool has_batch = true;        // false → identity-only: decide entirely here
@@ -374,11 +379,37 @@ struct FastConfig {
   // auth.identity.* operands were resolved to constants at refresh time
   int cred_kind = 0;            // 0 none, 1 auth header, 2 custom header, 3 cookie, 4 query
   std::string cred_key;
-  std::unordered_map<std::string, int32_t> variants;  // key → var_plans idx
-  std::vector<std::vector<FastPlan>> var_plans;
+  // dyn (OIDC/JWT): variants are registered at runtime by the slow lane
+  // after a successful verification (verified-token cache: the fast-lane
+  // analog of per-request JWT verification — the claims are constant per
+  // token, so its auth.* operands resolve once); unknown/expired tokens
+  // route to the slow lane instead of a static invalid-credential answer.
+  // Dyn entries hold their plans by shared_ptr so overwrites and expiry
+  // sweeps reclaim memory immediately (a long-lived snapshot must not
+  // accrete one plan vector per re-registration) while a mid-request
+  // reader keeps its copy alive without the lock.
+  bool dyn = false;
+  std::unordered_map<std::string, VarEnt> variants;  // credential → variant
+  std::deque<std::vector<FastPlan>> var_plans;       // deque: stable refs
+  struct DynVar {
+    std::shared_ptr<const std::vector<FastPlan>> plans;
+    int64_t exp_ns;
+  };
+  std::unordered_map<std::string, DynVar> dyn_variants;
   std::string unauth_missing_msg, unauth_invalid_msg;
   std::string ns, name;         // per-authconfig metric labels
 };
+
+// per-fc cap on runtime-registered variants (attacker-supplied token floods
+// must not grow the map unboundedly; beyond the cap new tokens keep being
+// served — correctly — by the slow lane)
+static const size_t DYN_VARIANT_CAP = 65536;
+
+static inline int64_t now_realtime_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (int64_t)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
 
 struct DfaRef { int32_t row; int32_t col; };  // dfa table row, cpu_dense column
 
@@ -411,6 +442,11 @@ struct Snapshot {
   // host / "*.suffix" wildcard → fc idx, -1 = slow lane
   std::unordered_map<std::string, int32_t> host_map;
   std::vector<FastConfig> fcs;
+  // guards every fc's variants/var_plans once dynamic registration starts
+  // (epoll thread looks up; the slow lane inserts via fe_add_variant).
+  // FastPlan vectors are immutable after publication, so a looked-up
+  // pointer stays valid after unlock (deque push_back never moves elements)
+  std::mutex var_mu;
   // batch slots (numpy arrays owned by Python until retirement)
   std::vector<Slot> slots;
   std::vector<int> free_slots;
@@ -519,7 +555,8 @@ struct Server {
   // stats
   std::atomic<uint64_t> n_fast{0}, n_slow{0}, n_notfound{0}, n_invalid{0},
       n_health{0}, n_allowed{0}, n_denied{0}, n_dfa_ovf{0}, n_slow_shed{0},
-      n_parse_err{0}, n_conns{0}, n_unauth{0}, n_direct_ok{0};
+      n_parse_err{0}, n_conns{0}, n_unauth{0}, n_direct_ok{0}, n_dyn_hit{0},
+      n_dyn_miss{0}, n_dyn_add{0};
   // fc counters of retired snapshots not yet drained (key ns+'\x1f'+name;
   // under mu)
   std::unordered_map<std::string, std::array<uint64_t, 3>> fc_leftover;
@@ -1012,12 +1049,18 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
   }
   if (fc_idx < 0) { push_slow(S, c, stream_id, msg, mlen); return; }
 
-  const FastConfig& fc = snap->fcs[fc_idx];
+  FastConfig& fc = snap->fcs[fc_idx];
   const std::vector<FastPlan>* extra = nullptr;
+  // keeps a dyn variant's plan vector alive across encode_fast after the
+  // variant lock is released (overwrites/sweeps may drop the map entry)
+  std::shared_ptr<const std::vector<FastPlan>> dyn_hold;
   if (fc.cred_kind != 0) {
-    // API-key identity: map lookup selects the per-key plan variant;
-    // missing/unknown credentials answer from the static UNAUTHENTICATED
-    // templates (ref pkg/service/auth_pipeline.go:468-472)
+    // credential-bearing identity: map lookup selects the per-credential
+    // plan variant.  Missing credentials answer from the static
+    // UNAUTHENTICATED template (ref pkg/service/auth_pipeline.go:468-472);
+    // unknown credentials answer statically for API key (the full key set
+    // is known at refresh time) but route to the slow lane for dyn (OIDC)
+    // configs, whose variants are verified-token cache entries.
     std::string cred;
     if (!extract_cred(fc, rv, cred)) {
       snap->fc_counts[3 * (size_t)fc_idx + 1].fetch_add(1, std::memory_order_relaxed);
@@ -1027,16 +1070,36 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
       submit_grpc_response(c, stream_id, fc.unauth_missing_msg);
       return;
     }
-    auto vit = fc.variants.find(cred);
-    if (vit == fc.variants.end()) {
-      snap->fc_counts[3 * (size_t)fc_idx + 2].fetch_add(1, std::memory_order_relaxed);
-      S->n_fast.fetch_add(1, std::memory_order_relaxed);
-      S->n_unauth.fetch_add(1, std::memory_order_relaxed);
-      S->n_denied.fetch_add(1, std::memory_order_relaxed);
-      submit_grpc_response(c, stream_id, fc.unauth_invalid_msg);
-      return;
+    if (fc.dyn) {
+      {
+        std::lock_guard<std::mutex> vlk(snap->var_mu);
+        auto vit = fc.dyn_variants.find(cred);
+        if (vit != fc.dyn_variants.end() &&
+            vit->second.exp_ns > now_realtime_ns()) {
+          dyn_hold = vit->second.plans;
+          extra = dyn_hold.get();
+        }
+      }
+      if (extra == nullptr) {
+        // unknown/expired token: the slow lane verifies (and registers on
+        // success) — full pipeline semantics for every miss
+        S->n_dyn_miss.fetch_add(1, std::memory_order_relaxed);
+        push_slow(S, c, stream_id, msg, mlen);
+        return;
+      }
+      S->n_dyn_hit.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      auto vit = fc.variants.find(cred);
+      if (vit == fc.variants.end()) {
+        snap->fc_counts[3 * (size_t)fc_idx + 2].fetch_add(1, std::memory_order_relaxed);
+        S->n_fast.fetch_add(1, std::memory_order_relaxed);
+        S->n_unauth.fetch_add(1, std::memory_order_relaxed);
+        S->n_denied.fetch_add(1, std::memory_order_relaxed);
+        submit_grpc_response(c, stream_id, fc.unauth_invalid_msg);
+        return;
+      }
+      extra = &fc.var_plans[vit->second.idx];
     }
-    extra = &fc.var_plans[vit->second];
   }
   if (!fc.has_batch) {
     // identity-only config: authenticated → OK, no kernel involvement
@@ -1488,6 +1551,48 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
   }
   emit_retired(S, retired);
   wake_epoll(S);
+}
+
+// register (or refresh) a runtime plan variant for one credential — the
+// slow lane calls this after a successful token verification.  Overwrites
+// swap the shared_ptr (a mid-request reader holds its own reference), so
+// stale plan vectors free as soon as the last reader drops.  Returns false
+// when the snapshot is gone (stale registration: harmless no-op) or the
+// cap is hit.
+static bool add_variant(Server* S, int64_t snap_id, int32_t fc_idx,
+                        std::string cred, std::vector<FastPlan> plans,
+                        int64_t exp_ns) {
+  std::shared_ptr<Snapshot> snap;
+  {
+    std::lock_guard<std::mutex> lk(S->mu);
+    auto it = S->snaps.find(snap_id);
+    if (it == S->snaps.end()) return false;
+    snap = it->second;
+  }
+  if (fc_idx < 0 || (size_t)fc_idx >= snap->fcs.size()) return false;
+  FastConfig& fc = snap->fcs[fc_idx];
+  if (!fc.dyn) return false;
+  auto sp = std::make_shared<const std::vector<FastPlan>>(std::move(plans));
+  {
+    std::lock_guard<std::mutex> vlk(snap->var_mu);
+    auto it = fc.dyn_variants.find(cred);
+    if (it == fc.dyn_variants.end() &&
+        fc.dyn_variants.size() >= DYN_VARIANT_CAP) {
+      // sweep expired entries once; if still full, the slow lane keeps
+      // serving this token (correct, just not fast)
+      int64_t now = now_realtime_ns();
+      for (auto sit = fc.dyn_variants.begin(); sit != fc.dyn_variants.end();)
+        sit = sit->second.exp_ns <= now ? fc.dyn_variants.erase(sit)
+                                        : std::next(sit);
+      if (fc.dyn_variants.size() >= DYN_VARIANT_CAP) return false;
+      it = fc.dyn_variants.end();
+    }
+    if (it != fc.dyn_variants.end()) it->second = {std::move(sp), exp_ns};
+    else fc.dyn_variants.emplace(std::move(cred),
+                                 FastConfig::DynVar{std::move(sp), exp_ns});
+  }
+  S->n_dyn_add.fetch_add(1, std::memory_order_relaxed);
+  return true;
 }
 
 static void complete_slow(Server* S, uint64_t req_id, const char* msg, size_t n,

@@ -444,6 +444,7 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
     if (!parse_plans(PyDict_GetItemString(f, "plans"), fc.plans, &fc.needs_split))
       return nullptr;
     fc.cred_kind = (int)dict_int(f, "cred_kind", 0);
+    fc.dyn = dict_int(f, "dyn", 0) != 0;
     dict_str(f, "cred_key", fc.cred_key);
     dict_str(f, "ns", fc.ns);
     dict_str(f, "name", fc.name);
@@ -462,7 +463,7 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
       int32_t vid = (int32_t)fc.var_plans.size();
       fc.var_plans.push_back(std::move(vp));
       fc.variants[std::string(PyBytes_AS_STRING(kb),
-                              (size_t)PyBytes_GET_SIZE(kb))] = vid;
+                              (size_t)PyBytes_GET_SIZE(kb))] = {vid, INT64_MAX};
     }
     snap->fcs.push_back(std::move(fc));
   }
@@ -582,6 +583,36 @@ PyObject* fe_complete_slow_py(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// fe_add_variant(snap_id, fc_idx, cred_bytes, plans, exp_ns) -> bool
+// — register a runtime plan variant (verified-token cache entry) for one
+// credential; called by the slow lane after a successful verification
+PyObject* fe_add_variant_py(PyObject*, PyObject* args) {
+  long long snap_id, exp_ns;
+  int fc_idx;
+  Py_buffer cred;
+  PyObject* plans;
+  if (!PyArg_ParseTuple(args, "Liy*O!L", &snap_id, &fc_idx, &cred, &PyList_Type,
+                        &plans, &exp_ns))
+    return nullptr;
+  fe::Server* S = fe::g_srv;
+  if (S == nullptr) {
+    PyBuffer_Release(&cred);
+    Py_RETURN_FALSE;
+  }
+  std::vector<fe::FastPlan> vp;
+  if (!parse_plans(plans, vp, nullptr)) {
+    PyBuffer_Release(&cred);
+    return nullptr;
+  }
+  std::string cs((const char*)cred.buf, (size_t)cred.len);
+  PyBuffer_Release(&cred);
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS
+  ok = fe::add_variant(S, snap_id, fc_idx, std::move(cs), std::move(vp), exp_ns);
+  Py_END_ALLOW_THREADS
+  return PyBool_FromLong(ok ? 1 : 0);
+}
+
 // fe_drain_fc_counts() -> list[(ns, name, ok, unauth_missing, unauth_invalid)]
 // — per-authconfig direct decisions since the last drain (the dispatcher
 // folds them into the pipeline's Prometheus series)
@@ -630,6 +661,9 @@ PyObject* fe_stats_py(PyObject*, PyObject*) {
   put("connections", S->n_conns.load());
   put("unauth", S->n_unauth.load());
   put("direct_ok", S->n_direct_ok.load());
+  put("dyn_hit", S->n_dyn_hit.load());
+  put("dyn_miss", S->n_dyn_miss.load());
+  put("dyn_add", S->n_dyn_add.load());
   return d;
 }
 
@@ -645,6 +679,8 @@ PyMethodDef methods[] = {
     {"fe_take_slow", fe_take_slow_py, METH_VARARGS, "take queued slow-lane requests"},
     {"fe_complete_batch", fe_complete_batch_py, METH_VARARGS, "complete a batch"},
     {"fe_complete_slow", fe_complete_slow_py, METH_VARARGS, "complete a slow request"},
+    {"fe_add_variant", fe_add_variant_py, METH_VARARGS,
+     "register a runtime credential plan variant"},
     {"fe_stats", fe_stats_py, METH_NOARGS, "frontend counters"},
     {"fe_drain_fc_counts", fe_drain_fc_counts_py, METH_NOARGS,
      "drain per-authconfig direct-decision counters"},

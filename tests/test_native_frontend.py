@@ -309,6 +309,195 @@ def test_sharded_engine_routes_slow():
         fe.stop()
 
 
+# ---------------------------------------------------------------------------
+# OIDC/JWT fast lane: the C++ variant map as a verified-token cache
+# (round 4; ref pkg/evaluators/identity/oidc.go:41-103 verifies per request —
+# here verification runs once in the slow lane and repeats serve natively)
+# ---------------------------------------------------------------------------
+
+def run_fake_idp():
+    """FakeIdP (test_evaluators) on its own loop thread, alive while the
+    frontend's slow lane and the Python server both fetch discovery/JWKS."""
+    from test_evaluators import FakeIdP
+
+    started = threading.Event()
+    holder = {}
+
+    def runner():
+        async def main():
+            from aiohttp.test_utils import TestServer
+
+            idp = FakeIdP()
+            server = TestServer(idp.app())
+            await server.start_server()
+            idp.issuer = str(server.make_url("")).rstrip("/")
+            holder["idp"] = idp
+            holder["loop"] = asyncio.get_running_loop()
+            holder["stop"] = asyncio.Event()
+            started.set()
+            await holder["stop"].wait()
+            await server.close()
+
+        asyncio.run(main())
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    started.wait(30)
+    return holder, t
+
+
+def _oidc_engine(idp):
+    from authorino_tpu.evaluators.identity import OIDC
+
+    engine = PolicyEngine(max_batch=32, max_delay_s=0.0005, mesh=None)
+    oidc = OIDC("kc", idp.issuer)
+    rule = Pattern("auth.identity.realm_access.roles", Operator.INCL, "admin")
+    pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/oidc"),
+                         evaluator_slot=0)
+    entries = [
+        EngineEntry(
+            id="ns/oidc", hosts=["oidc.test"],
+            runtime=RuntimeAuthConfig(
+                labels={"namespace": "ns", "name": "oidc"},
+                identity=[IdentityConfig("kc", oidc)],
+                authorization=[AuthorizationConfig("rules", pm)]),
+            rules=ConfigRules(name="ns/oidc", evaluators=[(None, rule)])),
+        # identity-only: token validity IS the decision (pure C++ on hits)
+        EngineEntry(
+            id="ns/oidc-only", hosts=["oidc-only.test"],
+            runtime=RuntimeAuthConfig(
+                labels={"namespace": "ns", "name": "oidc-only"},
+                identity=[IdentityConfig("kc", oidc)]),
+            rules=None),
+    ]
+    engine.apply_snapshot(entries)
+    return engine, oidc
+
+
+def test_oidc_fast_lane_token_cache():
+    holder, t = run_fake_idp()
+    idp = holder["idp"]
+    try:
+        engine, oidc = _oidc_engine(idp)
+        # eligibility: dyn spec with the claim attr rows for registration
+        snap = engine._snapshot
+        spec = fast_lane_eligible(snap.by_id["ns/oidc"], snap.policy)
+        assert spec is not None and spec.dyn and spec.cred_kind == 1
+        assert spec.cred_key == "Bearer" and spec.auth_attrs
+
+        fe = NativeFrontend(engine, port=0, max_batch=32, window_us=500)
+        port = fe.start()
+        pyholder, pyt = run_python_server(engine)
+        try:
+            bearer = lambda tok: {"authorization": f"Bearer {tok}"}
+            admin = idp.token()  # realm_access.roles = [admin]
+            user = idp.token({"realm_access": {"roles": ["user"]}})
+
+            # first sight of a token: slow lane verifies AND registers
+            r1 = grpc_call(port, make_req("oidc.test", headers=bearer(admin)))
+            assert r1.status.code == 0
+            assert fe.stats()["dyn_add"] >= 1
+            # repeats ride the fast lane (claims resolved from the cache)
+            r2 = grpc_call(port, make_req("oidc.test", headers=bearer(admin)))
+            assert r2.status.code == 0
+            assert fe.stats()["dyn_hit"] >= 1
+            # a cached token with the wrong role denies through the kernel
+            d1 = grpc_call(port, make_req("oidc.test", headers=bearer(user)))
+            d2 = grpc_call(port, make_req("oidc.test", headers=bearer(user)))
+            assert d1.status.code == 7 and d2.status.code == 7
+            assert fe.stats()["dyn_hit"] >= 2
+            # identity-only config: cached token → direct C++ OK
+            before_ok = fe.stats()["direct_ok"]
+            grpc_call(port, make_req("oidc-only.test", headers=bearer(admin)))
+            o2 = grpc_call(port, make_req("oidc-only.test", headers=bearer(admin)))
+            assert o2.status.code == 0
+            assert fe.stats()["direct_ok"] > before_ok
+
+            # differential vs the Python server, hits and misses both
+            matrix = [
+                make_req("oidc.test", headers=bearer(admin)),
+                make_req("oidc.test", headers=bearer(user)),
+                make_req("oidc.test", headers=bearer("not-a-token")),
+                make_req("oidc.test", headers={"authorization": "Basic zzz"}),
+                make_req("oidc.test"),
+                make_req("oidc-only.test", headers=bearer(admin)),
+                make_req("oidc-only.test"),
+            ]
+            for i, rq in enumerate(matrix):
+                native = response_key(grpc_call(port, rq))
+                python = response_key(grpc_call(pyholder["port"], rq))
+                assert native == python, f"oidc req #{i}: {native} vs {python}"
+
+            # expiry is enforced in C++: past its exp the token stops being
+            # served from the cache.  jose honors a 30s clock-skew leeway,
+            # so the slow lane still answers OK here — the point is the
+            # route: post-exp requests must MISS the cache (and a dead
+            # deadline must not re-register)
+            short = idp.token({"exp": int(time.time()) + 1})
+            a = grpc_call(port, make_req("oidc.test", headers=bearer(short)))
+            assert a.status.code == 0
+            time.sleep(1.3)
+            miss_before = fe.stats()["dyn_miss"]
+            b = grpc_call(port, make_req("oidc.test", headers=bearer(short)))
+            assert b.status.code == 0  # within leeway: pipeline parity
+            assert fe.stats()["dyn_miss"] > miss_before
+            c = grpc_call(port, make_req("oidc.test", headers=bearer(short)))
+            assert fe.stats()["dyn_miss"] > miss_before + 1  # stayed slow
+        finally:
+            pyholder["loop"].call_soon_threadsafe(pyholder["stop"].set)
+            pyt.join(timeout=10)
+            fe.stop()
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
+
+
+def test_oidc_jwks_rotation_drops_token_cache():
+    """Key rotation at the provider must invalidate every cached token:
+    the OIDC change listener swaps in a fresh C++ snapshot (empty variant
+    map), so old-key tokens fall back to the slow lane and fail
+    verification against the new JWKS."""
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    holder, t = run_fake_idp()
+    idp = holder["idp"]
+    try:
+        engine, oidc = _oidc_engine(idp)
+        fe = NativeFrontend(engine, port=0, max_batch=32, window_us=500)
+        port = fe.start()
+        try:
+            bearer = lambda tok: {"authorization": f"Bearer {tok}"}
+            old_tok = idp.token()
+            r1 = grpc_call(port, make_req("oidc.test", headers=bearer(old_tok)))
+            r2 = grpc_call(port, make_req("oidc.test", headers=bearer(old_tok)))
+            assert r1.status.code == 0 and r2.status.code == 0
+            assert fe.stats()["dyn_hit"] >= 1
+
+            idp.key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+            # refresh discovery+JWKS (any loop works; the change listener
+            # fires from here and rebuilds the frontend snapshot)
+            fut = asyncio.run_coroutine_threadsafe(oidc.refresh(), holder["loop"])
+            fut.result(30)
+
+            deadline = time.time() + 60
+            code = 0
+            while time.time() < deadline:
+                code = grpc_call(port, make_req(
+                    "oidc.test", headers=bearer(old_tok))).status.code
+                if code == 16:
+                    break
+                time.sleep(0.2)
+            assert code == 16, "old-key token still served after rotation"
+            new_tok = idp.token()
+            rn = grpc_call(port, make_req("oidc.test", headers=bearer(new_tok)))
+            assert rn.status.code == 0
+        finally:
+            fe.stop()
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
+
+
 @pytest.fixture(scope="module")
 def stack():
     engine = build_engine()
