@@ -664,6 +664,20 @@ PyObject* fe_stats_py(PyObject*, PyObject*) {
   put("dyn_hit", S->n_dyn_hit.load());
   put("dyn_miss", S->n_dyn_miss.load());
   put("dyn_add", S->n_dyn_add.load());
+  {
+    // live backlog gauges (not counters): queued + in-pipeline slow work
+    size_t pending, queued;
+    {
+      std::lock_guard<std::mutex> lk(S->mu);
+      pending = S->slow_pending.size();
+    }
+    {
+      std::lock_guard<std::mutex> lk(S->slow_mu);
+      queued = S->slow_q.size();
+    }
+    put("slow_pending", pending);
+    put("slow_queued", queued);
+  }
   return d;
 }
 
