@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -378,6 +379,10 @@ class NativeFrontend:
         # newest snapshot record — the slow lane registers verified-token
         # variants against it (GIL-atomic pointer read)
         self._cur_rec: Optional[_SnapRec] = None
+        # duration/stage histogram drain cadence + accumulated stage counts
+        self.hist_drain_s = 2.0
+        self._last_hist_drain = 0.0
+        self.stage_totals: Dict[str, Any] = {}
 
     # ------------------------------------------------------------------
     def start(self) -> int:
@@ -412,6 +417,11 @@ class NativeFrontend:
         self._running = False
         if self._mod is not None:
             self.engine.remove_swap_listener(self.refresh)
+            try:
+                self._fold_fc_counts()
+                self.drain_histograms()  # final fold: short runs lose nothing
+            except Exception:
+                log.exception("final metric drain failed")
             self._mod.fe_stop()
         for t in self._threads:
             t.join(timeout=5)
@@ -833,6 +843,40 @@ class NativeFrontend:
             if missing or invalid:
                 metrics_mod.authconfig_response_status.labels(
                     ns, name, "UNAUTHENTICATED").inc(missing + invalid)
+        # duration + stage histograms drain on a coarser cadence — each
+        # drain walks every fc × bucket atomic, too wide for per-batch
+        now = time.monotonic()
+        if now - self._last_hist_drain >= self.hist_drain_s:
+            self._last_hist_drain = now
+            self.drain_histograms()
+
+    def drain_histograms(self) -> None:
+        """Fold the C++-recorded duration/stage histograms into Prometheus:
+        auth_server_authconfig_duration_seconds per authconfig (metric
+        parity with ref pkg/service/auth_pipeline.go:26-36 on the fast
+        lane) and auth_server_frontend_stage_duration_seconds per on-box
+        stage.  Also accumulates raw stage counts in self.stage_totals for
+        the bench's on-box latency artifact."""
+        for ns, name, buckets, sum_ns in self._mod.fe_drain_durations():
+            metrics_mod.observe_bucketed(
+                metrics_mod.authconfig_duration.labels(ns, name),
+                buckets, sum_ns / 1e9)
+        stages = self._mod.fe_stage_hist()
+        for stage in ("wait", "exec", "respond"):
+            counts = stages[stage]
+            acc = self.stage_totals.setdefault(stage, [0] * len(counts))
+            for i, n in enumerate(counts):
+                acc[i] += n
+            # sum approximated from bucket midpoints: the stage series is
+            # for shape/percentiles, not totals (bounds are µs-dense)
+            bounds = stages["bounds_ns"]
+            mids = [b / 2e9 if i == 0 else (bounds[i - 1] + b) / 2e9
+                    for i, b in enumerate(bounds)] + [bounds[-1] / 1e9]
+            est_sum = sum(n * mids[i] for i, n in enumerate(counts))
+            metrics_mod.observe_bucketed(
+                metrics_mod.frontend_stage_duration.labels(stage),
+                counts, est_sum)
+        self.stage_totals["bounds_ns"] = stages["bounds_ns"]
 
     def _dispatch_loop(self) -> None:
         mod = self._mod

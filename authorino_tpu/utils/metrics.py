@@ -46,10 +46,12 @@ def _counter(name, doc, labels):
         return _NoopMetric()
 
 
-def _histogram(name, doc, labels):
+def _histogram(name, doc, labels, buckets=None):
     if not _PROM:
         return _NoopMetric()
     try:
+        if buckets is not None:
+            return Histogram(name, doc, labels, buckets=buckets)
         return Histogram(name, doc, labels)
     except ValueError:
         return _NoopMetric()
@@ -100,6 +102,42 @@ response_status = _counter(
     "Status of HTTP response sent by the auth server.",
     ("status",),
 )
+# µs-scale on-box stage bounds — MUST match native/frontend.cpp
+# STAGE_BOUNDS_NS (the C++ frontend buckets in ns; drains map 1:1)
+STAGE_BUCKETS = (
+    10e-6, 25e-6, 50e-6, 100e-6, 250e-6, 500e-6,
+    1e-3, 2.5e-3, 5e-3, 10e-3, 25e-3, 50e-3, 100e-3, 250e-3, 1.0,
+)
+frontend_stage_duration = _histogram(
+    "auth_server_frontend_stage_duration_seconds",
+    "On-box per-request stage latency of the native frontend (queue-wait: "
+    "encode to batch flush; execute: flush to verdict; respond: verdict to "
+    "HTTP/2 submit).",
+    ("stage",),
+    buckets=STAGE_BUCKETS,
+)
+
+
+def observe_bucketed(hist_child, bucket_counts, sum_seconds) -> None:
+    """Fold pre-bucketed counts (non-cumulative per-le, same bounds as the
+    histogram) into a prometheus_client Histogram child in O(buckets) —
+    per-request observe() calls cannot keep up with the native frontend's
+    rates.  Uses the documented-stable internals; falls back to midpoint
+    observes if they ever change."""
+    try:
+        buckets = hist_child._buckets
+        for i, n in enumerate(bucket_counts):
+            if n:
+                buckets[i].inc(n)
+        if sum_seconds:
+            hist_child._sum.inc(sum_seconds)
+    except AttributeError:
+        if hasattr(hist_child, "observe"):
+            total = sum(bucket_counts)
+            if total:
+                hist_child.observe(sum_seconds / total)
+
+
 host_fallback_total = _counter(
     "auth_server_host_fallback_total",
     "Requests re-decided by the host expression oracle because the compact "

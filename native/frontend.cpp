@@ -411,12 +411,52 @@ static inline int64_t now_realtime_ns() {
   return (int64_t)ts.tv_sec * 1000000000LL + ts.tv_nsec;
 }
 
+static inline int64_t now_mono_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+// auth_server_authconfig_duration_seconds bucket bounds — EXACTLY
+// prometheus_client's default Histogram buckets, so drained counts map 1:1
+// onto the same series the Python pipeline observes
+// (ref pkg/service/auth_pipeline.go:26-36 records per-request duration
+// histograms; the fast lane records them here and Python folds them in)
+static const int64_t DUR_BOUNDS_NS[] = {
+    5000000LL,    10000000LL,   25000000LL,  50000000LL,  75000000LL,
+    100000000LL,  250000000LL,  500000000LL, 750000000LL, 1000000000LL,
+    2500000000LL, 5000000000LL, 7500000000LL, 10000000000LL};
+static const int N_DUR_BUCKETS = 15;  // 14 bounds + +Inf
+// per-fc slot layout in fc_durs: [15 buckets][sum_ns] = 16 u64
+static const int DUR_STRIDE = N_DUR_BUCKETS + 1;
+
+// on-box stage bounds (µs-scale: the stages a co-located chip pays —
+// queue-wait enq→flush, execute flush→complete, respond complete→submit)
+static const int64_t STAGE_BOUNDS_NS[] = {
+    10000LL,     25000LL,     50000LL,     100000LL,   250000LL,
+    500000LL,    1000000LL,   2500000LL,   5000000LL,  10000000LL,
+    25000000LL,  50000000LL,  100000000LL, 250000000LL, 1000000000LL};
+static const int N_STAGE_BUCKETS = 16;  // 15 bounds + +Inf
+
+static inline int dur_bucket(int64_t ns) {
+  for (int i = 0; i < N_DUR_BUCKETS - 1; ++i)
+    if (ns <= DUR_BOUNDS_NS[i]) return i;
+  return N_DUR_BUCKETS - 1;
+}
+
+static inline int stage_bucket(int64_t ns) {
+  for (int i = 0; i < N_STAGE_BUCKETS - 1; ++i)
+    if (ns <= STAGE_BOUNDS_NS[i]) return i;
+  return N_STAGE_BUCKETS - 1;
+}
+
 struct DfaRef { int32_t row; int32_t col; };  // dfa table row, cpu_dense column
 
 struct Entry {
   uint32_t conn_id;
   int32_t stream_id;
   int32_t fc;
+  int64_t t_enq;  // CLOCK_MONOTONIC at encode time (stage/duration hists)
 };
 
 struct Slot {
@@ -460,6 +500,12 @@ struct Snapshot {
   // decisions that never enter a batch; the Python dispatcher folds them
   // into the pipeline's Prometheus series (fe_drain_fc_counts)
   std::unique_ptr<std::atomic<uint64_t>[]> fc_counts;
+  // per-fc request-duration histograms, DUR_STRIDE u64 each (15 prom
+  // buckets + sum_ns) — drained into
+  // auth_server_authconfig_duration_seconds (fe_drain_durations)
+  std::unique_ptr<std::atomic<uint64_t>[]> fc_durs;
+  // monotonic flush time of each slot's current batch
+  std::vector<int64_t> slot_flush_ns;
 };
 
 // ---------------------------------------------------------------------------
@@ -492,6 +538,7 @@ struct Done {
   int32_t stream_id;
   std::string msg;       // CheckResponse payload (no gRPC prefix)
   int grpc_status = 0;   // non-zero → trailers-only error response
+  int64_t t_done = 0;    // completion time (respond-stage histogram)
 };
 
 struct SlowReq {
@@ -557,6 +604,14 @@ struct Server {
       n_health{0}, n_allowed{0}, n_denied{0}, n_dfa_ovf{0}, n_slow_shed{0},
       n_parse_err{0}, n_conns{0}, n_unauth{0}, n_direct_ok{0}, n_dyn_hit{0},
       n_dyn_miss{0}, n_dyn_add{0};
+  // on-box stage histograms (server-wide): queue-wait (encode→flush),
+  // execute (flush→complete_batch), respond (complete→HTTP/2 submit)
+  std::atomic<uint64_t> stage_wait[N_STAGE_BUCKETS] = {};
+  std::atomic<uint64_t> stage_exec[N_STAGE_BUCKETS] = {};
+  std::atomic<uint64_t> stage_respond[N_STAGE_BUCKETS] = {};
+  // duration-histogram leftovers of retired snapshots (key ns+'\x1f'+name;
+  // under mu)
+  std::unordered_map<std::string, std::array<uint64_t, DUR_STRIDE>> dur_leftover;
   // fc counters of retired snapshots not yet drained (key ns+'\x1f'+name;
   // under mu)
   std::unordered_map<std::string, std::array<uint64_t, 3>> fc_leftover;
@@ -895,6 +950,7 @@ static void flush_batch(Server* S, bool from_timer = false) {
       // the slow lane.  Let the batch keep filling; re-check next window.
     } else {
       snap->slot_count[slot] = count;
+      snap->slot_flush_ns[slot] = now_mono_ns();
       snap->pending_batches++;
       S->fill_slot = -1;
       S->fill_count = 0;
@@ -990,7 +1046,17 @@ static void push_slow(Server* S, Conn* c, int32_t stream_id, const char* msg, si
   S->n_slow.fetch_add(1, std::memory_order_relaxed);
 }
 
+// record one direct (never-batched) decision's duration for fc_idx
+static inline void record_direct_dur(Snapshot* snap, int32_t fc_idx, int64_t t0) {
+  if (!snap->fc_durs) return;
+  int64_t dur = now_mono_ns() - t0;
+  auto* d = &snap->fc_durs[(size_t)fc_idx * DUR_STRIDE];
+  d[dur_bucket(dur)].fetch_add(1, std::memory_order_relaxed);
+  d[N_DUR_BUCKETS].fetch_add((uint64_t)dur, std::memory_order_relaxed);
+}
+
 static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
+  const int64_t t_start = now_mono_ns();
   if (st.body.size() < 5) { submit_grpc_error(c, stream_id, 13); return; }
   if (st.body[0] != 0) { submit_grpc_error(c, stream_id, 12); return; }  // compressed
   uint32_t mlen = ((uint8_t)st.body[1] << 24) | ((uint8_t)st.body[2] << 16) |
@@ -1067,6 +1133,7 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
       S->n_fast.fetch_add(1, std::memory_order_relaxed);
       S->n_unauth.fetch_add(1, std::memory_order_relaxed);
       S->n_denied.fetch_add(1, std::memory_order_relaxed);
+      record_direct_dur(snap.get(), fc_idx, t_start);
       submit_grpc_response(c, stream_id, fc.unauth_missing_msg);
       return;
     }
@@ -1095,6 +1162,7 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
         S->n_fast.fetch_add(1, std::memory_order_relaxed);
         S->n_unauth.fetch_add(1, std::memory_order_relaxed);
         S->n_denied.fetch_add(1, std::memory_order_relaxed);
+        record_direct_dur(snap.get(), fc_idx, t_start);
         submit_grpc_response(c, stream_id, fc.unauth_invalid_msg);
         return;
       }
@@ -1107,6 +1175,7 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
     S->n_fast.fetch_add(1, std::memory_order_relaxed);
     S->n_direct_ok.fetch_add(1, std::memory_order_relaxed);
     S->n_allowed.fetch_add(1, std::memory_order_relaxed);
+    record_direct_dur(snap.get(), fc_idx, t_start);
     submit_grpc_response(c, stream_id, fc.ok_msg);
     return;
   }
@@ -1129,7 +1198,7 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
     push_slow(S, c, stream_id, msg, mlen);
     return;
   }
-  snap->slot_entries[S->fill_slot].push_back({c->id, stream_id, fc_idx});
+  snap->slot_entries[S->fill_slot].push_back({c->id, stream_id, fc_idx, t_start});
   S->fill_count++;
   S->n_fast.fetch_add(1, std::memory_order_relaxed);
   if (S->fill_count >= S->bmax) flush_batch(S);
@@ -1319,6 +1388,9 @@ static void drain_done(Server* S) {
     if (!c) continue;
     if (d.grpc_status) submit_grpc_error(c, d.stream_id, d.grpc_status);
     else submit_grpc_response(c, d.stream_id, d.msg);
+    if (d.t_done)
+      S->stage_respond[stage_bucket(now_mono_ns() - d.t_done)].fetch_add(
+          1, std::memory_order_relaxed);
     if (std::find(touched.begin(), touched.end(), c) == touched.end())
       touched.push_back(c);
   }
@@ -1474,6 +1546,17 @@ static void maybe_retire_locked(Server* S, std::vector<int64_t>& retired) {
           agg[2] += inv;
         }
       }
+      // same for undrained duration-histogram buckets
+      for (size_t f = 0; sn->fc_durs && f < sn->fcs.size(); ++f) {
+        uint64_t any = 0;
+        uint64_t vals[DUR_STRIDE];
+        for (int k = 0; k < DUR_STRIDE; ++k)
+          any |= (vals[k] = sn->fc_durs[f * DUR_STRIDE + k].exchange(0));
+        if (any) {
+          auto& agg = S->dur_leftover[sn->fcs[f].ns + '\x1f' + sn->fcs[f].name];
+          for (int k = 0; k < DUR_STRIDE; ++k) agg[k] += vals[k];
+        }
+      }
       retired.push_back(sn->id);
       it = S->snaps.erase(it);
     } else {
@@ -1510,6 +1593,31 @@ static void drain_fc_counts(
   S->fc_leftover.clear();
 }
 
+// drain per-authconfig duration histograms (live snapshots + retired
+// leftovers) into `out`, keyed ns+'\x1f'+name → [15 buckets, sum_ns]
+static void drain_durations(
+    Server* S, std::unordered_map<std::string, std::array<uint64_t, DUR_STRIDE>>& out) {
+  std::lock_guard<std::mutex> lk(S->mu);
+  for (auto& kv : S->snaps) {
+    Snapshot* sn = kv.second.get();
+    for (size_t f = 0; sn->fc_durs && f < sn->fcs.size(); ++f) {
+      uint64_t any = 0;
+      uint64_t vals[DUR_STRIDE];
+      for (int k = 0; k < DUR_STRIDE; ++k)
+        any |= (vals[k] = sn->fc_durs[f * DUR_STRIDE + k].exchange(0));
+      if (any) {
+        auto& agg = out[sn->fcs[f].ns + '\x1f' + sn->fcs[f].name];
+        for (int k = 0; k < DUR_STRIDE; ++k) agg[k] += vals[k];
+      }
+    }
+  }
+  for (auto& kv : S->dur_leftover) {
+    auto& agg = out[kv.first];
+    for (int k = 0; k < DUR_STRIDE; ++k) agg[k] += kv.second[k];
+  }
+  S->dur_leftover.clear();
+}
+
 static void emit_retired(Server* S, const std::vector<int64_t>& retired) {
   if (retired.empty()) return;
   {
@@ -1530,6 +1638,9 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
     entries.swap(snap->slot_entries[slot]);
   }
   uint64_t allowed = 0;
+  const int64_t t_now = now_mono_ns();
+  const int64_t t_flush = snap->slot_flush_ns[slot];
+  const int exec_b = stage_bucket(t_now - t_flush);
   {
     std::lock_guard<std::mutex> lk(S->mu);
     for (size_t i = 0; i < entries.size(); ++i) {
@@ -1537,10 +1648,24 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
       const FastConfig& fc = snap->fcs[e.fc];
       bool ok = verdict[i] != 0;
       allowed += ok;
-      S->done_q.push_back({e.conn_id, e.stream_id, ok ? fc.ok_msg : fc.deny_msg, 0});
+      S->done_q.push_back(
+          {e.conn_id, e.stream_id, ok ? fc.ok_msg : fc.deny_msg, 0, t_now});
     }
     snap->free_slots.push_back(slot);
     snap->pending_batches--;
+  }
+  // per-request on-box stages + the duration series the pipeline observes
+  // (ref pkg/service/auth_pipeline.go:26-36): all clocked here, no tunnel
+  for (const Entry& e : entries) {
+    S->stage_wait[stage_bucket(t_flush - e.t_enq)].fetch_add(
+        1, std::memory_order_relaxed);
+    S->stage_exec[exec_b].fetch_add(1, std::memory_order_relaxed);
+    if (snap->fc_durs) {
+      int64_t dur = t_now - e.t_enq;
+      auto* d = &snap->fc_durs[(size_t)e.fc * DUR_STRIDE];
+      d[dur_bucket(dur)].fetch_add(1, std::memory_order_relaxed);
+      d[N_DUR_BUCKETS].fetch_add((uint64_t)dur, std::memory_order_relaxed);
+    }
   }
   S->n_allowed.fetch_add(allowed, std::memory_order_relaxed);
   S->n_denied.fetch_add(entries.size() - allowed, std::memory_order_relaxed);
@@ -1604,7 +1729,8 @@ static void complete_slow(Server* S, uint64_t req_id, const char* msg, size_t n,
     if (it == S->slow_pending.end()) return;
     sp = it->second;
     S->slow_pending.erase(it);
-    S->done_q.push_back({sp.conn_id, sp.stream_id, std::string(msg, n), grpc_status});
+    S->done_q.push_back({sp.conn_id, sp.stream_id, std::string(msg, n),
+                         grpc_status, now_mono_ns()});
   }
   wake_epoll(S);
 }

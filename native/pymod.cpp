@@ -468,6 +468,8 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
     snap->fcs.push_back(std::move(fc));
   }
   snap->fc_counts.reset(new std::atomic<uint64_t>[snap->fcs.size() * 3 + 1]());
+  snap->fc_durs.reset(
+      new std::atomic<uint64_t>[snap->fcs.size() * fe::DUR_STRIDE + 1]());
   PyObject* hosts = PyDict_GetItemString(d, "hosts");
   for (Py_ssize_t i = 0; hosts != nullptr && i < PyList_GET_SIZE(hosts); ++i) {
     PyObject* t = PyList_GET_ITEM(hosts, i);
@@ -492,6 +494,7 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
   }
   snap->slot_entries.resize(snap->slots.size());
   snap->slot_count.resize(snap->slots.size(), 0);
+  snap->slot_flush_ns.resize(snap->slots.size(), 0);
 
   std::vector<int64_t> retired;
   {
@@ -639,6 +642,63 @@ PyObject* fe_drain_fc_counts_py(PyObject*, PyObject*) {
   return out;
 }
 
+// fe_drain_durations() -> list[(ns, name, [15 bucket counts], sum_ns)] —
+// per-authconfig request-duration histogram increments since the last
+// drain; the dispatcher folds them into
+// auth_server_authconfig_duration_seconds (same buckets as prometheus
+// defaults, non-cumulative per-le counts)
+PyObject* fe_drain_durations_py(PyObject*, PyObject*) {
+  fe::Server* S = fe::g_srv;
+  PyObject* out = PyList_New(0);
+  if (S == nullptr || out == nullptr) return out;
+  std::unordered_map<std::string, std::array<uint64_t, fe::DUR_STRIDE>> agg;
+  Py_BEGIN_ALLOW_THREADS
+  fe::drain_durations(S, agg);
+  Py_END_ALLOW_THREADS
+  for (auto& kv : agg) {
+    size_t sep = kv.first.find('\x1f');
+    if (sep == std::string::npos) continue;
+    PyObject* buckets = PyList_New(fe::N_DUR_BUCKETS);
+    if (buckets == nullptr) { Py_DECREF(out); return nullptr; }
+    for (int k = 0; k < fe::N_DUR_BUCKETS; ++k)
+      PyList_SET_ITEM(buckets, k, PyLong_FromUnsignedLongLong(kv.second[k]));
+    PyObject* t = Py_BuildValue(
+        "(s#s#NK)", kv.first.data(), (Py_ssize_t)sep, kv.first.data() + sep + 1,
+        (Py_ssize_t)(kv.first.size() - sep - 1), buckets,
+        (unsigned long long)kv.second[fe::N_DUR_BUCKETS]);
+    if (t == nullptr) { Py_DECREF(out); return nullptr; }
+    PyList_Append(out, t);
+    Py_DECREF(t);
+  }
+  return out;
+}
+
+// fe_stage_hist() -> {"wait": [...], "exec": [...], "respond": [...],
+// "bounds_ns": [...]} — drains (resets) the on-box per-request stage
+// histograms: queue-wait (encode→flush), execute (flush→complete),
+// respond (complete→HTTP/2 submit)
+PyObject* fe_stage_hist_py(PyObject*, PyObject*) {
+  fe::Server* S = fe::g_srv;
+  PyObject* d = PyDict_New();
+  if (S == nullptr || d == nullptr) return d;
+  auto dump = [&](const char* key, std::atomic<uint64_t>* arr) {
+    PyObject* l = PyList_New(fe::N_STAGE_BUCKETS);
+    for (int i = 0; i < fe::N_STAGE_BUCKETS; ++i)
+      PyList_SET_ITEM(l, i, PyLong_FromUnsignedLongLong(arr[i].exchange(0)));
+    PyDict_SetItemString(d, key, l);
+    Py_DECREF(l);
+  };
+  dump("wait", S->stage_wait);
+  dump("exec", S->stage_exec);
+  dump("respond", S->stage_respond);
+  PyObject* b = PyList_New(fe::N_STAGE_BUCKETS - 1);
+  for (int i = 0; i < fe::N_STAGE_BUCKETS - 1; ++i)
+    PyList_SET_ITEM(b, i, PyLong_FromLongLong(fe::STAGE_BOUNDS_NS[i]));
+  PyDict_SetItemString(d, "bounds_ns", b);
+  Py_DECREF(b);
+  return d;
+}
+
 PyObject* fe_stats_py(PyObject*, PyObject*) {
   fe::Server* S = fe::g_srv;
   PyObject* d = PyDict_New();
@@ -698,6 +758,10 @@ PyMethodDef methods[] = {
     {"fe_stats", fe_stats_py, METH_NOARGS, "frontend counters"},
     {"fe_drain_fc_counts", fe_drain_fc_counts_py, METH_NOARGS,
      "drain per-authconfig direct-decision counters"},
+    {"fe_drain_durations", fe_drain_durations_py, METH_NOARGS,
+     "drain per-authconfig duration histograms"},
+    {"fe_stage_hist", fe_stage_hist_py, METH_NOARGS,
+     "drain the on-box per-request stage histograms"},
     {nullptr, nullptr, 0, nullptr},
 };
 
