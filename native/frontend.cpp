@@ -370,6 +370,7 @@ struct VarEnt {
 
 struct FastConfig {
   int32_t row = 0;
+  int32_t shard = 0;            // owning mp shard (sharded corpora; else 0)
   bool has_batch = true;        // false → identity-only: decide entirely here
   std::vector<FastPlan> plans;
   bool needs_split = false;     // any K_URL_PATH / K_QUERY plan
@@ -460,24 +461,28 @@ struct Entry {
 };
 
 struct Slot {
-  char* attrs_val = nullptr;     // [Bmax, A] int16/int32
-  char* members = nullptr;       // [Bmax, M, K] int16/int32
-  uint8_t* cpu_dense = nullptr;  // [Bmax, C] bool
-  int32_t* config_id = nullptr;  // [Bmax]
-  uint8_t* attr_bytes = nullptr; // [Bmax, NB, DVB]
-  uint8_t* byte_ovf = nullptr;   // [Bmax, NB] bool
+  // sharded corpora carry a leading shard axis: [Bmax, S, ...]; the
+  // single-corpus layout is the S=1 special case of the same strides
+  char* attrs_val = nullptr;     // [Bmax, S, A] int16/int32
+  char* members = nullptr;       // [Bmax, S, M, K] int16/int32
+  uint8_t* cpu_dense = nullptr;  // [Bmax, S, C] bool
+  int32_t* config_id = nullptr;  // [Bmax] row within the owning shard
+  int32_t* shard_of = nullptr;   // [Bmax] owning shard (null for S=1)
+  uint8_t* attr_bytes = nullptr; // [Bmax, S, NB, DVB]
+  uint8_t* byte_ovf = nullptr;   // [Bmax, S, NB] bool
 };
 
 struct Snapshot {
   int64_t id = 0;
   const Interner* interner = nullptr;  // borrowed from Policy (Python-owned)
   int A = 0, M = 0, K = 0, C = 0, NB = 0, DVB = 0;
+  int S = 1;  // mp shards (sharded corpora stack per-shard metadata)
   bool elem16 = false;
-  std::vector<int32_t> attr_member_slot;  // [A] → M row or -1
-  std::vector<int32_t> attr_byte_slot_v;  // [A] → NB row or -1
-  std::vector<std::vector<DfaRef>> attr_dfas;  // [A]
-  std::vector<uint8_t> dfa_trans;  // [R, S, 256]
-  std::vector<uint8_t> dfa_accept; // [R, S]
+  std::vector<int32_t> attr_member_slot;  // [S*A] → M row or -1
+  std::vector<int32_t> attr_byte_slot_v;  // [S*A] → NB row or -1
+  std::vector<std::vector<DfaRef>> attr_dfas;  // [S*A]; rows globalized
+  std::vector<uint8_t> dfa_trans;  // [S*R, St, 256]
+  std::vector<uint8_t> dfa_accept; // [S*R, St]
   int dfa_S = 0;
   // head-based trace sampling: route every Nth fast-eligible request to
   // the slow lane for full span export (0 = tracing off → all fast).
@@ -805,6 +810,10 @@ static bool encode_fast(Server* S, Snapshot* snap, Slot& sl, int b,
   }
 
   const int A = snap->A, K = snap->K, NB = snap->NB, DVB = snap->DVB;
+  // the request's row along the flattened [B, S] axis: all writes land in
+  // its owning shard's slice (other shards keep the zeroed EMPTY encoding)
+  const int64_t bs = (int64_t)b * snap->S + fc.shard;
+  const int64_t meta0 = (int64_t)fc.shard * A;  // per-shard metadata base
   std::string tmp;
   const std::vector<FastPlan>* lists[2] = {&fc.plans, extra};
   for (int li = 0; li < 2; ++li) {
@@ -852,59 +861,65 @@ static bool encode_fast(Server* S, Snapshot* snap, Slot& sl, int b,
       if (vp == nullptr) vn = 0;
       vid = missing ? snap->interner->lookup("", 0) : snap->interner->lookup(vp, vn);
     }
-    put_id(snap, sl.attrs_val, (int64_t)b * A + attr, vid);
-    int32_t mslot = snap->attr_member_slot[attr];
+    put_id(snap, sl.attrs_val, bs * A + attr, vid);
+    int32_t mslot = snap->attr_member_slot[meta0 + attr];
     if (mslot >= 0) {
       if (pl.kind == K_CONST) {
         for (size_t k = 0; k < pl.const_members.size() && (int)k < K; ++k)
-          put_id(snap, sl.members, ((int64_t)b * snap->M + mslot) * K + k,
+          put_id(snap, sl.members, (bs * snap->M + mslot) * K + k,
                  pl.const_members[k]);
       } else if (!missing) {
-        put_id(snap, sl.members, ((int64_t)b * snap->M + mslot) * K, vid);
+        put_id(snap, sl.members, (bs * snap->M + mslot) * K, vid);
       }
     }
-    int32_t bslot = snap->attr_byte_slot_v[attr];
+    int32_t bslot = snap->attr_byte_slot_v[meta0 + attr];
     if (bslot >= 0) {
       if (pl.kind != K_CONST && vn && memchr(vp, 0, vn) != nullptr)
         return false;  // NUL: byte 0 is the DFA pad identity — Python regex
                        // lane is the only exact evaluator (slow lane)
       bool ovf = pl.kind == K_CONST ? pl.const_byte_ovf : (int)vn > DVB;
       if (ovf) {
-        sl.byte_ovf[(int64_t)b * NB + bslot] = 1;
+        sl.byte_ovf[bs * NB + bslot] = 1;
         S->n_dfa_ovf.fetch_add(1, std::memory_order_relaxed);
         // exact host evaluation of every DFA leaf reading this attr (the
         // DFA is length-agnostic; only the device tensor is fixed-width)
         const char* sp = missing ? "" : vp;
         size_t sn = missing ? 0 : vn;
-        for (const DfaRef& d : snap->attr_dfas[attr])
-          sl.cpu_dense[(int64_t)b * snap->C + d.col] = dfa_scan(snap, d.row, sp, sn) ? 1 : 0;
+        for (const DfaRef& d : snap->attr_dfas[meta0 + attr])
+          sl.cpu_dense[bs * snap->C + d.col] = dfa_scan(snap, d.row, sp, sn) ? 1 : 0;
       } else if (vn) {
-        memcpy(sl.attr_bytes + ((int64_t)b * NB + bslot) * DVB, vp, vn);
+        memcpy(sl.attr_bytes + (bs * NB + bslot) * DVB, vp, vn);
       }
     }
   }
   }
   sl.config_id[b] = fc.row;
+  if (sl.shard_of) sl.shard_of[b] = fc.shard;
   return true;
 }
 
-// zero row b of the filling slot (arrays may hold a previous batch's rows)
+// zero row b of the filling slot (arrays may hold a previous batch's rows);
+// zeroes ALL S shard slices — non-owning shards must present the EMPTY
+// encoding so their verdict contributions stay masked out
 static void zero_row(Snapshot* snap, Slot& sl, int b) {
-  const int A = snap->A, M = snap->M, K = snap->K, C = snap->C, NB = snap->NB,
+  const int A = snap->A * snap->S, M = snap->M, K = snap->K,
+            C = snap->C * snap->S, NB = snap->NB * snap->S,
             DVB = snap->DVB;
+  const int MK = M * K * snap->S;
   const int es = snap->elem16 ? 2 : 4;
   // attrs_val ← EMPTY_ID (0), members ← PAD (-3)
   memset(sl.attrs_val + (int64_t)b * A * es, 0, (size_t)A * es);
   if (snap->elem16) {
-    int16_t* m = (int16_t*)sl.members + (int64_t)b * M * K;
-    for (int i = 0; i < M * K; ++i) m[i] = -3;
+    int16_t* m = (int16_t*)sl.members + (int64_t)b * MK;
+    for (int i = 0; i < MK; ++i) m[i] = -3;
   } else {
-    int32_t* m = (int32_t*)sl.members + (int64_t)b * M * K;
-    for (int i = 0; i < M * K; ++i) m[i] = -3;
+    int32_t* m = (int32_t*)sl.members + (int64_t)b * MK;
+    for (int i = 0; i < MK; ++i) m[i] = -3;
   }
   memset(sl.cpu_dense + (int64_t)b * C, 0, (size_t)C);
   if (sl.attr_bytes) memset(sl.attr_bytes + (int64_t)b * NB * DVB, 0, (size_t)NB * DVB);
   if (sl.byte_ovf) memset(sl.byte_ovf + (int64_t)b * NB, 0, (size_t)NB);
+  if (sl.shard_of) sl.shard_of[b] = 0;
 }
 
 // ---- batching (epoll thread) ----------------------------------------------

@@ -399,14 +399,19 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
   snap->DVB = (int)dict_int(d, "DVB");
   snap->elem16 = dict_int(d, "elem16") != 0;
   snap->trace_every = dict_int(d, "trace_every", 0);
+  snap->S = (int)dict_int(d, "S", 1);
+  if (snap->S < 1) snap->S = 1;
+  const long SA = (long)snap->S * snap->A;
   const int32_t* ams = (const int32_t*)dict_addr(d, "attr_member_slot_addr");
   const int32_t* abs_v = (const int32_t*)dict_addr(d, "attr_byte_slot_addr");
-  if (snap->A > 0 && ams != nullptr)
-    snap->attr_member_slot.assign(ams, ams + snap->A);
-  if (snap->A > 0 && abs_v != nullptr)
-    snap->attr_byte_slot_v.assign(abs_v, abs_v + snap->A);
-  snap->attr_member_slot.resize(snap->A, -1);
-  snap->attr_byte_slot_v.resize(snap->A, -1);
+  if (SA > 0 && ams != nullptr)
+    snap->attr_member_slot.assign(ams, ams + SA);
+  if (SA > 0 && abs_v != nullptr)
+    snap->attr_byte_slot_v.assign(abs_v, abs_v + SA);
+  snap->attr_member_slot.resize(SA, -1);
+  snap->attr_byte_slot_v.resize(SA, -1);
+  // dfa_R counts TOTAL stacked rows (S*R for sharded corpora); attr_dfas
+  // rows arrive globalized by the Python side
   long dfa_R = dict_int(d, "dfa_R");
   snap->dfa_S = (int)dict_int(d, "dfa_S");
   if (dfa_R > 0 && snap->dfa_S > 0) {
@@ -415,10 +420,10 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
     snap->dfa_trans.assign(tr, tr + (size_t)dfa_R * snap->dfa_S * 256);
     snap->dfa_accept.assign(ac, ac + (size_t)dfa_R * snap->dfa_S);
   }
-  snap->attr_dfas.resize(snap->A);
+  snap->attr_dfas.resize(SA);
   PyObject* adfas = PyDict_GetItemString(d, "attr_dfas");
   if (adfas != nullptr) {
-    for (Py_ssize_t a = 0; a < PyList_GET_SIZE(adfas) && a < snap->A; ++a) {
+    for (Py_ssize_t a = 0; a < PyList_GET_SIZE(adfas) && a < SA; ++a) {
       PyObject* lst = PyList_GET_ITEM(adfas, a);
       for (Py_ssize_t j = 0; j < PyList_GET_SIZE(lst); ++j) {
         PyObject* t = PyList_GET_ITEM(lst, j);
@@ -439,6 +444,7 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
     PyObject* f = PyList_GET_ITEM(fcs, i);
     fe::FastConfig fc;
     fc.row = (int32_t)dict_int(f, "row");
+    fc.shard = (int32_t)dict_int(f, "shard", 0);
     fc.has_batch = dict_int(f, "has_batch", 1) != 0;
     dict_bytes(f, "ok", fc.ok_msg);
     dict_bytes(f, "deny", fc.deny_msg);
@@ -488,6 +494,7 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
     sl.members = (char*)dict_addr(s, "members");
     sl.cpu_dense = (uint8_t*)dict_addr(s, "cpu_dense");
     sl.config_id = (int32_t*)dict_addr(s, "config_id");
+    sl.shard_of = (int32_t*)dict_addr(s, "shard_of");
     sl.attr_bytes = (uint8_t*)dict_addr(s, "attr_bytes");
     sl.byte_ovf = (uint8_t*)dict_addr(s, "byte_ovf");
     snap->slots.push_back(sl);

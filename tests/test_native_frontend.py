@@ -278,34 +278,55 @@ def run_python_server(engine):
     return holder, t
 
 
-def test_sharded_engine_routes_slow():
-    """With a mesh-sharded snapshot the fast lane has no packed single-corpus
-    params — every host must route to the Python pipeline and still answer
-    correctly.  (Runs FIRST: the C++ server is one-per-process, so this test
-    must finish before the module-scoped stack fixture starts its own.)"""
+def test_sharded_engine_serves_fast_lane():
+    """A mesh-sharded corpus must ride the fast lane too (round 4): the C++
+    encoder lays each request into its owning shard's [B, S, ...] slice and
+    one shard_map dispatch serves the batch — multi-device scaling composes
+    with the native frontend instead of disabling it.  Differential against
+    the Python server on the same sharded engine.  (Runs FIRST: the C++
+    server is one-per-process, so this test must finish before the
+    module-scoped stack fixture starts its own.)"""
     import jax
 
     if len(jax.devices()) < 2:
         pytest.skip("needs the virtual multi-device mesh")
     engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh="auto")
-    rule = Pattern("request.headers.x-org", Operator.EQ, "acme")
-    cfg_id = "ns/sharded"
-    pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
-                         evaluator_slot=0)
-    runtime = RuntimeAuthConfig(identity=[IdentityConfig("anon", Noop())],
-                                authorization=[AuthorizationConfig("rules", pm)])
-    engine.apply_snapshot([EngineEntry(id=cfg_id, hosts=["sharded.test"], runtime=runtime,
-                                       rules=ConfigRules(name=cfg_id,
-                                                         evaluators=[(None, rule)]))])
+    entries = []
+    # enough configs to land on several mp shards, incl. a device-DFA regex
+    for i in range(10):
+        entries.append(make_pattern_entry(
+            engine, f"ns/shard-{i}", [f"shard-{i}.test"],
+            All(Pattern("request.headers.x-org", Operator.EQ, f"org-{i}"),
+                Pattern("request.method", Operator.NEQ, "DELETE"))))
+    entries.append(make_pattern_entry(
+        engine, "ns/shard-rx", ["shard-rx.test"],
+        Pattern("request.url_path", Operator.MATCHES, r"^/v[0-9]+/ok")))
+    engine.apply_snapshot(entries)
+    assert engine._snapshot.sharded is not None, "mesh path not engaged"
     fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
     port = fe.start()
+    holder, t = run_python_server(engine)
     try:
-        ok = grpc_call(port, make_req("sharded.test", headers={"x-org": "acme"}))
-        deny = grpc_call(port, make_req("sharded.test", headers={"x-org": "no"}))
-        assert ok.status.code == 0 and deny.status.code == 7
+        reqs = []
+        for i in range(10):
+            reqs.append(make_req(f"shard-{i}.test", headers={"x-org": f"org-{i}"}))
+            reqs.append(make_req(f"shard-{i}.test", headers={"x-org": "evil"}))
+            reqs.append(make_req(f"shard-{i}.test", method="DELETE",
+                                 headers={"x-org": f"org-{i}"}))
+        reqs.append(make_req("shard-rx.test", path="/v2/ok"))
+        reqs.append(make_req("shard-rx.test", path="/nope"))
+        reqs.append(make_req("shard-rx.test", path="/v2/ok" + "x" * 200))  # ovf
+        reqs.append(make_req("unknown.test"))
+        for i, req in enumerate(reqs):
+            native = response_key(grpc_call(port, req))
+            python = response_key(grpc_call(holder["port"], req))
+            assert native == python, f"sharded req #{i}: {native} vs {python}"
         stats = fe.stats()
-        assert stats["fast"] == 0 and stats["slow"] >= 2
+        assert stats["fast"] > 0, f"sharded fast lane never engaged: {stats}"
+        assert stats["fast"] >= len(reqs) - 1  # all but the 404 ride fast
     finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
         fe.stop()
 
 
