@@ -30,6 +30,12 @@ surfaces as a translate error — fail closed):
     abs, max, min, sum, sort, indexof, substring, object.get, array.concat,
     json.unmarshal, regex.match/re_match, time.now_ns, is_null/is_string/
     is_boolean/is_number/is_array/is_object
+  - ``walk(x, [path, value])`` — the nested path/value relation
+  - ``with`` mocking of input/data paths AND of functions/builtins
+    (``with f as g`` / ``with count as 42``), scoped through referenced rules
+  - multi-module composition: extra ``package`` declarations in the same
+    source form sibling modules, addressable as ``data.<pkg>.<rule>`` and
+    ``data.<pkg>.<fn>(...)``; package docs nest/merge over external data
 
 ``regex.match`` evaluates through the linear-time DFA engine
 (compiler/redfa.py) whenever the pattern is DFA-compilable — matching
@@ -249,6 +255,10 @@ class RegoModule:
     rules: Dict[str, List[Rule]]
     defaults: Dict[str, Any]
     funcs: Dict[str, List[FuncDef]] = field(default_factory=dict)
+    # multi-module composition: auxiliary packages parsed from the same
+    # source, addressable as data.<package>.<rule> (OPA compiles a module
+    # SET; the main package is the policy entrypoint)
+    siblings: Dict[str, "RegoModule"] = field(default_factory=dict)
 
     def evaluate(self, input_doc: Any, data: Any = None) -> Dict[str, Any]:
         """Evaluate every rule in the package against ``input`` (plus an
@@ -366,23 +376,39 @@ class _Parser:
     # ---- module ----
 
     def parse_module(self) -> RegoModule:
+        """Parse a module SET: additional ``package`` declarations mid-source
+        start auxiliary modules (multi-module composition — OPA compiles
+        every module of a bundle; the first/unnamed package is the policy
+        entrypoint and the rest mount at data.<package>)."""
         self.skip_newlines()
         package = "policy"
         if self.peek().kind == "name" and self.peek().value == "package":
             self.next()
             package = self._parse_dotted_name()
-        self.skip_newlines()
-        while self.peek().kind == "name" and self.peek().value == "import":
-            while self.peek().kind not in ("newline", "eof"):
-                self.next()
-            self.skip_newlines()
-        rules: Dict[str, List[Rule]] = {}
-        defaults: Dict[str, Any] = {}
-        funcs: Dict[str, List[FuncDef]] = {}
+        modules: List[RegoModule] = []
+
+        def begin(pkg: str) -> RegoModule:
+            for m in modules:
+                if m.package == pkg:  # same package split across segments
+                    return m
+            m = RegoModule(package=pkg, rules={}, defaults={}, funcs={})
+            modules.append(m)
+            return m
+
+        cur = begin(package)
         while self.peek().kind != "eof":
             self.skip_newlines()
             if self.peek().kind == "eof":
                 break
+            if self.peek().kind == "name" and self.peek().value == "package":
+                self.next()
+                cur = begin(self._parse_dotted_name())
+                continue
+            if self.peek().kind == "name" and self.peek().value == "import":
+                while self.peek().kind not in ("newline", "eof"):
+                    self.next()
+                continue
+            rules, defaults, funcs = cur.rules, cur.defaults, cur.funcs
             rule = self._parse_rule()
             if isinstance(rule, FuncDef):
                 if rule.name in rules or rule.name in defaults:
@@ -403,7 +429,9 @@ class _Parser:
                         "(complete vs partial set)"
                     )
                 defs.append(rule)
-        return RegoModule(package=package, rules=rules, defaults=defaults, funcs=funcs)
+        main = modules[0]
+        main.siblings = {m.package: m for m in modules[1:]}
+        return main
 
     def _parse_dotted_name(self) -> str:
         parts = [self.expect("name").value]
@@ -602,19 +630,17 @@ class _Parser:
         return self._parse_with(left)
 
     def _parse_with(self, expr: Any) -> Any:
-        """Postfix ``with <input|data ref> as <term>`` modifiers (may chain);
-        targets outside input/data (builtin mocking) stay rejected — evaluating
-        past them would silently change the policy's meaning."""
+        """Postfix ``with <target> as <term>`` modifiers (may chain).
+        Targets: input/data paths (document mocking) or function/builtin
+        names (function mocking — the replacement is a function name or a
+        constant value; unknown targets fail at eval, closed)."""
         mods: List[Tuple[Any, Any]] = []
         while self.peek().kind == "name" and self.peek().value == "with":
             line = self.next().line
             target = self._parse_primary()
-            base = target.base if isinstance(target, Ref) else (
-                target.name if isinstance(target, Var) else None)
-            if base not in ("input", "data"):
+            if not isinstance(target, (Ref, Var)):
                 raise RegoError(
-                    f"rego: unsupported 'with' target at line {line} "
-                    "(only input/data paths can be mocked)")
+                    f"rego: unsupported 'with' target at line {line}")
             if isinstance(target, Ref) and not all(isinstance(s, str) for s in target.path):
                 raise RegoError(
                     f"rego: 'with' target path must be static at line {line}")
@@ -1013,21 +1039,87 @@ def _builtin(fn: str, args: List[Any]) -> Any:
     raise RegoError(f"rego: unsupported builtin {fn!r}")
 
 
+# every name _builtin dispatches on (function-mock targets must name one of
+# these or a user function); `walk` is the relation handled in _eval_expr
+_BUILTIN_NAMES = frozenset({
+    "abs", "array.concat", "array.reverse", "array.slice", "concat",
+    "contains", "count", "endswith", "format_int", "glob.match", "indexof",
+    "intersection", "is_array", "is_boolean", "is_null", "is_number",
+    "is_object", "is_string", "json.unmarshal", "lower", "max", "min",
+    "numbers.range", "object.filter", "object.get", "object.keys",
+    "object.remove", "object.union", "regex.match", "re_match", "replace",
+    "sort", "split", "sprintf", "startswith", "strings.reverse", "substring",
+    "sum", "time.now_ns", "to_number", "trim", "trim_prefix", "trim_suffix",
+    "union", "upper", "walk",
+})
+
+
+def _walk_doc(x: Any, prefix: List[Any]) -> Iterator[Tuple[List[Any], Any]]:
+    """OPA walk/2: every (path, value) pair of the nested document,
+    including ([], x) itself."""
+    yield (list(prefix), x)
+    if isinstance(x, dict):
+        for k, v in x.items():
+            prefix.append(k)
+            yield from _walk_doc(v, prefix)
+            prefix.pop()
+    elif isinstance(x, list):
+        for i, v in enumerate(x):
+            prefix.append(i)
+            yield from _walk_doc(v, prefix)
+            prefix.pop()
+
+
+def _dotted_name(term: Any) -> Optional[str]:
+    """The static dotted name a Var/Ref spells, or None."""
+    if isinstance(term, Var):
+        return term.name
+    if isinstance(term, Ref) and all(isinstance(s, str) for s in term.path):
+        return ".".join([term.base] + list(term.path))
+    return None
+
+
 class _Evaluator:
-    def __init__(self, module: RegoModule, input_doc: Any, data: Any = None):
+    def __init__(self, module: RegoModule, input_doc: Any, data: Any = None,
+                 mocks: Optional[Dict[Any, Any]] = None,
+                 registry: Optional[Dict[str, RegoModule]] = None,
+                 in_progress: Optional[set] = None,
+                 sib_cache: Optional[Dict[str, "_Evaluator"]] = None):
         self.module = module
         self.input = input_doc
         self.data = data if data is not None else {}
+        # function mocks from enclosing `with` scopes:
+        # key → ("const", value) | ("func", replacement name)
+        self.mocks: Dict[Any, Any] = mocks or {}
+        # package → module, spanning the whole module set (multi-module)
+        if registry is None:
+            registry = {module.package: module, **module.siblings}
+            for sib in module.siblings.values():
+                registry.setdefault(sib.package, sib)
+        self.registry = registry
         self._cache: Dict[str, Any] = {}
-        self._in_progress: set = set()
+        # recursion guard spans modules: keys are (package, rule name)
+        self._in_progress: set = in_progress if in_progress is not None else set()
         self._func_depth = 0
+        # one evaluator per package within this with-scope (shared caches)
+        self._sib: Dict[str, "_Evaluator"] = sib_cache if sib_cache is not None else {}
+        self._sib.setdefault(module.package, self)
+
+    def _sibling(self, pkg: str) -> "_Evaluator":
+        ev = self._sib.get(pkg)
+        if ev is None:
+            ev = _Evaluator(self.registry[pkg], self.input, data=self.data,
+                            mocks=self.mocks, registry=self.registry,
+                            in_progress=self._in_progress, sib_cache=self._sib)
+        return ev
 
     def rule_value(self, name: str) -> Any:
         if name in self._cache:
             return self._cache[name]
-        if name in self._in_progress:
+        guard = (self.module.package, name)
+        if guard in self._in_progress:
             raise RegoError(f"rego: recursive rule {name!r}")
-        self._in_progress.add(name)
+        self._in_progress.add(guard)
         try:
             result = _UNDEFINED
             defs = self.module.rules.get(name, [])
@@ -1059,7 +1151,7 @@ class _Evaluator:
             self._cache[name] = result
             return result
         finally:
-            self._in_progress.discard(name)
+            self._in_progress.discard(guard)
 
     def _def_value(self, value: Any, body: List[Any],
                    else_chain: List[Tuple[Any, List[Any]]],
@@ -1128,25 +1220,48 @@ class _Evaluator:
             yield bindings  # declaration only
             return
         if isinstance(expr, WithExpr):
-            # input/data mocking: overlay the documents and re-evaluate the
-            # wrapped expression in a FRESH evaluator — rules it references
-            # must recompute under the mocked docs (OPA `with` scoping)
+            # document AND function mocking: overlay input/data and/or
+            # override functions, then re-evaluate the wrapped expression in
+            # a FRESH evaluator — rules it references must recompute under
+            # the mocks (OPA `with` scoping)
             new_input, new_data = self.input, self.data
+            new_mocks = dict(self.mocks)
             for target, vterm in expr.mods:
+                path = list(target.path) if isinstance(target, Ref) else []
+                base = target.base if isinstance(target, Ref) else target.name
+                tname = _dotted_name(target)
+                fkey = self._func_key(tname) if base != "input" else None
+                if fkey is not None:
+                    # function/builtin mock: replacement is a function name
+                    # (user func or builtin) or a constant value
+                    rname = _dotted_name(vterm)
+                    if rname is not None and self._func_key(rname) is not None \
+                            and rname not in bindings:
+                        new_mocks[fkey] = ("func", rname)
+                    else:
+                        val = next(self._term_values(vterm, bindings), _UNDEFINED)
+                        if val is _UNDEFINED:
+                            return
+                        new_mocks[fkey] = ("const", val)
+                    continue
                 val = next(self._term_values(vterm, bindings), _UNDEFINED)
                 if val is _UNDEFINED:
                     return
-                path = list(target.path) if isinstance(target, Ref) else []
-                base = target.base if isinstance(target, Ref) else target.name
                 if base == "input":
                     new_input = _overlay(new_input, path, val)
-                else:
+                elif base == "data":
                     new_data = _overlay(new_data, path, val)
-            child = _Evaluator(self.module, new_input, data=new_data)
-            # the recursion guards span the whole with-chain: a cycle
-            # through mocked documents is still a cycle (OPA rejects
-            # recursion statically; we fail closed at eval)
-            child._in_progress = set(self._in_progress)
+                else:
+                    raise RegoError(
+                        f"rego: unknown 'with' target {tname!r} "
+                        "(not an input/data path or function)")
+            child = _Evaluator(self.module, new_input, data=new_data,
+                               mocks=new_mocks, registry=self.registry,
+                               # the recursion guards span the whole
+                               # with-chain: a cycle through mocked documents
+                               # is still a cycle (OPA rejects recursion
+                               # statically; we fail closed at eval)
+                               in_progress=set(self._in_progress))
             child._func_depth = self._func_depth
             yield from child._eval_expr(expr.expr, bindings)
             return
@@ -1214,12 +1329,49 @@ class _Evaluator:
                         yield bindings
                         return
             return
+        if (isinstance(expr, CallExpr) and expr.fn == "walk"
+                and len(expr.args) == 2 and not expr.path
+                and self.mocks.get(("B", "walk")) is None):
+            # walk(x, [path, value]) — the relation enumerates every nested
+            # (path, value) pair; the output pattern unifies per pair
+            for x in self._term_values(expr.args[0], bindings):
+                for pair_path, pair_val in _walk_doc(x, []):
+                    nb = self._unify(expr.args[1], [pair_path, pair_val], bindings)
+                    if nb is not None:
+                        yield nb
+            return
         # bare term: truthy & defined
         for v in self._term_values(expr, bindings):
             if v is not _UNDEFINED and v is not False and v is not None:
                 yield bindings
                 return
         return
+
+    def _unify(self, pat: Any, val: Any,
+               bindings: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Unify a term pattern against a concrete value: Vars bind (or must
+        match when already bound), array literals unify element-wise,
+        anything else evaluates and compares.  Returns the extended bindings
+        or None."""
+        if isinstance(pat, Var):
+            if pat.name == "_":
+                return bindings
+            if pat.name in bindings:
+                return bindings if bindings[pat.name] == val else None
+            nb = dict(bindings)
+            nb[pat.name] = val
+            return nb
+        if isinstance(pat, ArrayLit):
+            if not isinstance(val, list) or len(val) != len(pat.items):
+                return None
+            nb = bindings
+            for p, v in zip(pat.items, val):
+                nb = self._unify(p, v, nb)
+                if nb is None:
+                    return None
+            return nb
+        got = next(self._term_values(pat, bindings), _UNDEFINED)
+        return bindings if got is not _UNDEFINED and got == val else None
 
     @staticmethod
     def _compare(op: str, a: Any, b: Any) -> bool:
@@ -1332,13 +1484,9 @@ class _Evaluator:
             arg_vals = [next(self._term_values(a, bindings), _UNDEFINED) for a in term.args]
             if _UNDEFINED in arg_vals:
                 return
-            local = self._local_func_name(term.fn)
-            if local is not None:
-                result = self.call_function(local, arg_vals)
-                if result is _UNDEFINED:
-                    return  # no definition matched: the call is undefined
-            else:
-                result = _builtin(term.fn, arg_vals)
+            result = self._call(term.fn, arg_vals)
+            if result is _UNDEFINED:
+                return  # no definition matched: the call is undefined
             if term.path:
                 yield from self._walk_path([result], term.path, bindings)
             else:
@@ -1352,15 +1500,55 @@ class _Evaluator:
         else:
             raise RegoError(f"rego: cannot evaluate term {term!r}")
 
-    def _local_func_name(self, fn: str) -> Optional[str]:
-        """Bare or data-qualified name of a user function, or None for
-        builtins/unknown."""
+    def _resolve_func(self, fn: str) -> Optional[Tuple[str, str]]:
+        """(package, local name) of a user function, or None.  Bare names
+        resolve in the calling module; data.<pkg>.<fn> across the module
+        set (multi-module composition)."""
         if fn in self.module.funcs:
-            return fn
-        prefix = "data." + self.module.package + "."
-        if fn.startswith(prefix) and fn[len(prefix):] in self.module.funcs:
-            return fn[len(prefix):]
+            return (self.module.package, fn)
+        if fn.startswith("data."):
+            rest = fn[5:]
+            for pkg in sorted(self.registry, key=len, reverse=True):
+                if rest.startswith(pkg + "."):
+                    name = rest[len(pkg) + 1:]
+                    if name in self.registry[pkg].funcs:
+                        return (pkg, name)
         return None
+
+    def _func_key(self, fn: Optional[str]) -> Optional[Tuple]:
+        """Normalized mock key for a function-ish name: user functions key
+        by (package, name) so `f` and `data.<pkg>.f` share one mock;
+        builtins key by their dotted name.  None when `fn` names neither."""
+        if fn is None:
+            return None
+        rf = self._resolve_func(fn)
+        if rf is not None:
+            return ("F",) + rf
+        if fn in _BUILTIN_NAMES:
+            return ("B", fn)
+        return None
+
+    def _call(self, fn: str, args: List[Any]) -> Any:
+        """Dispatch a call through mocks → user functions (any module) →
+        builtins."""
+        key = self._func_key(fn)
+        if key is not None:
+            mock = self.mocks.get(key)
+            if mock is not None:
+                if mock[0] == "const":
+                    return mock[1]
+                # replacement function: bypass the SAME mock (no self-
+                # recursion through the override), keep others applicable
+                rname = mock[1]
+                if self._func_key(rname) == key:
+                    raise RegoError(f"rego: 'with' mock for {fn!r} replaces itself")
+                return self._call(rname, args)
+        rf = self._resolve_func(fn)
+        if rf is not None:
+            pkg, name = rf
+            ev = self if pkg == self.module.package else self._sibling(pkg)
+            return ev.call_function(name, args)
+        return _builtin(fn, args)
 
     def _ref_values(self, ref: Ref, bindings: Dict[str, Any]) -> Iterator[Any]:
         if ref.base == "input":
@@ -1390,46 +1578,43 @@ class _Evaluator:
         return doc
 
     def _data_values(self, path: List[Any], bindings: Dict[str, Any]) -> Iterator[Any]:
-        """``data.*`` resolution: the module's own package document mounts
-        at data.<package> (virtual document — rules re-evaluate on demand,
-        and it stays visible from ancestor refs like OPA's nested data
-        tree); everything else walks the external data tree handed to
-        evaluate() (the OPA embedded-library equivalent of compiled packages
-        + loaded data, ref pkg/evaluators/authorization/opa.go:86-141)."""
-        pkg = self.module.package.split(".")
-        n = len(pkg)
-        strs = [s for s in path if isinstance(s, str)]
-        if len(strs) == len(path) and len(path) >= n and path[:n] == pkg:
-            rest = path[n:]
-            if rest:
-                name = rest[0]
-                if name in self.module.rules or name in self.module.defaults:
-                    v = self.rule_value(name)
-                    if v is not _UNDEFINED:
-                        yield from self._walk_path([v], rest[1:], bindings)
-                    return
-            else:
-                # exact data.<package>: virtual doc layered over the
-                # external tree at the same path (same rule as ancestors)
-                doc = self._package_document()
+        """``data.*`` resolution across the module SET: every package's
+        document mounts at data.<package> (virtual documents — rules
+        re-evaluate on demand, visible from ancestor refs like OPA's nested
+        data tree, shadowing external data on conflicts); everything else
+        walks the external data tree handed to evaluate() (the OPA
+        embedded-library equivalent of compiled packages + loaded data,
+        ref pkg/evaluators/authorization/opa.go:86-141)."""
+        if all(isinstance(s, str) for s in path):
+            # a rule inside a package: the deepest matching package wins
+            for pkg_str in sorted(self.registry, key=len, reverse=True):
+                pkg = pkg_str.split(".")
+                if len(path) > len(pkg) and path[:len(pkg)] == pkg:
+                    ev = self._sibling(pkg_str)
+                    name = path[len(pkg)]
+                    if name in ev.module.rules or name in ev.module.defaults:
+                        v = ev.rule_value(name)
+                        if v is not _UNDEFINED:
+                            yield from self._walk_path([v], path[len(pkg) + 1:],
+                                                       bindings)
+                        return
+            # a package subtree: nest every package document under `path`,
+            # deep-merged, virtual docs winning over external data
+            contrib: Any = None
+            for pkg_str in self.registry:
+                pkg = pkg_str.split(".")
+                if len(pkg) >= len(path) and pkg[:len(path)] == path:
+                    sub: Any = self._sibling(pkg_str)._package_document()
+                    for part in reversed(pkg[len(path):]):
+                        sub = {part: sub}
+                    contrib = sub if contrib is None else _merge_docs(contrib, sub)
+            if contrib is not None:
                 ext = next(self._walk_path([self.data], list(path), bindings),
                            _UNDEFINED)
                 if isinstance(ext, dict):
-                    doc = _merge_docs(ext, doc)
-                yield doc
+                    contrib = _merge_docs(ext, contrib)
+                yield contrib
                 return
-        elif (len(strs) == len(path) and len(path) < n and pkg[:len(path)] == path):
-            # ancestor of the package path: nest the virtual document under
-            # the remaining package segments, merged over the external tree
-            # (virtual documents win on conflicts, like OPA)
-            doc: Any = self._package_document()
-            for part in reversed(pkg[len(path):]):
-                doc = {part: doc}
-            ext = next(self._walk_path([self.data], list(path), bindings), _UNDEFINED)
-            if isinstance(ext, dict):
-                doc = _merge_docs(ext, doc)
-            yield doc
-            return
         yield from self._walk_path([self.data], path, bindings)
 
     def _walk_path(self, values: List[Any], path: List[Any],
