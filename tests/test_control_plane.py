@@ -357,7 +357,10 @@ class AdversarialCluster:
 
     async def list_auth_configs_rv(self, selector):
         self.list_params.append(selector)
-        items, rv = self.lists.pop(0) if self.lists else self.lists_last
+        entry = self.lists.pop(0) if self.lists else self.lists_last
+        if entry == "raise":  # scripted apiserver outage during re-list
+            raise RuntimeError("apiserver unavailable")
+        items, rv = entry
         self.lists_last = (items, rv)
         if not self.lists:
             # capture the swap count as the FINAL list is served: the
@@ -483,5 +486,136 @@ class TestResyncDedupRetry:
             engine.add_swap_listener(lambda: swaps.__setitem__(0, swaps[0] + 1))
             await rec.reconcile_all([dict(cr)])
             assert swaps[0] == 0
+
+        run(body())
+
+
+class TestWatchBookmarksAndStorms:
+    def test_bookmarks_advance_resume_point(self):
+        """BOOKMARK events must advance the watch resume point without
+        reconciling anything: after a drop, the next watch resumes from the
+        bookmark's resourceVersion, so the re-watch window shrinks to
+        nothing even when no real events flowed (informer bookmark
+        semantics)."""
+        from authorino_tpu.controllers.sources import K8sWatchSource
+
+        async def body():
+            engine = PolicyEngine()
+            swaps = [0]
+            engine.add_swap_listener(lambda: swaps.__setitem__(0, swaps[0] + 1))
+            rec = AuthConfigReconciler(engine)
+            a = v1_ac("a", "1", ["a.test"])
+            bookmark = {"kind": "AuthConfig",
+                        "metadata": {"resourceVersion": "42"}}
+            # W1 ends gracefully after the bookmark; the follow-up re-list
+            # FAILS (apiserver outage) — the bookmark rv is then the only
+            # valid resume point, and the index must keep serving meanwhile
+            lists = [([a], "10"), "raise", ([a], "43")]
+            watches = [
+                [("yield", "BOOKMARK", bookmark)],
+                # W2: resumes from the bookmark rv, then parks via cluster
+            ]
+            cluster = AdversarialCluster(lists, watches, swaps)
+            src = K8sWatchSource(cluster, rec, resync_interval_s=0.01)
+            src.start()
+            await asyncio.wait_for(cluster.done.wait(), timeout=10)
+            swaps_after_first = swaps[0]
+            assert engine.lookup("a.test") is not None
+            # the second watch resumed from the BOOKMARK rv (42) — the
+            # failed re-list could not refresh it, the bookmark carried it
+            rvs = [p.get("resourceVersion") for p in cluster.watch_params[:2]]
+            assert rvs == ["10", "42"], rvs
+            # the bookmark itself reconciled nothing new
+            assert swaps[0] == swaps_after_first
+            await src.stop()
+
+        run(body())
+
+    def test_reconnect_storm_soak_zero_missed_deletes(self):
+        """A storm of watch drops / 410s / stale re-lists while requests
+        are being served: the index must end EXACTLY at the final apiserver
+        state (no missed deletes, no zombies), readiness must hold, and
+        every concurrent Check() must answer (VERDICT r3 next #9)."""
+        from authorino_tpu.controllers.sources import K8sWatchSource
+
+        async def body():
+            engine = PolicyEngine(max_batch=4, max_delay_s=0.0005)
+            swaps = [0]
+            engine.add_swap_listener(lambda: swaps.__setitem__(0, swaps[0] + 1))
+            rec = AuthConfigReconciler(engine)
+
+            # scripted evolution: 12 reconnect cycles; each cycle adds
+            # cfg-i, deletes cfg-(i-3) — half the deletes happen DURING the
+            # outage (only visible via the re-list), half on the stream
+            rv = [100]
+
+            def bump():
+                rv[0] += 1
+                return str(rv[0])
+
+            live: dict = {}
+            lists = []
+            watches = []
+            live["cfg-0"] = v1_ac("cfg-0", bump(), ["cfg-0.test"])
+            lists.append((list(live.values()), bump()))  # initial list
+            for i in range(1, 13):
+                script = []
+                added = v1_ac(f"cfg-{i}", bump(), [f"cfg-{i}.test"])
+                live[f"cfg-{i}"] = added
+                script.append(("yield", "ADDED", added))
+                gone = f"cfg-{i - 3}"
+                if gone in live:
+                    doomed = live.pop(gone)
+                    if i % 2 == 0:
+                        # on-stream delete
+                        script.append(("yield", "DELETED", doomed))
+                    # odd i: delete silently during the outage — only the
+                    # re-list can reveal it (the missed-delete trap)
+                if i % 5 == 0:
+                    script.append(
+                        ("yield", "ERROR", {"kind": "Status", "code": 410}))
+                else:
+                    script.append(("raise",))
+                watches.append(script)
+                lists.append((list(live.values()), bump()))
+            cluster = AdversarialCluster(lists, watches, swaps)
+            src = K8sWatchSource(cluster, rec, resync_interval_s=0.005)
+            src.start()
+
+            # concurrent serving during the storm
+            served = [0]
+            stop_serving = asyncio.Event()
+
+            async def serve():
+                while not stop_serving.is_set():
+                    req = CheckRequestModel(http=HttpRequestAttributes(
+                        method="GET", path="/x",
+                        host=f"cfg-{served[0] % 13}.test"))
+                    result = await engine.check(req)
+                    assert result is not None
+                    served[0] += 1
+                    await asyncio.sleep(0)
+
+            server_task = asyncio.ensure_future(serve())
+            try:
+                await asyncio.wait_for(cluster.done.wait(), timeout=20)
+                await asyncio.sleep(0.1)  # let the final re-list settle
+            finally:
+                stop_serving.set()
+                await server_task
+
+            # zero missed deletes, zero zombies: the index is EXACTLY the
+            # final live set
+            for i in range(13):
+                name = f"cfg-{i}"
+                if name in live:
+                    assert engine.lookup(f"{name}.test") is not None, name
+                    assert rec.status.get(f"t/{name}").reason == STATUS_RECONCILED
+                else:
+                    assert engine.lookup(f"{name}.test") is None, f"zombie {name}"
+                    assert rec.status.get(f"t/{name}") is None
+            assert rec.ready()
+            assert served[0] > 0
+            await src.stop()
 
         run(body())
