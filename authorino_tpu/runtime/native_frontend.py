@@ -1163,10 +1163,21 @@ class NativeFrontend:
                 mod.fe_complete_slow(req_id, b"", 13)  # INTERNAL
 
         async def main() -> None:
+            # continuous admission, NOT batch-gather convoys: a straggler
+            # (an OIDC discovery fetch, a slow metadata backend) must not
+            # block unrelated requests queued behind it — each completion
+            # frees an admission slot immediately (the asyncio analog of the
+            # reference's per-request goroutines, ref main.go:437-488)
             loop = asyncio.get_running_loop()
+            sem = asyncio.Semaphore(512)
+
+            def _release(_):
+                sem.release()
+
             while self._running:
                 batch = await loop.run_in_executor(None, mod.fe_take_slow, 200, 256)
-                if batch:
-                    await asyncio.gather(*(handle(i, raw) for i, raw in batch))
+                for i, raw in batch:
+                    await sem.acquire()
+                    loop.create_task(handle(i, raw)).add_done_callback(_release)
 
         asyncio.run(main())
