@@ -212,6 +212,16 @@ class SomeDecl:
 
 
 @dataclass
+class SomeInExpr:
+    """``some k, v in xs`` — existential iteration binding key (array index
+    / object key) and value together (OPA v1 `in` with two variables)."""
+
+    key: str
+    val: str
+    domain: Any
+
+
+@dataclass
 class WithExpr:
     """``expr with input.path as term`` — input/data mocking: the wrapped
     expression (and every rule it references) re-evaluates against the
@@ -612,10 +622,16 @@ class _Parser:
             while self.peek().kind == "op" and self.peek().value == ",":
                 self.next()
                 names.append(self.expect("name").value)
-            # `some x in xs` sugar
+            # `some x in xs` / `some k, v in xs` sugar
             if self.peek().kind == "name" and self.peek().value == "in":
                 self.next()
                 haystack = self._parse_term()
+                if len(names) == 2:
+                    return self._parse_with(
+                        SomeInExpr(names[0], names[1], haystack))
+                if len(names) != 1:
+                    raise RegoError(
+                        "rego: 'some ... in' takes one or two variables")
                 return self._parse_with(InExpr(Var(names[0]), haystack))
             return SomeDecl(names)
         left = self._parse_term()
@@ -1312,6 +1328,22 @@ class _Evaluator:
                 if ok:  # incl. the vacuous empty-domain case
                     yield bindings
                     return
+            return
+        if isinstance(expr, SomeInExpr):
+            for hay in self._term_values(expr.domain, bindings):
+                if isinstance(hay, list):
+                    pairs = list(enumerate(hay))
+                elif isinstance(hay, dict):
+                    pairs = list(hay.items())
+                else:
+                    continue  # non-collection domain: undefined
+                for k, v in pairs:
+                    nb = dict(bindings)
+                    if expr.key != "_":
+                        nb[expr.key] = k
+                    if expr.val != "_":
+                        nb[expr.val] = v
+                    yield nb
             return
         if isinstance(expr, InExpr):
             for hay in self._term_values(expr.haystack, bindings):

@@ -1013,3 +1013,15 @@ class TestRegoRound4:
         out = opa._module.evaluate(
             {"auth": {"identity": {"realm_access": {"roles": ["admin"]}}}})
         assert out["allow"] is True
+
+    def test_some_key_value_in(self):
+        m = compile_module(
+            "admins contains u { some u, r in input.users; r == \"admin\" }\n"
+            "second = v { some i, v in input.xs; i == 1 }\n"
+            "anyval { some _, v in input.users; v == \"admin\" }\n"
+        )
+        out = m.evaluate({"users": {"ann": "admin", "bob": "user"},
+                          "xs": ["a", "b", "c"]})
+        assert out["admins"] == ["ann"]
+        assert out["second"] == "b"
+        assert out["anyval"] is True
