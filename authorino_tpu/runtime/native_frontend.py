@@ -40,7 +40,7 @@ from ..compiler.pack import _trim_bytes, wire_dtype
 from ..evaluators import credentials as cred_mod
 from ..evaluators.base import DenyWithValues, RuntimeAuthConfig
 from ..evaluators.authorization import PatternMatching
-from ..evaluators.identity import APIKey, Noop
+from ..evaluators.identity import APIKey, MTLS, Noop
 from ..evaluators.identity.api_key import INVALID_API_KEY_MSG
 from ..evaluators.identity.oidc import OIDC
 from ..pipeline.pipeline import AuthPipeline, AuthResult
@@ -177,6 +177,9 @@ _CRED_KINDS = {
     cred_mod.LOCATION_COOKIE: 3,
     cred_mod.LOCATION_QUERY: 4,
 }
+# mTLS: the forwarded client certificate is the credential
+_CRED_KIND_CERT = 5
+MISSING_CERT_MSG = "client certificate is missing"
 
 
 @dataclass
@@ -233,13 +236,17 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
     is_noop = isinstance(ident, Noop)
     is_key = isinstance(ident, APIKey)
     is_oidc = isinstance(ident, OIDC)
-    if not is_noop and not is_key and not is_oidc:
+    is_mtls = isinstance(ident, MTLS)
+    if not is_noop and not is_key and not is_oidc and not is_mtls:
         return None
     cred_kind = 0
-    if is_key or is_oidc:
-        cred_kind = _CRED_KINDS.get(ident.credentials.location, 0)
-        if cred_kind == 0:
-            return None
+    if is_key or is_oidc or is_mtls:
+        if is_mtls:
+            cred_kind = _CRED_KIND_CERT
+        else:
+            cred_kind = _CRED_KINDS.get(ident.credentials.location, 0)
+            if cred_kind == 0:
+                return None
         # missing credentials answer from a static template — the
         # identity-failure denyWith must resolve without a request doc
         if not _deny_with_static(rt.deny_with.unauthenticated):
@@ -291,14 +298,15 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
                 return None
             spec.plans.append(p)
         return spec
-    if is_oidc:
-        # verified-token cache: variants registered at runtime by the slow
-        # lane (NativeFrontend._register_dyn); auth.* operands resolve per
-        # token, so their attr rows ride along for registration time
+    if is_oidc or is_mtls:
+        # verified-credential cache: variants registered at runtime by the
+        # slow lane (NativeFrontend._register_dyn); auth.* operands resolve
+        # per token/cert, so their attr rows ride along for registration
         spec.dyn = True
         spec.auth_attrs = auth_attrs
-        key_sel = ident.credentials.key_selector
-        spec.cred_key = key_sel.lower() if cred_kind == 2 else key_sel
+        if is_oidc:
+            key_sel = ident.credentials.key_selector
+            spec.cred_key = key_sel.lower() if cred_kind == 2 else key_sel
         return spec
     # API key: resolve each known key's auth.* operands to constants
     # (the fast-lane analog of precompile-at-reconcile,
@@ -356,7 +364,7 @@ class NativeFrontend:
     def __init__(self, engine, port: int = 0, max_batch: int = 1024,
                  window_us: int = 2000, slots: int = 16, slow_cap: int = 65536,
                  dispatch_threads: int = 6, bind_all: bool = False,
-                 dyn_ttl_s: float = 600.0, trace_sample_n: int = 16):
+                 dyn_ttl_s: float = 600.0, trace_sample_n: int = 64):
         self.engine = engine
         # verified-token cache entries live at most this long (and never
         # past the token's own exp claim)
@@ -844,8 +852,11 @@ class NativeFrontend:
                     # static identity-failure templates, byte-exact with the
                     # pipeline's UNAUTHENTICATED + challenges + denyWith path
                     # (ref pkg/service/auth_pipeline.go:468-472)
+                    missing_msg = (MISSING_CERT_MSG
+                                   if spec_fl.cred_kind == _CRED_KIND_CERT
+                                   else "credential not found")
                     fc["unauth_missing"] = self._result_bytes(
-                        self._unauth_result(entry.runtime, "credential not found"))
+                        self._unauth_result(entry.runtime, missing_msg))
                     fc["unauth_invalid"] = self._result_bytes(
                         self._unauth_result(entry.runtime, INVALID_API_KEY_MSG))
                 fcs.append(fc)
@@ -916,14 +927,32 @@ class NativeFrontend:
         conf, obj = pipeline.resolved_identity()
         if obj is None or conf is not idc:
             return
-        try:
-            token = idc.evaluator.credentials.extract(model.http)
-        except Exception:
-            return
         import time as _time
 
         now = _time.time()
         deadline = now + self.dyn_ttl_s
+        if isinstance(idc.evaluator, MTLS):
+            # the raw forwarded PEM is the cache key (exactly the bytes the
+            # C++ side extracts); the cert's own notAfter bounds the entry
+            token = model.source.certificate or ""
+            if not token:
+                return
+            try:
+                import urllib.parse
+
+                from cryptography import x509
+
+                cert = x509.load_pem_x509_certificate(
+                    urllib.parse.unquote(token).encode())
+                deadline = min(deadline,
+                               cert.not_valid_after_utc.timestamp())
+            except Exception:
+                return
+        else:
+            try:
+                token = idc.evaluator.credentials.extract(model.http)
+            except Exception:
+                return
         exp = obj.get("exp") if isinstance(obj, dict) else None
         if isinstance(exp, (int, float)) and not isinstance(exp, bool):
             deadline = min(deadline, float(exp))
@@ -1169,7 +1198,9 @@ class NativeFrontend:
             # frees an admission slot immediately (the asyncio analog of the
             # reference's per-request goroutines, ref main.go:437-488)
             loop = asyncio.get_running_loop()
-            sem = asyncio.Semaphore(512)
+            # deep enough to hide the device link RTT under the slow lane's
+            # own micro-batches (in-flight ≈ throughput × RTT)
+            sem = asyncio.Semaphore(2048)
 
             def _release(_):
                 sem.release()

@@ -386,6 +386,52 @@ def run_grpc_mode(args):
     return sum(totals), measured[0], lat, None, None
 
 
+def _start_fake_collector():
+    """OTLP/HTTP trace sink on a background loop thread: bench --trace
+    measures the fast lane with span export ACTIVE (head-sampled 1-in-N to
+    the slow lane) — the number that proves observability doesn't cost the
+    native throughput wholesale."""
+    import asyncio
+    import threading
+
+    from aiohttp import web
+
+    holder = {"spans": 0}
+    started = threading.Event()
+
+    def runner():
+        async def main():
+            app = web.Application()
+
+            async def v1_traces(request):
+                payload = await request.json()
+                for rs in payload.get("resourceSpans", []):
+                    for ss in rs.get("scopeSpans", []):
+                        holder["spans"] += len(ss.get("spans", []))
+                return web.json_response({})
+
+            app.router.add_post("/v1/traces", v1_traces)
+            r = web.AppRunner(app)
+            await r.setup()
+            site = web.TCPSite(r, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            holder["endpoint"] = f"http://127.0.0.1:{port}"
+            holder["loop"] = asyncio.get_running_loop()
+            holder["stop"] = asyncio.Event()
+            started.set()
+            await holder["stop"].wait()
+            await r.cleanup()
+
+        asyncio.run(main())
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    started.wait(30)
+    holder["thread"] = t
+    return holder
+
+
 def run_native_mode(args):
     """The device-owner service: C++ HTTP/2 gRPC frontend in THIS process
     (native/frontend.cpp) + one JAX dispatch per micro-batch, driven by the
@@ -414,6 +460,16 @@ def run_native_mode(args):
     external_auth_pb2 = protos.external_auth_pb2
     rng = random.Random(5)
     n_cfg = args.configs
+
+    collector = None
+    if getattr(args, "trace", False):
+        from authorino_tpu.utils import tracing as tracing_mod
+
+        collector = _start_fake_collector()
+        assert tracing_mod.setup_tracing(collector["endpoint"])
+        log(f"tracing ACTIVE → {collector['endpoint']} "
+            "(head sampling at the frontend default rate; spans exported "
+            "from the slow lane)")
 
     engine = PolicyEngine(max_batch=args.batch, max_delay_s=args.window_us / 1e6,
                           mesh=None)
@@ -530,6 +586,11 @@ def run_native_mode(args):
     finally:
         fe.stop()
         os.unlink(payload_path)
+        if collector is not None:
+            log(f"tracing run: {collector['spans']} spans received by the "
+                "collector (sampled count in the stats line above)")
+            collector["loop"].call_soon_threadsafe(collector["stop"].set)
+            collector["thread"].join(timeout=10)
 
     stats = {
         "request_p50_ms": best["p50_ms"],
@@ -915,6 +976,10 @@ def main():
                     help="strictly serial encode→apply loop (legacy)")
     ap.add_argument("--profile", action="store_true",
                     help="capture a jax.profiler trace under profiles/")
+    ap.add_argument("--trace", action="store_true",
+                    help="native mode: enable span export to an in-process "
+                         "fake OTLP collector (1-in-16 head sampling) — "
+                         "measures the cost of observability being ON")
     ap.add_argument("--trials", type=int, default=3,
                     help="run the measured loop N times and report the best "
                          "— the tunnel to the device on this image has "

@@ -197,6 +197,7 @@ struct PbView {
 struct ReqView {
   bool has_attributes = false, has_request = false, has_http = false;
   PbView method, path, host, scheme, query, fragment, protocol;
+  PbView source_cert;  // AttributeContext.source.certificate (Peer field 5)
   int64_t size = 0;
   std::vector<std::pair<PbView, PbView>> headers;   // last-wins on dup keys
   std::vector<std::pair<PbView, PbView>> ctx_ext;
@@ -307,7 +308,20 @@ static bool parse_check_request(const char* data, size_t n, ReqView& rv) {
     uint64_t tag;
     if (!pb_varint(p, end, tag)) return false;
     int f = (int)(tag >> 3), wt = (int)(tag & 7);
-    if (f == 4 && wt == 2) {  // request
+    if (f == 1 && wt == 2) {  // source peer (certificate at field 5)
+      PbView peer;
+      if (!pb_len(p, end, peer)) return false;
+      const char* q = peer.p;
+      const char* qe = peer.p + peer.n;
+      while (q < qe) {
+        uint64_t t2;
+        if (!pb_varint(q, qe, t2)) return false;
+        int f2 = (int)(t2 >> 3), w2 = (int)(t2 & 7);
+        if (f2 == 5 && w2 == 2) {
+          if (!pb_len(q, qe, rv.source_cert)) return false;
+        } else if (!pb_skip(q, qe, w2)) return false;
+      }
+    } else if (f == 4 && wt == 2) {  // request
       PbView req;
       if (!pb_len(p, end, req)) return false;
       rv.has_request = true;
@@ -378,7 +392,8 @@ struct FastConfig {
   // credential-bearing identity (API key, ref pkg/evaluators/identity/
   // api_key.go:72-93): extraction spec + per-key plan variants whose
   // auth.identity.* operands were resolved to constants at refresh time
-  int cred_kind = 0;            // 0 none, 1 auth header, 2 custom header, 3 cookie, 4 query
+  int cred_kind = 0;            // 0 none, 1 auth header, 2 custom header,
+                                // 3 cookie, 4 query, 5 client certificate
   std::string cred_key;
   // dyn (OIDC/JWT): variants are registered at runtime by the slow lane
   // after a successful verification (verified-token cache: the fast-lane
@@ -764,6 +779,11 @@ static bool extract_cred(const FastConfig& fc, const ReqView& rv, std::string& c
         p = semi + 1;
       }
       return false;
+    }
+    case 5: {  // client certificate (mTLS): the raw forwarded PEM is the key
+      if (!rv.source_cert.set || rv.source_cert.n == 0) return false;
+      cred.assign(rv.source_cert.p, rv.source_cert.n);
+      return true;
     }
     case 4: {  // query param in the raw path: [?&]<key>=([^&]*)
       if (!rv.path.set) return false;

@@ -519,6 +519,100 @@ def test_oidc_jwks_rotation_drops_token_cache():
         t.join(timeout=10)
 
 
+def test_mtls_fast_lane_cert_cache():
+    """mTLS identities ride the fast lane too (round 4): the forwarded
+    client certificate is the credential key of the verified-credential
+    cache — first sight verifies in the slow lane, repeats serve natively,
+    subject-based patterns resolve from the cached identity."""
+    import urllib.parse
+
+    from test_evaluators import TestMTLS
+
+    from authorino_tpu.k8s import InMemoryCluster
+
+    ca_pem, leaf_pem = TestMTLS()._make_ca_and_cert(valid=True)
+    _, rogue_pem = TestMTLS()._make_ca_and_cert(valid=False)
+    cluster = InMemoryCluster()
+    cluster.put_secret(Secret(name="ca", namespace="ns", labels={"app": "mtls"},
+                              data={"ca.crt": ca_pem}))
+    mtls = __import__("authorino_tpu.evaluators.identity",
+                      fromlist=["MTLS"]).MTLS(
+        "mtls", LabelSelector.parse("app=mtls"), cluster=cluster)
+    asyncio.run(mtls.load_secrets())
+
+    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    rule = Pattern("auth.identity.Organization", Operator.EQ, "acme")
+    pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/mtls"),
+                         evaluator_slot=0)
+    entries = [
+        EngineEntry(
+            id="ns/mtls", hosts=["mtls.test"],
+            runtime=RuntimeAuthConfig(
+                labels={"namespace": "ns", "name": "mtls"},
+                identity=[IdentityConfig("mtls", mtls)],
+                authorization=[AuthorizationConfig("rules", pm)]),
+            rules=ConfigRules(name="ns/mtls", evaluators=[(None, rule)])),
+        EngineEntry(  # identity-only: cert validity IS the decision
+            id="ns/mtls-only", hosts=["mtls-only.test"],
+            runtime=RuntimeAuthConfig(
+                labels={"namespace": "ns", "name": "mtls-only"},
+                identity=[IdentityConfig("mtls", mtls)]),
+            rules=None),
+    ]
+    engine.apply_snapshot(entries)
+    spec = fast_lane_eligible(engine._snapshot.by_id["ns/mtls"],
+                              engine._snapshot.policy)
+    assert spec is not None and spec.dyn and spec.cred_kind == 5
+
+    fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
+    port = fe.start()
+    holder, t = run_python_server(engine)
+    try:
+        def cert_req(host, pem=None):
+            req = make_req(host)
+            if pem is not None:
+                req.attributes.source.certificate = urllib.parse.quote(pem)
+            return req
+
+        r1 = grpc_call(port, cert_req("mtls.test", leaf_pem))
+        assert r1.status.code == 0
+        assert fe.stats()["dyn_add"] >= 1
+        r2 = grpc_call(port, cert_req("mtls.test", leaf_pem))
+        assert r2.status.code == 0
+        assert fe.stats()["dyn_hit"] >= 1
+        o1 = grpc_call(port, cert_req("mtls-only.test", leaf_pem))
+        o2 = grpc_call(port, cert_req("mtls-only.test", leaf_pem))
+        assert o1.status.code == 0 and o2.status.code == 0
+
+        matrix = [
+            cert_req("mtls.test", leaf_pem),
+            cert_req("mtls.test", rogue_pem),   # unknown authority → slow
+            cert_req("mtls.test"),              # missing cert → static unauth
+            cert_req("mtls-only.test", leaf_pem),
+            cert_req("mtls-only.test"),
+        ]
+        for i, rq in enumerate(matrix):
+            native = response_key(grpc_call(port, rq))
+            python = response_key(grpc_call(holder["port"], rq))
+            assert native == python, f"mtls req #{i}: {native} vs {python}"
+
+        # CA rotation: the secret reconciler's in-place mutation notifies
+        # swap listeners → fresh snapshot, cache dropped, old cert rejected
+        new_ca, _ = TestMTLS()._make_ca_and_cert(valid=True)
+        mtls.revoke_k8s_secret_based_identity("ns", "ca")
+        mtls.add_k8s_secret_based_identity(Secret(
+            name="ca", namespace="ns", labels={"app": "mtls"},
+            data={"ca.crt": new_ca}))
+        engine.notify_swap_listeners()
+        wait_for_snap_retire(fe)
+        r3 = grpc_call(port, cert_req("mtls.test", leaf_pem))
+        assert r3.status.code == 16  # UNAUTHENTICATED: unknown authority now
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
+        fe.stop()
+
+
 def test_slow_lane_no_head_of_line_blocking():
     """A straggling slow-lane request (slow metadata backend) must not
     delay unrelated slow-lane requests queued behind it: admission is
