@@ -334,6 +334,13 @@ async def main() -> int:
             out.append(("grpc Check allow", ok.status.code, 0))
             deny = call(req(H, "APIKEY wrong"), timeout=10)
             out.append(("grpc Check deny", deny.status.code, 16))
+            # OIDC through the wire: first sight verifies in the slow lane
+            # (and registers the token in the verified-token cache when the
+            # native frontend serves), the repeat must answer identically
+            j1 = call(req(H, f"Bearer {admin_jwt}"), timeout=10)
+            out.append(("grpc Check jwt allow (verify)", j1.status.code, 0))
+            j2 = call(req(H, f"Bearer {admin_jwt}"), timeout=10)
+            out.append(("grpc Check jwt allow (repeat)", j2.status.code, 0))
             nf = call(req("nope.example.com"), timeout=10)
             out.append(("grpc Check unknown host", nf.denied_response.status.code, 404))
             health = ch.unary_unary(
@@ -351,7 +358,7 @@ async def main() -> int:
                 failures += 1
             print(f"[{mark}] {desc}: {got} (want {want})")
     except Exception as e:
-        failures += 4
+        failures += 6
         print(f"[FAIL] grpc listener checks: {e}")
 
     server_task.cancel()
@@ -363,7 +370,7 @@ async def main() -> int:
     from authorino_tpu.utils.http import close_sessions
 
     await close_sessions()
-    n_assertions = len(TABLE) + 3 + 4  # + wristband + rotation + recompile + grpc
+    n_assertions = len(TABLE) + 3 + 6  # + wristband + rotation + recompile + grpc
     print(f"\n{'OK' if failures == 0 else 'FAILED'}: {n_assertions - failures}/{n_assertions} assertions passed")
     return 1 if failures else 0
 
