@@ -461,16 +461,6 @@ def run_native_mode(args):
     rng = random.Random(5)
     n_cfg = args.configs
 
-    collector = None
-    if getattr(args, "trace", False):
-        from authorino_tpu.utils import tracing as tracing_mod
-
-        collector = _start_fake_collector()
-        assert tracing_mod.setup_tracing(collector["endpoint"])
-        log(f"tracing ACTIVE → {collector['endpoint']} "
-            "(head sampling at the frontend default rate; spans exported "
-            "from the slow lane)")
-
     engine = PolicyEngine(max_batch=args.batch, max_delay_s=args.window_us / 1e6,
                           mesh=None)
     engine.apply_snapshot(build_wire_entries(args, engine.provider_for))
@@ -547,6 +537,41 @@ def run_native_mode(args):
         lg(max(3.0, args.seconds / 2), 1, light_total // 2, 2)
         onbox_light = stage_capture("light")
 
+        # --trace: re-measure with span export ACTIVE in the SAME process —
+        # same jit cache, same tunnel window — so the traced/untraced ratio
+        # isn't tunnel noise (the claim: observability on ≥ ~80% of off)
+        trace_cmp = None
+        if getattr(args, "trace", False):
+            from authorino_tpu.utils import tracing as tracing_mod
+
+            collector = _start_fake_collector()
+            assert tracing_mod.setup_tracing(collector["endpoint"])
+            fe.refresh()  # rebuild the C++ snapshot with sampling on
+            fe.wait_warm(600)
+            log(f"tracing ACTIVE → {collector['endpoint']} "
+                f"(1-in-{fe.trace_sample_n} head sampling)")
+            traced_best = None
+            for trial in range(args.trials):
+                tr = lg(args.seconds, 1, sat_depth, sat_conns)
+                log(f"traced trial {trial + 1}/{args.trials}: "
+                    f"rps={tr['rps']:,.0f}")
+                if traced_best is None or tr["rps"] > traced_best["rps"]:
+                    traced_best = tr
+            s = fe.stats()
+            log(f"traced: {traced_best['rps']:,.0f} vs untraced "
+                f"{best['rps']:,.0f} → ratio "
+                f"{traced_best['rps'] / best['rps']:.3f}; "
+                f"sampled={s.get('trace_sampled', 0)}")
+            trace_cmp = {
+                "traced_rps": round(traced_best["rps"], 1),
+                "ratio_vs_untraced": round(traced_best["rps"] / best["rps"], 4),
+                "spans_received": collector["spans"],
+                "sampled": int(s.get("trace_sampled", 0)),
+            }
+            tracing_mod._native_exporter = None  # detach before shutdown
+            collector["loop"].call_soon_threadsafe(collector["stop"].set)
+            collector["thread"].join(timeout=10)
+
         # tunnel accounting: serial per-batch device round trips at the
         # light-load batch shape — the part of every request latency that a
         # co-located chip would not pay (transfer + RTT through the tunnel)
@@ -586,11 +611,6 @@ def run_native_mode(args):
     finally:
         fe.stop()
         os.unlink(payload_path)
-        if collector is not None:
-            log(f"tracing run: {collector['spans']} spans received by the "
-                "collector (sampled count in the stats line above)")
-            collector["loop"].call_soon_threadsafe(collector["stop"].set)
-            collector["thread"].join(timeout=10)
 
     stats = {
         "request_p50_ms": best["p50_ms"],
@@ -608,6 +628,8 @@ def run_native_mode(args):
         "onbox_stages": onbox,
         "onbox_stages_light": onbox_light,
     }
+    if trace_cmp is not None:
+        stats["tracing"] = trace_cmp
     log(f"device batch RTT p50 {batch_rtt_p50:.2f}ms p90 {batch_rtt_p90:.2f}ms → "
         f"light-load p99 net of RTT: {stats['light_load_p99_ms_net_of_device_rtt']:.2f}ms")
     return best["rps"], stats
@@ -804,8 +826,10 @@ def run_mix_mode(args):
         RuntimeAuthConfig,
     )
     from authorino_tpu.evaluators.authorization import OPA, PatternMatching
-    from authorino_tpu.evaluators.identity import Noop, OIDC
+    from authorino_tpu.evaluators.credentials import AuthCredentials
+    from authorino_tpu.evaluators.identity import APIKey, Noop, OIDC
     from authorino_tpu.expressions import All, Any_, Operator, Pattern
+    from authorino_tpu.k8s.client import LabelSelector, Secret
     from authorino_tpu.runtime import EngineEntry, PolicyEngine
     from authorino_tpu.utils import jose
 
@@ -944,6 +968,44 @@ def run_mix_mode(args):
     # shedding (shed answers are errors, not throughput)
     results["c5_mixed_opa"] = wire_trial(engine, payloads, args, "c5",
                                          sat=(256, 4))
+
+    # ---- class 6 (extra): API-key identities + auth.* patterns ------------
+    # (VERDICT r4 item 1 done-criterion: an API-key wire number; per-key
+    # plan variants resolve auth.identity.* to constants at refresh time)
+    engine = new_engine()
+    n6 = 200
+    entries = []
+    for i in range(n6):
+        cfg_id = f"ns/key-{i}"
+        ak = APIKey(f"keys-{i}", LabelSelector.from_spec(
+            {"matchLabels": {"app": f"svc-{i}"}}),
+            credentials=AuthCredentials(key_selector="APIKEY"))
+        for role, key in (("admin", f"adm-{i}-k"), ("user", f"usr-{i}-k")):
+            ak.add_k8s_secret_based_identity(Secret(
+                namespace="ns", name=f"{role}-{i}",
+                labels={"app": f"svc-{i}"}, annotations={"role": role},
+                data={"api_key": key.encode()}))
+        rule = Pattern("auth.identity.metadata.annotations.role",
+                       Operator.EQ, "admin")
+        pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
+                             evaluator_slot=0)
+        entries.append(EngineEntry(
+            id=cfg_id, hosts=[f"key-{i}.bench"],
+            runtime=RuntimeAuthConfig(
+                identity=[IdentityConfig(
+                    f"keys-{i}", ak,
+                    credentials=AuthCredentials(key_selector="APIKEY"))],
+                authorization=[AuthorizationConfig("rules", pm)]),
+            rules=ConfigRules(name=cfg_id, evaluators=[(None, rule)])))
+    engine.apply_snapshot(entries)
+    payloads = []
+    for j in range(4096):
+        i = j % n6
+        r = rng.random()
+        key = f"adm-{i}-k" if r < 0.5 else (f"usr-{i}-k" if r < 0.85 else "nope")
+        payloads.append(payload(f"key-{i}.bench",
+                                {"authorization": f"APIKEY {key}"}))
+    results["c6_api_key"] = wire_trial(engine, payloads, args, "c6")
 
     return results
 
