@@ -183,33 +183,51 @@ MISSING_CERT_MSG = "client certificate is missing"
 
 
 @dataclass
+class SourceSpec:
+    """One identity source of a fast-lane config, in the pipeline's
+    priority-then-declaration order (identity is an OR,
+    ref pkg/service/auth_pipeline.go:203-258)."""
+
+    name: str                     # IdentityConfig name (all-fail error keys)
+    cred_kind: int = 0
+    cred_key: str = ""
+    dyn: bool = False             # OIDC/mTLS: verified-credential cache
+    # static (API key): per-key plan variants resolved at refresh time
+    variants: List[Tuple[bytes, List[tuple]]] = field(default_factory=list)
+    idc: Any = None               # the IdentityConfig (dyn registration)
+    missing_msg: str = ""         # per-source failure when credential absent
+    invalid_msg: str = ""         # static: failure when the key is unknown
+
+
+@dataclass
 class FastLaneSpec:
     """Everything the C++ frontend needs to serve one AuthConfig natively.
 
     ``has_batch`` configs evaluate pattern authorization through the kernel;
     configs without authorization (identity-only) decide entirely in C++.
-    ``cred_kind != 0`` configs (API-key identity,
-    ref pkg/evaluators/identity/api_key.go:72-93) carry a credential
-    extraction spec plus per-key plan variants: each known key's
-    ``auth.identity.*`` operands are resolved to constants at refresh time;
-    unknown/missing credentials answer with the static UNAUTHENTICATED
-    templates built in NativeFrontend._refresh_locked.
-
-    ``dyn`` configs (OIDC/JWT identity, ref pkg/evaluators/identity/
-    oidc.go:41-103) have no key set known at refresh time: the C++ variant
-    map becomes a verified-token cache.  Unknown/expired tokens route to
-    the slow lane, which runs the full pipeline (JWT verification included)
-    and registers the token's resolved ``auth.*`` operands as a plan
-    variant with TTL = min(token exp, dyn_ttl); ``auth_attrs`` carries the
-    attr rows the registration must resolve per token."""
+    ``sources`` lists the config's identity sources (empty = anonymous):
+    API-key sources (ref pkg/evaluators/identity/api_key.go:72-93) carry
+    per-key plan variants — each known key's ``auth.identity.*`` operands
+    resolved to constants at refresh time; dyn sources (OIDC/JWT,
+    ref oidc.go:41-103; mTLS, ref mtls.go:23-189) use the variant map as a
+    verified-credential cache registered at runtime by the slow lane, TTL
+    = min(exp/notAfter, dyn_ttl).  ``auth_attrs`` carries the attr rows a
+    registration must resolve per credential.  Multi-identity configs are
+    an OR: the first source (priority order) whose credential resolves a
+    variant wins; all-fail answers come from static templates indexed by
+    which static credentials were present."""
 
     plans: List[tuple] = field(default_factory=list)
     has_batch: bool = False
-    cred_kind: int = 0
-    cred_key: str = ""
-    variants: List[Tuple[bytes, List[tuple]]] = field(default_factory=list)
-    dyn: bool = False
+    sources: List[SourceSpec] = field(default_factory=list)
     auth_attrs: List[int] = field(default_factory=list)
+
+
+# bounds on the identity-source fan-out the C++ lane carries: the all-fail
+# template table is 2^n_static entries, and every extra source is a per-
+# request extraction attempt
+_MAX_SOURCES = 4
+_MAX_STATIC_SOURCES = 3
 
 
 def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[FastLaneSpec]:
@@ -225,30 +243,53 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
         return None
     if rt.metadata or rt.callbacks or rt.response:
         return None
-    if len(rt.identity) != 1:
+    if not rt.identity or len(rt.identity) > _MAX_SOURCES:
         return None
-    idc = rt.identity[0]
-    if idc.conditions is not None or idc.cache is not None or idc.extended_properties:
-        return None
-    if idc.metrics or metrics_mod.DEEP_METRICS_ENABLED:
-        return None  # deep per-evaluator series need the pipeline
-    ident = idc.evaluator
-    is_noop = isinstance(ident, Noop)
-    is_key = isinstance(ident, APIKey)
-    is_oidc = isinstance(ident, OIDC)
-    is_mtls = isinstance(ident, MTLS)
-    if not is_noop and not is_key and not is_oidc and not is_mtls:
-        return None
-    cred_kind = 0
-    if is_key or is_oidc or is_mtls:
-        if is_mtls:
-            cred_kind = _CRED_KIND_CERT
-        else:
-            cred_kind = _CRED_KINDS.get(ident.credentials.location, 0)
-            if cred_kind == 0:
-                return None
-        # missing credentials answer from a static template — the
-        # identity-failure denyWith must resolve without a request doc
+    for idc in rt.identity:
+        if idc.conditions is not None or idc.cache is not None or idc.extended_properties:
+            return None
+        if idc.metrics or metrics_mod.DEEP_METRICS_ENABLED:
+            return None  # deep per-evaluator series need the pipeline
+    is_noop = len(rt.identity) == 1 and isinstance(rt.identity[0].evaluator, Noop)
+    sources: List[SourceSpec] = []
+    if not is_noop:
+        # identity sources in the pipeline's priority-then-declaration
+        # order (ascending priority buckets; within a bucket the pipeline
+        # RACES — the reference's outcome there is scheduling-dependent, so
+        # any single winner is within its semantics)
+        ordered = sorted(enumerate(rt.identity), key=lambda p: (p[1].priority, p[0]))
+        for _, idc in ordered:
+            ident = idc.evaluator
+            if isinstance(ident, APIKey):
+                kind = _CRED_KINDS.get(ident.credentials.location, 0)
+                if kind == 0:
+                    return None
+                key_sel = ident.credentials.key_selector
+                src = SourceSpec(
+                    name=idc.name, cred_kind=kind,
+                    cred_key=key_sel.lower() if kind == 2 else key_sel,
+                    idc=idc, missing_msg="credential not found",
+                    invalid_msg=INVALID_API_KEY_MSG)
+            elif isinstance(ident, OIDC):
+                kind = _CRED_KINDS.get(ident.credentials.location, 0)
+                if kind == 0:
+                    return None
+                key_sel = ident.credentials.key_selector
+                src = SourceSpec(
+                    name=idc.name, cred_kind=kind,
+                    cred_key=key_sel.lower() if kind == 2 else key_sel,
+                    dyn=True, idc=idc, missing_msg="credential not found")
+            elif isinstance(ident, MTLS):
+                src = SourceSpec(name=idc.name, cred_kind=_CRED_KIND_CERT,
+                                 dyn=True, idc=idc,
+                                 missing_msg=MISSING_CERT_MSG)
+            else:
+                return None  # incl. Noop mixed into a multi-identity OR
+            sources.append(src)
+        if sum(1 for s in sources if not s.dyn) > _MAX_STATIC_SOURCES:
+            return None
+        # all-fail answers come from static templates — the identity-failure
+        # denyWith must resolve without a request doc
         if not _deny_with_static(rt.deny_with.unauthenticated):
             return None
 
@@ -290,7 +331,8 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
     elif entry.rules is not None and entry.rules.evaluators:
         return None  # compiled rules without runtime authz configs: engine bug
 
-    spec = FastLaneSpec(plans=plans, has_batch=has_batch, cred_kind=cred_kind)
+    spec = FastLaneSpec(plans=plans, has_batch=has_batch, sources=sources,
+                        auth_attrs=auth_attrs)
     if is_noop:
         for attr in auth_attrs:
             p = _const_plan(policy, attr, _CONST_AUTH_DOC)
@@ -298,39 +340,31 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
                 return None
             spec.plans.append(p)
         return spec
-    if is_oidc or is_mtls:
-        # verified-credential cache: variants registered at runtime by the
-        # slow lane (NativeFrontend._register_dyn); auth.* operands resolve
-        # per token/cert, so their attr rows ride along for registration
-        spec.dyn = True
-        spec.auth_attrs = auth_attrs
-        if is_oidc:
-            key_sel = ident.credentials.key_selector
-            spec.cred_key = key_sel.lower() if cred_kind == 2 else key_sel
-        return spec
-    # API key: resolve each known key's auth.* operands to constants
-    # (the fast-lane analog of precompile-at-reconcile,
-    # ref pkg/evaluators/authorization/opa.go:141)
-    key_sel = ident.credentials.key_selector
-    spec.cred_key = key_sel.lower() if cred_kind == 2 else key_sel
-    for key, secret in ident.snapshot_secrets().items():
-        vplans: List[tuple] = []
-        if auth_attrs:
-            doc = {
-                "auth": {
-                    "identity": secret.to_identity_object(),
-                    "metadata": {},
-                    "authorization": {},
-                    "response": {},
-                    "callbacks": {},
+    # API-key sources: resolve each known key's auth.* operands to
+    # constants (the fast-lane analog of precompile-at-reconcile,
+    # ref pkg/evaluators/authorization/opa.go:141); dyn sources register
+    # their variants at runtime (NativeFrontend._register_dyn)
+    for src in sources:
+        if src.dyn:
+            continue
+        for key, secret in src.idc.evaluator.snapshot_secrets().items():
+            vplans: List[tuple] = []
+            if auth_attrs:
+                doc = {
+                    "auth": {
+                        "identity": secret.to_identity_object(),
+                        "metadata": {},
+                        "authorization": {},
+                        "response": {},
+                        "callbacks": {},
+                    }
                 }
-            }
-            for attr in auth_attrs:
-                p = _const_plan(policy, attr, doc)
-                if p is None:
-                    return None
-                vplans.append(p)
-        spec.variants.append((key.encode("utf-8"), vplans))
+                for attr in auth_attrs:
+                    p = _const_plan(policy, attr, doc)
+                    if p is None:
+                        return None
+                    vplans.append(p)
+            src.variants.append((key.encode("utf-8"), vplans))
     return spec
 
 
@@ -352,10 +386,12 @@ class _SnapRec:
     # ref pkg/evaluators/authorization/opa.go:141)
     warm: set = field(default_factory=set)
     warm_done: threading.Event = field(default_factory=threading.Event)
-    # dyn (OIDC) configs: entry.id → (fc_idx, auth_attrs, policy) — the
-    # slow lane registers verified-token plan variants against this
-    # snapshot (policy = the entry's OWN compile: its shard's on a mesh)
-    dyn_regs: Dict[str, Tuple[int, List[int], Any]] = field(default_factory=dict)
+    # configs with dyn sources: entry.id → (fc_idx, auth_attrs, policy,
+    # {id(IdentityConfig): source idx}) — the slow lane registers verified-
+    # credential plan variants against this snapshot (policy = the entry's
+    # OWN compile: its shard's on a mesh)
+    dyn_regs: Dict[str, Tuple[int, List[int], Any, Dict[int, int]]] = field(
+        default_factory=dict)
 
 
 class NativeFrontend:
@@ -364,7 +400,7 @@ class NativeFrontend:
     def __init__(self, engine, port: int = 0, max_batch: int = 1024,
                  window_us: int = 2000, slots: int = 16, slow_cap: int = 65536,
                  dispatch_threads: int = 6, bind_all: bool = False,
-                 dyn_ttl_s: float = 600.0, trace_sample_n: int = 64):
+                 dyn_ttl_s: float = 600.0, trace_sample_n: int = 128):
         self.engine = engine
         # verified-token cache entries live at most this long (and never
         # past the token's own exp claim)
@@ -486,12 +522,45 @@ class NativeFrontend:
             PERMISSION_DENIED, "Unauthorized", [], rt.deny_with.unauthorized)
 
     def _unauth_result(self, rt: RuntimeAuthConfig, message: str) -> AuthResult:
-        """Identity-failure template for a single-identity config:
-        UNAUTHENTICATED + WWW-Authenticate challenges + static
-        denyWith.unauthenticated (ref pkg/service/auth_pipeline.go:468-472)."""
+        """Identity-failure template: UNAUTHENTICATED + WWW-Authenticate
+        challenges + static denyWith.unauthenticated
+        (ref pkg/service/auth_pipeline.go:468-472)."""
         return self._static_deny(
             UNAUTHENTICATED, message, rt.challenge_headers(),
             rt.deny_with.unauthenticated)
+
+    def _unauth_templates(self, rt: RuntimeAuthConfig,
+                          sources: List[SourceSpec]) -> List[bytes]:
+        """All-sources-failed CheckResponse templates, indexed by the
+        bitmask of which STATIC sources' credentials were present (present
+        ⇒ key unknown; absent ⇒ missing; dyn sources hitting this path are
+        always missing — extractable dyn credentials go to the slow lane).
+        Byte-exact with the pipeline: one source returns its bare error,
+        several return the sorted JSON error dict
+        (pipeline._evaluate_identity, ref auth_pipeline.go:203-258)."""
+        if not sources:
+            return []
+        import json as _json
+
+        statics = [s for s in sources if not s.dyn]
+        out: List[bytes] = []
+        for mask in range(1 << len(statics)):
+            if len(sources) == 1:
+                s = sources[0]
+                msg = s.invalid_msg if (not s.dyn and mask & 1) else s.missing_msg
+            else:
+                errors: Dict[str, str] = {}
+                si = 0
+                for s in sources:
+                    if s.dyn:
+                        errors[s.name] = s.missing_msg
+                    else:
+                        errors[s.name] = (s.invalid_msg if (mask >> si) & 1
+                                          else s.missing_msg)
+                        si += 1
+                msg = _json.dumps(errors, separators=(",", ":"), sort_keys=True)
+            out.append(self._result_bytes(self._unauth_result(rt, msg)))
+        return out
 
     # ---- jit pre-warm (compiles must never land on live requests) ----
 
@@ -817,27 +886,37 @@ class NativeFrontend:
                     "ok": ok_bytes,
                     "deny": self._result_bytes(self._deny_result(entry.runtime)),
                     "plans": spec_fl.plans,
-                    "cred_kind": spec_fl.cred_kind,
-                    "cred_key": spec_fl.cred_key,
-                    "variants": spec_fl.variants,
-                    "dyn": 1 if spec_fl.dyn else 0,
-                    "unauth_missing": b"",
-                    "unauth_invalid": b"",
+                    "sources": [
+                        {
+                            "cred_kind": s.cred_kind,
+                            "cred_key": s.cred_key,
+                            "dyn": 1 if s.dyn else 0,
+                            "variants": s.variants,
+                        }
+                        for s in spec_fl.sources
+                    ],
+                    "unauth_msgs": self._unauth_templates(entry.runtime,
+                                                          spec_fl.sources),
                     "ns": ns_l,
                     "name": nm_l,
                 }
-                if spec_fl.dyn:
+                dyn_map = {id(s.idc): i for i, s in enumerate(spec_fl.sources)
+                           if s.dyn}
+                if dyn_map:
                     rec.dyn_regs[entry.id] = (fc_idx, spec_fl.auth_attrs,
-                                              policy_for)
+                                              policy_for, dyn_map)
                     # a JWKS rotation invalidates every cached token: swap
                     # in a fresh snapshot (empty variant map) when the
                     # provider's key set actually changes (add_change_listener
                     # dedups, so re-wiring on every refresh is safe — and a
                     # reconcile-minted evaluator gets wired the first time)
-                    ev = entry.runtime.identity[0].evaluator
-                    add_listener = getattr(ev, "add_change_listener", None)
-                    if add_listener is not None:
-                        add_listener(self._on_oidc_change)
+                    for s in spec_fl.sources:
+                        if not s.dyn:
+                            continue
+                        add_listener = getattr(s.idc.evaluator,
+                                               "add_change_listener", None)
+                        if add_listener is not None:
+                            add_listener(self._on_oidc_change)
                 if spec_fl.has_batch:
                     if sharded is not None:
                         shard, row = sharded.locator[entry.rules.name]
@@ -848,17 +927,6 @@ class NativeFrontend:
                         fc["row"] = int(row)
                         fc_rows.append(int(row))
                         rec.row_labels[int(row)] = (ns_l, nm_l)
-                if spec_fl.cred_kind:
-                    # static identity-failure templates, byte-exact with the
-                    # pipeline's UNAUTHENTICATED + challenges + denyWith path
-                    # (ref pkg/service/auth_pipeline.go:468-472)
-                    missing_msg = (MISSING_CERT_MSG
-                                   if spec_fl.cred_kind == _CRED_KIND_CERT
-                                   else "credential not found")
-                    fc["unauth_missing"] = self._result_bytes(
-                        self._unauth_result(entry.runtime, missing_msg))
-                    fc["unauth_invalid"] = self._result_bytes(
-                        self._unauth_result(entry.runtime, INVALID_API_KEY_MSG))
                 fcs.append(fc)
                 for host in entry.hosts:
                     hosts.append((host, fc_idx))
@@ -922,11 +990,14 @@ class NativeFrontend:
         reg = rec.dyn_regs.get(entry.id)
         if reg is None:
             return
-        fc_idx, auth_attrs, reg_policy = reg
-        idc = entry.runtime.identity[0]
+        fc_idx, auth_attrs, reg_policy, src_map = reg
         conf, obj = pipeline.resolved_identity()
-        if obj is None or conf is not idc:
+        if obj is None:
             return
+        src_idx = src_map.get(id(conf))
+        if src_idx is None:
+            return  # the winning identity is not a dyn source
+        idc = conf
         import time as _time
 
         now = _time.time()
@@ -976,8 +1047,9 @@ class NativeFrontend:
                 if p is None:
                     return  # this token's values don't fit the compact payload
                 vplans.append(p)
-        self._mod.fe_add_variant(rec.snap_id, fc_idx, token.encode("utf-8"),
-                                 vplans, int(deadline * 1e9))
+        self._mod.fe_add_variant(rec.snap_id, fc_idx, src_idx,
+                                 token.encode("utf-8"), vplans,
+                                 int(deadline * 1e9))
 
     # ------------------------------------------------------------------
     def _fold_fc_counts(self) -> None:

@@ -382,6 +382,29 @@ struct VarEnt {
   int64_t exp_ns;               // CLOCK_REALTIME expiry; INT64_MAX = static
 };
 
+// one identity source of a config (multi-identity configs carry several,
+// in pipeline priority-then-declaration order — identity is an OR,
+// ref pkg/service/auth_pipeline.go:203-258)
+struct CredSource {
+  int cred_kind = 0;            // 1 auth header, 2 custom header, 3 cookie,
+                                // 4 query, 5 client certificate
+  std::string cred_key;
+  // static (API key): the full key set is known at refresh time — each
+  // key's auth.identity.* operands resolved to constant plan variants
+  std::unordered_map<std::string, VarEnt> variants;
+  std::deque<std::vector<FastPlan>> var_plans;       // deque: stable refs
+  // dyn (OIDC/JWT, mTLS): the variant map is a verified-credential cache
+  // registered at runtime by the slow lane.  Entries hold their plans by
+  // shared_ptr so overwrites and expiry sweeps reclaim memory immediately
+  // while a mid-request reader keeps its copy alive without the lock.
+  bool dyn = false;
+  struct DynVar {
+    std::shared_ptr<const std::vector<FastPlan>> plans;
+    int64_t exp_ns;
+  };
+  std::unordered_map<std::string, DynVar> dyn_variants;
+};
+
 struct FastConfig {
   int32_t row = 0;
   int32_t shard = 0;            // owning mp shard (sharded corpora; else 0)
@@ -389,30 +412,16 @@ struct FastConfig {
   std::vector<FastPlan> plans;
   bool needs_split = false;     // any K_URL_PATH / K_QUERY plan
   std::string ok_msg, deny_msg; // CheckResponse payloads (pb2-built in Python)
-  // credential-bearing identity (API key, ref pkg/evaluators/identity/
-  // api_key.go:72-93): extraction spec + per-key plan variants whose
-  // auth.identity.* operands were resolved to constants at refresh time
-  int cred_kind = 0;            // 0 none, 1 auth header, 2 custom header,
-                                // 3 cookie, 4 query, 5 client certificate
-  std::string cred_key;
-  // dyn (OIDC/JWT): variants are registered at runtime by the slow lane
-  // after a successful verification (verified-token cache: the fast-lane
-  // analog of per-request JWT verification — the claims are constant per
-  // token, so its auth.* operands resolve once); unknown/expired tokens
-  // route to the slow lane instead of a static invalid-credential answer.
-  // Dyn entries hold their plans by shared_ptr so overwrites and expiry
-  // sweeps reclaim memory immediately (a long-lived snapshot must not
-  // accrete one plan vector per re-registration) while a mid-request
-  // reader keeps its copy alive without the lock.
-  bool dyn = false;
-  std::unordered_map<std::string, VarEnt> variants;  // credential → variant
-  std::deque<std::vector<FastPlan>> var_plans;       // deque: stable refs
-  struct DynVar {
-    std::shared_ptr<const std::vector<FastPlan>> plans;
-    int64_t exp_ns;
-  };
-  std::unordered_map<std::string, DynVar> dyn_variants;
-  std::string unauth_missing_msg, unauth_invalid_msg;
+  // identity sources (empty = anonymous).  A request authenticates via the
+  // first source whose credential resolves a variant; an extractable dyn
+  // credential that misses its cache routes to the slow lane (it may still
+  // verify); with no authentication at all the response is the
+  // all-sources-failed template for the observed extraction bitmask
+  std::vector<CredSource> sources;
+  // [2^n_static] UNAUTHENTICATED templates indexed by which STATIC
+  // sources' credentials were present (present ⇒ invalid; absent ⇒
+  // missing; dyn sources reaching this path are always missing)
+  std::vector<std::string> unauth_msgs;
   std::string ns, name;         // per-authconfig metric labels
 };
 
@@ -740,7 +749,7 @@ static void render_i64(int64_t v, std::string& out) {
 
 // mirror of evaluators/credentials.py AuthCredentials.extract
 // (ref pkg/auth/credentials.go:62-75); false → credential not found
-static bool extract_cred(const FastConfig& fc, const ReqView& rv, std::string& cred) {
+static bool extract_cred(const CredSource& fc, const ReqView& rv, std::string& cred) {
   const size_t kl = fc.cred_key.size();
   switch (fc.cred_kind) {
     case 1: {  // authorization header: "<key_selector> <cred>"
@@ -1170,53 +1179,64 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
   // keeps a dyn variant's plan vector alive across encode_fast after the
   // variant lock is released (overwrites/sweeps may drop the map entry)
   std::shared_ptr<const std::vector<FastPlan>> dyn_hold;
-  if (fc.cred_kind != 0) {
-    // credential-bearing identity: map lookup selects the per-credential
-    // plan variant.  Missing credentials answer from the static
-    // UNAUTHENTICATED template (ref pkg/service/auth_pipeline.go:468-472);
-    // unknown credentials answer statically for API key (the full key set
-    // is known at refresh time) but route to the slow lane for dyn (OIDC)
-    // configs, whose variants are verified-token cache entries.
+  if (!fc.sources.empty()) {
+    // identity is an OR over the sources, tried in the pipeline's
+    // priority-then-declaration order: the first source whose credential
+    // resolves a variant authenticates (its auth.* constants ride along).
+    // An extractable dyn credential that misses its cache routes to the
+    // slow lane — it may still verify there; a missed STATIC credential
+    // (unknown API key) just falls through to the next source.  With no
+    // authentication at all, the all-fail template for the observed
+    // static-extraction bitmask answers (every per-source failure message
+    // is a static string in that case, so the aggregate is too —
+    // ref pkg/service/auth_pipeline.go:203-258 + :468-472).
+    bool authenticated = false;
+    uint32_t extracted_static = 0;
+    int static_idx = 0;
     std::string cred;
-    if (!extract_cred(fc, rv, cred)) {
-      snap->fc_counts[3 * (size_t)fc_idx + 1].fetch_add(1, std::memory_order_relaxed);
+    for (const CredSource& src : fc.sources) {
+      const int bit = src.dyn ? -1 : static_idx++;
+      cred.clear();
+      if (!extract_cred(src, rv, cred)) continue;
+      if (src.dyn) {
+        {
+          std::lock_guard<std::mutex> vlk(snap->var_mu);
+          auto vit = src.dyn_variants.find(cred);
+          if (vit != src.dyn_variants.end() &&
+              vit->second.exp_ns > now_realtime_ns()) {
+            dyn_hold = vit->second.plans;
+            extra = dyn_hold.get();
+          }
+        }
+        if (extra == nullptr) {
+          // unknown/expired credential: the slow lane verifies (and
+          // registers on success) — full pipeline semantics
+          S->n_dyn_miss.fetch_add(1, std::memory_order_relaxed);
+          push_slow(S, c, stream_id, msg, mlen);
+          return;
+        }
+        S->n_dyn_hit.fetch_add(1, std::memory_order_relaxed);
+        authenticated = true;
+        break;
+      }
+      extracted_static |= 1u << bit;
+      auto vit = src.variants.find(cred);
+      if (vit != src.variants.end()) {
+        extra = &src.var_plans[vit->second.idx];
+        authenticated = true;
+        break;
+      }
+    }
+    if (!authenticated) {
+      const bool any_present = extracted_static != 0;
+      snap->fc_counts[3 * (size_t)fc_idx + (any_present ? 2 : 1)].fetch_add(
+          1, std::memory_order_relaxed);
       S->n_fast.fetch_add(1, std::memory_order_relaxed);
       S->n_unauth.fetch_add(1, std::memory_order_relaxed);
       S->n_denied.fetch_add(1, std::memory_order_relaxed);
       record_direct_dur(snap.get(), fc_idx, t_start);
-      submit_grpc_response(c, stream_id, fc.unauth_missing_msg);
+      submit_grpc_response(c, stream_id, fc.unauth_msgs[extracted_static]);
       return;
-    }
-    if (fc.dyn) {
-      {
-        std::lock_guard<std::mutex> vlk(snap->var_mu);
-        auto vit = fc.dyn_variants.find(cred);
-        if (vit != fc.dyn_variants.end() &&
-            vit->second.exp_ns > now_realtime_ns()) {
-          dyn_hold = vit->second.plans;
-          extra = dyn_hold.get();
-        }
-      }
-      if (extra == nullptr) {
-        // unknown/expired token: the slow lane verifies (and registers on
-        // success) — full pipeline semantics for every miss
-        S->n_dyn_miss.fetch_add(1, std::memory_order_relaxed);
-        push_slow(S, c, stream_id, msg, mlen);
-        return;
-      }
-      S->n_dyn_hit.fetch_add(1, std::memory_order_relaxed);
-    } else {
-      auto vit = fc.variants.find(cred);
-      if (vit == fc.variants.end()) {
-        snap->fc_counts[3 * (size_t)fc_idx + 2].fetch_add(1, std::memory_order_relaxed);
-        S->n_fast.fetch_add(1, std::memory_order_relaxed);
-        S->n_unauth.fetch_add(1, std::memory_order_relaxed);
-        S->n_denied.fetch_add(1, std::memory_order_relaxed);
-        record_direct_dur(snap.get(), fc_idx, t_start);
-        submit_grpc_response(c, stream_id, fc.unauth_invalid_msg);
-        return;
-      }
-      extra = &fc.var_plans[vit->second.idx];
     }
   }
   if (!fc.has_batch) {
@@ -1735,8 +1755,8 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
 // when the snapshot is gone (stale registration: harmless no-op) or the
 // cap is hit.
 static bool add_variant(Server* S, int64_t snap_id, int32_t fc_idx,
-                        std::string cred, std::vector<FastPlan> plans,
-                        int64_t exp_ns) {
+                        int32_t src_idx, std::string cred,
+                        std::vector<FastPlan> plans, int64_t exp_ns) {
   std::shared_ptr<Snapshot> snap;
   {
     std::lock_guard<std::mutex> lk(S->mu);
@@ -1746,25 +1766,27 @@ static bool add_variant(Server* S, int64_t snap_id, int32_t fc_idx,
   }
   if (fc_idx < 0 || (size_t)fc_idx >= snap->fcs.size()) return false;
   FastConfig& fc = snap->fcs[fc_idx];
-  if (!fc.dyn) return false;
+  if (src_idx < 0 || (size_t)src_idx >= fc.sources.size()) return false;
+  CredSource& src = fc.sources[src_idx];
+  if (!src.dyn) return false;
   auto sp = std::make_shared<const std::vector<FastPlan>>(std::move(plans));
   {
     std::lock_guard<std::mutex> vlk(snap->var_mu);
-    auto it = fc.dyn_variants.find(cred);
-    if (it == fc.dyn_variants.end() &&
-        fc.dyn_variants.size() >= DYN_VARIANT_CAP) {
+    auto it = src.dyn_variants.find(cred);
+    if (it == src.dyn_variants.end() &&
+        src.dyn_variants.size() >= DYN_VARIANT_CAP) {
       // sweep expired entries once; if still full, the slow lane keeps
       // serving this token (correct, just not fast)
       int64_t now = now_realtime_ns();
-      for (auto sit = fc.dyn_variants.begin(); sit != fc.dyn_variants.end();)
-        sit = sit->second.exp_ns <= now ? fc.dyn_variants.erase(sit)
+      for (auto sit = src.dyn_variants.begin(); sit != src.dyn_variants.end();)
+        sit = sit->second.exp_ns <= now ? src.dyn_variants.erase(sit)
                                         : std::next(sit);
-      if (fc.dyn_variants.size() >= DYN_VARIANT_CAP) return false;
-      it = fc.dyn_variants.end();
+      if (src.dyn_variants.size() >= DYN_VARIANT_CAP) return false;
+      it = src.dyn_variants.end();
     }
-    if (it != fc.dyn_variants.end()) it->second = {std::move(sp), exp_ns};
-    else fc.dyn_variants.emplace(std::move(cred),
-                                 FastConfig::DynVar{std::move(sp), exp_ns});
+    if (it != src.dyn_variants.end()) it->second = {std::move(sp), exp_ns};
+    else src.dyn_variants.emplace(std::move(cred),
+                                  CredSource::DynVar{std::move(sp), exp_ns});
   }
   S->n_dyn_add.fetch_add(1, std::memory_order_relaxed);
   return true;

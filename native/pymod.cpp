@@ -450,27 +450,50 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
     dict_bytes(f, "deny", fc.deny_msg);
     if (!parse_plans(PyDict_GetItemString(f, "plans"), fc.plans, &fc.needs_split))
       return nullptr;
-    fc.cred_kind = (int)dict_int(f, "cred_kind", 0);
-    fc.dyn = dict_int(f, "dyn", 0) != 0;
-    dict_str(f, "cred_key", fc.cred_key);
     dict_str(f, "ns", fc.ns);
     dict_str(f, "name", fc.name);
-    dict_bytes(f, "unauth_missing", fc.unauth_missing_msg);
-    dict_bytes(f, "unauth_invalid", fc.unauth_invalid_msg);
-    PyObject* vars = PyDict_GetItemString(f, "variants");
-    for (Py_ssize_t j = 0; vars != nullptr && j < PyList_GET_SIZE(vars); ++j) {
-      PyObject* kv = PyList_GET_ITEM(vars, j);
-      PyObject* kb = PyTuple_GET_ITEM(kv, 0);
-      if (!PyBytes_Check(kb)) {
-        PyErr_SetString(PyExc_TypeError, "variant key must be bytes");
+    PyObject* srcs = PyDict_GetItemString(f, "sources");
+    for (Py_ssize_t j = 0; srcs != nullptr && j < PyList_GET_SIZE(srcs); ++j) {
+      PyObject* sd = PyList_GET_ITEM(srcs, j);
+      fe::CredSource src;
+      src.cred_kind = (int)dict_int(sd, "cred_kind", 0);
+      src.dyn = dict_int(sd, "dyn", 0) != 0;
+      dict_str(sd, "cred_key", src.cred_key);
+      PyObject* vars = PyDict_GetItemString(sd, "variants");
+      for (Py_ssize_t k = 0; vars != nullptr && k < PyList_GET_SIZE(vars); ++k) {
+        PyObject* kv = PyList_GET_ITEM(vars, k);
+        PyObject* kb = PyTuple_GET_ITEM(kv, 0);
+        if (!PyBytes_Check(kb)) {
+          PyErr_SetString(PyExc_TypeError, "variant key must be bytes");
+          return nullptr;
+        }
+        std::vector<fe::FastPlan> vp;
+        if (!parse_plans(PyTuple_GET_ITEM(kv, 1), vp, nullptr)) return nullptr;
+        int32_t vid = (int32_t)src.var_plans.size();
+        src.var_plans.push_back(std::move(vp));
+        src.variants[std::string(PyBytes_AS_STRING(kb),
+                                 (size_t)PyBytes_GET_SIZE(kb))] = {vid, INT64_MAX};
+      }
+      fc.sources.push_back(std::move(src));
+    }
+    PyObject* umsgs = PyDict_GetItemString(f, "unauth_msgs");
+    for (Py_ssize_t j = 0; umsgs != nullptr && j < PyList_GET_SIZE(umsgs); ++j) {
+      PyObject* b = PyList_GET_ITEM(umsgs, j);
+      if (!PyBytes_Check(b)) {
+        PyErr_SetString(PyExc_TypeError, "unauth template must be bytes");
         return nullptr;
       }
-      std::vector<fe::FastPlan> vp;
-      if (!parse_plans(PyTuple_GET_ITEM(kv, 1), vp, nullptr)) return nullptr;
-      int32_t vid = (int32_t)fc.var_plans.size();
-      fc.var_plans.push_back(std::move(vp));
-      fc.variants[std::string(PyBytes_AS_STRING(kb),
-                              (size_t)PyBytes_GET_SIZE(kb))] = {vid, INT64_MAX};
+      fc.unauth_msgs.emplace_back(PyBytes_AS_STRING(b),
+                                  (size_t)PyBytes_GET_SIZE(b));
+    }
+    if (!fc.sources.empty()) {
+      size_t n_static = 0;
+      for (const auto& s : fc.sources) n_static += s.dyn ? 0 : 1;
+      if (fc.unauth_msgs.size() != ((size_t)1 << n_static)) {
+        PyErr_SetString(PyExc_ValueError,
+                        "unauth_msgs must cover every static-extraction mask");
+        return nullptr;
+      }
     }
     snap->fcs.push_back(std::move(fc));
   }
@@ -594,16 +617,17 @@ PyObject* fe_complete_slow_py(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
-// fe_add_variant(snap_id, fc_idx, cred_bytes, plans, exp_ns) -> bool
-// — register a runtime plan variant (verified-token cache entry) for one
-// credential; called by the slow lane after a successful verification
+// fe_add_variant(snap_id, fc_idx, src_idx, cred_bytes, plans, exp_ns) ->
+// bool — register a runtime plan variant (verified-credential cache entry)
+// for one identity source; called by the slow lane after a successful
+// verification
 PyObject* fe_add_variant_py(PyObject*, PyObject* args) {
   long long snap_id, exp_ns;
-  int fc_idx;
+  int fc_idx, src_idx;
   Py_buffer cred;
   PyObject* plans;
-  if (!PyArg_ParseTuple(args, "Liy*O!L", &snap_id, &fc_idx, &cred, &PyList_Type,
-                        &plans, &exp_ns))
+  if (!PyArg_ParseTuple(args, "Liiy*O!L", &snap_id, &fc_idx, &src_idx, &cred,
+                        &PyList_Type, &plans, &exp_ns))
     return nullptr;
   fe::Server* S = fe::g_srv;
   if (S == nullptr) {
@@ -619,7 +643,8 @@ PyObject* fe_add_variant_py(PyObject*, PyObject* args) {
   PyBuffer_Release(&cred);
   bool ok;
   Py_BEGIN_ALLOW_THREADS
-  ok = fe::add_variant(S, snap_id, fc_idx, std::move(cs), std::move(vp), exp_ns);
+  ok = fe::add_variant(S, snap_id, fc_idx, src_idx, std::move(cs),
+                       std::move(vp), exp_ns);
   Py_END_ALLOW_THREADS
   return PyBool_FromLong(ok ? 1 : 0);
 }

@@ -403,8 +403,9 @@ def test_oidc_fast_lane_token_cache():
         # eligibility: dyn spec with the claim attr rows for registration
         snap = engine._snapshot
         spec = fast_lane_eligible(snap.by_id["ns/oidc"], snap.policy)
-        assert spec is not None and spec.dyn and spec.cred_kind == 1
-        assert spec.cred_key == "Bearer" and spec.auth_attrs
+        assert spec is not None and len(spec.sources) == 1
+        assert spec.sources[0].dyn and spec.sources[0].cred_kind == 1
+        assert spec.sources[0].cred_key == "Bearer" and spec.auth_attrs
 
         fe = NativeFrontend(engine, port=0, max_batch=32, window_us=500)
         port = fe.start()
@@ -519,6 +520,99 @@ def test_oidc_jwks_rotation_drops_token_cache():
         t.join(timeout=10)
 
 
+def test_multi_identity_or_fast_lane():
+    """API key OR JWT in one AuthConfig (the canonical Authorino pairing):
+    both identity sources ride the fast lane — static per-key variants for
+    the API key, the verified-token cache for OIDC — and the all-sources-
+    failed answers come from per-bitmask static templates, byte-exact with
+    the pipeline's aggregated JSON error (round 4)."""
+    holder, t = run_fake_idp()
+    idp = holder["idp"]
+    try:
+        from authorino_tpu.evaluators.identity import OIDC
+
+        engine = PolicyEngine(max_batch=32, max_delay_s=0.0005, mesh=None)
+        ak = APIKey("api-users", LabelSelector.from_spec(
+            {"matchLabels": {"g": "multi"}}),
+            credentials=AuthCredentials(key_selector="APIKEY"))
+        ak.add_k8s_secret_based_identity(Secret(
+            namespace="ns", name="svc-key", labels={"g": "multi"},
+            annotations={"role": "admin"}, data={"api_key": b"svc-secret"}))
+        oidc = OIDC("kc", idp.issuer)
+        rule = Any_(
+            Pattern("auth.identity.metadata.annotations.role", Operator.EQ,
+                    "admin"),
+            Pattern("auth.identity.realm_access.roles", Operator.INCL,
+                    "admin"))
+        cfg_id = "ns/multi"
+        pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
+                             evaluator_slot=0)
+        engine.apply_snapshot([EngineEntry(
+            id=cfg_id, hosts=["multi.test"],
+            runtime=RuntimeAuthConfig(
+                labels={"namespace": "ns", "name": "multi"},
+                # distinct priorities: deterministic order in BOTH servers
+                identity=[
+                    IdentityConfig("api-users", ak, priority=0,
+                                   credentials=AuthCredentials(
+                                       key_selector="APIKEY")),
+                    IdentityConfig("kc", oidc, priority=1),
+                ],
+                authorization=[AuthorizationConfig("rules", pm)]),
+            rules=ConfigRules(name=cfg_id, evaluators=[(None, rule)]))])
+        spec = fast_lane_eligible(engine._snapshot.by_id[cfg_id],
+                                  engine._snapshot.policy)
+        assert spec is not None and len(spec.sources) == 2
+        assert not spec.sources[0].dyn and spec.sources[1].dyn
+
+        fe = NativeFrontend(engine, port=0, max_batch=32, window_us=500)
+        port = fe.start()
+        pyholder, pyt = run_python_server(engine)
+        try:
+            admin = idp.token()  # realm_access.roles = [admin]
+            viewer = idp.token({"realm_access": {"roles": ["viewer"]}})
+
+            # API-key path: pure static variant, no slow lane at all
+            r = grpc_call(port, make_req("multi.test",
+                                         headers={"authorization": "APIKEY svc-secret"}))
+            assert r.status.code == 0
+            assert fe.stats()["slow"] == 0
+            # JWT path: first sight slow, repeat fast
+            r1 = grpc_call(port, make_req("multi.test",
+                                          headers={"authorization": f"Bearer {admin}"}))
+            r2 = grpc_call(port, make_req("multi.test",
+                                          headers={"authorization": f"Bearer {admin}"}))
+            assert r1.status.code == 0 and r2.status.code == 0
+            assert fe.stats()["dyn_hit"] >= 1
+
+            matrix = [
+                make_req("multi.test",
+                         headers={"authorization": "APIKEY svc-secret"}),
+                make_req("multi.test",
+                         headers={"authorization": f"Bearer {admin}"}),
+                make_req("multi.test",
+                         headers={"authorization": f"Bearer {viewer}"}),  # deny
+                make_req("multi.test"),                       # both missing
+                make_req("multi.test",
+                         headers={"authorization": "APIKEY nope"}),  # invalid+missing
+                make_req("multi.test",
+                         headers={"authorization": "Bearer junk"}),  # slow verify
+            ]
+            for i, rq in enumerate(matrix):
+                native = response_key(grpc_call(port, rq))
+                python = response_key(grpc_call(pyholder["port"], rq))
+                assert native == python, f"multi req #{i}: {native} vs {python}"
+            # the all-fail answers above were native template decisions
+            assert fe.stats()["unauth"] >= 2
+        finally:
+            pyholder["loop"].call_soon_threadsafe(pyholder["stop"].set)
+            pyt.join(timeout=10)
+            fe.stop()
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
+
+
 def test_mtls_fast_lane_cert_cache():
     """mTLS identities ride the fast lane too (round 4): the forwarded
     client certificate is the credential key of the verified-credential
@@ -562,7 +656,8 @@ def test_mtls_fast_lane_cert_cache():
     engine.apply_snapshot(entries)
     spec = fast_lane_eligible(engine._snapshot.by_id["ns/mtls"],
                               engine._snapshot.policy)
-    assert spec is not None and spec.dyn and spec.cred_kind == 5
+    assert spec is not None and len(spec.sources) == 1
+    assert spec.sources[0].dyn and spec.sources[0].cred_kind == 5
 
     fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
     port = fe.start()
@@ -694,14 +789,16 @@ def test_fast_lane_classification(stack):
     assert fast_lane_eligible(by_id["ns/fast-deny"], policy) is not None
     # API-key identity-only: pure credential-map decision, no kernel
     spec = fast_lane_eligible(by_id["ns/fast-keyonly"], policy)
-    assert spec is not None and spec.cred_kind == 1 and not spec.has_batch
-    assert any(k == b"sekret" for k, _ in spec.variants)
+    assert spec is not None and not spec.has_batch
+    assert len(spec.sources) == 1 and spec.sources[0].cred_kind == 1
+    assert any(k == b"sekret" for k, _ in spec.sources[0].variants)
     # API-key + auth.identity.* patterns: per-key K_CONST plan variants
     spec2 = fast_lane_eligible(by_id["ns/fast-key"], policy)
-    assert spec2 is not None and spec2.has_batch and spec2.cred_kind == 2
-    assert spec2.cred_key == "x-api-key"
-    assert len(spec2.variants) == 2
-    assert all(vplans for _, vplans in spec2.variants)
+    assert spec2 is not None and spec2.has_batch
+    assert spec2.sources[0].cred_kind == 2
+    assert spec2.sources[0].cred_key == "x-api-key"
+    assert len(spec2.sources[0].variants) == 2
+    assert all(vplans for _, vplans in spec2.sources[0].variants)
     # templated denyWith: per-request resolution → slow lane
     assert fast_lane_eligible(by_id["ns/slow-tmpl"], policy) is None
 
