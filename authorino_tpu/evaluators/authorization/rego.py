@@ -1560,21 +1560,24 @@ class _Evaluator:
             return ("B", fn)
         return None
 
-    def _call(self, fn: str, args: List[Any]) -> Any:
+    def _call(self, fn: str, args: List[Any],
+              _seen: Optional[set] = None) -> Any:
         """Dispatch a call through mocks → user functions (any module) →
-        builtins."""
+        builtins.  ``_seen`` tracks mock keys already followed so a mock
+        chain that cycles (directly or mutually: ``with f as g with g as
+        f``) fails closed as a RegoError instead of recursing unboundedly."""
         key = self._func_key(fn)
         if key is not None:
             mock = self.mocks.get(key)
             if mock is not None:
                 if mock[0] == "const":
                     return mock[1]
-                # replacement function: bypass the SAME mock (no self-
-                # recursion through the override), keep others applicable
-                rname = mock[1]
-                if self._func_key(rname) == key:
-                    raise RegoError(f"rego: 'with' mock for {fn!r} replaces itself")
-                return self._call(rname, args)
+                seen = _seen if _seen is not None else set()
+                if key in seen:
+                    raise RegoError(
+                        f"rego: 'with' mock cycle through {fn!r}")
+                seen.add(key)
+                return self._call(mock[1], args, _seen=seen)
         rf = self._resolve_func(fn)
         if rf is not None:
             pkg, name = rf

@@ -462,7 +462,19 @@ class NativeFrontend:
         self.engine.add_swap_listener(self.refresh)
         return self.bound_port
 
-    def stop(self) -> None:
+    def stop(self, drain_s: float = 10.0) -> None:
+        if self._mod is not None and self._running:
+            # graceful: already-accepted slow-lane work flushes to the wire
+            # while the listener is still alive — a cancelled handler would
+            # leave its client hanging to the gRPC deadline.  Bounded:
+            # steady incoming traffic degrades to the old abrupt stop.
+            deadline = time.monotonic() + drain_s
+            while time.monotonic() < deadline:
+                s = self._mod.fe_stats()
+                if not s or (s.get("slow_pending", 0) == 0
+                             and s.get("slow_queued", 0) == 0):
+                    break
+                time.sleep(0.05)
         self._running = False
         if self._mod is not None:
             self.engine.remove_swap_listener(self.refresh)
@@ -725,7 +737,6 @@ class NativeFrontend:
         # enabling observability must not cost ~8x throughput wholesale)
         from ..utils.tracing import tracing_active
 
-        allow_fast = True
         spec["trace_every"] = self.trace_sample_n if tracing_active() else 0
 
         enc = None
@@ -858,78 +869,77 @@ class NativeFrontend:
 
         fast_ids = set()
         fc_rows: List[int] = []
-        if allow_fast:
-            for entry in entries:
-                # each entry is judged against its OWN compile: the single
-                # corpus, or its owning shard's sub-corpus on a mesh
-                policy_for = policy
+        for entry in entries:
+            # each entry is judged against its OWN compile: the single
+            # corpus, or its owning shard's sub-corpus on a mesh
+            policy_for = policy
+            if sharded is not None:
+                policy_for = None
+                if entry.rules is not None:
+                    loc = sharded.locator.get(entry.rules.name)
+                    if loc is not None:
+                        policy_for = sharded.shards[loc[0]]
+            spec_fl = fast_lane_eligible(entry, policy_for)
+            if spec_fl is None:
+                continue
+            fast_ids.add(id(entry))
+            fc_idx = len(fcs)
+            # per-authconfig metric labels — EXACTLY the pipeline's
+            # scheme (ref pkg/service/auth_pipeline.go:26-36; translate
+            # injects namespace/name into runtime labels), so a
+            # config's fast- and slow-lane traffic lands on one series
+            lbl = entry.runtime.labels or {}
+            ns_l, nm_l = lbl.get("namespace", ""), lbl.get("name", "")
+            fc = {
+                "row": 0,
+                "has_batch": 1 if spec_fl.has_batch else 0,
+                "ok": ok_bytes,
+                "deny": self._result_bytes(self._deny_result(entry.runtime)),
+                "plans": spec_fl.plans,
+                "sources": [
+                    {
+                        "cred_kind": s.cred_kind,
+                        "cred_key": s.cred_key,
+                        "dyn": 1 if s.dyn else 0,
+                        "variants": s.variants,
+                    }
+                    for s in spec_fl.sources
+                ],
+                "unauth_msgs": self._unauth_templates(entry.runtime,
+                                                      spec_fl.sources),
+                "ns": ns_l,
+                "name": nm_l,
+            }
+            dyn_map = {id(s.idc): i for i, s in enumerate(spec_fl.sources)
+                       if s.dyn}
+            if dyn_map:
+                rec.dyn_regs[entry.id] = (fc_idx, spec_fl.auth_attrs,
+                                          policy_for, dyn_map)
+                # a JWKS rotation invalidates every cached token: swap
+                # in a fresh snapshot (empty variant map) when the
+                # provider's key set actually changes (add_change_listener
+                # dedups, so re-wiring on every refresh is safe — and a
+                # reconcile-minted evaluator gets wired the first time)
+                for s in spec_fl.sources:
+                    if not s.dyn:
+                        continue
+                    add_listener = getattr(s.idc.evaluator,
+                                           "add_change_listener", None)
+                    if add_listener is not None:
+                        add_listener(self._on_oidc_change)
+            if spec_fl.has_batch:
                 if sharded is not None:
-                    policy_for = None
-                    if entry.rules is not None:
-                        loc = sharded.locator.get(entry.rules.name)
-                        if loc is not None:
-                            policy_for = sharded.shards[loc[0]]
-                spec_fl = fast_lane_eligible(entry, policy_for)
-                if spec_fl is None:
-                    continue
-                fast_ids.add(id(entry))
-                fc_idx = len(fcs)
-                # per-authconfig metric labels — EXACTLY the pipeline's
-                # scheme (ref pkg/service/auth_pipeline.go:26-36; translate
-                # injects namespace/name into runtime labels), so a
-                # config's fast- and slow-lane traffic lands on one series
-                lbl = entry.runtime.labels or {}
-                ns_l, nm_l = lbl.get("namespace", ""), lbl.get("name", "")
-                fc = {
-                    "row": 0,
-                    "has_batch": 1 if spec_fl.has_batch else 0,
-                    "ok": ok_bytes,
-                    "deny": self._result_bytes(self._deny_result(entry.runtime)),
-                    "plans": spec_fl.plans,
-                    "sources": [
-                        {
-                            "cred_kind": s.cred_kind,
-                            "cred_key": s.cred_key,
-                            "dyn": 1 if s.dyn else 0,
-                            "variants": s.variants,
-                        }
-                        for s in spec_fl.sources
-                    ],
-                    "unauth_msgs": self._unauth_templates(entry.runtime,
-                                                          spec_fl.sources),
-                    "ns": ns_l,
-                    "name": nm_l,
-                }
-                dyn_map = {id(s.idc): i for i, s in enumerate(spec_fl.sources)
-                           if s.dyn}
-                if dyn_map:
-                    rec.dyn_regs[entry.id] = (fc_idx, spec_fl.auth_attrs,
-                                              policy_for, dyn_map)
-                    # a JWKS rotation invalidates every cached token: swap
-                    # in a fresh snapshot (empty variant map) when the
-                    # provider's key set actually changes (add_change_listener
-                    # dedups, so re-wiring on every refresh is safe — and a
-                    # reconcile-minted evaluator gets wired the first time)
-                    for s in spec_fl.sources:
-                        if not s.dyn:
-                            continue
-                        add_listener = getattr(s.idc.evaluator,
-                                               "add_change_listener", None)
-                        if add_listener is not None:
-                            add_listener(self._on_oidc_change)
-                if spec_fl.has_batch:
-                    if sharded is not None:
-                        shard, row = sharded.locator[entry.rules.name]
-                        fc["row"], fc["shard"] = int(row), int(shard)
-                        rec.row_labels[(int(shard), int(row))] = (ns_l, nm_l)
-                    else:
-                        row = policy.config_ids[entry.rules.name]
-                        fc["row"] = int(row)
-                        fc_rows.append(int(row))
-                        rec.row_labels[int(row)] = (ns_l, nm_l)
-                fcs.append(fc)
-                for host in entry.hosts:
-                    hosts.append((host, fc_idx))
+                    shard, row = sharded.locator[entry.rules.name]
+                    fc["row"], fc["shard"] = int(row), int(shard)
+                    rec.row_labels[(int(shard), int(row))] = (ns_l, nm_l)
+                else:
+                    row = policy.config_ids[entry.rules.name]
+                    fc["row"] = int(row)
+                    fc_rows.append(int(row))
+                    rec.row_labels[int(row)] = (ns_l, nm_l)
+            fcs.append(fc)
+            for host in entry.hosts:
+                hosts.append((host, fc_idx))
         rec.fc_rows = np.asarray(fc_rows or [0], dtype=np.int64)
 
         # non-fast hosts route to the Python pipeline (slow lane)
@@ -1273,14 +1283,26 @@ class NativeFrontend:
             # deep enough to hide the device link RTT under the slow lane's
             # own micro-batches (in-flight ≈ throughput × RTT)
             sem = asyncio.Semaphore(2048)
+            # strong refs: asyncio holds tasks weakly — an unreferenced
+            # task can be garbage-collected mid-execution
+            tasks: set = set()
 
-            def _release(_):
+            def _done(t):
+                tasks.discard(t)
                 sem.release()
 
             while self._running:
                 batch = await loop.run_in_executor(None, mod.fe_take_slow, 200, 256)
                 for i, raw in batch:
                     await sem.acquire()
-                    loop.create_task(handle(i, raw)).add_done_callback(_release)
+                    t = loop.create_task(handle(i, raw))
+                    tasks.add(t)
+                    t.add_done_callback(_done)
+            # drain in-flight work before the loop closes: every request
+            # taken from the C++ queue MUST complete (asyncio.run would
+            # otherwise cancel these tasks and their clients would hang
+            # until their gRPC deadlines)
+            if tasks:
+                await asyncio.gather(*tuple(tasks), return_exceptions=True)
 
         asyncio.run(main())

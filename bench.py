@@ -1040,7 +1040,8 @@ def main():
                     help="capture a jax.profiler trace under profiles/")
     ap.add_argument("--trace", action="store_true",
                     help="native mode: enable span export to an in-process "
-                         "fake OTLP collector (1-in-16 head sampling) — "
+                         "fake OTLP collector (head sampling at the frontend "
+                         "default, 1-in-128) — "
                          "measures the cost of observability being ON")
     ap.add_argument("--trials", type=int, default=3,
                     help="run the measured loop N times and report the best "

@@ -1025,3 +1025,15 @@ class TestRegoRound4:
         assert out["admins"] == ["ann"]
         assert out["second"] == "b"
         assert out["anyval"] is True
+
+    def test_mock_cycle_fails_closed(self):
+        # a mock chain that cycles (directly or mutually) must be a
+        # RegoError (→ deny), never unbounded recursion
+        direct = compile_module(
+            'allow { count([1]) == 1 with count as count }')
+        with pytest.raises(RegoError, match="cycle"):
+            direct.evaluate({})
+        mutual = compile_module(
+            'allow { count([1]) == 1 with count as sum with sum as count }')
+        with pytest.raises(RegoError, match="cycle"):
+            mutual.evaluate({})

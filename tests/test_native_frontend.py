@@ -613,6 +613,47 @@ def test_multi_identity_or_fast_lane():
         t.join(timeout=10)
 
 
+def test_stop_drains_inflight_slow_requests():
+    """fe.stop() while slow-lane requests are in flight must complete them
+    before the loop closes — a cancelled handler would leave its client
+    hanging until the gRPC deadline (round-4 review finding)."""
+    import concurrent.futures
+
+    from authorino_tpu.evaluators import MetadataConfig
+
+    class SleepyMeta:
+        async def call(self, pipeline):
+            await asyncio.sleep(1.0)
+            return {}
+
+    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    engine.apply_snapshot([EngineEntry(
+        id="ns/sleepy2", hosts=["sleepy2.test"],
+        runtime=RuntimeAuthConfig(
+            identity=[IdentityConfig("anon", Noop())],
+            metadata=[MetadataConfig("m", SleepyMeta())]),
+        rules=None)])
+    fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
+    port = fe.start()
+    stopped = False
+    try:
+        with concurrent.futures.ThreadPoolExecutor(1) as pool:
+            fut = pool.submit(grpc_call, port, make_req("sleepy2.test"))
+            deadline = time.monotonic() + 5
+            while fe.stats().get("slow", 0) < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            t0 = time.monotonic()
+            fe.stop()
+            stopped = True
+            # the in-flight request still answers (drained, not cancelled)
+            resp = fut.result(timeout=10)
+            assert resp.status.code == 0
+            assert time.monotonic() - t0 < 8
+    finally:
+        if not stopped:
+            fe.stop()
+
+
 def test_mtls_fast_lane_cert_cache():
     """mTLS identities ride the fast lane too (round 4): the forwarded
     client certificate is the credential key of the verified-credential
