@@ -917,6 +917,33 @@ def _builtin(fn: str, args: List[Any]) -> Any:
     try:
         if fn == "count":
             return len(args[0])
+        if fn == "json.marshal":
+            return json.dumps(args[0], separators=(",", ":"), sort_keys=False)
+        if fn in ("base64.encode", "base64.decode", "base64url.encode",
+                  "base64url.encode_no_pad", "base64url.decode",
+                  "hex.encode", "hex.decode"):
+            import base64 as _b64
+
+            s = args[0]
+            if fn == "base64.encode":
+                return _b64.b64encode(s.encode()).decode()
+            if fn == "base64.decode":
+                return _b64.b64decode(s.encode()).decode()
+            if fn == "base64url.encode":
+                return _b64.urlsafe_b64encode(s.encode()).decode()
+            if fn == "base64url.encode_no_pad":
+                return _b64.urlsafe_b64encode(s.encode()).decode().rstrip("=")
+            if fn == "base64url.decode":
+                pad = s + "=" * (-len(s) % 4)  # OPA accepts unpadded input
+                return _b64.urlsafe_b64decode(pad.encode()).decode()
+            if fn == "hex.encode":
+                return s.encode().hex()
+            return bytes.fromhex(s).decode()
+        if fn == "time.parse_rfc3339_ns":
+            from datetime import datetime
+
+            dt = datetime.fromisoformat(str(args[0]).replace("Z", "+00:00"))
+            return int(dt.timestamp() * 1e9)
         if fn == "contains":
             return args[1] in args[0]
         if fn == "startswith":
@@ -1058,15 +1085,18 @@ def _builtin(fn: str, args: List[Any]) -> Any:
 # every name _builtin dispatches on (function-mock targets must name one of
 # these or a user function); `walk` is the relation handled in _eval_expr
 _BUILTIN_NAMES = frozenset({
-    "abs", "array.concat", "array.reverse", "array.slice", "concat",
-    "contains", "count", "endswith", "format_int", "glob.match", "indexof",
+    "abs", "array.concat", "array.reverse", "array.slice",
+    "base64.decode", "base64.encode", "base64url.decode", "base64url.encode",
+    "base64url.encode_no_pad", "concat", "contains", "count", "endswith",
+    "format_int", "glob.match", "hex.decode", "hex.encode", "indexof",
     "intersection", "is_array", "is_boolean", "is_null", "is_number",
-    "is_object", "is_string", "json.unmarshal", "lower", "max", "min",
-    "numbers.range", "object.filter", "object.get", "object.keys",
-    "object.remove", "object.union", "regex.match", "re_match", "replace",
-    "sort", "split", "sprintf", "startswith", "strings.reverse", "substring",
-    "sum", "time.now_ns", "to_number", "trim", "trim_prefix", "trim_suffix",
-    "union", "upper", "walk",
+    "is_object", "is_string", "json.marshal", "json.unmarshal", "lower",
+    "max", "min", "numbers.range", "object.filter", "object.get",
+    "object.keys", "object.remove", "object.union", "regex.match",
+    "re_match", "replace", "sort", "split", "sprintf", "startswith",
+    "strings.reverse", "substring", "sum", "time.now_ns",
+    "time.parse_rfc3339_ns", "to_number", "trim", "trim_prefix",
+    "trim_suffix", "union", "upper", "walk",
 })
 
 

@@ -1037,3 +1037,21 @@ class TestRegoRound4:
             'allow { count([1]) == 1 with count as sum with sum as count }')
         with pytest.raises(RegoError, match="cycle"):
             mutual.evaluate({})
+
+    def test_encoding_and_time_builtins(self):
+        m = compile_module(
+            'j = json.marshal({"a": [1, 2]})\n'
+            'b = base64.encode("hi")\n'
+            'bd = base64.decode("aGk=")\n'
+            'bu = base64url.encode_no_pad("hi?")\n'
+            'bud = base64url.decode("aGk_")\n'
+            'h = hex.encode("hi")\n'
+            'hd = hex.decode("6869")\n'
+            't = time.parse_rfc3339_ns("2026-07-30T00:00:00Z")\n'
+        )
+        out = m.evaluate({})
+        assert out["j"] == '{"a":[1,2]}'
+        assert out["b"] == "aGk=" and out["bd"] == "hi"
+        assert out["bu"] == "aGk_" and out["bud"] == "hi?"
+        assert out["h"] == "6869" and out["hd"] == "hi"
+        assert out["t"] == 1785369600000000000
