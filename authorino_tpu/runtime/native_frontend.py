@@ -162,6 +162,42 @@ def _static_value(v) -> bool:
     return v is None or not getattr(v, "pattern", "")
 
 
+def _auth_only_value(v) -> bool:
+    """True when a JSONValue resolves constantly per identity outcome:
+    static, or selectors/templates rooted entirely in the auth.* subtree."""
+    from ..authjson.value import is_template, template_selectors
+
+    if not getattr(v, "pattern", ""):
+        return True
+    sels = (template_selectors(v.pattern) if is_template(v.pattern)
+            else [v.pattern])
+    return all(_classify_selector(s) == ("auth",) for s in sels)
+
+
+def _response_templates_eligible(rt: RuntimeAuthConfig) -> bool:
+    """Response evaluators whose outputs are constant per identity outcome
+    (DynamicJSON / Plain over auth.*-only values) can precompute their OK
+    CheckResponse bytes per credential variant — the 'inject an identity
+    header' pattern stays on the fast lane.  Anything per-request
+    (request.* selectors, Wristbands: per-request iat/exp signatures)
+    disqualifies."""
+    from ..evaluators.response import DynamicJSON, Plain
+
+    for conf in rt.response:
+        if conf.conditions is not None or conf.cache is not None or conf.metrics:
+            return False
+        ev = conf.evaluator
+        if isinstance(ev, DynamicJSON):
+            vals = [p.value for p in ev.properties]
+        elif isinstance(ev, Plain):
+            vals = [ev.value]
+        else:
+            return False
+        if not all(_auth_only_value(v) for v in vals):
+            return False
+    return True
+
+
 def _deny_with_static(dw: Optional[DenyWithValues]) -> bool:
     if dw is None:
         return True
@@ -241,7 +277,9 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
         return None
     if rt.conditions is not None:
         return None
-    if rt.metadata or rt.callbacks or rt.response:
+    if rt.metadata or rt.callbacks:
+        return None
+    if rt.response and not _response_templates_eligible(rt):
         return None
     if not rt.identity or len(rt.identity) > _MAX_SOURCES:
         return None
@@ -348,11 +386,12 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
         if src.dyn:
             continue
         for key, secret in src.idc.evaluator.snapshot_secrets().items():
+            ident_obj = secret.to_identity_object()
             vplans: List[tuple] = []
             if auth_attrs:
                 doc = {
                     "auth": {
-                        "identity": secret.to_identity_object(),
+                        "identity": ident_obj,
                         "metadata": {},
                         "authorization": {},
                         "response": {},
@@ -364,7 +403,10 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
                     if p is None:
                         return None
                     vplans.append(p)
-            src.variants.append((key.encode("utf-8"), vplans))
+            # the identity object rides along so refresh can precompute the
+            # per-key OK response bytes for response-template configs
+            src.variants.append((key.encode("utf-8"), vplans,
+                                 ident_obj if rt.response else None))
     return spec
 
 
@@ -540,6 +582,40 @@ class NativeFrontend:
         return self._static_deny(
             UNAUTHENTICATED, message, rt.challenge_headers(),
             rt.deny_with.unauthenticated)
+
+    def _ok_bytes_for(self, rt: RuntimeAuthConfig, identity_obj) -> bytes:
+        """Success CheckResponse bytes for a CONSTANT identity outcome:
+        response evaluators resolved bucket by bucket against the const
+        doc — mirrors pipeline._evaluate_response (per-bucket _sync_auth:
+        later buckets see earlier outputs under auth.response.*) +
+        wrap_responses + the success assembly in _evaluate_phases
+        (ref pkg/service/auth_pipeline.go:487-491)."""
+        from ..evaluators.base import wrap_responses
+        from ..evaluators.response import DynamicJSON
+        from ..pipeline.pipeline import AuthPipeline as _AP
+
+        doc = {
+            "auth": {
+                "identity": identity_obj,
+                "metadata": {},
+                "authorization": {},
+                "response": {},
+                "callbacks": {},
+            }
+        }
+        results: Dict[Any, Any] = {}
+        for bucket in _AP._priority_buckets(rt.response):
+            for conf in bucket:
+                ev = conf.evaluator
+                if isinstance(ev, DynamicJSON):
+                    results[conf] = {p.name: p.value.resolve_for(doc)
+                                     for p in ev.properties}
+                else:
+                    results[conf] = ev.value.resolve_for(doc)
+            doc["auth"]["response"] = {c.name: o for c, o in results.items()}
+        headers, metadata = wrap_responses(results)
+        return self._result_bytes(
+            AuthResult(code=OK, headers=[headers], metadata=metadata))
 
     def _unauth_templates(self, rt: RuntimeAuthConfig,
                           sources: List[SourceSpec]) -> List[bytes]:
@@ -890,23 +966,33 @@ class NativeFrontend:
             # config's fast- and slow-lane traffic lands on one series
             lbl = entry.runtime.labels or {}
             ns_l, nm_l = lbl.get("namespace", ""), lbl.get("name", "")
+            rt_e = entry.runtime
+            # response-template configs: OK bytes are per identity outcome
+            # (anonymous at swap; per-key at swap; per-credential at dyn
+            # registration) — empty ok in a variant = the config default
+            fc_ok = (self._ok_bytes_for(rt_e, _CONST_AUTH_DOC["auth"]["identity"])
+                     if rt_e.response and not spec_fl.sources else ok_bytes)
             fc = {
                 "row": 0,
                 "has_batch": 1 if spec_fl.has_batch else 0,
-                "ok": ok_bytes,
-                "deny": self._result_bytes(self._deny_result(entry.runtime)),
+                "ok": fc_ok,
+                "deny": self._result_bytes(self._deny_result(rt_e)),
                 "plans": spec_fl.plans,
                 "sources": [
                     {
                         "cred_kind": s.cred_kind,
                         "cred_key": s.cred_key,
                         "dyn": 1 if s.dyn else 0,
-                        "variants": s.variants,
+                        "variants": [
+                            (key, vplans,
+                             self._ok_bytes_for(rt_e, ident_obj)
+                             if ident_obj is not None else b"")
+                            for key, vplans, ident_obj in s.variants
+                        ],
                     }
                     for s in spec_fl.sources
                 ],
-                "unauth_msgs": self._unauth_templates(entry.runtime,
-                                                      spec_fl.sources),
+                "unauth_msgs": self._unauth_templates(rt_e, spec_fl.sources),
                 "ns": ns_l,
                 "name": nm_l,
             }
@@ -1057,8 +1143,14 @@ class NativeFrontend:
                 if p is None:
                     return  # this token's values don't fit the compact payload
                 vplans.append(p)
+        ok_bytes = b""
+        if entry.runtime.response:
+            try:
+                ok_bytes = self._ok_bytes_for(entry.runtime, obj)
+            except Exception:
+                return  # this credential's response doesn't template: slow
         self._mod.fe_add_variant(rec.snap_id, fc_idx, src_idx,
-                                 token.encode("utf-8"), vplans,
+                                 token.encode("utf-8"), vplans, ok_bytes,
                                  int(deadline * 1e9))
 
     # ------------------------------------------------------------------

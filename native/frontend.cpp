@@ -380,6 +380,9 @@ struct FastPlan {
 struct VarEnt {
   int32_t idx;                  // var_plans index
   int64_t exp_ns;               // CLOCK_REALTIME expiry; INT64_MAX = static
+  int32_t ok_idx = -1;          // var_oks index (per-identity OK response
+                                // bytes — response-template configs); -1 =
+                                // the config's default OK
 };
 
 // one identity source of a config (multi-identity configs carry several,
@@ -393,6 +396,7 @@ struct CredSource {
   // key's auth.identity.* operands resolved to constant plan variants
   std::unordered_map<std::string, VarEnt> variants;
   std::deque<std::vector<FastPlan>> var_plans;       // deque: stable refs
+  std::deque<std::string> var_oks;                   // per-key OK bytes
   // dyn (OIDC/JWT, mTLS): the variant map is a verified-credential cache
   // registered at runtime by the slow lane.  Entries hold their plans by
   // shared_ptr so overwrites and expiry sweeps reclaim memory immediately
@@ -401,6 +405,9 @@ struct CredSource {
   struct DynVar {
     std::shared_ptr<const std::vector<FastPlan>> plans;
     int64_t exp_ns;
+    // per-credential OK response bytes (response-template configs);
+    // null = the config's default OK
+    std::shared_ptr<const std::string> ok;
   };
   std::unordered_map<std::string, DynVar> dyn_variants;
 };
@@ -482,6 +489,10 @@ struct Entry {
   int32_t stream_id;
   int32_t fc;
   int64_t t_enq;  // CLOCK_MONOTONIC at encode time (stage/duration hists)
+  // per-identity OK response override (response-template configs);
+  // ok_hold keeps a dyn variant's bytes alive until completion
+  const std::string* ok_msg = nullptr;
+  std::shared_ptr<const std::string> ok_hold;
 };
 
 struct Slot {
@@ -1179,6 +1190,9 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
   // keeps a dyn variant's plan vector alive across encode_fast after the
   // variant lock is released (overwrites/sweeps may drop the map entry)
   std::shared_ptr<const std::vector<FastPlan>> dyn_hold;
+  // the winning identity's OK response override (response-template configs)
+  const std::string* ok_override = nullptr;
+  std::shared_ptr<const std::string> ok_hold;
   if (!fc.sources.empty()) {
     // identity is an OR over the sources, tried in the pipeline's
     // priority-then-declaration order: the first source whose credential
@@ -1206,6 +1220,10 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
               vit->second.exp_ns > now_realtime_ns()) {
             dyn_hold = vit->second.plans;
             extra = dyn_hold.get();
+            if (vit->second.ok) {
+              ok_hold = vit->second.ok;
+              ok_override = ok_hold.get();
+            }
           }
         }
         if (extra == nullptr) {
@@ -1223,6 +1241,8 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
       auto vit = src.variants.find(cred);
       if (vit != src.variants.end()) {
         extra = &src.var_plans[vit->second.idx];
+        if (vit->second.ok_idx >= 0)
+          ok_override = &src.var_oks[vit->second.ok_idx];
         authenticated = true;
         break;
       }
@@ -1246,7 +1266,8 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
     S->n_direct_ok.fetch_add(1, std::memory_order_relaxed);
     S->n_allowed.fetch_add(1, std::memory_order_relaxed);
     record_direct_dur(snap.get(), fc_idx, t_start);
-    submit_grpc_response(c, stream_id, fc.ok_msg);
+    submit_grpc_response(c, stream_id,
+                         ok_override ? *ok_override : fc.ok_msg);
     return;
   }
   std::shared_ptr<Snapshot> fsnap;
@@ -1268,7 +1289,8 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
     push_slow(S, c, stream_id, msg, mlen);
     return;
   }
-  snap->slot_entries[S->fill_slot].push_back({c->id, stream_id, fc_idx, t_start});
+  snap->slot_entries[S->fill_slot].push_back(
+      {c->id, stream_id, fc_idx, t_start, ok_override, std::move(ok_hold)});
   S->fill_count++;
   S->n_fast.fetch_add(1, std::memory_order_relaxed);
   if (S->fill_count >= S->bmax) flush_batch(S);
@@ -1719,7 +1741,8 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
       bool ok = verdict[i] != 0;
       allowed += ok;
       S->done_q.push_back(
-          {e.conn_id, e.stream_id, ok ? fc.ok_msg : fc.deny_msg, 0, t_now});
+          {e.conn_id, e.stream_id,
+           ok ? (e.ok_msg ? *e.ok_msg : fc.ok_msg) : fc.deny_msg, 0, t_now});
     }
     snap->free_slots.push_back(slot);
     snap->pending_batches--;
@@ -1756,7 +1779,8 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
 // cap is hit.
 static bool add_variant(Server* S, int64_t snap_id, int32_t fc_idx,
                         int32_t src_idx, std::string cred,
-                        std::vector<FastPlan> plans, int64_t exp_ns) {
+                        std::vector<FastPlan> plans, std::string ok_bytes,
+                        int64_t exp_ns) {
   std::shared_ptr<Snapshot> snap;
   {
     std::lock_guard<std::mutex> lk(S->mu);
@@ -1770,6 +1794,9 @@ static bool add_variant(Server* S, int64_t snap_id, int32_t fc_idx,
   CredSource& src = fc.sources[src_idx];
   if (!src.dyn) return false;
   auto sp = std::make_shared<const std::vector<FastPlan>>(std::move(plans));
+  std::shared_ptr<const std::string> ok;
+  if (!ok_bytes.empty())
+    ok = std::make_shared<const std::string>(std::move(ok_bytes));
   {
     std::lock_guard<std::mutex> vlk(snap->var_mu);
     auto it = src.dyn_variants.find(cred);
@@ -1784,9 +1811,12 @@ static bool add_variant(Server* S, int64_t snap_id, int32_t fc_idx,
       if (src.dyn_variants.size() >= DYN_VARIANT_CAP) return false;
       it = src.dyn_variants.end();
     }
-    if (it != src.dyn_variants.end()) it->second = {std::move(sp), exp_ns};
-    else src.dyn_variants.emplace(std::move(cred),
-                                  CredSource::DynVar{std::move(sp), exp_ns});
+    if (it != src.dyn_variants.end())
+      it->second = {std::move(sp), exp_ns, std::move(ok)};
+    else
+      src.dyn_variants.emplace(
+          std::move(cred),
+          CredSource::DynVar{std::move(sp), exp_ns, std::move(ok)});
   }
   S->n_dyn_add.fetch_add(1, std::memory_order_relaxed);
   return true;

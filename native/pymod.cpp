@@ -461,18 +461,27 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
       dict_str(sd, "cred_key", src.cred_key);
       PyObject* vars = PyDict_GetItemString(sd, "variants");
       for (Py_ssize_t k = 0; vars != nullptr && k < PyList_GET_SIZE(vars); ++k) {
+        // (key_bytes, plans, ok_bytes) — empty ok = the config default
         PyObject* kv = PyList_GET_ITEM(vars, k);
         PyObject* kb = PyTuple_GET_ITEM(kv, 0);
-        if (!PyBytes_Check(kb)) {
-          PyErr_SetString(PyExc_TypeError, "variant key must be bytes");
+        PyObject* okb = PyTuple_GET_SIZE(kv) > 2 ? PyTuple_GET_ITEM(kv, 2) : nullptr;
+        if (!PyBytes_Check(kb) || (okb != nullptr && !PyBytes_Check(okb))) {
+          PyErr_SetString(PyExc_TypeError, "variant key/ok must be bytes");
           return nullptr;
         }
         std::vector<fe::FastPlan> vp;
         if (!parse_plans(PyTuple_GET_ITEM(kv, 1), vp, nullptr)) return nullptr;
         int32_t vid = (int32_t)src.var_plans.size();
         src.var_plans.push_back(std::move(vp));
+        int32_t ok_idx = -1;
+        if (okb != nullptr && PyBytes_GET_SIZE(okb) > 0) {
+          ok_idx = (int32_t)src.var_oks.size();
+          src.var_oks.emplace_back(PyBytes_AS_STRING(okb),
+                                   (size_t)PyBytes_GET_SIZE(okb));
+        }
         src.variants[std::string(PyBytes_AS_STRING(kb),
-                                 (size_t)PyBytes_GET_SIZE(kb))] = {vid, INT64_MAX};
+                                 (size_t)PyBytes_GET_SIZE(kb))] = {
+            vid, INT64_MAX, ok_idx};
       }
       fc.sources.push_back(std::move(src));
     }
@@ -617,34 +626,38 @@ PyObject* fe_complete_slow_py(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
-// fe_add_variant(snap_id, fc_idx, src_idx, cred_bytes, plans, exp_ns) ->
-// bool — register a runtime plan variant (verified-credential cache entry)
-// for one identity source; called by the slow lane after a successful
-// verification
+// fe_add_variant(snap_id, fc_idx, src_idx, cred_bytes, plans, ok_bytes,
+// exp_ns) -> bool — register a runtime plan variant (verified-credential
+// cache entry) for one identity source; called by the slow lane after a
+// successful verification.  Empty ok_bytes = the config's default OK.
 PyObject* fe_add_variant_py(PyObject*, PyObject* args) {
   long long snap_id, exp_ns;
   int fc_idx, src_idx;
-  Py_buffer cred;
+  Py_buffer cred, okb;
   PyObject* plans;
-  if (!PyArg_ParseTuple(args, "Liiy*O!L", &snap_id, &fc_idx, &src_idx, &cred,
-                        &PyList_Type, &plans, &exp_ns))
+  if (!PyArg_ParseTuple(args, "Liiy*O!y*L", &snap_id, &fc_idx, &src_idx, &cred,
+                        &PyList_Type, &plans, &okb, &exp_ns))
     return nullptr;
   fe::Server* S = fe::g_srv;
   if (S == nullptr) {
     PyBuffer_Release(&cred);
+    PyBuffer_Release(&okb);
     Py_RETURN_FALSE;
   }
   std::vector<fe::FastPlan> vp;
   if (!parse_plans(plans, vp, nullptr)) {
     PyBuffer_Release(&cred);
+    PyBuffer_Release(&okb);
     return nullptr;
   }
   std::string cs((const char*)cred.buf, (size_t)cred.len);
+  std::string oks((const char*)okb.buf, (size_t)okb.len);
   PyBuffer_Release(&cred);
+  PyBuffer_Release(&okb);
   bool ok;
   Py_BEGIN_ALLOW_THREADS
   ok = fe::add_variant(S, snap_id, fc_idx, src_idx, std::move(cs),
-                       std::move(vp), exp_ns);
+                       std::move(vp), std::move(oks), exp_ns);
   Py_END_ALLOW_THREADS
   return PyBool_FromLong(ok ? 1 : 0);
 }

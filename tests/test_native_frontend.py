@@ -613,6 +613,116 @@ def test_multi_identity_or_fast_lane():
         t.join(timeout=10)
 
 
+def test_response_templates_ride_fast_lane():
+    """Response evaluators whose outputs are constant per identity outcome
+    (DynamicJSON/Plain over auth.*) keep the fast lane: OK bytes are
+    precomputed per credential variant — the 'inject an identity header'
+    pattern (round 4).  Differential against the Python server, headers
+    AND dynamic metadata."""
+    from google.protobuf.json_format import MessageToDict
+
+    from authorino_tpu.evaluators import ResponseConfig
+    from authorino_tpu.evaluators.response import DynamicJSON, Plain
+
+    holder, t = run_fake_idp()
+    idp = holder["idp"]
+    try:
+        from authorino_tpu.evaluators.identity import OIDC
+
+        engine = PolicyEngine(max_batch=32, max_delay_s=0.0005, mesh=None)
+        ak = APIKey("keys", LabelSelector.from_spec({"matchLabels": {"g": "rt"}}),
+                    credentials=AuthCredentials(key_selector="APIKEY"))
+        ak.add_k8s_secret_based_identity(Secret(
+            namespace="ns", name="alice-key", labels={"g": "rt"},
+            annotations={"role": "admin"}, data={"api_key": b"alice-secret"}))
+        oidc = OIDC("kc", idp.issuer)
+        entries = []
+        # anonymous + static/template response headers
+        rule = Pattern("request.method", Operator.NEQ, "DELETE")
+        pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/r-anon"),
+                             evaluator_slot=0)
+        entries.append(EngineEntry(
+            id="ns/r-anon", hosts=["r-anon.test"],
+            runtime=RuntimeAuthConfig(
+                labels={"namespace": "ns", "name": "r-anon"},
+                identity=[IdentityConfig("anon", Noop())],
+                authorization=[AuthorizationConfig("rules", pm)],
+                response=[
+                    ResponseConfig("x-static", Plain(JSONValue(static="on"))),
+                    ResponseConfig("x-anon", DynamicJSON([JSONProperty(
+                        "anon", JSONValue(pattern="auth.identity.anonymous"))])),
+                ]),
+            rules=ConfigRules(name="ns/r-anon", evaluators=[(None, rule)])))
+        # API key + per-key identity header (template) + dynamic metadata
+        entries.append(EngineEntry(
+            id="ns/r-key", hosts=["r-key.test"],
+            runtime=RuntimeAuthConfig(
+                labels={"namespace": "ns", "name": "r-key"},
+                identity=[IdentityConfig("keys", ak,
+                                         credentials=AuthCredentials(
+                                             key_selector="APIKEY"))],
+                response=[
+                    ResponseConfig("x-user", Plain(JSONValue(
+                        pattern="secret {auth.identity.metadata.name} "
+                                "is {auth.identity.metadata.annotations.role}"))),
+                    ResponseConfig("ident", DynamicJSON([JSONProperty(
+                        "name",
+                        JSONValue(pattern="auth.identity.metadata.name"))]),
+                        wrapper="envoyDynamicMetadata"),
+                ]),
+            rules=None))
+        # OIDC + claim-derived header (registered with the token variant)
+        entries.append(EngineEntry(
+            id="ns/r-jwt", hosts=["r-jwt.test"],
+            runtime=RuntimeAuthConfig(
+                labels={"namespace": "ns", "name": "r-jwt"},
+                identity=[IdentityConfig("kc", oidc)],
+                response=[ResponseConfig("x-sub", Plain(JSONValue(
+                    pattern="auth.identity.sub")))]),
+            rules=None))
+        engine.apply_snapshot(entries)
+        for cfg in ("ns/r-anon", "ns/r-key", "ns/r-jwt"):
+            assert fast_lane_eligible(engine._snapshot.by_id[cfg],
+                                      engine._snapshot.policy) is not None, cfg
+
+        fe = NativeFrontend(engine, port=0, max_batch=32, window_us=500)
+        port = fe.start()
+        pyholder, pyt = run_python_server(engine)
+        try:
+            tok = idp.token({"sub": "john"})
+            reqs = [
+                make_req("r-anon.test"),
+                make_req("r-key.test",
+                         headers={"authorization": "APIKEY alice-secret"}),
+                make_req("r-jwt.test",
+                         headers={"authorization": f"Bearer {tok}"}),
+                make_req("r-jwt.test",
+                         headers={"authorization": f"Bearer {tok}"}),  # cached
+            ]
+            for i, rq in enumerate(reqs):
+                native = grpc_call(port, rq)
+                python = grpc_call(pyholder["port"], rq)
+                assert MessageToDict(native) == MessageToDict(python), (
+                    f"response req #{i}: {MessageToDict(native)} "
+                    f"vs {MessageToDict(python)}")
+            # spot-check the injected values themselves
+            r = grpc_call(port, reqs[1])
+            hdrs = {h.header.key: h.header.value for h in r.ok_response.headers}
+            assert hdrs["x-user"] == "secret alice-key is admin"
+            assert r.dynamic_metadata.fields["ident"].struct_value.fields[
+                "name"].string_value == "alice-key"
+            # the repeats were native, not pipeline
+            stats = fe.stats()
+            assert stats["fast"] >= 4
+        finally:
+            pyholder["loop"].call_soon_threadsafe(pyholder["stop"].set)
+            pyt.join(timeout=10)
+            fe.stop()
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
+
+
 def test_stop_drains_inflight_slow_requests():
     """fe.stop() while slow-lane requests are in flight must complete them
     before the loop closes — a cancelled handler would leave its client
@@ -832,14 +942,14 @@ def test_fast_lane_classification(stack):
     spec = fast_lane_eligible(by_id["ns/fast-keyonly"], policy)
     assert spec is not None and not spec.has_batch
     assert len(spec.sources) == 1 and spec.sources[0].cred_kind == 1
-    assert any(k == b"sekret" for k, _ in spec.sources[0].variants)
+    assert any(k == b"sekret" for k, _, _ in spec.sources[0].variants)
     # API-key + auth.identity.* patterns: per-key K_CONST plan variants
     spec2 = fast_lane_eligible(by_id["ns/fast-key"], policy)
     assert spec2 is not None and spec2.has_batch
     assert spec2.sources[0].cred_kind == 2
     assert spec2.sources[0].cred_key == "x-api-key"
     assert len(spec2.sources[0].variants) == 2
-    assert all(vplans for _, vplans in spec2.sources[0].variants)
+    assert all(vplans for _, vplans, _ in spec2.sources[0].variants)
     # templated denyWith: per-request resolution → slow lane
     assert fast_lane_eligible(by_id["ns/slow-tmpl"], policy) is None
 
