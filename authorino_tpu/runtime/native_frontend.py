@@ -174,6 +174,30 @@ def _auth_only_value(v) -> bool:
     return all(_classify_selector(s) == ("auth",) for s in sels)
 
 
+def _extend_identity(idc, obj):
+    """Mirror IdentityConfig.resolve_extended_properties against a CONSTANT
+    identity outcome: extensions read the raw identity through the doc
+    (auth.identity stays raw during the loop, exactly like the pipeline's
+    _sync_auth-then-extend ordering) while mutating the extended copy."""
+    if not idc.extended_properties:
+        return obj
+    if not isinstance(obj, dict):
+        raise ValueError("cannot extend non-object identity")
+    doc = {
+        "auth": {
+            "identity": obj,
+            "metadata": {},
+            "authorization": {},
+            "response": {},
+            "callbacks": {},
+        }
+    }
+    extended = dict(obj)
+    for prop in idc.extended_properties:
+        extended[prop.name] = prop.resolve_for(extended, doc)
+    return extended
+
+
 def _response_templates_eligible(rt: RuntimeAuthConfig) -> bool:
     """Response evaluators whose outputs are constant per identity outcome
     (DynamicJSON / Plain over auth.*-only values) can precompute their OK
@@ -257,6 +281,9 @@ class FastLaneSpec:
     has_batch: bool = False
     sources: List[SourceSpec] = field(default_factory=list)
     auth_attrs: List[int] = field(default_factory=list)
+    # anonymous configs: the (possibly extended) constant identity object —
+    # response templates resolve against it at swap time
+    const_identity: Any = None
 
 
 # bounds on the identity-source fan-out the C++ lane carries: the all-fail
@@ -284,10 +311,16 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
     if not rt.identity or len(rt.identity) > _MAX_SOURCES:
         return None
     for idc in rt.identity:
-        if idc.conditions is not None or idc.cache is not None or idc.extended_properties:
+        if idc.conditions is not None or idc.cache is not None:
             return None
         if idc.metrics or metrics_mod.DEEP_METRICS_ENABLED:
             return None  # deep per-evaluator series need the pipeline
+        # identity extensions are constant per identity outcome when their
+        # values resolve over auth.* only (ref pkg/evaluators/
+        # identity_extension.go) — applied at variant-build time
+        if idc.extended_properties and not all(
+                _auth_only_value(e.value) for e in idc.extended_properties):
+            return None
     is_noop = len(rt.identity) == 1 and isinstance(rt.identity[0].evaluator, Noop)
     sources: List[SourceSpec] = []
     if not is_noop:
@@ -372,8 +405,22 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
     spec = FastLaneSpec(plans=plans, has_batch=has_batch, sources=sources,
                         auth_attrs=auth_attrs)
     if is_noop:
+        try:
+            spec.const_identity = _extend_identity(
+                rt.identity[0], dict(_CONST_AUTH_DOC["auth"]["identity"]))
+        except ValueError:
+            return None
+        doc = {
+            "auth": {
+                "identity": spec.const_identity,
+                "metadata": {},
+                "authorization": {},
+                "response": {},
+                "callbacks": {},
+            }
+        }
         for attr in auth_attrs:
-            p = _const_plan(policy, attr, _CONST_AUTH_DOC)
+            p = _const_plan(policy, attr, doc)
             if p is None:
                 return None
             spec.plans.append(p)
@@ -386,7 +433,11 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
         if src.dyn:
             continue
         for key, secret in src.idc.evaluator.snapshot_secrets().items():
-            ident_obj = secret.to_identity_object()
+            try:
+                ident_obj = _extend_identity(src.idc,
+                                             secret.to_identity_object())
+            except ValueError:
+                return None
             vplans: List[tuple] = []
             if auth_attrs:
                 doc = {
@@ -970,7 +1021,7 @@ class NativeFrontend:
             # response-template configs: OK bytes are per identity outcome
             # (anonymous at swap; per-key at swap; per-credential at dyn
             # registration) — empty ok in a variant = the config default
-            fc_ok = (self._ok_bytes_for(rt_e, _CONST_AUTH_DOC["auth"]["identity"])
+            fc_ok = (self._ok_bytes_for(rt_e, spec_fl.const_identity)
                      if rt_e.response and not spec_fl.sources else ok_bytes)
             fc = {
                 "row": 0,

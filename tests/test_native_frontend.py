@@ -723,6 +723,62 @@ def test_response_templates_ride_fast_lane():
         t.join(timeout=10)
 
 
+def test_identity_extensions_ride_fast_lane():
+    """auth.*-only identity extensions resolve constantly per credential —
+    applied at variant-build time, visible to both the kernel's auth.*
+    patterns and the response templates (round 4)."""
+    from google.protobuf.json_format import MessageToDict
+
+    from authorino_tpu.evaluators import ResponseConfig
+    from authorino_tpu.evaluators.base import IdentityExtension
+    from authorino_tpu.evaluators.response import Plain
+
+    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    ak = APIKey("keys", LabelSelector.from_spec({"matchLabels": {"g": "ext"}}),
+                credentials=AuthCredentials(key_selector="APIKEY"))
+    ak.add_k8s_secret_based_identity(Secret(
+        namespace="ns", name="bob-key", labels={"g": "ext"},
+        annotations={"level": "9"}, data={"api_key": b"bob-secret"}))
+    exts = [
+        IdentityExtension("tier", JSONValue(
+            pattern="auth.identity.metadata.annotations.level")),
+        IdentityExtension("source", JSONValue(static="api-key")),
+    ]
+    rule = Pattern("auth.identity.tier", Operator.EQ, "9")
+    pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/ext"),
+                         evaluator_slot=0)
+    engine.apply_snapshot([EngineEntry(
+        id="ns/ext", hosts=["ext.test"],
+        runtime=RuntimeAuthConfig(
+            labels={"namespace": "ns", "name": "ext"},
+            identity=[IdentityConfig(
+                "keys", ak, extended_properties=exts,
+                credentials=AuthCredentials(key_selector="APIKEY"))],
+            authorization=[AuthorizationConfig("rules", pm)],
+            response=[ResponseConfig("x-src", Plain(JSONValue(
+                pattern="auth.identity.source")))]),
+        rules=ConfigRules(name="ns/ext", evaluators=[(None, rule)]))])
+    assert fast_lane_eligible(engine._snapshot.by_id["ns/ext"],
+                              engine._snapshot.policy) is not None
+
+    fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
+    port = fe.start()
+    holder, t = run_python_server(engine)
+    try:
+        req = make_req("ext.test", headers={"authorization": "APIKEY bob-secret"})
+        native = grpc_call(port, req)
+        python = grpc_call(holder["port"], req)
+        assert MessageToDict(native) == MessageToDict(python)
+        assert native.status.code == 0  # pattern over the EXTENDED tier
+        hdrs = {h.header.key: h.header.value for h in native.ok_response.headers}
+        assert hdrs["x-src"] == "api-key"
+        assert fe.stats()["fast"] >= 1 and fe.stats()["slow"] == 0
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
+        fe.stop()
+
+
 def test_stop_drains_inflight_slow_requests():
     """fe.stop() while slow-lane requests are in flight must complete them
     before the loop closes — a cancelled handler would leave its client
