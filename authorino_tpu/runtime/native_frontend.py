@@ -1,17 +1,29 @@
 """Python half of the native device-owner gRPC frontend (native/frontend.cpp).
 
 One process owns the TPU; the wire runs in C++.  This module decides, per
-AuthConfig, whether its FULL pipeline semantics reduce to the compiled
-kernel verdict (the *fast lane*: anonymous identity + compiled
-pattern-matching authorization + static responses — then packed column 0 is
-exactly the pipeline's decision, ops/pattern_eval.py eval_verdicts), builds
-the C++ encode plans + byte-exact response templates (with the same pb2 code
-as service/grpc_server.py so fast-lane responses match the Python server
-bit for bit), and runs two Python threads:
+AuthConfig, whether its FULL pipeline semantics reduce to a native
+decision — the *fast lane*:
 
-  - dispatcher: one JAX dispatch per micro-batch (the only per-batch Python)
-  - slow lane: full AuthPipeline for everything else (OIDC identities,
-    metadata fetches, templated denyWith, wildcard-host corpora, …)
+  - compiled pattern-matching authorization (`when` conditions included):
+    packed column 0 is exactly the pipeline's decision
+    (ops/pattern_eval.py eval_verdicts), single-corpus or mesh-sharded;
+  - identity as an ordered OR of sources: anonymous, API keys (per-key
+    plan variants resolved at refresh), and OIDC/JWT + mTLS through a
+    verified-credential cache registered by the slow lane (TTL-bounded by
+    exp/notAfter; JWKS/CA rotation swaps the cache away);
+  - auth.*-only identity extensions and DynamicJSON/Plain response
+    templates, precomputed per identity outcome (OK bytes per variant);
+  - static denyWith templates, all-sources-failed answers per
+    static-credential-presence bitmask.
+
+It builds the C++ encode plans + byte-exact response templates (with the
+same pb2 code as service/grpc_server.py so fast-lane responses match the
+Python server bit for bit), and runs two kinds of Python threads:
+
+  - dispatchers: one JAX dispatch per micro-batch (the only per-batch Python)
+  - slow lane: full AuthPipeline for everything else (unknown/expired
+    credentials, metadata fetches, Rego, templated denyWith, sampled
+    traces, …) with continuous admission and graceful-drain shutdown
 
 Reference parity: main.go:437-488 (one-process gRPC server),
 pkg/service/auth.go:239-310 (Check flow incl. host override + port strip).
