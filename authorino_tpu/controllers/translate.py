@@ -494,6 +494,30 @@ async def translate_auth_config(
     if not hosts:
         raise TranslationError("missing hosts")
 
+    # top-level `when` folding (round 4): an unmatched AuthConfig gate skips
+    # the WHOLE pipeline → OK (ref pkg/service/auth_pipeline.go:454-457).
+    # For an anonymous-identity config whose authorization is entirely
+    # compiled patterns and which produces no response/metadata/callbacks,
+    # that is exactly  ¬C ∨ ∧(¬cond ∨ rule) = ∧(¬(C ∧ cond) ∨ rule)  — so
+    # the gate compiles into every evaluator's condition and the config
+    # keeps the kernel fast lane.  Credential identities cannot fold (a
+    # skipped pipeline must allow even credential-less requests) nor can
+    # response outputs (skipped requests carry none).
+    if (runtime.conditions is not None
+            and engine is not None
+            and pattern_slots
+            and len(pattern_slots) == len(runtime.authorization)
+            and len(runtime.identity) == 1
+            and isinstance(runtime.identity[0].evaluator, Noop)
+            and not runtime.metadata and not runtime.response
+            and not runtime.callbacks):
+        gate = runtime.conditions
+        pattern_slots = [
+            (gate if cond is None else All(gate, cond), rule)
+            for cond, rule in pattern_slots
+        ]
+        runtime.conditions = None
+
     return EngineEntry(
         id=cfg_id,
         hosts=hosts,

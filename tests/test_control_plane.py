@@ -619,3 +619,76 @@ class TestWatchBookmarksAndStorms:
             await src.stop()
 
         run(body())
+
+
+class TestTopLevelWhenFolding:
+    def test_anonymous_gate_folds_into_kernel(self):
+        """An AuthConfig-level `when` gate on an anonymous pattern config
+        compiles into every evaluator's condition (unmatched gate ⇒ whole
+        pipeline skipped ⇒ OK, ref auth_pipeline.go:454-457) so the config
+        keeps the kernel fast lane (round 4)."""
+        from authorino_tpu.runtime.native_frontend import fast_lane_eligible
+
+        engine = PolicyEngine(max_batch=8, max_delay_s=0.0005, mesh=None)
+        spec = {
+            "hosts": ["gated.test"],
+            "when": [{"selector": "request.method",
+                      "operator": "neq", "value": "OPTIONS"}],
+            "authentication": {"anon": {"anonymous": {}}},
+            "authorization": {"rules": {"patternMatching": {"patterns": [
+                {"selector": "request.headers.x-org",
+                 "operator": "eq", "value": "acme"}]}}},
+        }
+        entry = run(translate_auth_config("gated", "t", spec, engine=engine))
+        # the gate moved into the compiled rules
+        assert entry.runtime.conditions is None
+        cond, _rule = entry.rules.evaluators[0]
+        assert cond is not None
+        engine.apply_snapshot([entry])
+        assert fast_lane_eligible(entry, engine._snapshot.policy) is not None
+
+        async def check(method, headers=None):
+            req = CheckRequestModel(http=HttpRequestAttributes(
+                method=method, path="/x", host="gated.test",
+                headers=headers or {}))
+            return (await engine.check(req)).code
+
+        # gate unmatched (OPTIONS) → whole pipeline skipped → OK
+        assert run(check("OPTIONS")) == 0
+        # gate matched: the rule decides
+        assert run(check("GET", {"x-org": "acme"})) == 0
+        assert run(check("GET", {"x-org": "evil"})) == 7
+
+    def test_credential_identity_gate_does_not_fold(self):
+        """Folding is only sound for anonymous identities: a skipped
+        pipeline must allow credential-less requests, which the credential
+        fast lane could not honor — the gate stays on the pipeline."""
+        engine = PolicyEngine(max_batch=8, max_delay_s=0.0005)
+        cluster = InMemoryCluster()
+        cluster.put_secret(Secret(name="k", namespace="t",
+                                  labels={"g": "w"}, data={"api_key": b"s3"}))
+        spec = {
+            "hosts": ["gated-key.test"],
+            "when": [{"selector": "context.request.http.method",
+                      "operator": "neq", "value": "OPTIONS"}],
+            "authentication": {"keys": {"apiKey": {
+                "selector": {"matchLabels": {"g": "w"}}}}},
+            "authorization": {"rules": {"patternMatching": {"patterns": [
+                {"selector": "context.request.http.headers.x-org",
+                 "operator": "eq", "value": "acme"}]}}},
+        }
+        entry = run(translate_auth_config("gk", "t", spec,
+                                          cluster=cluster, engine=engine))
+        assert entry.runtime.conditions is not None
+        engine.apply_snapshot([entry])
+
+        async def check(method, headers=None):
+            req = CheckRequestModel(http=HttpRequestAttributes(
+                method=method, path="/x", host="gated-key.test",
+                headers=headers or {}))
+            return (await engine.check(req)).code
+
+        # skipped pipeline allows even without credentials
+        assert run(check("OPTIONS")) == 0
+        # gate matched: credentials enforced
+        assert run(check("GET")) == 16
