@@ -301,6 +301,30 @@ def test_sharded_engine_serves_fast_lane():
     entries.append(make_pattern_entry(
         engine, "ns/shard-rx", ["shard-rx.test"],
         Pattern("request.url_path", Operator.MATCHES, r"^/v[0-9]+/ok")))
+    # credential variants × sharding: per-key auth.* constants must resolve
+    # against the OWNING SHARD's compile
+    aks = APIKey("sh-keys", LabelSelector.from_spec({"matchLabels": {"g": "sh"}}),
+                 credentials=AuthCredentials(key_selector="APIKEY"))
+    aks.add_k8s_secret_based_identity(Secret(
+        namespace="ns", name="sh-adm", labels={"g": "sh"},
+        annotations={"role": "admin"}, data={"api_key": b"sh-admin"}))
+    aks.add_k8s_secret_based_identity(Secret(
+        namespace="ns", name="sh-usr", labels={"g": "sh"},
+        annotations={"role": "user"}, data={"api_key": b"sh-user"}))
+    rule_sh = Pattern("auth.identity.metadata.annotations.role", Operator.EQ,
+                      "admin")
+    pm_sh = PatternMatching(rule_sh,
+                            batched_provider=engine.provider_for("ns/shard-key"),
+                            evaluator_slot=0)
+    entries.append(EngineEntry(
+        id="ns/shard-key", hosts=["shard-key.test"],
+        runtime=RuntimeAuthConfig(
+            labels={"namespace": "ns", "name": "shard-key"},
+            identity=[IdentityConfig("sh-keys", aks,
+                                     credentials=AuthCredentials(
+                                         key_selector="APIKEY"))],
+            authorization=[AuthorizationConfig("rules", pm_sh)]),
+        rules=ConfigRules(name="ns/shard-key", evaluators=[(None, rule_sh)])))
     engine.apply_snapshot(entries)
     assert engine._snapshot.sharded is not None, "mesh path not engaged"
     fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
@@ -316,6 +340,13 @@ def test_sharded_engine_serves_fast_lane():
         reqs.append(make_req("shard-rx.test", path="/v2/ok"))
         reqs.append(make_req("shard-rx.test", path="/nope"))
         reqs.append(make_req("shard-rx.test", path="/v2/ok" + "x" * 200))  # ovf
+        reqs.append(make_req("shard-key.test",
+                             headers={"authorization": "APIKEY sh-admin"}))
+        reqs.append(make_req("shard-key.test",
+                             headers={"authorization": "APIKEY sh-user"}))
+        reqs.append(make_req("shard-key.test",
+                             headers={"authorization": "APIKEY nope"}))
+        reqs.append(make_req("shard-key.test"))
         reqs.append(make_req("unknown.test"))
         for i, req in enumerate(reqs):
             native = response_key(grpc_call(port, req))
