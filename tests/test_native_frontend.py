@@ -810,6 +810,63 @@ def test_identity_extensions_ride_fast_lane():
         fe.stop()
 
 
+def test_oidc_cache_survives_reconcile_storm():
+    """Reconcile swaps drop the verified-token cache (by design: fresh
+    snapshot, empty variant maps).  Under a storm of swaps with live OIDC
+    traffic, every response must stay correct — misses re-verify and
+    re-register, hits serve natively, nothing errors (round 4)."""
+    import concurrent.futures
+
+    holder, t = run_fake_idp()
+    idp = holder["idp"]
+    try:
+        engine, oidc = _oidc_engine(idp)
+        base_entries = list(engine._snapshot.by_id.values())
+        fe = NativeFrontend(engine, port=0, max_batch=32, window_us=500)
+        port = fe.start()
+        try:
+            bearer = {"authorization": f"Bearer {idp.token()}"}
+            grpc_call(port, make_req("oidc.test", headers=bearer))  # prime
+
+            stop = threading.Event()
+            codes = []
+
+            def loader():
+                with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+                    call = ch.unary_unary(
+                        "/envoy.service.auth.v3.Authorization/Check",
+                        request_serializer=pb.CheckRequest.SerializeToString,
+                        response_deserializer=pb.CheckResponse.FromString)
+                    req = make_req("oidc.test", headers=bearer)
+                    while not stop.is_set():
+                        codes.append(call(req, timeout=30).status.code)
+
+            with concurrent.futures.ThreadPoolExecutor(2) as pool:
+                futs = [pool.submit(loader) for _ in range(2)]
+                adds_seen = [fe.stats()["dyn_add"]]
+                for i in range(5):
+                    # a real reconcile: new snapshot, cache dropped
+                    extra = make_pattern_entry(
+                        engine, f"ns/storm-{i}", [f"storm-{i}.test"],
+                        Pattern("request.method", Operator.NEQ, "DELETE"))
+                    engine.apply_snapshot(base_entries + [extra])
+                    time.sleep(0.4)
+                    adds_seen.append(fe.stats()["dyn_add"])
+                stop.set()
+                for f in futs:
+                    f.result(timeout=30)
+            assert codes and all(c == 0 for c in codes), (
+                f"{sum(1 for c in codes if c)} non-OK of {len(codes)}")
+            # each swap forced at least one re-registration
+            assert adds_seen[-1] >= adds_seen[0] + 3, adds_seen
+            assert fe.stats()["dyn_hit"] > 0
+        finally:
+            fe.stop()
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
+
+
 def test_stop_drains_inflight_slow_requests():
     """fe.stop() while slow-lane requests are in flight must complete them
     before the loop closes — a cancelled handler would leave its client
