@@ -867,6 +867,48 @@ def test_oidc_cache_survives_reconcile_storm():
         t.join(timeout=10)
 
 
+def test_per_request_features_stay_slow():
+    """Negative eligibility: anything genuinely per-request must keep the
+    slow lane — response templates over request.*, identity extensions
+    over request.*, wristbands (per-request signatures)."""
+    from authorino_tpu.evaluators import ResponseConfig
+    from authorino_tpu.evaluators.base import IdentityExtension
+    from authorino_tpu.evaluators.response import Plain
+
+    engine = PolicyEngine(max_batch=8, max_delay_s=0.0005, mesh=None)
+
+    def entry_with(response=None, exts=None):
+        rule = Pattern("request.method", Operator.NEQ, "DELETE")
+        cfg_id = f"ns/neg-{len(response or [])}-{len(exts or [])}"
+        pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
+                             evaluator_slot=0)
+        return EngineEntry(
+            id=cfg_id, hosts=[f"{cfg_id.split('/')[1]}.test"],
+            runtime=RuntimeAuthConfig(
+                identity=[IdentityConfig("anon", Noop(),
+                                         extended_properties=exts or [])],
+                authorization=[AuthorizationConfig("rules", pm)],
+                response=response or []),
+            rules=ConfigRules(name=cfg_id, evaluators=[(None, rule)]))
+
+    # request.*-templated response → slow
+    e1 = entry_with(response=[ResponseConfig(
+        "x-path", Plain(JSONValue(pattern="request.path")))])
+    # request.*-templated identity extension → slow
+    e2 = entry_with(exts=[IdentityExtension(
+        "path", JSONValue(pattern="request.path"))])
+    # auth.*-only versions of both → fast
+    e3 = entry_with(
+        response=[ResponseConfig("x-anon", Plain(JSONValue(
+            pattern="auth.identity.anonymous")))],
+        exts=[IdentityExtension("src", JSONValue(static="anon"))])
+    engine.apply_snapshot([e1, e2, e3])
+    policy = engine._snapshot.policy
+    assert fast_lane_eligible(e1, policy) is None
+    assert fast_lane_eligible(e2, policy) is None
+    assert fast_lane_eligible(e3, policy) is not None
+
+
 def test_stop_drains_inflight_slow_requests():
     """fe.stop() while slow-lane requests are in flight must complete them
     before the loop closes — a cancelled handler would leave its client
