@@ -111,6 +111,15 @@ class PolicyEngine:
         self._pending: Dict[Any, List[_Pending]] = {}
         self._flush_handles: Dict[Any, asyncio.TimerHandle] = {}
         self._swap_listeners: List[Any] = []
+        # dedicated dispatch pool: asyncio.to_thread rides the loop's
+        # default executor (≈5 workers on a 1-CPU host), which caps the
+        # number of micro-batches in flight — on a device behind a long
+        # link that cap IS the slow-path throughput ceiling
+        # (in-flight batches × batch ≈ throughput × RTT)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._dispatch_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="atpu-engine-dispatch")
 
     # swap listeners: the native frontend rebuilds its C++ snapshot after
     # every corpus swap (runtime/native_frontend.py refresh)
@@ -226,7 +235,8 @@ class PolicyEngine:
                     p.future.set_exception(RuntimeError("no compiled policy snapshot"))
             return
         try:
-            own_rule, own_skipped = await asyncio.to_thread(self._run_batch, snap, batch)
+            own_rule, own_skipped = await asyncio.get_running_loop().run_in_executor(
+                self._dispatch_pool, self._run_batch, snap, batch)
         except Exception as e:
             for p in batch:
                 if not p.future.done():
