@@ -509,6 +509,11 @@ async def translate_auth_config(
             and len(pattern_slots) == len(runtime.authorization)
             and len(runtime.identity) == 1
             and isinstance(runtime.identity[0].evaluator, Noop)
+            # the anonymous identity must be unconditional: its own `when`
+            # (or a failing extension) could flip a gate-unmatched request
+            # from skip-OK to UNAUTHENTICATED under the fold
+            and runtime.identity[0].conditions is None
+            and not runtime.identity[0].extended_properties
             and not runtime.metadata and not runtime.response
             and not runtime.callbacks):
         gate = runtime.conditions

@@ -918,7 +918,8 @@ def _builtin(fn: str, args: List[Any]) -> Any:
         if fn == "count":
             return len(args[0])
         if fn == "json.marshal":
-            return json.dumps(args[0], separators=(",", ":"), sort_keys=False)
+            # Go encoding/json marshals object keys sorted
+            return json.dumps(args[0], separators=(",", ":"), sort_keys=True)
         if fn in ("base64.encode", "base64.decode", "base64url.encode",
                   "base64url.encode_no_pad", "base64url.decode",
                   "hex.encode", "hex.decode"):
@@ -940,10 +941,18 @@ def _builtin(fn: str, args: List[Any]) -> Any:
                 return s.encode().hex()
             return bytes.fromhex(s).decode()
         if fn == "time.parse_rfc3339_ns":
+            # exact integer ns: float timestamp math would corrupt sub-µs
+            # digits (and fromisoformat silently truncates past 6)
             from datetime import datetime
 
-            dt = datetime.fromisoformat(str(args[0]).replace("Z", "+00:00"))
-            return int(dt.timestamp() * 1e9)
+            s = str(args[0])
+            m = re.fullmatch(r"([^.]*)(?:\.(\d+))?(Z|[+-]\d{2}:\d{2})", s)
+            if not m:
+                raise RegoError(f"invalid RFC3339 timestamp: {s!r}")
+            base, frac, tz = m.group(1), m.group(2) or "", m.group(3)
+            dt = datetime.fromisoformat(base + tz.replace("Z", "+00:00"))
+            return (int(dt.timestamp()) * 10**9
+                    + int((frac + "000000000")[:9]))
         if fn == "contains":
             return args[1] in args[0]
         if fn == "startswith":

@@ -111,15 +111,6 @@ class PolicyEngine:
         self._pending: Dict[Any, List[_Pending]] = {}
         self._flush_handles: Dict[Any, asyncio.TimerHandle] = {}
         self._swap_listeners: List[Any] = []
-        # dedicated dispatch pool: asyncio.to_thread rides the loop's
-        # default executor (≈5 workers on a 1-CPU host), which caps the
-        # number of micro-batches in flight — on a device behind a long
-        # link that cap IS the slow-path throughput ceiling
-        # (in-flight batches × batch ≈ throughput × RTT)
-        from concurrent.futures import ThreadPoolExecutor
-
-        self._dispatch_pool = ThreadPoolExecutor(
-            max_workers=16, thread_name_prefix="atpu-engine-dispatch")
 
     # swap listeners: the native frontend rebuilds its C++ snapshot after
     # every corpus swap (runtime/native_frontend.py refresh)
@@ -236,7 +227,7 @@ class PolicyEngine:
             return
         try:
             own_rule, own_skipped = await asyncio.get_running_loop().run_in_executor(
-                self._dispatch_pool, self._run_batch, snap, batch)
+                _dispatch_pool(), self._run_batch, snap, batch)
         except Exception as e:
             for p in batch:
                 if not p.future.done():
@@ -287,6 +278,28 @@ class PolicyEngine:
                 own_rule, own_skipped, self.max_fallback_per_batch,
             )
         return own_rule, own_skipped
+
+
+# dispatch pool, shared process-wide: asyncio.to_thread rides the loop's
+# default executor (≈5 workers on a 1-CPU host), which caps the number of
+# micro-batches in flight — on a device behind a long link that cap IS the
+# slow-path throughput ceiling (in-flight batches × batch ≈ throughput ×
+# RTT).  One shared pool: engines are created freely (tests, reconciles)
+# and per-engine pools with no shutdown path would leak threads.
+_DISPATCH_POOL = None
+_DISPATCH_POOL_LOCK = threading.Lock()
+
+
+def _dispatch_pool():
+    global _DISPATCH_POOL
+    if _DISPATCH_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with _DISPATCH_POOL_LOCK:
+            if _DISPATCH_POOL is None:
+                _DISPATCH_POOL = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="atpu-engine-dispatch")
+    return _DISPATCH_POOL
 
 
 from ..utils import bucket_pow2 as _bucket  # noqa: E402 — shared bucketing policy

@@ -156,34 +156,57 @@ def _const_plan(policy: CompiledPolicy, attr: int, const_doc: Dict[str, Any]):
     return (int(attr), K_CONST, "", int(vid), missing, members, raw, False)
 
 
-# the constant auth.* subtree of a fast-lane request (anonymous identity,
-# no metadata/authorization/response outputs at pattern-eval time — the
-# authorization phase reads the doc BEFORE its own results are stored)
-_CONST_AUTH_DOC = {
-    "auth": {
-        "identity": {"anonymous": True},
-        "metadata": {},
-        "authorization": {},
-        "response": {},
-        "callbacks": {},
+def _const_doc(identity_obj) -> Dict[str, Any]:
+    """The constant auth.* subtree of a fast-lane request: identity as
+    resolved, everything else empty — the authorization phase reads the doc
+    BEFORE its own results are stored, and fast-lane configs have no
+    metadata/callbacks."""
+    return {
+        "auth": {
+            "identity": identity_obj,
+            "metadata": {},
+            "authorization": {},
+            "response": {},
+            "callbacks": {},
+        }
     }
-}
+
+
+_ANON_IDENTITY = {"anonymous": True}
 
 
 def _static_value(v) -> bool:
     return v is None or not getattr(v, "pattern", "")
 
 
+# auth.* subtrees that are constant per identity outcome in BOTH lanes at
+# every fast-lane resolve point.  auth.authorization is NOT: the pipeline
+# stores authorization results before the response phase (and before
+# later-priority authorization buckets), while the fast lane's const doc
+# holds {} — and a bare `auth` selector includes it
+_CONST_AUTH_ROOTS = ("identity", "metadata", "response", "callbacks")
+
+
+def _auth_subroot_ok(s: str) -> bool:
+    parts = s.split(".")
+    if len(parts) < 2:
+        return False
+    sub = parts[1].split("|")[0].split("#")[0].split("@")[0]
+    return sub in _CONST_AUTH_ROOTS
+
+
 def _auth_only_value(v) -> bool:
     """True when a JSONValue resolves constantly per identity outcome:
-    static, or selectors/templates rooted entirely in the auth.* subtree."""
+    static, or selectors/templates rooted entirely in the constant parts
+    of the auth.* subtree."""
     from ..authjson.value import is_template, template_selectors
 
     if not getattr(v, "pattern", ""):
         return True
     sels = (template_selectors(v.pattern) if is_template(v.pattern)
             else [v.pattern])
-    return all(_classify_selector(s) == ("auth",) for s in sels)
+    return all(_classify_selector(s) == ("auth",) and _auth_subroot_ok(s)
+               for s in sels)
 
 
 def _extend_identity(idc, obj):
@@ -195,15 +218,7 @@ def _extend_identity(idc, obj):
         return obj
     if not isinstance(obj, dict):
         raise ValueError("cannot extend non-object identity")
-    doc = {
-        "auth": {
-            "identity": obj,
-            "metadata": {},
-            "authorization": {},
-            "response": {},
-            "callbacks": {},
-        }
-    }
+    doc = _const_doc(obj)
     extended = dict(obj)
     for prop in idc.extended_properties:
         extended[prop.name] = prop.resolve_for(extended, doc)
@@ -404,12 +419,18 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
                 return None
         has_batch = True
         for attr in policy.config_attrs[row]:
-            c = _classify_selector(policy.attr_selectors[attr])
+            sel_str = policy.attr_selectors[attr]
+            c = _classify_selector(sel_str)
             if c is None:
                 return None
             if c[0] == "req":
                 plans.append((int(attr), c[1], c[2], 0, False, [], b"", False))
             else:
+                # auth.authorization-rooted pattern operands would see the
+                # pipeline's earlier-bucket results but the const doc's {} —
+                # only the truly constant subtrees are plannable
+                if not _auth_subroot_ok(sel_str):
+                    return None
                 auth_attrs.append(int(attr))
     elif entry.rules is not None and entry.rules.evaluators:
         return None  # compiled rules without runtime authz configs: engine bug
@@ -418,19 +439,11 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
                         auth_attrs=auth_attrs)
     if is_noop:
         try:
-            spec.const_identity = _extend_identity(
-                rt.identity[0], dict(_CONST_AUTH_DOC["auth"]["identity"]))
+            spec.const_identity = _extend_identity(rt.identity[0],
+                                                   dict(_ANON_IDENTITY))
         except ValueError:
             return None
-        doc = {
-            "auth": {
-                "identity": spec.const_identity,
-                "metadata": {},
-                "authorization": {},
-                "response": {},
-                "callbacks": {},
-            }
-        }
+        doc = _const_doc(spec.const_identity)
         for attr in auth_attrs:
             p = _const_plan(policy, attr, doc)
             if p is None:
@@ -452,15 +465,7 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
                 return None
             vplans: List[tuple] = []
             if auth_attrs:
-                doc = {
-                    "auth": {
-                        "identity": ident_obj,
-                        "metadata": {},
-                        "authorization": {},
-                        "response": {},
-                        "callbacks": {},
-                    }
-                }
+                doc = _const_doc(ident_obj)
                 for attr in auth_attrs:
                     p = _const_plan(policy, attr, doc)
                     if p is None:
@@ -657,15 +662,7 @@ class NativeFrontend:
         from ..evaluators.response import DynamicJSON
         from ..pipeline.pipeline import AuthPipeline as _AP
 
-        doc = {
-            "auth": {
-                "identity": identity_obj,
-                "metadata": {},
-                "authorization": {},
-                "response": {},
-                "callbacks": {},
-            }
-        }
+        doc = _const_doc(identity_obj)
         results: Dict[Any, Any] = {}
         for bucket in _AP._priority_buckets(rt.response):
             for conf in bucket:
@@ -1192,15 +1189,7 @@ class NativeFrontend:
         if auth_attrs:
             if reg_policy is None:
                 return
-            doc = {
-                "auth": {
-                    "identity": obj,
-                    "metadata": {},
-                    "authorization": {},
-                    "response": {},
-                    "callbacks": {},
-                }
-            }
+            doc = _const_doc(obj)
             for attr in auth_attrs:
                 p = _const_plan(reg_policy, attr, doc)
                 if p is None:

@@ -692,3 +692,35 @@ class TestTopLevelWhenFolding:
         assert run(check("OPTIONS")) == 0
         # gate matched: credentials enforced
         assert run(check("GET")) == 16
+
+    def test_conditioned_anonymous_identity_does_not_fold(self):
+        """A conditional anonymous identity could turn gate-unmatched
+        requests from skip-OK into 401 under the fold — the gate must stay
+        on the pipeline."""
+        engine = PolicyEngine(max_batch=8, max_delay_s=0.0005, mesh=None)
+        spec = {
+            "hosts": ["gated-cond.test"],
+            "when": [{"selector": "request.method",
+                      "operator": "neq", "value": "OPTIONS"}],
+            "authentication": {"anon": {"anonymous": {}, "when": [
+                {"selector": "request.headers.x-flag",
+                 "operator": "eq", "value": "on"}]}},
+            "authorization": {"rules": {"patternMatching": {"patterns": [
+                {"selector": "request.headers.x-org",
+                 "operator": "eq", "value": "acme"}]}}},
+        }
+        entry = run(translate_auth_config("gc", "t", spec, engine=engine))
+        assert entry.runtime.conditions is not None
+        engine.apply_snapshot([entry])
+
+        async def check(method, headers=None):
+            req = CheckRequestModel(http=HttpRequestAttributes(
+                method=method, path="/x", host="gated-cond.test",
+                headers=headers or {}))
+            return (await engine.check(req)).code
+
+        # gate unmatched → skip whole pipeline → OK despite the identity's
+        # own (unmatched) conditions
+        assert run(check("OPTIONS")) == 0
+        # gate matched, identity conditions unmatched → UNAUTHENTICATED
+        assert run(check("GET", {"x-org": "acme"})) == 16

@@ -1048,6 +1048,9 @@ class TestRegoRound4:
             'h = hex.encode("hi")\n'
             'hd = hex.decode("6869")\n'
             't = time.parse_rfc3339_ns("2026-07-30T00:00:00Z")\n'
+            'tns = time.parse_rfc3339_ns("2026-07-30T00:00:00.123456789Z")\n'
+            'tus = time.parse_rfc3339_ns("2026-07-30T12:34:56.654321+00:00")\n'
+            'js = json.marshal({"b": 1, "a": 2})\n'
         )
         out = m.evaluate({})
         assert out["j"] == '{"a":[1,2]}'
@@ -1055,3 +1058,8 @@ class TestRegoRound4:
         assert out["bu"] == "aGk_" and out["bud"] == "hi?"
         assert out["h"] == "6869" and out["hd"] == "hi"
         assert out["t"] == 1785369600000000000
+        # exact integer ns — no float rounding, no sub-µs truncation
+        assert out["tns"] == 1785369600123456789
+        assert out["tus"] == 1785414896654321000
+        # Go encoding/json marshals object keys sorted
+        assert out["js"] == '{"a":2,"b":1}'
