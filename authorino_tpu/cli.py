@@ -294,21 +294,27 @@ async def run_server(args) -> None:
             loop.add_signal_handler(sig, stop.set)
         except NotImplementedError:
             pass
-    await stop.wait()
-    log.info("shutting down")
-    if status_updater is not None:
-        await status_updater.stop()
-    if source is not None:
-        await source.stop()
-    if native_fe is not None:
-        await asyncio.get_running_loop().run_in_executor(None, native_fe.stop)
-    if grpc_server is not None:
-        await grpc_server.stop(2)
-    await runner.cleanup()
-    await oidc_runner.cleanup()
-    from .utils.tracing import shutdown_tracing
+    try:
+        await stop.wait()
+    finally:
+        # runs on signal AND on task cancellation (embedders/tests cancel
+        # the serve task): the native frontend's threads must stop before
+        # interpreter teardown or they race the atexit executor shutdown
+        # (RuntimeError in the slow loop, C++ aborts mid-wait)
+        log.info("shutting down")
+        if status_updater is not None:
+            await status_updater.stop()
+        if source is not None:
+            await source.stop()
+        if native_fe is not None:
+            await asyncio.get_running_loop().run_in_executor(None, native_fe.stop)
+        if grpc_server is not None:
+            await grpc_server.stop(2)
+        await runner.cleanup()
+        await oidc_runner.cleanup()
+        from .utils.tracing import shutdown_tracing
 
-    await shutdown_tracing()  # flush the last spans to the collector
+        await shutdown_tracing()  # flush the last spans to the collector
 
 
 def main(argv=None) -> int:

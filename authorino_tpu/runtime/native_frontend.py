@@ -542,6 +542,8 @@ class NativeFrontend:
         self.hist_drain_s = 2.0
         self._last_hist_drain = 0.0
         self.stage_totals: Dict[str, Any] = {}
+        # live pre-warm/refresh helper threads (joined on stop)
+        self._prewarm_threads: List[threading.Thread] = []
 
     # ------------------------------------------------------------------
     def start(self) -> int:
@@ -596,6 +598,11 @@ class NativeFrontend:
             self._mod.fe_stop()
         for t in self._threads:
             t.join(timeout=5)
+        # pre-warm compiles can't be interrupted mid-XLA; they bail between
+        # variants (self._running) — wait them out so interpreter teardown
+        # never force-unwinds a thread inside native code
+        for t in self._prewarm_threads:
+            t.join(timeout=300)
 
     def stats(self) -> Dict[str, int]:
         return dict(self._mod.fe_stats()) if self._mod else {}
@@ -1113,8 +1120,15 @@ class NativeFrontend:
                 log.exception("jit pre-warm (swap gate) failed")
         mod.fe_swap(spec)
         if grid:
-            threading.Thread(target=self._prewarm_rest, args=(rec, grid),
-                             name="atpu-fe-prewarm", daemon=True).start()
+            # NON-daemon and tracked: a daemon thread mid-XLA-compile at
+            # interpreter exit force-unwinds through native code and aborts
+            # the process ("FATAL: exception not rethrown"); stop() joins
+            # these, and _prewarm_rest bails between variants once stopped
+            t = threading.Thread(target=self._prewarm_rest, args=(rec, grid),
+                                 name="atpu-fe-prewarm")
+            self._prewarm_threads = [
+                p for p in self._prewarm_threads if p.is_alive()] + [t]
+            t.start()
         else:
             rec.warm_done.set()
         log.info("native frontend snapshot %d: %d fast configs, %d host keys",
@@ -1127,8 +1141,15 @@ class NativeFrontend:
         refresh() blocks on the swap-gate jit compile."""
         if not self._running:
             return
-        threading.Thread(target=self.refresh, name="atpu-fe-oidc-refresh",
-                         daemon=True).start()
+        t = threading.Thread(target=self._refresh_if_running,
+                             name="atpu-fe-oidc-refresh")
+        self._prewarm_threads = [
+            p for p in self._prewarm_threads if p.is_alive()] + [t]
+        t.start()
+
+    def _refresh_if_running(self) -> None:
+        if self._running:
+            self.refresh()
 
     def _register_dyn(self, rec, entry, pipeline, model) -> None:
         """After a slow-lane pipeline run: if the config is dyn-eligible and
