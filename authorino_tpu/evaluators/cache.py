@@ -26,6 +26,16 @@ class EvaluatorCache:
         self._store: "OrderedDict[str, tuple[float, Any]]" = OrderedDict()
         self._lock = threading.Lock()
 
+    @property
+    def ttl(self) -> int:
+        return self._ttl
+
+    @property
+    def key_pattern(self) -> str:
+        """The key's selector pattern ("" for static keys) — the fast lane
+        checks it for credential equivalence."""
+        return getattr(self._key_value, "pattern", "") or ""
+
     def resolve_key_for(self, auth_json: Any) -> Optional[str]:
         from ..authjson.value import stringify_json
 

@@ -52,7 +52,7 @@ from ..compiler.pack import _trim_bytes, wire_dtype
 from ..evaluators import credentials as cred_mod
 from ..evaluators.base import DenyWithValues, RuntimeAuthConfig
 from ..evaluators.authorization import PatternMatching
-from ..evaluators.identity import APIKey, MTLS, Noop
+from ..evaluators.identity import APIKey, MTLS, Noop, OAuth2
 from ..evaluators.identity.api_key import INVALID_API_KEY_MSG
 from ..evaluators.identity.oidc import OIDC
 from ..pipeline.pipeline import AuthPipeline, AuthResult
@@ -284,6 +284,8 @@ class SourceSpec:
     idc: Any = None               # the IdentityConfig (dyn registration)
     missing_msg: str = ""         # per-source failure when credential absent
     invalid_msg: str = ""         # static: failure when the key is unknown
+    # dyn: extra TTL bound from the user's own cache opt-in (OAuth2)
+    ttl_cap: Optional[float] = None
 
 
 @dataclass
@@ -338,7 +340,11 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
     if not rt.identity or len(rt.identity) > _MAX_SOURCES:
         return None
     for idc in rt.identity:
-        if idc.conditions is not None or idc.cache is not None:
+        if idc.conditions is not None:
+            return None
+        # per-evaluator TTL caches run in the pipeline — except OAuth2's,
+        # which the dyn lane honors itself (checked in the source builder)
+        if idc.cache is not None and not isinstance(idc.evaluator, OAuth2):
             return None
         if idc.metrics or metrics_mod.DEEP_METRICS_ENABLED:
             return None  # deep per-evaluator series need the pipeline
@@ -381,6 +387,29 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
                 src = SourceSpec(name=idc.name, cred_kind=_CRED_KIND_CERT,
                                  dyn=True, idc=idc,
                                  missing_msg=MISSING_CERT_MSG)
+            elif isinstance(ident, OAuth2):
+                # opaque tokens are revocable at the AS and introspection
+                # IS the revocation check — cacheable ONLY when the user
+                # explicitly opted in via a `cache` spec keyed by the
+                # credential header (the reference's own TTL-cache
+                # semantics, ref pkg/evaluators/cache.go:16-89); the dyn
+                # entry is then bounded by that TTL (and the response exp)
+                if idc.cache is None:
+                    return None
+                kind = _CRED_KINDS.get(ident.credentials.location, 0)
+                if kind not in (1, 2):
+                    return None  # header credentials map 1:1 to cache keys
+                key_sel = ident.credentials.key_selector
+                hdr = ("authorization" if kind == 1 else key_sel.lower())
+                if idc.cache.key_pattern not in (
+                        f"request.headers.{hdr}",
+                        f"context.request.http.headers.{hdr}"):
+                    return None
+                src = SourceSpec(
+                    name=idc.name, cred_kind=kind,
+                    cred_key=key_sel.lower() if kind == 2 else key_sel,
+                    dyn=True, idc=idc, missing_msg="credential not found",
+                    ttl_cap=float(idc.cache.ttl))
             else:
                 return None  # incl. Noop mixed into a multi-identity OR
             sources.append(src)
@@ -1063,8 +1092,8 @@ class NativeFrontend:
                 "ns": ns_l,
                 "name": nm_l,
             }
-            dyn_map = {id(s.idc): i for i, s in enumerate(spec_fl.sources)
-                       if s.dyn}
+            dyn_map = {id(s.idc): (i, s.ttl_cap)
+                       for i, s in enumerate(spec_fl.sources) if s.dyn}
             if dyn_map:
                 rec.dyn_regs[entry.id] = (fc_idx, spec_fl.auth_attrs,
                                           policy_for, dyn_map)
@@ -1171,14 +1200,16 @@ class NativeFrontend:
         conf, obj = pipeline.resolved_identity()
         if obj is None:
             return
-        src_idx = src_map.get(id(conf))
-        if src_idx is None:
+        reg_src = src_map.get(id(conf))
+        if reg_src is None:
             return  # the winning identity is not a dyn source
+        src_idx, ttl_cap = reg_src
         idc = conf
         import time as _time
 
         now = _time.time()
-        deadline = now + self.dyn_ttl_s
+        deadline = now + (min(self.dyn_ttl_s, ttl_cap)
+                          if ttl_cap is not None else self.dyn_ttl_s)
         if isinstance(idc.evaluator, MTLS):
             # the raw forwarded PEM is the cache key (exactly the bytes the
             # C++ side extracts); the cert's own notAfter bounds the entry

@@ -909,6 +909,78 @@ def test_per_request_features_stay_slow():
     assert fast_lane_eligible(e3, policy) is not None
 
 
+def test_oauth2_cache_opt_in_rides_fast_lane():
+    """OAuth2 introspection identities stay slow by default (introspection
+    IS the revocation check) — but an explicit `cache` opt-in keyed by the
+    credential header makes the dyn lane honor the user's own TTL
+    semantics (round 4): hits serve natively, entries expire at cache.ttl,
+    and post-TTL revocation is enforced."""
+    from authorino_tpu.evaluators.cache import EvaluatorCache
+    from authorino_tpu.evaluators.identity import OAuth2
+
+    holder, t = run_fake_idp()
+    idp = holder["idp"]
+    try:
+        engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+        url = f"{idp.issuer}/introspect"
+        no_cache = OAuth2("oa", url, "cid", "csec")
+        cached = OAuth2("oa", url, "cid", "csec")
+        entries = [
+            EngineEntry(
+                id="ns/oauth-nocache", hosts=["oauth-nocache.test"],
+                runtime=RuntimeAuthConfig(
+                    labels={"namespace": "ns", "name": "oauth-nocache"},
+                    identity=[IdentityConfig("oa", no_cache)]),
+                rules=None),
+            EngineEntry(
+                id="ns/oauth", hosts=["oauth.test"],
+                runtime=RuntimeAuthConfig(
+                    labels={"namespace": "ns", "name": "oauth"},
+                    identity=[IdentityConfig(
+                        "oa", cached,
+                        cache=EvaluatorCache(JSONValue(
+                            pattern="request.headers.authorization"), 1))]),
+                rules=None),
+        ]
+        engine.apply_snapshot(entries)
+        snap = engine._snapshot
+        assert fast_lane_eligible(snap.by_id["ns/oauth-nocache"],
+                                  snap.policy) is None
+        spec = fast_lane_eligible(snap.by_id["ns/oauth"], snap.policy)
+        assert spec is not None and spec.sources[0].dyn
+        assert spec.sources[0].ttl_cap == 1.0
+
+        fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
+        port = fe.start()
+        try:
+            hdr = {"authorization": "Bearer opaque-token-1"}
+            r1 = grpc_call(port, make_req("oauth.test", headers=hdr))
+            assert r1.status.code == 0  # slow: introspected + registered
+            r2 = grpc_call(port, make_req("oauth.test", headers=hdr))
+            assert r2.status.code == 0
+            assert fe.stats()["dyn_hit"] >= 1
+            # the no-cache config always introspects (slow lane)
+            slow_before = fe.stats()["slow"]
+            n1 = grpc_call(port, make_req("oauth-nocache.test", headers=hdr))
+            n2 = grpc_call(port, make_req("oauth-nocache.test", headers=hdr))
+            assert n1.status.code == 0 and n2.status.code == 0
+            assert fe.stats()["slow"] >= slow_before + 2
+
+            # revocation takes effect once the user's TTL lapses: the dyn
+            # entry AND the pipeline cache both expire at cache.ttl = 1s
+            idp.active_tokens["opaque-token-1"] = {"active": False}
+            r3 = grpc_call(port, make_req("oauth.test", headers=hdr))
+            assert r3.status.code == 0  # within TTL: the opted-in window
+            time.sleep(1.3)
+            r4 = grpc_call(port, make_req("oauth.test", headers=hdr))
+            assert r4.status.code == 16  # re-introspected: revoked
+        finally:
+            fe.stop()
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
+
+
 def test_stop_drains_inflight_slow_requests():
     """fe.stop() while slow-lane requests are in flight must complete them
     before the loop closes — a cancelled handler would leave its client
