@@ -52,7 +52,7 @@ from ..compiler.pack import _trim_bytes, wire_dtype
 from ..evaluators import credentials as cred_mod
 from ..evaluators.base import DenyWithValues, RuntimeAuthConfig
 from ..evaluators.authorization import PatternMatching
-from ..evaluators.identity import APIKey, MTLS, Noop, OAuth2
+from ..evaluators.identity import APIKey, KubernetesAuth, MTLS, Noop, OAuth2
 from ..evaluators.identity.api_key import INVALID_API_KEY_MSG
 from ..evaluators.identity.oidc import OIDC
 from ..pipeline.pipeline import AuthPipeline, AuthResult
@@ -342,9 +342,12 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
     for idc in rt.identity:
         if idc.conditions is not None:
             return None
-        # per-evaluator TTL caches run in the pipeline — except OAuth2's,
-        # which the dyn lane honors itself (checked in the source builder)
-        if idc.cache is not None and not isinstance(idc.evaluator, OAuth2):
+        # per-evaluator TTL caches run in the pipeline — except for the
+        # revocable-credential identities (OAuth2 introspection, K8s
+        # TokenReview), whose opt-in caches the dyn lane honors itself
+        # (checked in the source builder)
+        if idc.cache is not None and not isinstance(
+                idc.evaluator, (OAuth2, KubernetesAuth)):
             return None
         if idc.metrics or metrics_mod.DEEP_METRICS_ENABLED:
             return None  # deep per-evaluator series need the pipeline
@@ -387,15 +390,17 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
                 src = SourceSpec(name=idc.name, cred_kind=_CRED_KIND_CERT,
                                  dyn=True, idc=idc,
                                  missing_msg=MISSING_CERT_MSG)
-            elif isinstance(ident, OAuth2):
-                # opaque tokens are revocable at the AS and introspection
-                # IS the revocation check — cacheable ONLY when the user
+            elif isinstance(ident, (OAuth2, KubernetesAuth)):
+                # revocable credentials: the AS/apiserver check IS the
+                # revocation check — cacheable ONLY when the user
                 # explicitly opted in via a `cache` spec keyed by the
                 # credential header (the reference's own TTL-cache
                 # semantics, ref pkg/evaluators/cache.go:16-89); the dyn
-                # entry is then bounded by that TTL (and the response exp)
+                # entry is then bounded by that TTL (and a response exp)
                 if idc.cache is None:
                     return None
+                if isinstance(ident, KubernetesAuth) and not ident.audiences:
+                    return None  # default audience is the REQUEST host
                 kind = _CRED_KINDS.get(ident.credentials.location, 0)
                 if kind not in (1, 2):
                     return None  # header credentials map 1:1 to cache keys

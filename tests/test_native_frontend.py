@@ -981,6 +981,74 @@ def test_oauth2_cache_opt_in_rides_fast_lane():
         t.join(timeout=10)
 
 
+def test_k8s_tokenreview_cache_opt_in_rides_fast_lane():
+    """K8s TokenReview under an explicit cache opt-in (and explicit
+    audiences — the default audience is the request host, which would vary
+    per request): first review slow, repeats native, patterns over the
+    reviewed user resolve from the cached identity."""
+    from authorino_tpu.evaluators.cache import EvaluatorCache
+    from authorino_tpu.evaluators.identity import KubernetesAuth
+    from authorino_tpu.k8s import InMemoryCluster
+
+    cluster = InMemoryCluster()
+    cluster.token_reviews["sa-token"] = {"status": {
+        "authenticated": True,
+        "user": {"username": "system:serviceaccount:ns:app",
+                 "groups": ["system:authenticated"]}}}
+    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    ka = KubernetesAuth("k8s", audiences=["talker-api"], cluster=cluster)
+    rule = Pattern("auth.identity.username", Operator.EQ,
+                   "system:serviceaccount:ns:app")
+    pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/k8s"),
+                         evaluator_slot=0)
+    entries = [
+        EngineEntry(
+            id="ns/k8s", hosts=["k8s.test"],
+            runtime=RuntimeAuthConfig(
+                labels={"namespace": "ns", "name": "k8s"},
+                identity=[IdentityConfig(
+                    "k8s", ka,
+                    cache=EvaluatorCache(JSONValue(
+                        pattern="request.headers.authorization"), 60))],
+                authorization=[AuthorizationConfig("rules", pm)]),
+            rules=ConfigRules(name="ns/k8s", evaluators=[(None, rule)])),
+        # no explicit audiences → host-dependent review → ineligible
+        EngineEntry(
+            id="ns/k8s-hostaud", hosts=["k8s-hostaud.test"],
+            runtime=RuntimeAuthConfig(
+                labels={"namespace": "ns", "name": "k8s-hostaud"},
+                identity=[IdentityConfig(
+                    "k8s", KubernetesAuth("k8s", cluster=cluster),
+                    cache=EvaluatorCache(JSONValue(
+                        pattern="request.headers.authorization"), 60))]),
+            rules=None),
+    ]
+    engine.apply_snapshot(entries)
+    snap = engine._snapshot
+    assert fast_lane_eligible(snap.by_id["ns/k8s"], snap.policy) is not None
+    assert fast_lane_eligible(snap.by_id["ns/k8s-hostaud"], snap.policy) is None
+
+    fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
+    port = fe.start()
+    holder, t = run_python_server(engine)
+    try:
+        hdr = {"authorization": "Bearer sa-token"}
+        r1 = grpc_call(port, make_req("k8s.test", headers=hdr))
+        r2 = grpc_call(port, make_req("k8s.test", headers=hdr))
+        assert r1.status.code == 0 and r2.status.code == 0
+        assert fe.stats()["dyn_hit"] >= 1
+        for rq in (make_req("k8s.test", headers=hdr),
+                   make_req("k8s.test", headers={"authorization": "Bearer bad"}),
+                   make_req("k8s.test")):
+            native = response_key(grpc_call(port, rq))
+            python = response_key(grpc_call(holder["port"], rq))
+            assert native == python, (native, python)
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
+        fe.stop()
+
+
 def test_stop_drains_inflight_slow_requests():
     """fe.stop() while slow-lane requests are in flight must complete them
     before the loop closes — a cancelled handler would leave its client
