@@ -300,21 +300,31 @@ async def run_server(args) -> None:
         # runs on signal AND on task cancellation (embedders/tests cancel
         # the serve task): the native frontend's threads must stop before
         # interpreter teardown or they race the atexit executor shutdown
-        # (RuntimeError in the slow loop, C++ aborts mid-wait)
+        # (RuntimeError in the slow loop, C++ aborts mid-wait).  Every step
+        # is isolated — a second cancellation or one failing stop must not
+        # skip the remaining teardown (esp. native_fe.stop)
         log.info("shutting down")
+
+        async def best_effort(awaitable) -> None:
+            try:
+                await asyncio.shield(asyncio.ensure_future(awaitable))
+            except (Exception, asyncio.CancelledError) as e:
+                log.warning("shutdown step failed: %r", e)
+
         if status_updater is not None:
-            await status_updater.stop()
+            await best_effort(status_updater.stop())
         if source is not None:
-            await source.stop()
+            await best_effort(source.stop())
         if native_fe is not None:
-            await asyncio.get_running_loop().run_in_executor(None, native_fe.stop)
+            await best_effort(asyncio.get_running_loop().run_in_executor(
+                None, native_fe.stop))
         if grpc_server is not None:
-            await grpc_server.stop(2)
-        await runner.cleanup()
-        await oidc_runner.cleanup()
+            await best_effort(grpc_server.stop(2))
+        await best_effort(runner.cleanup())
+        await best_effort(oidc_runner.cleanup())
         from .utils.tracing import shutdown_tracing
 
-        await shutdown_tracing()  # flush the last spans to the collector
+        await best_effort(shutdown_tracing())  # flush the last spans
 
 
 def main(argv=None) -> int:

@@ -44,6 +44,19 @@ class EvaluatorCache:
             return None
         return stringify_json(key)
 
+    def remaining(self, key: Optional[str]) -> Optional[float]:
+        """Seconds until this key's entry expires, or None when absent/
+        expired — the fast lane bounds its dyn entries by it so a
+        cache-hit re-registration never extends the opted-in window."""
+        if key is None:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            hit = self._store.get(key)
+            if hit is None or now >= hit[0]:
+                return None
+            return hit[0] - now
+
     def get(self, key: Optional[str]) -> Optional[Any]:
         if key is None:
             return None

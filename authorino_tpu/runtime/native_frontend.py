@@ -284,7 +284,8 @@ class SourceSpec:
     idc: Any = None               # the IdentityConfig (dyn registration)
     missing_msg: str = ""         # per-source failure when credential absent
     invalid_msg: str = ""         # static: failure when the key is unknown
-    # dyn: extra TTL bound from the user's own cache opt-in (OAuth2)
+    # dyn: extra TTL bound from the user's own cache opt-in (OAuth2
+    # introspection / K8s TokenReview)
     ttl_cap: Optional[float] = None
 
 
@@ -531,10 +532,11 @@ class _SnapRec:
     warm: set = field(default_factory=set)
     warm_done: threading.Event = field(default_factory=threading.Event)
     # configs with dyn sources: entry.id → (fc_idx, auth_attrs, policy,
-    # {id(IdentityConfig): source idx}) — the slow lane registers verified-
-    # credential plan variants against this snapshot (policy = the entry's
-    # OWN compile: its shard's on a mesh)
-    dyn_regs: Dict[str, Tuple[int, List[int], Any, Dict[int, int]]] = field(
+    # {id(IdentityConfig): (source idx, ttl cap)}) — the slow lane
+    # registers verified-credential plan variants against this snapshot
+    # (policy = the entry's OWN compile: its shard's on a mesh)
+    dyn_regs: Dict[str, Tuple[int, List[int], Any,
+                              Dict[int, Tuple[int, Optional[float]]]]] = field(
         default_factory=dict)
 
 
@@ -576,8 +578,10 @@ class NativeFrontend:
         self.hist_drain_s = 2.0
         self._last_hist_drain = 0.0
         self.stage_totals: Dict[str, Any] = {}
-        # live pre-warm/refresh helper threads (joined on stop)
+        # live pre-warm/refresh helper threads (joined on stop); own lock —
+        # trackers run both under _lock (refresh) and without it (notifier)
         self._prewarm_threads: List[threading.Thread] = []
+        self._thread_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def start(self) -> int:
@@ -635,7 +639,9 @@ class NativeFrontend:
         # pre-warm compiles can't be interrupted mid-XLA; they bail between
         # variants (self._running) — wait them out so interpreter teardown
         # never force-unwinds a thread inside native code
-        for t in self._prewarm_threads:
+        with self._thread_lock:
+            helpers = list(self._prewarm_threads)
+        for t in helpers:
             t.join(timeout=300)
 
     def stats(self) -> Dict[str, int]:
@@ -1158,11 +1164,9 @@ class NativeFrontend:
             # interpreter exit force-unwinds through native code and aborts
             # the process ("FATAL: exception not rethrown"); stop() joins
             # these, and _prewarm_rest bails between variants once stopped
-            t = threading.Thread(target=self._prewarm_rest, args=(rec, grid),
-                                 name="atpu-fe-prewarm")
-            self._prewarm_threads = [
-                p for p in self._prewarm_threads if p.is_alive()] + [t]
-            t.start()
+            self._track_thread(threading.Thread(
+                target=self._prewarm_rest, args=(rec, grid),
+                name="atpu-fe-prewarm"))
         else:
             rec.warm_done.set()
         log.info("native frontend snapshot %d: %d fast configs, %d host keys",
@@ -1175,15 +1179,21 @@ class NativeFrontend:
         refresh() blocks on the swap-gate jit compile."""
         if not self._running:
             return
-        t = threading.Thread(target=self._refresh_if_running,
-                             name="atpu-fe-oidc-refresh")
-        self._prewarm_threads = [
-            p for p in self._prewarm_threads if p.is_alive()] + [t]
-        t.start()
+        self._track_thread(threading.Thread(target=self._refresh_if_running,
+                                            name="atpu-fe-oidc-refresh"))
 
     def _refresh_if_running(self) -> None:
         if self._running:
             self.refresh()
+
+    def _track_thread(self, t: threading.Thread) -> None:
+        """Register-then-start a compile-bearing helper thread under its
+        own lock (callers run both with and without _lock) — a dropped
+        entry would escape stop()'s join and race interpreter teardown."""
+        with self._thread_lock:
+            self._prewarm_threads = [
+                p for p in self._prewarm_threads if p.is_alive()] + [t]
+        t.start()
 
     def _register_dyn(self, rec, entry, pipeline, model) -> None:
         """After a slow-lane pipeline run: if the config is dyn-eligible and
@@ -1213,8 +1223,21 @@ class NativeFrontend:
         import time as _time
 
         now = _time.time()
-        deadline = now + (min(self.dyn_ttl_s, ttl_cap)
-                          if ttl_cap is not None else self.dyn_ttl_s)
+        ttl = self.dyn_ttl_s
+        if ttl_cap is not None:
+            # the opted-in window is anchored at the LAST REAL check: a
+            # registration off a pipeline-cache hit must not restart the
+            # clock (revocation would slip past cache.ttl otherwise)
+            ttl = min(ttl, ttl_cap)
+            if idc.cache is not None:
+                try:
+                    rem = idc.cache.remaining(idc.cache.resolve_key_for(
+                        pipeline.authorization_json()))
+                except Exception:
+                    rem = None
+                if rem is not None:
+                    ttl = min(ttl, rem)
+        deadline = now + ttl
         if isinstance(idc.evaluator, MTLS):
             # the raw forwarded PEM is the cache key (exactly the bytes the
             # C++ side extracts); the cert's own notAfter bounds the entry
