@@ -940,6 +940,30 @@ def _builtin(fn: str, args: List[Any]) -> Any:
             if fn == "hex.encode":
                 return s.encode().hex()
             return bytes.fromhex(s).decode()
+        if fn in ("crypto.md5", "crypto.sha1", "crypto.sha256"):
+            import hashlib
+
+            algo = fn.split(".", 1)[1]
+            return getattr(hashlib, algo)(str(args[0]).encode()).hexdigest()
+        if fn == "units.parse_bytes":
+            s = str(args[0]).strip().upper()
+            m = re.fullmatch(r"([0-9.]+)\s*([KMGTPE]I?B?|B?)", s)
+            if not m:
+                raise RegoError(f"units.parse_bytes: cannot parse {s!r}")
+            num, unit = float(m.group(1)), m.group(2)
+            if unit.startswith(("K", "M", "G", "T", "P", "E")):
+                exp = "KMGTPE".index(unit[0]) + 1
+                base = 1024 if "I" in unit else 1000
+                num *= base ** exp
+            if not num.is_integer():
+                raise RegoError("units.parse_bytes: fractional byte count")
+            return int(num)
+        if fn == "regex.split":
+            return re.split(args[0], args[1])
+        if fn == "regex.replace":
+            # OPA wraps Go ReplaceAllString: $1-style group refs → \\1
+            repl = re.sub(r"\$(\d+)", r"\\\1", args[2])
+            return re.sub(args[0], repl, args[1])
         if fn == "time.parse_rfc3339_ns":
             # exact integer ns: float timestamp math would corrupt sub-µs
             # digits (and fromisoformat silently truncates past 6)
@@ -1096,16 +1120,18 @@ def _builtin(fn: str, args: List[Any]) -> Any:
 _BUILTIN_NAMES = frozenset({
     "abs", "array.concat", "array.reverse", "array.slice",
     "base64.decode", "base64.encode", "base64url.decode", "base64url.encode",
-    "base64url.encode_no_pad", "concat", "contains", "count", "endswith",
+    "base64url.encode_no_pad", "concat", "contains", "count",
+    "crypto.md5", "crypto.sha1", "crypto.sha256", "endswith",
     "format_int", "glob.match", "hex.decode", "hex.encode", "indexof",
     "intersection", "is_array", "is_boolean", "is_null", "is_number",
     "is_object", "is_string", "json.marshal", "json.unmarshal", "lower",
     "max", "min", "numbers.range", "object.filter", "object.get",
     "object.keys", "object.remove", "object.union", "regex.match",
-    "re_match", "replace", "sort", "split", "sprintf", "startswith",
-    "strings.reverse", "substring", "sum", "time.now_ns",
-    "time.parse_rfc3339_ns", "to_number", "trim", "trim_prefix",
-    "trim_suffix", "union", "upper", "walk",
+    "regex.replace", "regex.split", "re_match", "replace", "sort", "split",
+    "sprintf", "startswith", "strings.reverse", "substring", "sum",
+    "time.now_ns", "time.parse_rfc3339_ns", "to_number", "trim",
+    "trim_prefix", "trim_suffix", "union", "units.parse_bytes", "upper",
+    "walk",
 })
 
 

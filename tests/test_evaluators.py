@@ -1063,3 +1063,23 @@ class TestRegoRound4:
         assert out["tus"] == 1785414896654321000
         # Go encoding/json marshals object keys sorted
         assert out["js"] == '{"a":2,"b":1}'
+
+    def test_crypto_units_regex_builtins(self):
+        m = compile_module(
+            'h = crypto.sha256("hello")\n'
+            'h1 = crypto.sha1("hello")\n'
+            'h5 = crypto.md5("hello")\n'
+            'b = units.parse_bytes("10MiB")\n'
+            'b2 = units.parse_bytes("2K")\n'
+            'parts = regex.split("[,;] ?", "a,b; c")\n'
+            'rep = regex.replace("a(b+)c", "xabbcy", "<$1>")\n'
+        )
+        out = m.evaluate({})
+        assert out["h"] == ("2cf24dba5fb0a30e26e83b2ac5b9e29e"
+                            "1b161e5c1fa7425e73043362938b9824")
+        assert out["h1"] == "aaf4c61ddcc5e8a2dabede0f3b482cd9aea9434d"
+        assert out["h5"] == "5d41402abc4b2a76b9719d911017c592"
+        assert out["b"] == 10 * 1024 * 1024
+        assert out["b2"] == 2000
+        assert out["parts"] == ["a", "b", "c"]
+        assert out["rep"] == "x<bb>y"
