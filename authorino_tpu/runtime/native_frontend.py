@@ -257,6 +257,19 @@ def _deny_with_static(dw: Optional[DenyWithValues]) -> bool:
     return all(_static_value(h.value) for h in dw.headers)
 
 
+def _deny_with_const(dw: Optional[DenyWithValues]) -> bool:
+    """True when every denyWith value is constant per identity outcome:
+    static, or templated over the constant auth.* subtrees — then the
+    denial bytes precompute per credential variant (identity-failure
+    templates resolve against the empty doc, where auth-only selectors are
+    constantly missing, exactly like the pipeline's identity-None doc)."""
+    if dw is None:
+        return True
+    vals = [dw.message, dw.body] + [h.value for h in dw.headers]
+    return all(v is None or _static_value(v) or _auth_only_value(v)
+               for v in vals)
+
+
 # AuthCredentials location → C++ CredKind (native/frontend.cpp)
 _CRED_KINDS = {
     cred_mod.LOCATION_AUTH_HEADER: 1,
@@ -314,6 +327,9 @@ class FastLaneSpec:
     # anonymous configs: the (possibly extended) constant identity object —
     # response templates resolve against it at swap time
     const_identity: Any = None
+    # unauthorized denyWith carries identity-templated values → per-variant
+    # DENY bytes must be built (else the config-default static deny serves)
+    deny_templated: bool = False
 
 
 # bounds on the identity-source fan-out the C++ lane carries: the all-fail
@@ -421,9 +437,10 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
             sources.append(src)
         if sum(1 for s in sources if not s.dyn) > _MAX_STATIC_SOURCES:
             return None
-        # all-fail answers come from static templates — the identity-failure
-        # denyWith must resolve without a request doc
-        if not _deny_with_static(rt.deny_with.unauthenticated):
+        # all-fail answers come from constant templates — the identity-
+        # failure denyWith must resolve without a request doc (auth-only
+        # values are constantly missing there, like the pipeline's)
+        if not _deny_with_const(rt.deny_with.unauthenticated):
             return None
 
     plans: List[tuple] = []
@@ -446,7 +463,7 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
                 return None
             if conf.metrics:
                 return None
-        if not _deny_with_static(rt.deny_with.unauthorized):
+        if not _deny_with_const(rt.deny_with.unauthorized):
             return None
         # per-request regex/tree oracles cannot run in C++
         for leaf in policy.config_cpu_leaves[row]:
@@ -471,7 +488,9 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
         return None  # compiled rules without runtime authz configs: engine bug
 
     spec = FastLaneSpec(plans=plans, has_batch=has_batch, sources=sources,
-                        auth_attrs=auth_attrs)
+                        auth_attrs=auth_attrs,
+                        deny_templated=has_batch and not _deny_with_static(
+                            rt.deny_with.unauthorized))
     if is_noop:
         try:
             spec.const_identity = _extend_identity(rt.identity[0],
@@ -507,9 +526,10 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
                         return None
                     vplans.append(p)
             # the identity object rides along so refresh can precompute the
-            # per-key OK response bytes for response-template configs
-            src.variants.append((key.encode("utf-8"), vplans,
-                                 ident_obj if rt.response else None))
+            # per-key OK/DENY bytes for response/denyWith-template configs
+            src.variants.append((
+                key.encode("utf-8"), vplans,
+                ident_obj if (rt.response or spec.deny_templated) else None))
     return spec
 
 
@@ -664,31 +684,38 @@ class NativeFrontend:
 
     @staticmethod
     def _static_deny(code: int, message: str, headers: List[Dict[str, str]],
-                     deny: Optional[DenyWithValues]) -> AuthResult:
-        """Static mirror of pipeline._customize_deny_with
+                     deny: Optional[DenyWithValues],
+                     doc: Optional[Dict[str, Any]] = None) -> AuthResult:
+        """Constant mirror of pipeline._customize_deny_with
         (ref pkg/service/auth_pipeline.go:581-608): the denyWith values are
-        pre-checked static, so they resolve against an empty doc."""
+        pre-checked constant for ``doc`` (static, or auth-only against a
+        const identity doc; the default empty doc serves identity-failure
+        templates, where auth-only selectors are constantly missing)."""
         from ..authjson.value import stringify_json
 
+        doc = doc or {}
         result = AuthResult(code=code, message=message, headers=headers)
         if deny is not None:
             if deny.code:
                 result.status = deny.code
             if deny.message is not None:
-                result.message = stringify_json(deny.message.resolve_for({}))
+                result.message = stringify_json(deny.message.resolve_for(doc))
             if deny.body is not None:
-                result.body = stringify_json(deny.body.resolve_for({}))
+                result.body = stringify_json(deny.body.resolve_for(doc))
             if deny.headers:
                 result.headers = [
-                    {h.name: stringify_json(h.value.resolve_for({}))}
+                    {h.name: stringify_json(h.value.resolve_for(doc))}
                     for h in deny.headers
                 ]
         return result
 
-    def _deny_result(self, rt: RuntimeAuthConfig) -> AuthResult:
-        """Authorization-failure template (ref pkg/service/auth_pipeline.go:478-481)."""
+    def _deny_result(self, rt: RuntimeAuthConfig,
+                     identity_obj: Any = None) -> AuthResult:
+        """Authorization-failure template, optionally resolved against a
+        constant identity (ref pkg/service/auth_pipeline.go:478-481)."""
         return self._static_deny(
-            PERMISSION_DENIED, "Unauthorized", [], rt.deny_with.unauthorized)
+            PERMISSION_DENIED, "Unauthorized", [], rt.deny_with.unauthorized,
+            doc=_const_doc(identity_obj) if identity_obj is not None else None)
 
     def _unauth_result(self, rt: RuntimeAuthConfig, message: str) -> AuthResult:
         """Identity-failure template: UNAUTHENTICATED + WWW-Authenticate
@@ -1074,16 +1101,21 @@ class NativeFrontend:
             lbl = entry.runtime.labels or {}
             ns_l, nm_l = lbl.get("namespace", ""), lbl.get("name", "")
             rt_e = entry.runtime
-            # response-template configs: OK bytes are per identity outcome
-            # (anonymous at swap; per-key at swap; per-credential at dyn
-            # registration) — empty ok in a variant = the config default
+            # response/denyWith-template configs: OK and DENY bytes are per
+            # identity outcome (anonymous at swap; per-key at swap; per-
+            # credential at dyn registration) — empty bytes in a variant =
+            # the config default
             fc_ok = (self._ok_bytes_for(rt_e, spec_fl.const_identity)
                      if rt_e.response and not spec_fl.sources else ok_bytes)
+            fc_deny = self._result_bytes(self._deny_result(
+                rt_e,
+                spec_fl.const_identity
+                if spec_fl.deny_templated and not spec_fl.sources else None))
             fc = {
                 "row": 0,
                 "has_batch": 1 if spec_fl.has_batch else 0,
                 "ok": fc_ok,
-                "deny": self._result_bytes(self._deny_result(rt_e)),
+                "deny": fc_deny,
                 "plans": spec_fl.plans,
                 "sources": [
                     {
@@ -1093,7 +1125,12 @@ class NativeFrontend:
                         "variants": [
                             (key, vplans,
                              self._ok_bytes_for(rt_e, ident_obj)
-                             if ident_obj is not None else b"")
+                             if ident_obj is not None and rt_e.response
+                             else b"",
+                             self._result_bytes(
+                                 self._deny_result(rt_e, ident_obj))
+                             if ident_obj is not None
+                             and spec_fl.deny_templated else b"")
                             for key, vplans, ident_obj in s.variants
                         ],
                     }
@@ -1275,15 +1312,20 @@ class NativeFrontend:
                 if p is None:
                     return  # this token's values don't fit the compact payload
                 vplans.append(p)
+        rt_e = entry.runtime
         ok_bytes = b""
-        if entry.runtime.response:
-            try:
-                ok_bytes = self._ok_bytes_for(entry.runtime, obj)
-            except Exception:
-                return  # this credential's response doesn't template: slow
+        deny_bytes = b""
+        try:
+            if rt_e.response:
+                ok_bytes = self._ok_bytes_for(rt_e, obj)
+            if rt_e.authorization and not _deny_with_static(
+                    rt_e.deny_with.unauthorized):
+                deny_bytes = self._result_bytes(self._deny_result(rt_e, obj))
+        except Exception:
+            return  # this credential's templates don't resolve: stay slow
         self._mod.fe_add_variant(rec.snap_id, fc_idx, src_idx,
                                  token.encode("utf-8"), vplans, ok_bytes,
-                                 int(deadline * 1e9))
+                                 deny_bytes, int(deadline * 1e9))
 
     # ------------------------------------------------------------------
     def _fold_fc_counts(self) -> None:

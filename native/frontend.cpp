@@ -383,6 +383,8 @@ struct VarEnt {
   int32_t ok_idx = -1;          // var_oks index (per-identity OK response
                                 // bytes — response-template configs); -1 =
                                 // the config's default OK
+  int32_t deny_idx = -1;        // var_denies index (per-identity DENY bytes
+                                // — denyWith templates over the identity)
 };
 
 // one identity source of a config (multi-identity configs carry several,
@@ -397,6 +399,7 @@ struct CredSource {
   std::unordered_map<std::string, VarEnt> variants;
   std::deque<std::vector<FastPlan>> var_plans;       // deque: stable refs
   std::deque<std::string> var_oks;                   // per-key OK bytes
+  std::deque<std::string> var_denies;                // per-key DENY bytes
   // dyn (OIDC/JWT, mTLS): the variant map is a verified-credential cache
   // registered at runtime by the slow lane.  Entries hold their plans by
   // shared_ptr so overwrites and expiry sweeps reclaim memory immediately
@@ -405,9 +408,10 @@ struct CredSource {
   struct DynVar {
     std::shared_ptr<const std::vector<FastPlan>> plans;
     int64_t exp_ns;
-    // per-credential OK response bytes (response-template configs);
-    // null = the config's default OK
+    // per-credential OK / DENY response bytes (response / denyWith
+    // templates over the identity); null = the config's defaults
     std::shared_ptr<const std::string> ok;
+    std::shared_ptr<const std::string> deny;
   };
   std::unordered_map<std::string, DynVar> dyn_variants;
 };
@@ -489,10 +493,12 @@ struct Entry {
   int32_t stream_id;
   int32_t fc;
   int64_t t_enq;  // CLOCK_MONOTONIC at encode time (stage/duration hists)
-  // per-identity OK response override (response-template configs);
-  // ok_hold keeps a dyn variant's bytes alive until completion
+  // per-identity OK / DENY response overrides (response + denyWith
+  // templates); the _hold fields keep dyn bytes alive until completion
   const std::string* ok_msg = nullptr;
   std::shared_ptr<const std::string> ok_hold;
+  const std::string* deny_msg = nullptr;
+  std::shared_ptr<const std::string> deny_hold;
 };
 
 struct Slot {
@@ -1190,9 +1196,11 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
   // keeps a dyn variant's plan vector alive across encode_fast after the
   // variant lock is released (overwrites/sweeps may drop the map entry)
   std::shared_ptr<const std::vector<FastPlan>> dyn_hold;
-  // the winning identity's OK response override (response-template configs)
+  // the winning identity's OK/DENY response overrides (template configs)
   const std::string* ok_override = nullptr;
   std::shared_ptr<const std::string> ok_hold;
+  const std::string* deny_override = nullptr;
+  std::shared_ptr<const std::string> deny_hold;
   if (!fc.sources.empty()) {
     // identity is an OR over the sources, tried in the pipeline's
     // priority-then-declaration order: the first source whose credential
@@ -1224,6 +1232,10 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
               ok_hold = vit->second.ok;
               ok_override = ok_hold.get();
             }
+            if (vit->second.deny) {
+              deny_hold = vit->second.deny;
+              deny_override = deny_hold.get();
+            }
           }
         }
         if (extra == nullptr) {
@@ -1243,6 +1255,8 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
         extra = &src.var_plans[vit->second.idx];
         if (vit->second.ok_idx >= 0)
           ok_override = &src.var_oks[vit->second.ok_idx];
+        if (vit->second.deny_idx >= 0)
+          deny_override = &src.var_denies[vit->second.deny_idx];
         authenticated = true;
         break;
       }
@@ -1290,7 +1304,8 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
     return;
   }
   snap->slot_entries[S->fill_slot].push_back(
-      {c->id, stream_id, fc_idx, t_start, ok_override, std::move(ok_hold)});
+      {c->id, stream_id, fc_idx, t_start, ok_override, std::move(ok_hold),
+       deny_override, std::move(deny_hold)});
   S->fill_count++;
   S->n_fast.fetch_add(1, std::memory_order_relaxed);
   if (S->fill_count >= S->bmax) flush_batch(S);
@@ -1742,7 +1757,9 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
       allowed += ok;
       S->done_q.push_back(
           {e.conn_id, e.stream_id,
-           ok ? (e.ok_msg ? *e.ok_msg : fc.ok_msg) : fc.deny_msg, 0, t_now});
+           ok ? (e.ok_msg ? *e.ok_msg : fc.ok_msg)
+              : (e.deny_msg ? *e.deny_msg : fc.deny_msg),
+           0, t_now});
     }
     snap->free_slots.push_back(slot);
     snap->pending_batches--;
@@ -1780,7 +1797,7 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
 static bool add_variant(Server* S, int64_t snap_id, int32_t fc_idx,
                         int32_t src_idx, std::string cred,
                         std::vector<FastPlan> plans, std::string ok_bytes,
-                        int64_t exp_ns) {
+                        std::string deny_bytes, int64_t exp_ns) {
   std::shared_ptr<Snapshot> snap;
   {
     std::lock_guard<std::mutex> lk(S->mu);
@@ -1797,6 +1814,9 @@ static bool add_variant(Server* S, int64_t snap_id, int32_t fc_idx,
   std::shared_ptr<const std::string> ok;
   if (!ok_bytes.empty())
     ok = std::make_shared<const std::string>(std::move(ok_bytes));
+  std::shared_ptr<const std::string> deny;
+  if (!deny_bytes.empty())
+    deny = std::make_shared<const std::string>(std::move(deny_bytes));
   {
     std::lock_guard<std::mutex> vlk(snap->var_mu);
     auto it = src.dyn_variants.find(cred);
@@ -1812,11 +1832,12 @@ static bool add_variant(Server* S, int64_t snap_id, int32_t fc_idx,
       it = src.dyn_variants.end();
     }
     if (it != src.dyn_variants.end())
-      it->second = {std::move(sp), exp_ns, std::move(ok)};
+      it->second = {std::move(sp), exp_ns, std::move(ok), std::move(deny)};
     else
       src.dyn_variants.emplace(
           std::move(cred),
-          CredSource::DynVar{std::move(sp), exp_ns, std::move(ok)});
+          CredSource::DynVar{std::move(sp), exp_ns, std::move(ok),
+                             std::move(deny)});
   }
   S->n_dyn_add.fetch_add(1, std::memory_order_relaxed);
   return true;

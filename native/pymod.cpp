@@ -461,12 +461,14 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
       dict_str(sd, "cred_key", src.cred_key);
       PyObject* vars = PyDict_GetItemString(sd, "variants");
       for (Py_ssize_t k = 0; vars != nullptr && k < PyList_GET_SIZE(vars); ++k) {
-        // (key_bytes, plans, ok_bytes) — empty ok = the config default
+        // (key_bytes, plans, ok_bytes, deny_bytes) — empty = config default
         PyObject* kv = PyList_GET_ITEM(vars, k);
         PyObject* kb = PyTuple_GET_ITEM(kv, 0);
         PyObject* okb = PyTuple_GET_SIZE(kv) > 2 ? PyTuple_GET_ITEM(kv, 2) : nullptr;
-        if (!PyBytes_Check(kb) || (okb != nullptr && !PyBytes_Check(okb))) {
-          PyErr_SetString(PyExc_TypeError, "variant key/ok must be bytes");
+        PyObject* dnb = PyTuple_GET_SIZE(kv) > 3 ? PyTuple_GET_ITEM(kv, 3) : nullptr;
+        if (!PyBytes_Check(kb) || (okb != nullptr && !PyBytes_Check(okb)) ||
+            (dnb != nullptr && !PyBytes_Check(dnb))) {
+          PyErr_SetString(PyExc_TypeError, "variant key/ok/deny must be bytes");
           return nullptr;
         }
         std::vector<fe::FastPlan> vp;
@@ -479,9 +481,15 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
           src.var_oks.emplace_back(PyBytes_AS_STRING(okb),
                                    (size_t)PyBytes_GET_SIZE(okb));
         }
+        int32_t deny_idx = -1;
+        if (dnb != nullptr && PyBytes_GET_SIZE(dnb) > 0) {
+          deny_idx = (int32_t)src.var_denies.size();
+          src.var_denies.emplace_back(PyBytes_AS_STRING(dnb),
+                                      (size_t)PyBytes_GET_SIZE(dnb));
+        }
         src.variants[std::string(PyBytes_AS_STRING(kb),
                                  (size_t)PyBytes_GET_SIZE(kb))] = {
-            vid, INT64_MAX, ok_idx};
+            vid, INT64_MAX, ok_idx, deny_idx};
       }
       fc.sources.push_back(std::move(src));
     }
@@ -627,37 +635,33 @@ PyObject* fe_complete_slow_py(PyObject*, PyObject* args) {
 }
 
 // fe_add_variant(snap_id, fc_idx, src_idx, cred_bytes, plans, ok_bytes,
-// exp_ns) -> bool — register a runtime plan variant (verified-credential
-// cache entry) for one identity source; called by the slow lane after a
-// successful verification.  Empty ok_bytes = the config's default OK.
+// deny_bytes, exp_ns) -> bool — register a runtime plan variant
+// (verified-credential cache entry) for one identity source; called by the
+// slow lane after a successful verification.  Empty ok/deny bytes = the
+// config's defaults.
 PyObject* fe_add_variant_py(PyObject*, PyObject* args) {
   long long snap_id, exp_ns;
   int fc_idx, src_idx;
-  Py_buffer cred, okb;
+  Py_buffer cred, okb, dnb;
   PyObject* plans;
-  if (!PyArg_ParseTuple(args, "Liiy*O!y*L", &snap_id, &fc_idx, &src_idx, &cred,
-                        &PyList_Type, &plans, &okb, &exp_ns))
+  if (!PyArg_ParseTuple(args, "Liiy*O!y*y*L", &snap_id, &fc_idx, &src_idx,
+                        &cred, &PyList_Type, &plans, &okb, &dnb, &exp_ns))
     return nullptr;
   fe::Server* S = fe::g_srv;
-  if (S == nullptr) {
-    PyBuffer_Release(&cred);
-    PyBuffer_Release(&okb);
-    Py_RETURN_FALSE;
-  }
   std::vector<fe::FastPlan> vp;
-  if (!parse_plans(plans, vp, nullptr)) {
-    PyBuffer_Release(&cred);
-    PyBuffer_Release(&okb);
-    return nullptr;
-  }
+  bool parsed = S != nullptr && parse_plans(plans, vp, nullptr);
   std::string cs((const char*)cred.buf, (size_t)cred.len);
   std::string oks((const char*)okb.buf, (size_t)okb.len);
+  std::string dns((const char*)dnb.buf, (size_t)dnb.len);
   PyBuffer_Release(&cred);
   PyBuffer_Release(&okb);
+  PyBuffer_Release(&dnb);
+  if (S == nullptr) Py_RETURN_FALSE;
+  if (!parsed) return nullptr;
   bool ok;
   Py_BEGIN_ALLOW_THREADS
   ok = fe::add_variant(S, snap_id, fc_idx, src_idx, std::move(cs),
-                       std::move(vp), std::move(oks), exp_ns);
+                       std::move(vp), std::move(oks), std::move(dns), exp_ns);
   Py_END_ALLOW_THREADS
   return PyBool_FromLong(ok ? 1 : 0);
 }

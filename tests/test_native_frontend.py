@@ -1054,6 +1054,75 @@ def test_k8s_tokenreview_cache_opt_in_rides_fast_lane():
         fe.stop()
 
 
+def test_identity_templated_deny_rides_fast_lane():
+    """denyWith.unauthorized templated over the identity precomputes per
+    credential variant (round 4): denial messages naming the caller serve
+    natively, byte-exact with the pipeline; request.*-templated denials
+    still route slow."""
+    from google.protobuf.json_format import MessageToDict
+
+    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    ak = APIKey("keys", LabelSelector.from_spec({"matchLabels": {"g": "dt"}}),
+                credentials=AuthCredentials(key_selector="APIKEY"))
+    ak.add_k8s_secret_based_identity(Secret(
+        namespace="ns", name="eve-key", labels={"g": "dt"},
+        annotations={"role": "viewer"}, data={"api_key": b"eve-secret"}))
+    rule = Pattern("auth.identity.metadata.annotations.role", Operator.EQ,
+                   "admin")
+
+    def entry(cfg_id, host, deny_pattern):
+        pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
+                             evaluator_slot=0)
+        return EngineEntry(
+            id=cfg_id, hosts=[host],
+            runtime=RuntimeAuthConfig(
+                labels={"namespace": "ns", "name": cfg_id.split("/")[1]},
+                identity=[IdentityConfig("keys", ak,
+                                         credentials=AuthCredentials(
+                                             key_selector="APIKEY"))],
+                authorization=[AuthorizationConfig("rules", pm)],
+                deny_with=DenyWith(unauthorized=DenyWithValues(
+                    code=403,
+                    message=JSONValue(pattern=deny_pattern),
+                    headers=[JSONProperty("x-denied-user", JSONValue(
+                        pattern="auth.identity.metadata.name"))]))),
+            rules=ConfigRules(name=cfg_id, evaluators=[(None, rule)]))
+
+    e_auth = entry("ns/deny-tmpl", "deny-tmpl.test",
+                   "role {auth.identity.metadata.annotations.role} "
+                   "may not pass")
+    e_req = entry("ns/deny-req", "deny-req.test", "request.path")
+    engine.apply_snapshot([e_auth, e_req])
+    policy = engine._snapshot.policy
+    assert fast_lane_eligible(e_auth, policy) is not None
+    assert fast_lane_eligible(e_req, policy) is None  # request-templated
+
+    fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
+    port = fe.start()
+    holder, t = run_python_server(engine)
+    try:
+        hdr = {"authorization": "APIKEY eve-secret"}
+        native = grpc_call(port, make_req("deny-tmpl.test", headers=hdr))
+        python = grpc_call(holder["port"], make_req("deny-tmpl.test", headers=hdr))
+        assert MessageToDict(native) == MessageToDict(python)
+        assert native.status.code == 7
+        assert native.denied_response.status.code == 403
+        assert native.denied_response.body == ""
+        hdrs = {h.header.key: h.header.value
+                for h in native.denied_response.headers}
+        assert hdrs["x-denied-user"] == "eve-key"
+        # the denial itself was a native fast-lane decision
+        assert fe.stats()["fast"] >= 1 and fe.stats()["slow"] == 0
+        # missing credential: all-fail template still byte-exact
+        n2 = grpc_call(port, make_req("deny-tmpl.test"))
+        p2 = grpc_call(holder["port"], make_req("deny-tmpl.test"))
+        assert MessageToDict(n2) == MessageToDict(p2)
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
+        fe.stop()
+
+
 def test_stop_drains_inflight_slow_requests():
     """fe.stop() while slow-lane requests are in flight must complete them
     before the loop closes — a cancelled handler would leave its client
