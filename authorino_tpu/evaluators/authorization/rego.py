@@ -943,8 +943,10 @@ def _builtin(fn: str, args: List[Any]) -> Any:
         if fn in ("crypto.md5", "crypto.sha1", "crypto.sha256"):
             import hashlib
 
+            if not isinstance(args[0], str):
+                raise RegoError(f"{fn}: operand must be a string")
             algo = fn.split(".", 1)[1]
-            return getattr(hashlib, algo)(str(args[0]).encode()).hexdigest()
+            return getattr(hashlib, algo)(args[0].encode()).hexdigest()
         if fn == "units.parse_bytes":
             s = str(args[0]).strip().upper()
             m = re.fullmatch(r"([0-9.]+)\s*([KMGTPE]I?B?|B?)", s)
@@ -959,11 +961,40 @@ def _builtin(fn: str, args: List[Any]) -> Any:
                 raise RegoError("units.parse_bytes: fractional byte count")
             return int(num)
         if fn == "regex.split":
-            return re.split(args[0], args[1])
+            # OPA regex.split(pattern, s) wraps Go regexp.Split: the result
+            # never contains capture-group texts (Python re.split would
+            # inject them, None included) — split by match spans instead
+            rx = re.compile(args[0])
+            s = args[1]
+            out, last = [], 0
+            for mo in rx.finditer(s):
+                out.append(s[last:mo.start()])
+                last = mo.end()
+            out.append(s[last:])
+            return out
         if fn == "regex.replace":
-            # OPA wraps Go ReplaceAllString: $1-style group refs → \\1
-            repl = re.sub(r"\$(\d+)", r"\\\1", args[2])
-            return re.sub(args[0], repl, args[1])
+            # OPA regex.replace(s, pattern, value) wraps Go
+            # ReplaceAllString: translate $$/$n/${n}/$name refs to Python,
+            # with literal backslashes escaped (Go treats them literally)
+            s, pattern, value = args[0], args[1], args[2]
+            repl_parts: List[str] = []
+            i = 0
+            value_esc = value.replace("\\", "\\\\")
+            while i < len(value_esc):
+                ch = value_esc[i]
+                if ch == "$" and i + 1 < len(value_esc):
+                    if value_esc[i + 1] == "$":
+                        repl_parts.append("$")
+                        i += 2
+                        continue
+                    mg = re.match(r"\{(\w+)\}|(\w+)", value_esc[i + 1:])
+                    if mg:
+                        repl_parts.append(f"\\g<{mg.group(1) or mg.group(2)}>")
+                        i += 1 + mg.end()
+                        continue
+                repl_parts.append(ch)
+                i += 1
+            return re.sub(pattern, "".join(repl_parts), s)
         if fn == "time.parse_rfc3339_ns":
             # exact integer ns: float timestamp math would corrupt sub-µs
             # digits (and fromisoformat silently truncates past 6)

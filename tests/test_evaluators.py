@@ -1072,7 +1072,10 @@ class TestRegoRound4:
             'b = units.parse_bytes("10MiB")\n'
             'b2 = units.parse_bytes("2K")\n'
             'parts = regex.split("[,;] ?", "a,b; c")\n'
-            'rep = regex.replace("a(b+)c", "xabbcy", "<$1>")\n'
+            'parts2 = regex.split("(,)|;", "a,b;c")\n'
+            'rep = regex.replace("xabbcy", "a(b+)c", "<$1>")\n'
+            'rep0 = regex.replace("xabbcy", "ab+c", "<$0>")\n'
+            'repd = regex.replace("cost", "co", "$$")\n'
         )
         out = m.evaluate({})
         assert out["h"] == ("2cf24dba5fb0a30e26e83b2ac5b9e29e"
@@ -1082,4 +1085,9 @@ class TestRegoRound4:
         assert out["b"] == 10 * 1024 * 1024
         assert out["b2"] == 2000
         assert out["parts"] == ["a", "b", "c"]
+        assert out["parts2"] == ["a", "b", "c"]  # no capture-group leakage
         assert out["rep"] == "x<bb>y"
+        assert out["rep0"] == "x<abbc>y"
+        assert out["repd"] == "$st"
+        with pytest.raises(RegoError):
+            compile_module("h = crypto.sha256(3)").evaluate({})
