@@ -355,6 +355,29 @@ def test_sharded_engine_serves_fast_lane():
         stats = fe.stats()
         assert stats["fast"] > 0, f"sharded fast lane never engaged: {stats}"
         assert stats["fast"] >= len(reqs) - 1  # all but the 404 ride fast
+        # seeded random sweep across shards, credentials, regex/overflow
+        rng = __import__("random").Random(8)
+        mism = []
+        for i in range(120):
+            host = rng.choice([f"shard-{rng.randrange(10)}.test",
+                               "shard-rx.test", "shard-key.test",
+                               "nope.test"])
+            headers = {}
+            if rng.random() < 0.6:
+                headers["x-org"] = rng.choice(
+                    [f"org-{rng.randrange(10)}", "evil", ""])
+            if rng.random() < 0.5:
+                headers["authorization"] = rng.choice(
+                    ["APIKEY sh-admin", "APIKEY sh-user", "APIKEY zz", ""])
+            req = make_req(host, method=rng.choice(["GET", "DELETE"]),
+                           path=rng.choice(["/v2/ok", "/no",
+                                            "/v1/ok" + "y" * 180]),
+                           headers=headers)
+            nk = response_key(grpc_call(port, req))
+            pk = response_key(grpc_call(holder["port"], req))
+            if nk != pk:
+                mism.append((i, nk, pk))
+        assert not mism, f"{len(mism)} diverged on the mesh, first: {mism[0]}"
     finally:
         holder["loop"].call_soon_threadsafe(holder["stop"].set)
         t.join(timeout=10)
