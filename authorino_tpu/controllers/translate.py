@@ -84,6 +84,27 @@ def build_expression(
 # helpers
 # ---------------------------------------------------------------------------
 
+_FOLD_SAFE_ROOTS = ("request.", "context.", "source.", "destination.")
+
+
+def _gate_selectors_request_rooted(expr: Expression) -> bool:
+    """True iff every selector in the gate reads data that is identical at
+    pipeline start (where the reference evaluates top-level `when`,
+    auth.identity still None) and after identity resolution (where a folded
+    gate runs).  Only request-shaped roots qualify; anything auth.*-rooted —
+    or unrecognized — keeps the gate on the pipeline."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        children = getattr(node, "children", None)
+        if children is not None:
+            stack.extend(children)
+        else:
+            if not str(node.selector).startswith(_FOLD_SAFE_ROOTS):
+                return False
+    return True
+
+
 def _value_or_selector(spec: Optional[dict]) -> Optional[JSONValue]:
     if spec is None:
         return None
@@ -502,8 +523,15 @@ async def translate_auth_config(
     # the gate compiles into every evaluator's condition and the config
     # keeps the kernel fast lane.  Credential identities cannot fold (a
     # skipped pipeline must allow even credential-less requests) nor can
-    # response outputs (skipped requests carry none).
+    # response outputs (skipped requests carry none).  The gate itself must
+    # also only read request-rooted data: the reference evaluates it at
+    # pipeline start where auth.identity is still None (ref
+    # auth_pipeline.go:454-457), whereas a folded gate evaluates after
+    # identity resolution ({anonymous: true}) — an auth.*-referencing gate
+    # would flip verdicts either way (fail-open for neq-style, OK→deny for
+    # eq-style), so those stay on the pipeline.
     if (runtime.conditions is not None
+            and _gate_selectors_request_rooted(runtime.conditions)
             and engine is not None
             and pattern_slots
             and len(pattern_slots) == len(runtime.authorization)

@@ -974,27 +974,40 @@ def _builtin(fn: str, args: List[Any]) -> Any:
             return out
         if fn == "regex.replace":
             # OPA regex.replace(s, pattern, value) wraps Go
-            # ReplaceAllString: translate $$/$n/${n}/$name refs to Python,
-            # with literal backslashes escaped (Go treats them literally)
+            # ReplaceAllString.  Go Regexp.Expand semantics: $$ → "$",
+            # $name/${name} with name = longest \w+ run resolved against
+            # groups by number-or-name, and ANY unresolvable or unmatched
+            # reference expands to "" (never an error) — so references are
+            # resolved manually per match; re.sub's \g<> syntax would raise
+            # on Go-legal refs like `$1x`.  Backslashes are literal in Go
+            # templates; a function repl keeps them literal here too.
             s, pattern, value = args[0], args[1], args[2]
-            repl_parts: List[str] = []
-            i = 0
-            value_esc = value.replace("\\", "\\\\")
-            while i < len(value_esc):
-                ch = value_esc[i]
-                if ch == "$" and i + 1 < len(value_esc):
-                    if value_esc[i + 1] == "$":
-                        repl_parts.append("$")
-                        i += 2
-                        continue
-                    mg = re.match(r"\{(\w+)\}|(\w+)", value_esc[i + 1:])
-                    if mg:
-                        repl_parts.append(f"\\g<{mg.group(1) or mg.group(2)}>")
-                        i += 1 + mg.end()
-                        continue
-                repl_parts.append(ch)
-                i += 1
-            return re.sub(pattern, "".join(repl_parts), s)
+
+            def expand(mo, _tpl=value):
+                out: List[str] = []
+                i = 0
+                while i < len(_tpl):
+                    ch = _tpl[i]
+                    if ch == "$" and i + 1 < len(_tpl):
+                        if _tpl[i + 1] == "$":
+                            out.append("$")
+                            i += 2
+                            continue
+                        mg = re.match(r"\{(\w+)\}|(\w+)", _tpl[i + 1:])
+                        if mg:
+                            name = mg.group(1) or mg.group(2)
+                            i += 1 + mg.end()
+                            try:
+                                g = mo.group(int(name) if name.isdigit() else name)
+                            except (IndexError, re.error):
+                                g = None
+                            out.append(g or "")
+                            continue
+                    out.append(ch)
+                    i += 1
+                return "".join(out)
+
+            return re.sub(pattern, expand, s)
         if fn == "time.parse_rfc3339_ns":
             # exact integer ns: float timestamp math would corrupt sub-µs
             # digits (and fromisoformat silently truncates past 6)

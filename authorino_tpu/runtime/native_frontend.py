@@ -32,6 +32,7 @@ pkg/service/auth.go:239-310 (Check flow incl. host override + port strip).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -572,8 +573,15 @@ class NativeFrontend:
         # past the token's own exp claim)
         self.dyn_ttl_s = float(dyn_ttl_s)
         # with tracing active, 1-in-N requests take the slow lane with full
-        # span export; the rest serve natively
+        # span export; the rest serve natively.  AUTHORINO_TPU_TRACE_ALL=1
+        # restores the reference's every-request tracing (at slow-lane
+        # throughput — the reference traces in-process,
+        # ref pkg/trace/trace.go:20-27)
+        if os.environ.get("AUTHORINO_TPU_TRACE_ALL", "").lower() in (
+                "1", "true", "yes"):
+            trace_sample_n = 1
         self.trace_sample_n = max(1, int(trace_sample_n))
+        self._trace_mode_logged = False
         self.port = port
         self.bind_all = bind_all
         self.max_batch = int(max_batch)
@@ -602,6 +610,10 @@ class NativeFrontend:
         # trackers run both under _lock (refresh) and without it (notifier)
         self._prewarm_threads: List[threading.Thread] = []
         self._thread_lock = threading.Lock()
+        # evaluators this instance registered _on_oidc_change on —
+        # unregistered in stop() so a replaced frontend isn't kept alive
+        # (and re-fired) by long-lived evaluators
+        self._change_wired: set = set()
 
     # ------------------------------------------------------------------
     def start(self) -> int:
@@ -648,6 +660,15 @@ class NativeFrontend:
         self._running = False
         if self._mod is not None:
             self.engine.remove_swap_listener(self.refresh)
+        # unwire AFTER the swap listener is gone and under _lock, so a
+        # concurrent refresh() can't re-register listeners mid-unwire
+        with self._lock:
+            for ev in self._change_wired:
+                remove = getattr(ev, "remove_change_listener", None)
+                if remove is not None:
+                    remove(self._on_oidc_change)
+            self._change_wired.clear()
+        if self._mod is not None:
             try:
                 self._fold_fc_counts()
                 self.drain_histograms()  # final fold: short runs lose nothing
@@ -906,6 +927,13 @@ class NativeFrontend:
             self._refresh_locked()
 
     def _refresh_locked(self) -> None:
+        # a refresh already blocked on _lock when stop() ran would re-wire
+        # change listeners (re-leaking this instance) and fe_swap onto a
+        # torn-down module — the lock alone doesn't order it before stop()'s
+        # unwire, so bail once stopped (start() sets _running before the
+        # first refresh)
+        if not self._running:
+            return
         engine = self.engine
         snap = engine._snapshot
         policy = snap.policy if snap is not None else None
@@ -948,6 +976,13 @@ class NativeFrontend:
         from ..utils.tracing import tracing_active
 
         spec["trace_every"] = self.trace_sample_n if tracing_active() else 0
+        if spec["trace_every"] > 1 and not self._trace_mode_logged:
+            self._trace_mode_logged = True
+            log.info(
+                "tracing active: head-sampling 1-in-%d requests to the slow "
+                "lane for span export (the rest serve natively, untraced); "
+                "set AUTHORINO_TPU_TRACE_ALL=1 for every-request tracing",
+                spec["trace_every"])
 
         enc = None
         if policy is not None:
@@ -1157,6 +1192,10 @@ class NativeFrontend:
                                            "add_change_listener", None)
                     if add_listener is not None:
                         add_listener(self._on_oidc_change)
+                        # unregistered in stop(): evaluators outlive
+                        # frontend instances (reconcile re-creates the
+                        # frontend, not the evaluator graph)
+                        self._change_wired.add(s.idc.evaluator)
             if spec_fl.has_batch:
                 if sharded is not None:
                     shard, row = sharded.locator[entry.rules.name]

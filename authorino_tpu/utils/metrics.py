@@ -8,6 +8,8 @@ always being cheap; recording is unconditional on the aggregate metrics."""
 
 from __future__ import annotations
 
+import logging
+
 try:
     from prometheus_client import Counter, Histogram, REGISTRY
 
@@ -118,12 +120,17 @@ frontend_stage_duration = _histogram(
 )
 
 
+_bucketed_fallback_warned = False
+
+
 def observe_bucketed(hist_child, bucket_counts, sum_seconds) -> None:
     """Fold pre-bucketed counts (non-cumulative per-le, same bounds as the
     histogram) into a prometheus_client Histogram child in O(buckets) —
     per-request observe() calls cannot keep up with the native frontend's
-    rates.  Uses the documented-stable internals; falls back to midpoint
-    observes if they ever change."""
+    rates.  Uses the documented-stable internals (`_buckets`/`_sum`, probed
+    here so a library change degrades loudly, not silently); the fallback
+    preserves the distribution shape by spreading observes across each
+    bucket's midpoint instead of collapsing everything into one mean."""
     try:
         buckets = hist_child._buckets
         for i, n in enumerate(bucket_counts):
@@ -131,11 +138,75 @@ def observe_bucketed(hist_child, bucket_counts, sum_seconds) -> None:
                 buckets[i].inc(n)
         if sum_seconds:
             hist_child._sum.inc(sum_seconds)
+        return
     except AttributeError:
-        if hasattr(hist_child, "observe"):
-            total = sum(bucket_counts)
-            if total:
-                hist_child.observe(sum_seconds / total)
+        pass
+    global _bucketed_fallback_warned
+    if not _bucketed_fallback_warned:
+        _bucketed_fallback_warned = True
+        logging.getLogger(__name__).warning(
+            "prometheus_client histogram internals changed "
+            "(_buckets/_sum missing) — falling back to per-bucket midpoint "
+            "observes for drained native-frontend histograms")
+    if not hasattr(hist_child, "observe"):
+        return
+    bounds = list(getattr(hist_child, "_upper_bounds", ()))[:len(bucket_counts)]
+    total = sum(bucket_counts)
+    if not total:
+        return
+    if not bounds:
+        hist_child.observe(sum_seconds / total)
+        return
+    import math
+
+    # per-observe cost is the very thing this function exists to avoid — a
+    # huge drained backlog must not stall the drain thread for seconds, so
+    # counts above the cap are proportionally thinned (logged: rate(count)
+    # dashboards undercount while the fallback is active)
+    cap = 200_000
+    scale = 1.0
+    if total > cap:
+        scale = cap / total
+        logging.getLogger(__name__).warning(
+            "histogram fallback drain thinned %d observations to %d "
+            "(per-observe fallback cannot keep up with native rates)",
+            total, cap)
+    counts: list = []
+    values: list = []
+    lo = 0.0
+    for i, n in enumerate(bucket_counts):
+        hi = bounds[i] if i < len(bounds) else float("inf")
+        if hi == float("inf"):
+            # strictly above the last finite bound, else observe() bins
+            # these overflow counts into the last finite bucket (le is <=)
+            v = math.nextafter(lo, math.inf)
+        else:
+            v = (lo + hi) / 2.0
+        if n:
+            counts.append((int(round(n * scale)), len(values)))
+            values.append((v, lo, hi))
+        if hi != float("inf"):
+            lo = hi
+    # match the drained sum by shifting values inside their buckets
+    # (midpoints alone misstate rate(sum)/rate(count) averages): walk from
+    # the top bucket down, absorbing the residual within each bucket's
+    # bounds — exact whenever the target sum is consistent with the shape
+    # (the +Inf bucket is unbounded above)
+    residual = sum_seconds * scale - sum(n * values[j][0] for n, j in counts)
+    for n, j in reversed(counts):
+        if not n or abs(residual) <= 1e-12:
+            continue
+        v, b_lo, b_hi = values[j]
+        want = v + residual / n
+        got = max(want, math.nextafter(b_lo, math.inf))
+        if b_hi != float("inf"):
+            got = min(got, b_hi)
+        values[j] = (got, b_lo, b_hi)
+        residual -= (got - v) * n
+    for n, j in counts:
+        v = values[j][0]
+        for _ in range(n):
+            hist_child.observe(v)
 
 
 host_fallback_total = _counter(

@@ -693,6 +693,57 @@ class TestTopLevelWhenFolding:
         # gate matched: credentials enforced
         assert run(check("GET")) == 16
 
+    def test_auth_rooted_gate_does_not_fold(self):
+        """The reference evaluates the AuthConfig gate at pipeline start,
+        where auth.identity is still None (ref auth_pipeline.go:454-457);
+        a folded gate would see the resolved anonymous identity instead.
+        `auth.identity.anonymous neq "true"` matches pre-resolution
+        (missing selector → "") and runs the deny rules — after folding it
+        would be unmatched and ALLOW, a fail-open divergence.  Any
+        auth.*-rooted selector keeps the gate on the pipeline."""
+        engine = PolicyEngine(max_batch=8, max_delay_s=0.0005, mesh=None)
+        spec = {
+            "hosts": ["gated-auth.test"],
+            "when": [{"selector": "auth.identity.anonymous",
+                      "operator": "neq", "value": "true"}],
+            "authentication": {"anon": {"anonymous": {}}},
+            "authorization": {"rules": {"patternMatching": {"patterns": [
+                {"selector": "request.headers.x-org",
+                 "operator": "eq", "value": "acme"}]}}},
+        }
+        entry = run(translate_auth_config("ga", "t", spec, engine=engine))
+        assert entry.runtime.conditions is not None
+        engine.apply_snapshot([entry])
+
+        async def check(headers=None):
+            req = CheckRequestModel(http=HttpRequestAttributes(
+                method="GET", path="/x", host="gated-auth.test",
+                headers=headers or {}))
+            return (await engine.check(req)).code
+
+        # gate matches pre-resolution ("" neq "true") → rules enforced
+        assert run(check({"x-org": "evil"})) == 7
+        assert run(check({"x-org": "acme"})) == 0
+
+    def test_nested_auth_rooted_gate_does_not_fold(self):
+        """auth.* detection must walk nested And/Or gate trees."""
+        engine = PolicyEngine(max_batch=8, max_delay_s=0.0005, mesh=None)
+        spec = {
+            "hosts": ["gated-nest.test"],
+            "patterns": {"who": [
+                {"selector": "auth.identity.sub", "operator": "eq", "value": "x"}]},
+            "when": [{"any": [
+                {"selector": "request.method", "operator": "eq", "value": "GET"},
+                {"patternRef": "who"},
+            ]}],
+            "authentication": {"anon": {"anonymous": {}}},
+            "authorization": {"rules": {"patternMatching": {"patterns": [
+                {"selector": "request.headers.x-org",
+                 "operator": "eq", "value": "acme"}]}}},
+        }
+        entry = run(translate_auth_config("gn", "t", spec, engine=engine))
+        assert entry.runtime.conditions is not None
+
     def test_conditioned_anonymous_identity_does_not_fold(self):
         """A conditional anonymous identity could turn gate-unmatched
         requests from skip-OK into 401 under the fold — the gate must stay

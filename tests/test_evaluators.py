@@ -1076,6 +1076,15 @@ class TestRegoRound4:
             'rep = regex.replace("xabbcy", "a(b+)c", "<$1>")\n'
             'rep0 = regex.replace("xabbcy", "ab+c", "<$0>")\n'
             'repd = regex.replace("cost", "co", "$$")\n'
+            # Go Regexp.Expand: `$1x` parses as group name "1x" → no such
+            # group → "" (Python \g<1x> would raise re.error)
+            'repgo = regex.replace("xabbcy", "a(b+)c", "<$1x>")\n'
+            # reference to a nonexistent numeric group → "" (not an error)
+            'repmiss = regex.replace("xabbcy", "a(b+)c", "<$9>")\n'
+            # unmatched optional group expands to ""
+            'repopt = regex.replace("ac", "a(b)?c", "<$1>")\n'
+            # backslashes in the template are literal in Go
+            'repbs = regex.replace("ab", "a", "\\\\d$0")\n'
         )
         out = m.evaluate({})
         assert out["h"] == ("2cf24dba5fb0a30e26e83b2ac5b9e29e"
@@ -1089,5 +1098,9 @@ class TestRegoRound4:
         assert out["rep"] == "x<bb>y"
         assert out["rep0"] == "x<abbc>y"
         assert out["repd"] == "$st"
+        assert out["repgo"] == "x<>y"
+        assert out["repmiss"] == "x<>y"
+        assert out["repopt"] == "<>"
+        assert out["repbs"] == "\\dab"
         with pytest.raises(RegoError):
             compile_module("h = crypto.sha256(3)").evaluate({})

@@ -1708,6 +1708,36 @@ def test_duration_and_stage_histograms(stack):
     assert samples.get(("ns", "fast-keyonly"), 0) >= 1
 
 
+def test_observe_bucketed_fallback_preserves_shape():
+    """If prometheus_client internals (`_buckets`/`_sum`) ever vanish, the
+    fallback must keep per-bucket counts (incl. +Inf overflow binned ABOVE
+    the last finite bound) and land the exact drained sum — not collapse to
+    one mean observation (ADVICE r4)."""
+    from authorino_tpu.utils import metrics as metrics_mod
+
+    class FakeChild:
+        _upper_bounds = [0.001, 0.01, 0.1, float("inf")]
+
+        def __init__(self):
+            self.observed = []
+
+        def observe(self, v):
+            self.observed.append(v)
+
+    child = FakeChild()
+    # counts per bucket: 5 in (0,1ms], 3 in (1,10ms], 0, 2 overflow
+    metrics_mod.observe_bucketed(child, [5, 3, 0, 2], sum_seconds=0.5)
+    assert len(child.observed) == 10
+    binned = [0, 0, 0, 0]
+    for v in child.observed:
+        for i, b in enumerate(FakeChild._upper_bounds):
+            if v <= b:
+                binned[i] += 1
+                break
+    assert binned == [5, 3, 0, 2]  # overflow NOT folded into le=0.1
+    assert abs(sum(child.observed) - 0.5) < 1e-9
+
+
 def test_randomized_differential_sweep(stack):
     """300 seeded-random requests across the module corpus — hosts (exact,
     wildcard, ports, overrides, unknown), methods, paths (regex lane,
