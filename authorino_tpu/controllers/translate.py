@@ -395,6 +395,18 @@ async def translate_auth_config(
                     await ev.load_external()
                 except Exception as e:
                     raise TranslationError(f"failed to fetch external rego policy: {e}")
+            if engine is not None:
+                # decidable Rego rides the kernel: the verdict lowers into
+                # the same compiled slots the pattern evaluators use (the
+                # TPU analog of the reference's precompile-at-reconcile,
+                # ref pkg/evaluators/authorization/opa.go:141-176).  The
+                # pipeline keeps the interpreter (and the `when` gate) —
+                # the kernel slot carries the same gate, so both lanes
+                # agree; non-lowerable policies change nothing.
+                lowered = ev.lowered_verdict()
+                if lowered is not None:
+                    ev.kernel_slot = len(pattern_slots)
+                    pattern_slots.append((common["conditions"], lowered))
             etype = "OPA"
         elif azspec.get("kubernetesSubjectAccessReview") is not None:
             k = azspec["kubernetesSubjectAccessReview"]
@@ -535,6 +547,12 @@ async def translate_auth_config(
             and engine is not None
             and pattern_slots
             and len(pattern_slots) == len(runtime.authorization)
+            # lowered-OPA slots don't qualify: the pipeline runs the
+            # interpreter UNgated, so a folded gate would vanish from the
+            # slow lane (PatternMatching evaluates through the kernel in
+            # both lanes, so its gate folds safely)
+            and all(isinstance(c.evaluator, PatternMatching)
+                    for c in runtime.authorization)
             and len(runtime.identity) == 1
             and isinstance(runtime.identity[0].evaluator, Noop)
             # the anonymous identity must be unconditional: its own `when`

@@ -68,8 +68,24 @@ class OPA:
         self.policy_uid = hashlib.sha256(name.encode()).hexdigest()[:16]
         self._module: Optional[rego.RegoModule] = None
         self._refresher: Optional[Worker] = None
+        # set by translate (or any snapshot builder) when lowered_verdict()
+        # was compiled into the config's ConfigRules at this slot — the
+        # native fast lane accepts the evaluator as kernel-covered then
+        self.kernel_slot: Optional[int] = None
         if inline_rego:
             self.precompile(inline_rego)
+
+    def lowered_verdict(self):
+        """The policy's ``allow`` as a compiled pattern Expression when it
+        falls in the provably-equivalent subset (see rego_lower), else
+        None.  Only INLINE policies qualify: an external policy hot-swaps
+        on TTL refresh (ref :118-139) without a reconcile, which would
+        leave stale lowered rules in the compiled corpus."""
+        if self.external_source is not None or self._module is None:
+            return None
+        from .rego_lower import lower_verdict
+
+        return lower_verdict(self._module)
 
     def precompile(self, rego_src: str) -> None:
         """(ref :141-176: policy template + PrepareForEval; swap-on-refresh

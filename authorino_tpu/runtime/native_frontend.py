@@ -52,6 +52,7 @@ from ..compiler.intern import PAD
 from ..compiler.pack import _trim_bytes, wire_dtype
 from ..evaluators import credentials as cred_mod
 from ..evaluators.base import DenyWithValues, RuntimeAuthConfig
+from ..evaluators.authorization import OPA as OPAEval
 from ..evaluators.authorization import PatternMatching
 from ..evaluators.identity import APIKey, KubernetesAuth, MTLS, Noop, OAuth2
 from ..evaluators.identity.api_key import INVALID_API_KEY_MSG
@@ -303,6 +304,23 @@ class SourceSpec:
     ttl_cap: Optional[float] = None
 
 
+def _kernel_covered(conf) -> bool:
+    """True when this authorization evaluator's verdict is decided by the
+    compiled kernel corpus: pattern-matching evaluators with a batched
+    provider, and OPA evaluators whose decidable Rego was lowered into a
+    ConfigRules slot at translate time (rego_lower)."""
+    if conf.cache is not None or conf.metrics:
+        return False
+    ev_c = conf.evaluator
+    if isinstance(ev_c, PatternMatching):
+        return ev_c.batched_provider is not None and conf.conditions is None
+    if isinstance(ev_c, OPAEval):
+        # wrapper conditions are fine: translate compiles the same gate
+        # into the kernel slot AND keeps it on the pipeline
+        return ev_c.kernel_slot is not None
+    return False
+
+
 @dataclass
 class FastLaneSpec:
     """Everything the C++ frontend needs to serve one AuthConfig natively.
@@ -331,6 +349,11 @@ class FastLaneSpec:
     # unauthorized denyWith carries identity-templated values → per-variant
     # DENY bytes must be built (else the config-default static deny serves)
     deny_templated: bool = False
+    # hybrid lane: the kernel covers only part of the authorization phase —
+    # a kernel DENY answers natively, a kernel PASS hands the raw request
+    # to the slow lane for the full pipeline (procedural Rego/SAR/SpiceDB
+    # evaluators, arbitrary responses)
+    hybrid: bool = False
 
 
 # bounds on the identity-source fan-out the C++ lane carries: the all-fail
@@ -353,7 +376,12 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
         return None
     if rt.metadata or rt.callbacks:
         return None
-    if rt.response and not _response_templates_eligible(rt):
+    covered = [c for c in rt.authorization if _kernel_covered(c)]
+    uncovered = [c for c in rt.authorization if not _kernel_covered(c)]
+    # hybrid: kernel pre-filters denials, the pipeline finishes the allows —
+    # so responses (which only run on OK) need no template eligibility
+    hybrid = bool(covered) and bool(uncovered)
+    if rt.response and not hybrid and not _response_templates_eligible(rt):
         return None
     if not rt.identity or len(rt.identity) > _MAX_SOURCES:
         return None
@@ -453,16 +481,16 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
         row = policy.config_ids.get(entry.rules.name)
         if row is None:
             return None
-        if len(rt.authorization) != len(entry.rules.evaluators):
+        if not covered or len(covered) != len(entry.rules.evaluators):
             return None
-        for conf in rt.authorization:
-            if not isinstance(conf.evaluator, PatternMatching):
-                return None
-            if conf.evaluator.batched_provider is None:
-                return None
-            if conf.conditions is not None or conf.cache is not None:
-                return None
-            if conf.metrics:
+        if uncovered:
+            # a kernel pre-deny must not preempt an uncovered evaluator the
+            # pipeline would have FAILED in an earlier priority bucket
+            # (its denial could differ); same-bucket outcomes race in the
+            # reference (ref pkg/service/auth_pipeline.go:160-199), so any
+            # single winner there is within its semantics
+            if max(c.priority for c in covered) > min(
+                    u.priority for u in uncovered):
                 return None
         if not _deny_with_const(rt.deny_with.unauthorized):
             return None
@@ -489,7 +517,7 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
         return None  # compiled rules without runtime authz configs: engine bug
 
     spec = FastLaneSpec(plans=plans, has_batch=has_batch, sources=sources,
-                        auth_attrs=auth_attrs,
+                        auth_attrs=auth_attrs, hybrid=hybrid,
                         deny_templated=has_batch and not _deny_with_static(
                             rt.deny_with.unauthorized))
     if is_noop:
@@ -528,9 +556,12 @@ def fast_lane_eligible(entry, policy: Optional[CompiledPolicy]) -> Optional[Fast
                     vplans.append(p)
             # the identity object rides along so refresh can precompute the
             # per-key OK/DENY bytes for response/denyWith-template configs
+            # (hybrid OKs are answered by the pipeline, which runs the
+            # response phase itself — no per-key OK bytes there)
             src.variants.append((
                 key.encode("utf-8"), vplans,
-                ident_obj if (rt.response or spec.deny_templated) else None))
+                ident_obj if ((rt.response and not hybrid)
+                              or spec.deny_templated) else None))
     return spec
 
 
@@ -1141,7 +1172,8 @@ class NativeFrontend:
             # credential at dyn registration) — empty bytes in a variant =
             # the config default
             fc_ok = (self._ok_bytes_for(rt_e, spec_fl.const_identity)
-                     if rt_e.response and not spec_fl.sources else ok_bytes)
+                     if rt_e.response and not spec_fl.sources
+                     and not spec_fl.hybrid else ok_bytes)
             fc_deny = self._result_bytes(self._deny_result(
                 rt_e,
                 spec_fl.const_identity
@@ -1149,6 +1181,7 @@ class NativeFrontend:
             fc = {
                 "row": 0,
                 "has_batch": 1 if spec_fl.has_batch else 0,
+                "hybrid": 1 if spec_fl.hybrid else 0,
                 "ok": fc_ok,
                 "deny": fc_deny,
                 "plans": spec_fl.plans,
@@ -1161,6 +1194,7 @@ class NativeFrontend:
                             (key, vplans,
                              self._ok_bytes_for(rt_e, ident_obj)
                              if ident_obj is not None and rt_e.response
+                             and not spec_fl.hybrid
                              else b"",
                              self._result_bytes(
                                  self._deny_result(rt_e, ident_obj))
@@ -1179,7 +1213,8 @@ class NativeFrontend:
                        for i, s in enumerate(spec_fl.sources) if s.dyn}
             if dyn_map:
                 rec.dyn_regs[entry.id] = (fc_idx, spec_fl.auth_attrs,
-                                          policy_for, dyn_map)
+                                          policy_for, dyn_map,
+                                          spec_fl.hybrid)
                 # a JWKS rotation invalidates every cached token: swap
                 # in a fresh snapshot (empty variant map) when the
                 # provider's key set actually changes (add_change_listener
@@ -1287,7 +1322,7 @@ class NativeFrontend:
         reg = rec.dyn_regs.get(entry.id)
         if reg is None:
             return
-        fc_idx, auth_attrs, reg_policy, src_map = reg
+        fc_idx, auth_attrs, reg_policy, src_map, reg_hybrid = reg
         conf, obj = pipeline.resolved_identity()
         if obj is None:
             return
@@ -1355,7 +1390,9 @@ class NativeFrontend:
         ok_bytes = b""
         deny_bytes = b""
         try:
-            if rt_e.response:
+            if rt_e.response and not reg_hybrid:
+                # hybrid OKs are answered by the pipeline (response phase
+                # runs there) — no per-credential OK bytes
                 ok_bytes = self._ok_bytes_for(rt_e, obj)
             if rt_e.authorization and not _deny_with_static(
                     rt_e.deny_with.unauthorized):

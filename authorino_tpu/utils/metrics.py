@@ -132,15 +132,20 @@ def observe_bucketed(hist_child, bucket_counts, sum_seconds) -> None:
     preserves the distribution shape by spreading observes across each
     bucket's midpoint instead of collapsing everything into one mean."""
     try:
-        buckets = hist_child._buckets
+        # resolve EVERY internal before mutating anything: a partial apply
+        # (buckets bumped, then _sum missing) followed by the fallback
+        # would double-count the whole drained distribution
+        bucket_incs = [b.inc for b in hist_child._buckets]
+        sum_inc = hist_child._sum.inc
+    except (AttributeError, TypeError):
+        bucket_incs = None
+    if bucket_incs is not None:
         for i, n in enumerate(bucket_counts):
             if n:
-                buckets[i].inc(n)
+                bucket_incs[i](n)
         if sum_seconds:
-            hist_child._sum.inc(sum_seconds)
+            sum_inc(sum_seconds)
         return
-    except AttributeError:
-        pass
     global _bucketed_fallback_warned
     if not _bucketed_fallback_warned:
         _bucketed_fallback_warned = True

@@ -420,6 +420,14 @@ struct FastConfig {
   int32_t row = 0;
   int32_t shard = 0;            // owning mp shard (sharded corpora; else 0)
   bool has_batch = true;        // false → identity-only: decide entirely here
+  // hybrid lane: the kernel covers only part of the authorization phase
+  // (procedural Rego / SAR / SpiceDB evaluators stay in Python).  A kernel
+  // DENY answers immediately — ∧-semantics, any authz failure denies with
+  // the same config bytes — while a kernel PASS hands the RAW request to
+  // the slow lane for the full pipeline (which re-runs the covered
+  // patterns too: correct by construction, and they are kernel-batched
+  // there as well)
+  bool hybrid = false;
   std::vector<FastPlan> plans;
   bool needs_split = false;     // any K_URL_PATH / K_QUERY plan
   std::string ok_msg, deny_msg; // CheckResponse payloads (pb2-built in Python)
@@ -499,6 +507,10 @@ struct Entry {
   std::shared_ptr<const std::string> ok_hold;
   const std::string* deny_msg = nullptr;
   std::shared_ptr<const std::string> deny_hold;
+  // hybrid configs only: the raw CheckRequest pb, kept so a kernel PASS
+  // can hand the request to the slow lane at completion time (the stream
+  // buffer is not safely reachable from a dispatch thread)
+  std::string raw;
 };
 
 struct Slot {
@@ -654,6 +666,7 @@ struct Server {
   // stats
   std::atomic<uint64_t> n_fast{0}, n_slow{0}, n_notfound{0}, n_invalid{0},
       n_health{0}, n_allowed{0}, n_denied{0}, n_dfa_ovf{0}, n_slow_shed{0},
+      n_hybrid{0},
       n_parse_err{0}, n_conns{0}, n_unauth{0}, n_direct_ok{0}, n_dyn_hit{0},
       n_dyn_miss{0}, n_dyn_add{0}, n_trace_sampled{0};
   std::atomic<uint64_t> trace_ctr{0};
@@ -1305,7 +1318,8 @@ static void process_check(Server* S, Conn* c, int32_t stream_id, StreamSt& st) {
   }
   snap->slot_entries[S->fill_slot].push_back(
       {c->id, stream_id, fc_idx, t_start, ok_override, std::move(ok_hold),
-       deny_override, std::move(deny_hold)});
+       deny_override, std::move(deny_hold),
+       fc.hybrid ? std::string(msg, mlen) : std::string()});
   S->fill_count++;
   S->n_fast.fetch_add(1, std::memory_order_relaxed);
   if (S->fill_count >= S->bmax) flush_batch(S);
@@ -1744,16 +1758,26 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
     snap = it->second;
     entries.swap(snap->slot_entries[slot]);
   }
-  uint64_t allowed = 0;
+  uint64_t allowed = 0, handed_off = 0;
   const int64_t t_now = now_mono_ns();
   const int64_t t_flush = snap->slot_flush_ns[slot];
   const int exec_b = stage_bucket(t_now - t_flush);
+  // hybrid kernel-PASS entries: collected under mu, enqueued to the slow
+  // lane after (push ordering mirrors push_slow: mu for slow_pending,
+  // then slow_mu — never nested)
+  struct Handoff { uint32_t conn_id; int32_t stream_id; std::string raw; };
+  std::vector<Handoff> handoffs;
   {
     std::lock_guard<std::mutex> lk(S->mu);
     for (size_t i = 0; i < entries.size(); ++i) {
-      const Entry& e = entries[i];
+      Entry& e = entries[i];
       const FastConfig& fc = snap->fcs[e.fc];
       bool ok = verdict[i] != 0;
+      if (ok && fc.hybrid) {
+        handed_off++;
+        handoffs.push_back({e.conn_id, e.stream_id, std::move(e.raw)});
+        continue;
+      }
       allowed += ok;
       S->done_q.push_back(
           {e.conn_id, e.stream_id,
@@ -1764,12 +1788,40 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
     snap->free_slots.push_back(slot);
     snap->pending_batches--;
   }
+  for (Handoff& h : handoffs) {
+    uint64_t id = 0;
+    bool shed = false;
+    {
+      std::lock_guard<std::mutex> lk(S->mu);
+      if (S->slow_pending.size() >= S->slow_cap) {
+        shed = true;
+        S->done_q.push_back({h.conn_id, h.stream_id, std::string(), 8, 0});
+      } else {
+        id = S->next_slow_id++;
+        S->slow_pending[id] = {h.conn_id, h.stream_id};
+      }
+    }
+    if (shed) {
+      S->n_slow_shed.fetch_add(1, std::memory_order_relaxed);
+      continue;
+    }
+    {
+      std::lock_guard<std::mutex> lk(S->slow_mu);
+      S->slow_q.push_back({id, std::move(h.raw)});
+    }
+    S->n_slow.fetch_add(1, std::memory_order_relaxed);
+  }
+  if (!handoffs.empty()) S->slow_cv.notify_all();
   // per-request on-box stages + the duration series the pipeline observes
-  // (ref pkg/service/auth_pipeline.go:26-36): all clocked here, no tunnel
-  for (const Entry& e : entries) {
+  // (ref pkg/service/auth_pipeline.go:26-36): all clocked here, no tunnel.
+  // Hybrid handoffs skip the duration series — the Python pipeline they
+  // continue into observes them itself (no double counting)
+  for (size_t i = 0; i < entries.size(); ++i) {
+    const Entry& e = entries[i];
     S->stage_wait[stage_bucket(t_flush - e.t_enq)].fetch_add(
         1, std::memory_order_relaxed);
     S->stage_exec[exec_b].fetch_add(1, std::memory_order_relaxed);
+    if (verdict[i] != 0 && snap->fcs[e.fc].hybrid) continue;
     if (snap->fc_durs) {
       int64_t dur = t_now - e.t_enq;
       auto* d = &snap->fc_durs[(size_t)e.fc * DUR_STRIDE];
@@ -1777,8 +1829,10 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
       d[N_DUR_BUCKETS].fetch_add((uint64_t)dur, std::memory_order_relaxed);
     }
   }
+  S->n_hybrid.fetch_add(handed_off, std::memory_order_relaxed);
   S->n_allowed.fetch_add(allowed, std::memory_order_relaxed);
-  S->n_denied.fetch_add(entries.size() - allowed, std::memory_order_relaxed);
+  S->n_denied.fetch_add(entries.size() - handed_off - allowed,
+                        std::memory_order_relaxed);
   std::vector<int64_t> retired;
   {
     std::lock_guard<std::mutex> lk(S->mu);

@@ -446,6 +446,7 @@ PyObject* fe_swap_py(PyObject*, PyObject* args) {
     fc.row = (int32_t)dict_int(f, "row");
     fc.shard = (int32_t)dict_int(f, "shard", 0);
     fc.has_batch = dict_int(f, "has_batch", 1) != 0;
+    fc.hybrid = dict_int(f, "hybrid", 0) != 0;
     dict_bytes(f, "ok", fc.ok_msg);
     dict_bytes(f, "deny", fc.deny_msg);
     if (!parse_plans(PyDict_GetItemString(f, "plans"), fc.plans, &fc.needs_split))
@@ -775,6 +776,7 @@ PyObject* fe_stats_py(PyObject*, PyObject*) {
   put("dyn_miss", S->n_dyn_miss.load());
   put("dyn_add", S->n_dyn_add.load());
   put("trace_sampled", S->n_trace_sampled.load());
+  put("hybrid", S->n_hybrid.load());
   {
     // live backlog gauges (not counters): queued + in-pipeline slow work
     size_t pending, queued;

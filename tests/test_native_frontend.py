@@ -26,7 +26,7 @@ from authorino_tpu.evaluators import (
     RuntimeAuthConfig,
 )
 from authorino_tpu.authjson.value import JSONProperty, JSONValue
-from authorino_tpu.evaluators.authorization import PatternMatching
+from authorino_tpu.evaluators.authorization import OPA, PatternMatching
 from authorino_tpu.evaluators.credentials import AuthCredentials
 from authorino_tpu.evaluators.identity import APIKey, Noop
 from authorino_tpu.expressions import All, Any_, Operator, Pattern
@@ -160,6 +160,29 @@ def build_engine() -> PolicyEngine:
     entries.append(pattern_entry(
         5, "ns/fast-wild", ["*.wild.test"],
         Pattern("request.method", Operator.NEQ, "DELETE")))
+    # fast (round 5): patternMatching + decidable inline Rego in ONE config —
+    # the Rego verdict lowers into a kernel slot (rego_lower) so the mixed
+    # config keeps the fast lane (VERDICT r4 item 1; the reference runs OPA
+    # inline at full server speed, ref pkg/evaluators/authorization/opa.go:86-117)
+    opa = OPA("ns/fast-rego/rego", inline_rego=(
+        'allow { input.request.method == "GET" }\n'
+        'allow { input.request.headers["x-root"] == "true" }'))
+    rule_tier = Pattern("request.headers.x-tier", Operator.EQ, "gold")
+    pm_tier = PatternMatching(rule_tier,
+                              batched_provider=engine.provider_for("ns/fast-rego"),
+                              evaluator_slot=0)
+    lowered = opa.lowered_verdict()
+    assert lowered is not None
+    opa.kernel_slot = 1
+    entries.append(EngineEntry(
+        id="ns/fast-rego", hosts=["fast-rego.test"],
+        runtime=RuntimeAuthConfig(
+            labels={"namespace": "ns", "name": "fast-rego"},
+            identity=[IdentityConfig("anon", Noop())],
+            authorization=[AuthorizationConfig("rules", pm_tier),
+                           AuthorizationConfig("rego", opa)]),
+        rules=ConfigRules(name="ns/fast-rego",
+                          evaluators=[(None, rule_tier), (None, lowered)])))
     engine.apply_snapshot(entries)
     return engine
 
@@ -213,6 +236,13 @@ REQUESTS = [
     make_req("unknown.test"),                # exact+wildcard miss → 404
     make_req("fast-eq.test:8080", headers={"x-org": "acme"}),    # port strip
     make_req("other.test", headers={"x-org": "acme"}, ctx={"host": "fast-eq.test"}),
+    # mixed pattern + lowered-Rego config: both evaluators kernel-decided
+    make_req("fast-rego.test", headers={"x-tier": "gold"}),              # GET → allow
+    make_req("fast-rego.test", method="DELETE", headers={"x-tier": "gold"}),  # rego deny
+    make_req("fast-rego.test", method="DELETE",
+             headers={"x-tier": "gold", "x-root": "true"}),              # 2nd rego body
+    make_req("fast-rego.test", headers={"x-tier": "wood"}),              # pattern deny
+    make_req("fast-rego.test", method="DELETE", headers={"x-root": "TRUE"}),  # both deny
 ]
 
 
@@ -1146,6 +1176,143 @@ def test_identity_templated_deny_rides_fast_lane():
         fe.stop()
 
 
+def test_hybrid_lane_procedural_rego():
+    """A config mixing kernel patterns with PROCEDURAL (non-lowerable) Rego
+    rides the hybrid lane (round 5): kernel denials answer natively, kernel
+    passes hand the raw request to the slow pipeline — which re-runs the
+    full phase (∧-verdict, so re-deciding covered patterns is correct).
+    The reference evaluates OPA inline in the same server
+    (ref pkg/evaluators/authorization/opa.go:86-117)."""
+    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    rule = Pattern("request.headers.x-tier", Operator.EQ, "gold")
+    pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/hyb"),
+                         evaluator_slot=0)
+    opa = OPA("ns/hyb/rego",
+              inline_rego='allow { count(input.request.path) > 5 }')
+    assert opa.lowered_verdict() is None  # genuinely procedural
+    engine.apply_snapshot([EngineEntry(
+        id="ns/hyb", hosts=["hyb.test"],
+        runtime=RuntimeAuthConfig(
+            labels={"namespace": "ns", "name": "hyb"},
+            identity=[IdentityConfig("anon", Noop())],
+            authorization=[AuthorizationConfig("rules", pm),
+                           AuthorizationConfig("rego", opa)]),
+        rules=ConfigRules(name="ns/hyb", evaluators=[(None, rule)]))])
+    snap = engine._snapshot
+    spec = fast_lane_eligible(snap.by_id["ns/hyb"], snap.policy)
+    assert spec is not None and spec.hybrid and spec.has_batch
+
+    fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
+    port = fe.start()
+    holder, t = run_python_server(engine)
+    try:
+        # kernel deny: answered natively, zero slow-lane work
+        d = grpc_call(port, make_req("hyb.test", path="/abcdefg",
+                                     headers={"x-tier": "wood"}))
+        assert d.status.code == 7
+        s0 = fe.stats()
+        assert s0["fast"] >= 1 and s0["slow"] == 0 and s0["hybrid"] == 0
+        # kernel pass + rego deny: handed off, denied by the pipeline
+        d2 = grpc_call(port, make_req("hyb.test", path="/ab",
+                                      headers={"x-tier": "gold"}))
+        assert d2.status.code == 7
+        s1 = fe.stats()
+        assert s1["hybrid"] == 1 and s1["slow"] == 1
+        # kernel pass + rego pass: handed off, allowed by the pipeline
+        ok = grpc_call(port, make_req("hyb.test", path="/abcdefg",
+                                      headers={"x-tier": "gold"}))
+        assert ok.status.code == 0
+        assert fe.stats()["hybrid"] == 2
+        # differential vs the Python server across the whole matrix
+        matrix = [
+            make_req("hyb.test", path=p, headers=h)
+            for p in ("/ab", "/abcdefg")
+            for h in ({"x-tier": "gold"}, {"x-tier": "wood"}, {})
+        ]
+        for i, rq in enumerate(matrix):
+            native = response_key(grpc_call(port, rq))
+            python = response_key(grpc_call(holder["port"], rq))
+            assert native == python, f"hybrid req #{i}: {native} vs {python}"
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
+        fe.stop()
+
+
+def test_hybrid_priority_order_guard():
+    """Kernel pre-deny must not preempt an uncovered evaluator the pipeline
+    would have failed in an EARLIER priority bucket (its denial could
+    differ) — such configs stay fully slow."""
+    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    rule = Pattern("request.headers.x-tier", Operator.EQ, "gold")
+    pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/hp"),
+                         evaluator_slot=0)
+    opa = OPA("ns/hp/rego",
+              inline_rego='allow { count(input.request.path) > 5 }')
+    engine.apply_snapshot([EngineEntry(
+        id="ns/hp", hosts=["hp.test"],
+        runtime=RuntimeAuthConfig(
+            identity=[IdentityConfig("anon", Noop())],
+            authorization=[
+                AuthorizationConfig("rules", pm, priority=1),
+                AuthorizationConfig("rego", opa, priority=0)]),
+        rules=ConfigRules(name="ns/hp", evaluators=[(None, rule)]))])
+    snap = engine._snapshot
+    assert fast_lane_eligible(snap.by_id["ns/hp"], snap.policy) is None
+
+
+def test_hybrid_allows_arbitrary_responses():
+    """Hybrid OKs run the full pipeline, so per-request response templates
+    (which disqualify the FULL fast lane) are fine on hybrid configs."""
+    from authorino_tpu.evaluators import ResponseConfig
+    from authorino_tpu.evaluators.response import Plain
+
+    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    rule = Pattern("request.headers.x-tier", Operator.EQ, "gold")
+    pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/hr"),
+                         evaluator_slot=0)
+    opa = OPA("ns/hr/rego",
+              inline_rego='allow { count(input.request.path) > 5 }')
+    engine.apply_snapshot([EngineEntry(
+        id="ns/hr", hosts=["hr.test"],
+        runtime=RuntimeAuthConfig(
+            labels={"namespace": "ns", "name": "hr"},
+            identity=[IdentityConfig("anon", Noop())],
+            authorization=[AuthorizationConfig("rules", pm),
+                           AuthorizationConfig("rego", opa)],
+            response=[ResponseConfig(
+                "x-path", Plain(JSONValue(pattern="request.path")))]),
+        rules=ConfigRules(name="ns/hr", evaluators=[(None, rule)]))])
+    snap = engine._snapshot
+    spec = fast_lane_eligible(snap.by_id["ns/hr"], snap.policy)
+    assert spec is not None and spec.hybrid
+
+    fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
+    port = fe.start()
+    holder, t = run_python_server(engine)
+    try:
+        ok = grpc_call(port, make_req("hr.test", path="/abcdefg",
+                                      headers={"x-tier": "gold"}))
+        assert ok.status.code == 0
+        hdrs = {h.header.key: h.header.value
+                for h in ok.ok_response.headers}
+        assert hdrs.get("x-path") == "/abcdefg"
+        python = grpc_call(holder["port"], make_req(
+            "hr.test", path="/abcdefg", headers={"x-tier": "gold"}))
+        assert response_key(ok) == response_key(python)
+        # kernel deny still answers natively
+        d = grpc_call(port, make_req("hr.test", path="/abcdefg",
+                                     headers={"x-tier": "wood"}))
+        pd = grpc_call(holder["port"], make_req(
+            "hr.test", path="/abcdefg", headers={"x-tier": "wood"}))
+        assert response_key(d) == response_key(pd)
+        assert fe.stats()["slow"] == fe.stats()["hybrid"]
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=10)
+        fe.stop()
+
+
 def test_stop_drains_inflight_slow_requests():
     """fe.stop() while slow-lane requests are in flight must complete them
     before the loop closes — a cancelled handler would leave its client
@@ -1375,6 +1542,25 @@ def test_fast_lane_classification(stack):
     assert all(vplans for _, vplans, _ in spec2.sources[0].variants)
     # templated denyWith: per-request resolution → slow lane
     assert fast_lane_eligible(by_id["ns/slow-tmpl"], policy) is None
+    # mixed pattern + lowered Rego: BOTH evaluators kernel-decided (r5)
+    spec3 = fast_lane_eligible(by_id["ns/fast-rego"], policy)
+    assert spec3 is not None and spec3.has_batch
+
+
+def test_lowered_rego_rides_fast_lane(stack):
+    """Mixed pattern+Rego traffic must be served natively — zero slow-lane
+    handoffs for the lowered config (BASELINE class 5, VERDICT r4 item 1)."""
+    _, fe, native_port, _ = stack
+    before = fe.stats()
+    for hdrs, method in [({"x-tier": "gold"}, "GET"),
+                         ({"x-tier": "gold"}, "DELETE"),
+                         ({"x-tier": "gold", "x-root": "true"}, "DELETE"),
+                         ({"x-tier": "wood"}, "GET")]:
+        grpc_call(native_port, make_req("fast-rego.test", method=method,
+                                        headers=hdrs))
+    after = fe.stats()
+    assert after["fast"] - before["fast"] == 4
+    assert after["slow"] == before["slow"]
 
 
 def test_prewarm_covers_bucket_grid(stack):
@@ -1752,7 +1938,7 @@ def test_randomized_differential_sweep(stack):
              "fast-deny.test", "slow-key.test", "fast-key.test",
              "cookie-key.test", "query-key.test", "slow-tmpl.test",
              "a.wild.test", "deep.a.wild.test", "wild.test", "unknown.test",
-             "fast-eq.test:8080"]
+             "fast-eq.test:8080", "fast-rego.test"]
     methods = ["GET", "POST", "DELETE", "OPTIONS"]
     creds = [None, "APIKEY sekret", "APIKEY wrong", "Bearer sekret",
              "APIKEY", ""]
@@ -1782,6 +1968,10 @@ def test_randomized_differential_sweep(stack):
             headers["x-role"] = rng.choice(["admin", "user"])
         if rng.random() < 0.2:
             headers["x-pass"] = rng.choice(["yes", "no"])
+        if rng.random() < 0.3:
+            headers["x-tier"] = rng.choice(["gold", "wood", ""])
+        if rng.random() < 0.3:
+            headers["x-root"] = rng.choice(["true", "false", "TRUE", ""])
         ctx = ({"host": rng.choice(hosts[:4])}
                if rng.random() < 0.1 else None)
         req = make_req(rng.choice(hosts), method=rng.choice(methods),

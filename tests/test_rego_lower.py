@@ -1,0 +1,140 @@
+"""rego_lower: the lowered pattern Expression must agree with the mini-Rego
+interpreter on EVERY input — randomized differential sweeps over docs with
+missing keys, empty strings, and adversarial header values — and must refuse
+anything outside the provably-equivalent subset."""
+
+import random
+
+import pytest
+
+from authorino_tpu.authjson.wellknown import (
+    CheckRequestModel,
+    HttpRequestAttributes,
+    build_authorization_json,
+)
+from authorino_tpu.evaluators.authorization import OPA, rego
+from authorino_tpu.evaluators.authorization.rego_lower import lower_verdict
+
+
+def compile_allow(src: str) -> rego.RegoModule:
+    return rego.compile_module(f"default allow = false\n{src}", package="t")
+
+
+def interp_allow(module: rego.RegoModule, doc) -> bool:
+    return bool(module.evaluate(doc).get("allow"))
+
+
+def rand_doc(rng: random.Random):
+    methods = ["GET", "POST", "DELETE", "OPTIONS", ""]
+    paths = ["/", "/api/v1", "/apix", "/admin", "/a b", ""]
+    header_pool = [
+        ("x-root", ["true", "false", "", "TRUE"]),
+        ("x-tier", ["t-1", "t-2", "", "t"]),
+        ("x-org", ["acme", "evil", "ac", ""]),
+    ]
+    headers = {}
+    for name, vals in header_pool:
+        if rng.random() < 0.7:
+            headers[name] = rng.choice(vals)
+    req = CheckRequestModel(http=HttpRequestAttributes(
+        method=rng.choice(methods), path=rng.choice(paths),
+        host="h.test", scheme=rng.choice(["http", "https", ""]),
+        headers=headers))
+    return build_authorization_json(req)
+
+
+LOWERABLE = [
+    'allow { input.request.method == "GET" }',
+    ('allow { input.request.method == "GET" }\n'
+     'allow { input.request.headers["x-root"] == "true" }'),
+    'allow { "POST" == input.request.method }',
+    'allow { input.request.method != "DELETE" }',
+    'allow { not input.request.headers["x-org"] == "evil" }',
+    'allow { not input.request.method != "GET" }',
+    'allow { startswith(input.request.path, "/api") }',
+    'allow { endswith(input.request.path, "/v1") }',
+    'allow { contains(input.request.path, "admin") }',
+    'allow { regex.match("^t-[0-9]+$", input.request.headers["x-tier"]) }',
+    ('allow { input.request.method == "GET"; '
+     'input.request.headers["x-tier"] == "t-1" }'),
+    'allow { input.request.scheme == "" }',   # always-present, empty const ok
+    'allow { true }',
+    'allow { input.request.method = "GET" }',  # unification form
+    # statically-false body: the rule contributes nothing
+    'allow { 1 == 2 }\nallow { input.request.method == "GET" }',
+]
+
+
+@pytest.mark.parametrize("src", LOWERABLE)
+def test_lowered_matches_interpreter(src):
+    module = compile_allow(src)
+    expr = lower_verdict(module)
+    assert expr is not None, f"must lower: {src}"
+    rng = random.Random(hash(src) & 0xFFFF)
+    for _ in range(200):
+        doc = rand_doc(rng)
+        assert expr.matches(doc) == interp_allow(module, doc), (
+            f"divergence for {src!r} on "
+            f"method={doc['request']['method']!r} "
+            f"path={doc['request']['path']!r} "
+            f"headers={doc['request']['headers']!r}")
+
+
+NOT_LOWERABLE = [
+    # maybe-missing selector with != (missing: Rego false, pattern true)
+    'allow { input.request.headers["x-root"] != "true" }',
+    # maybe-missing selector, == "" (missing: Rego false, pattern true)
+    'allow { input.request.headers["x-root"] == "" }',
+    # not(!=) on maybe-missing
+    'allow { not input.request.headers["x-root"] != "true" }',
+    # regex matching "" on a maybe-missing selector
+    'allow { regex.match("a*", input.request.headers["x-root"]) }',
+    # non-string comparand (typed vs rendered equality)
+    'allow { input.request.size == 0 }',
+    # numeric path value
+    'allow { input.request.method == 3 }',
+    # auth.* (identity values not provably strings)
+    'allow { input.auth.identity.sub == "x" }',
+    # data refs
+    'allow { data.roles[_] == "x" }',
+    # helper rules could error / matter
+    'ok { input.request.method == "GET" }\nallow { ok }',
+    # functions
+    'f(x) = y { y := x }\nallow { f("a") == "a" }',
+    # else chains
+    'allow { input.request.method == "GET" } else = true { true }',
+    # non-true rule value
+    'allow = "yes" { input.request.method == "GET" }',
+    # arbitrary builtins
+    'allow { count(input.request.headers) > 0 }',
+    # invalid regex (interpreter raises → fail-closed; must not lower)
+    'allow { regex.match("(", input.request.method) }',
+]
+
+
+@pytest.mark.parametrize("src", NOT_LOWERABLE)
+def test_refuses_outside_subset(src):
+    module = compile_allow(src)
+    assert lower_verdict(module) is None, f"must NOT lower: {src}"
+
+
+def test_opa_evaluator_gates_lowering():
+    ev = OPA("t/a", inline_rego='allow { input.request.method == "GET" }')
+    assert ev.lowered_verdict() is not None
+    proc = OPA("t/b", inline_rego='allow { count(input.request.headers) > 0 }')
+    assert proc.lowered_verdict() is None
+    # external policies hot-swap without reconcile: never lowered
+    from authorino_tpu.evaluators.authorization import OPAExternalSource
+
+    ext = OPA("t/c", external_source=OPAExternalSource("http://x"))
+    ext.precompile('allow { input.request.method == "GET" }')
+    assert ext.lowered_verdict() is None
+
+
+def test_unsatisfiable_and_empty_policies_lower_to_false():
+    module = compile_allow('allow { 1 == 2 }')
+    expr = lower_verdict(module)
+    assert expr is not None
+    rng = random.Random(7)
+    for _ in range(20):
+        assert expr.matches(rand_doc(rng)) is False
