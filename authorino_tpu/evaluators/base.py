@@ -200,6 +200,14 @@ class RuntimeAuthConfig:
     response: List[ResponseConfig] = field(default_factory=list)
     callbacks: List[CallbackConfig] = field(default_factory=list)
     deny_with: DenyWith = field(default_factory=DenyWith)
+    # hot-path caches, populated lazily by AuthPipeline (the runtime model
+    # is immutable after translate — reconciles build NEW configs): bound
+    # Prometheus label children and per-phase priority buckets.  Rebuilding
+    # these per request was ~6% of the slow lane's budget.
+    _metric_children: Any = field(default=None, init=False, repr=False,
+                                  compare=False)
+    _bucket_cache: Any = field(default=None, init=False, repr=False,
+                               compare=False)
 
     def challenge_headers(self) -> List[Dict[str, str]]:
         """WWW-Authenticate challenges, one per identity config

@@ -162,12 +162,22 @@ class AuthPipeline:
                 t.cancel()
         await asyncio.gather(*tasks, return_exceptions=True)
 
-    @staticmethod
-    def _priority_buckets(configs: List[PhaseConfig]) -> List[List[PhaseConfig]]:
+    def _priority_buckets(self, configs: List[PhaseConfig]) -> List[List[PhaseConfig]]:
+        # cached per phase list on the (immutable-after-translate) runtime
+        # config — recomputing the grouping per request was measurable at
+        # slow-lane rates
+        cache = self.config._bucket_cache
+        if cache is None:
+            cache = self.config._bucket_cache = {}
+        got = cache.get(id(configs))
+        if got is not None:
+            return got
         buckets: Dict[int, List[PhaseConfig]] = {}
         for c in configs:
             buckets.setdefault(c.priority, []).append(c)
-        return [buckets[p] for p in sorted(buckets)]
+        out = [buckets[p] for p in sorted(buckets)]
+        cache[id(configs)] = out
+        return out
 
     # ---- phases ----------------------------------------------------------
 
@@ -338,18 +348,31 @@ class AuthPipeline:
             except Exception:
                 return result
 
-        labels = self.config.labels
-        alabels = (labels.get("namespace", ""), labels.get("name", ""))
-        metrics_mod.authconfig_total.labels(*alabels).inc()
+        # bound label children cached on the runtime config: labels() does
+        # validation + locking per call, a real cost at slow-lane rates
+        mc = self.config._metric_children
+        if mc is None:
+            labels = self.config.labels
+            alabels = (labels.get("namespace", ""), labels.get("name", ""))
+            mc = self.config._metric_children = (
+                metrics_mod.authconfig_total.labels(*alabels),
+                metrics_mod.authconfig_duration.labels(*alabels),
+                alabels, {})
+        mc[0].inc()
 
-        with metrics_mod.authconfig_duration.labels(*alabels).time():
+        with mc[1].time():
             try:
                 async with asyncio.timeout(self.timeout) if self.timeout else _null_async_ctx():
                     result = await self._evaluate_phases()
             except TimeoutError:
                 result = AuthResult(code=PERMISSION_DENIED, message="context deadline exceeded")
 
-        metrics_mod.authconfig_response_status.labels(*alabels, _code_name(result.code)).inc()
+        code = _code_name(result.code)
+        sc = mc[3].get(code)
+        if sc is None:
+            sc = mc[3][code] = metrics_mod.authconfig_response_status.labels(
+                *mc[2], code)
+        sc.inc()
         return result
 
     async def _evaluate_phases(self) -> AuthResult:

@@ -584,12 +584,17 @@ class _SnapRec:
     warm: set = field(default_factory=set)
     warm_done: threading.Event = field(default_factory=threading.Event)
     # configs with dyn sources: entry.id → (fc_idx, auth_attrs, policy,
-    # {id(IdentityConfig): (source idx, ttl cap)}) — the slow lane
+    # {id(IdentityConfig): (source idx, ttl cap)}, hybrid) — the slow lane
     # registers verified-credential plan variants against this snapshot
-    # (policy = the entry's OWN compile: its shard's on a mesh)
+    # (policy = the entry's OWN compile: its shard's on a mesh; hybrid
+    # suppresses per-credential OK bytes — the pipeline answers those)
     dyn_regs: Dict[str, Tuple[int, List[int], Any,
-                              Dict[int, Tuple[int, Optional[float]]]]] = field(
-        default_factory=dict)
+                              Dict[int, Tuple[int, Optional[float]]],
+                              bool]] = field(default_factory=dict)
+    # kernel rows of HYBRID configs (same key type as row_labels): dispatch
+    # attribution must count only their native denials — kernel-allowed
+    # requests continue into the pipeline, which observes them itself
+    hybrid_rows: set = field(default_factory=set)
 
 
 class NativeFrontend:
@@ -645,6 +650,13 @@ class NativeFrontend:
         # unregistered in stop() so a replaced frontend isn't kept alive
         # (and re-fired) by long-lived evaluators
         self._change_wired: set = set()
+        # slow-lane responses buffer here; a dedicated completer thread
+        # lands them in C++ in batches (per-response fe_complete_slow was
+        # ~35µs of contended wall on the asyncio thread)
+        from collections import deque as _deque
+
+        self._done_buf = _deque()
+        self._done_evt = threading.Event()
 
     # ------------------------------------------------------------------
     def start(self) -> int:
@@ -669,6 +681,9 @@ class NativeFrontend:
         ]
         self._threads.append(
             threading.Thread(target=self._slow_loop, name="atpu-fe-slow", daemon=True))
+        self._threads.append(
+            threading.Thread(target=self._completer_loop,
+                             name="atpu-fe-completer", daemon=True))
         for t in self._threads:
             t.start()
         self.refresh()
@@ -786,11 +801,13 @@ class NativeFrontend:
         (ref pkg/service/auth_pipeline.go:487-491)."""
         from ..evaluators.base import wrap_responses
         from ..evaluators.response import DynamicJSON
-        from ..pipeline.pipeline import AuthPipeline as _AP
 
         doc = _const_doc(identity_obj)
         results: Dict[Any, Any] = {}
-        for bucket in _AP._priority_buckets(rt.response):
+        grouped: Dict[int, list] = {}
+        for c in rt.response:
+            grouped.setdefault(c.priority, []).append(c)
+        for bucket in (grouped[p] for p in sorted(grouped)):
             for conf in bucket:
                 ev = conf.evaluator
                 if isinstance(ev, DynamicJSON):
@@ -1235,12 +1252,15 @@ class NativeFrontend:
                 if sharded is not None:
                     shard, row = sharded.locator[entry.rules.name]
                     fc["row"], fc["shard"] = int(row), int(shard)
-                    rec.row_labels[(int(shard), int(row))] = (ns_l, nm_l)
+                    row_key: Any = (int(shard), int(row))
                 else:
                     row = policy.config_ids[entry.rules.name]
                     fc["row"] = int(row)
                     fc_rows.append(int(row))
-                    rec.row_labels[int(row)] = (ns_l, nm_l)
+                    row_key = int(row)
+                rec.row_labels[row_key] = (ns_l, nm_l)
+                if spec_fl.hybrid:
+                    rec.hybrid_rows.add(row_key)
             fcs.append(fc)
             for host in entry.hosts:
                 hosts.append((host, fc_idx))
@@ -1513,6 +1533,14 @@ class NativeFrontend:
         for row in np.nonzero(n_per_row)[0]:
             n, n_ok = int(n_per_row[row]), int(ok_per_row[row])
             ns, name = rec.row_labels.get(int(row), ("", ""))
+            if int(row) in rec.hybrid_rows:
+                # kernel-allowed hybrid requests continue into the
+                # pipeline, which observes them itself — only the native
+                # denials are final here
+                n = n - n_ok
+                n_ok = 0
+                if not n:
+                    continue
             metrics_mod.authconfig_total.labels(ns, name).inc(n)
             if n_ok:
                 metrics_mod.authconfig_response_status.labels(ns, name, "OK").inc(n_ok)
@@ -1554,13 +1582,48 @@ class NativeFrontend:
         ok_per = np.bincount(flat, weights=verdict).astype(np.int64)
         for f in np.nonzero(n_per)[0]:
             n, n_ok = int(n_per[f]), int(ok_per[f])
-            ns, name = rec.row_labels.get((int(f // G), int(f % G)), ("", ""))
+            key = (int(f // G), int(f % G))
+            ns, name = rec.row_labels.get(key, ("", ""))
+            if key in rec.hybrid_rows:
+                n = n - n_ok
+                n_ok = 0
+                if not n:
+                    continue
             metrics_mod.authconfig_total.labels(ns, name).inc(n)
             if n_ok:
                 metrics_mod.authconfig_response_status.labels(ns, name, "OK").inc(n_ok)
             if n - n_ok:
                 metrics_mod.authconfig_response_status.labels(
                     ns, name, "PERMISSION_DENIED").inc(n - n_ok)
+
+    # ------------------------------------------------------------------
+    def _completer_loop(self) -> None:
+        """Drain buffered slow-lane responses into C++ in batches: two lock
+        rounds + at most one epoll wake per batch instead of per response.
+        Runs until stop() AND the buffer is flushed (stop()'s drain loop
+        waits for slow_pending to clear, which needs these flushes)."""
+        mod = self._mod
+        buf = self._done_buf
+        evt = self._done_evt
+        while True:
+            if not buf:
+                # only sleep when the buffer is empty: a burst past the
+                # batch cap must flush immediately, not after the timeout
+                evt.wait(0.2)
+                evt.clear()
+            items = []
+            while buf and len(items) < 1024:
+                try:
+                    items.append(buf.popleft())
+                except IndexError:
+                    break
+            if items:
+                try:
+                    mod.fe_complete_slow_many(items)
+                except Exception:
+                    log.exception("batch completion failed")
+            elif not self._running:
+                return
 
     # ------------------------------------------------------------------
     def _slow_loop(self) -> None:
@@ -1577,6 +1640,13 @@ class NativeFrontend:
         external_auth_pb2 = protos.external_auth_pb2
 
         from ..utils.tracing import RequestSpan
+
+        done_buf = self._done_buf
+        done_evt = self._done_evt
+
+        def complete(req_id: int, payload: bytes, status: int) -> None:
+            done_buf.append((req_id, payload, status))
+            done_evt.set()
 
         async def handle(req_id: int, raw: bytes) -> None:
             try:
@@ -1609,11 +1679,11 @@ class NativeFrontend:
                             self._register_dyn(rec, entry, pipeline, model)
                     finally:
                         span.end()
-                mod.fe_complete_slow(
-                    req_id, check_response_from_result(result).SerializeToString(), 0)
+                complete(req_id,
+                         check_response_from_result(result).SerializeToString(), 0)
             except Exception:
                 log.exception("slow-lane request failed")
-                mod.fe_complete_slow(req_id, b"", 13)  # INTERNAL
+                complete(req_id, b"", 13)  # INTERNAL
 
         async def main() -> None:
             # continuous admission, NOT batch-gather convoys: a straggler

@@ -10,7 +10,8 @@ raw-HTTP adapter."""
 from __future__ import annotations
 
 import asyncio
-import uuid
+import os
+import random
 from typing import Dict, Optional
 
 import grpc
@@ -59,6 +60,17 @@ def _metadata_context_dict(metadata) -> Dict[str, dict]:
     return {"filter_metadata": out} if out else {}
 
 
+# request-id UUIDs come from a crypto-seeded PRNG: they are log/trace
+# correlation handles, not secrets, and os.urandom per request is a
+# measurable slow-lane cost
+_RID_RNG = random.Random(os.urandom(16))
+
+
+def _request_id() -> str:
+    s = "%032x" % _RID_RNG.getrandbits(128)
+    return f"{s[:8]}-{s[8:12]}-4{s[13:16]}-{s[16:20]}-{s[20:]}"
+
+
 def request_model_from_proto(req) -> Optional[CheckRequestModel]:
     """CheckRequest proto → CheckRequestModel; None when http attributes are
     missing (→ INVALID_ARGUMENT, ref auth.go:242-255)."""
@@ -71,7 +83,7 @@ def request_model_from_proto(req) -> Optional[CheckRequestModel]:
         time_str = attrs.request.time.ToJsonString()
     return CheckRequestModel(
         http=HttpRequestAttributes(
-            id=http.id or str(uuid.uuid4()),
+            id=http.id or _request_id(),
             method=http.method,
             headers=dict(http.headers),
             path=http.path,
@@ -87,7 +99,8 @@ def request_model_from_proto(req) -> Optional[CheckRequestModel]:
         source=_peer_from_proto(attrs.source),
         destination=_peer_from_proto(attrs.destination),
         context_extensions=dict(attrs.context_extensions),
-        metadata_context=_metadata_context_dict(attrs.metadata_context),
+        metadata_context=(_metadata_context_dict(attrs.metadata_context)
+                          if attrs.HasField("metadata_context") else {}),
         time=time_str,
     )
 

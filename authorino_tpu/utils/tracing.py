@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import re
 import secrets
 import time
@@ -26,6 +27,9 @@ from typing import Any, Dict, Optional
 from urllib.parse import urlsplit
 
 log = logging.getLogger("authorino_tpu.trace")
+
+# crypto-seeded PRNG for span/trace ids (GIL-atomic getrandbits)
+_ID_RNG = random.Random(secrets.token_bytes(16))
 
 _TRACEPARENT_RE = re.compile(
     r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
@@ -199,11 +203,15 @@ class RequestSpan:
             trace_id = m.group(2)
             sampled = bool(int(m.group(4), 16) & 1)
         else:
-            trace_id = secrets.token_hex(16)
+            # PRNG ids, crypto-seeded once: trace ids are correlation
+            # handles, not secrets (OTel's own generator is math/rand), and
+            # os.urandom per request is measurable at slow-lane rates.
+            # `| 1` keeps the all-zero id W3C-invalid case out.
+            trace_id = "%032x" % (_ID_RNG.getrandbits(128) | 1)
             sampled = True
         span = cls(
             trace_id=trace_id,
-            span_id=secrets.token_hex(8),
+            span_id="%016x" % (_ID_RNG.getrandbits(64) | 1),
             sampled=sampled,
             request_id=request_id,
         )

@@ -652,7 +652,10 @@ struct Server {
   bool timer_armed = false;
 
   // queues
-  std::deque<Done> done_q;                            // under mu; evfd wakes epoll
+  std::mutex done_mu;   // done_q only — its own lock so completion storms
+                        // from dispatch/slow threads don't contend with
+                        // everything else S->mu guards
+  std::deque<Done> done_q;                            // under done_mu; evfd wakes epoll
   std::mutex batch_mu;
   std::condition_variable batch_cv;
   std::deque<Event> batch_events;
@@ -1495,7 +1498,7 @@ static void accept_conns(Server* S) {
 static void drain_done(Server* S) {
   std::deque<Done> q;
   {
-    std::lock_guard<std::mutex> lk(S->mu);
+    std::lock_guard<std::mutex> lk(S->done_mu);
     q.swap(S->done_q);
   }
   std::vector<Conn*> touched;
@@ -1767,6 +1770,7 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
   // then slow_mu — never nested)
   struct Handoff { uint32_t conn_id; int32_t stream_id; std::string raw; };
   std::vector<Handoff> handoffs;
+  std::deque<Done> dones;
   {
     std::lock_guard<std::mutex> lk(S->mu);
     for (size_t i = 0; i < entries.size(); ++i) {
@@ -1779,7 +1783,7 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
         continue;
       }
       allowed += ok;
-      S->done_q.push_back(
+      dones.push_back(
           {e.conn_id, e.stream_id,
            ok ? (e.ok_msg ? *e.ok_msg : fc.ok_msg)
               : (e.deny_msg ? *e.deny_msg : fc.deny_msg),
@@ -1795,13 +1799,13 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
       std::lock_guard<std::mutex> lk(S->mu);
       if (S->slow_pending.size() >= S->slow_cap) {
         shed = true;
-        S->done_q.push_back({h.conn_id, h.stream_id, std::string(), 8, 0});
       } else {
         id = S->next_slow_id++;
         S->slow_pending[id] = {h.conn_id, h.stream_id};
       }
     }
     if (shed) {
+      dones.push_back({h.conn_id, h.stream_id, std::string(), 8, 0});
       S->n_slow_shed.fetch_add(1, std::memory_order_relaxed);
       continue;
     }
@@ -1812,6 +1816,10 @@ static void complete_batch(Server* S, int64_t snap_id, int slot, const uint8_t* 
     S->n_slow.fetch_add(1, std::memory_order_relaxed);
   }
   if (!handoffs.empty()) S->slow_cv.notify_all();
+  if (!dones.empty()) {
+    std::lock_guard<std::mutex> lk(S->done_mu);
+    for (Done& d : dones) S->done_q.push_back(std::move(d));
+  }
   // per-request on-box stages + the duration series the pipeline observes
   // (ref pkg/service/auth_pipeline.go:26-36): all clocked here, no tunnel.
   // Hybrid handoffs skip the duration series — the Python pipeline they
@@ -1906,10 +1914,47 @@ static void complete_slow(Server* S, uint64_t req_id, const char* msg, size_t n,
     if (it == S->slow_pending.end()) return;
     sp = it->second;
     S->slow_pending.erase(it);
+  }
+  bool was_empty;
+  {
+    std::lock_guard<std::mutex> lk(S->done_mu);
+    was_empty = S->done_q.empty();
     S->done_q.push_back({sp.conn_id, sp.stream_id, std::string(msg, n),
                          grpc_status, now_mono_ns()});
   }
-  wake_epoll(S);
+  // coalesce wakes: drain_done swaps the WHOLE queue under done_mu, so a
+  // non-empty observation means a wake is already owed — the eventfd
+  // write per completion was a measurable share of the slow lane's budget
+  if (was_empty) wake_epoll(S);
+}
+
+// batch form: the Python slow lane buffers finished responses and a
+// dedicated completer thread lands N of them in two lock rounds + at most
+// one wake — per-response mutex/wake traffic was ~35µs of contended wall
+// on the asyncio thread
+struct SlowDone { uint64_t req_id; std::string msg; int grpc_status; };
+
+static void complete_slow_many(Server* S, std::vector<SlowDone>& items) {
+  std::deque<Done> dones;
+  const int64_t t_now = now_mono_ns();
+  {
+    std::lock_guard<std::mutex> lk(S->mu);
+    for (SlowDone& sd : items) {
+      auto it = S->slow_pending.find(sd.req_id);
+      if (it == S->slow_pending.end()) continue;
+      dones.push_back({it->second.conn_id, it->second.stream_id,
+                       std::move(sd.msg), sd.grpc_status, t_now});
+      S->slow_pending.erase(it);
+    }
+  }
+  if (dones.empty()) return;
+  bool was_empty;
+  {
+    std::lock_guard<std::mutex> lk(S->done_mu);
+    was_empty = S->done_q.empty();
+    for (Done& d : dones) S->done_q.push_back(std::move(d));
+  }
+  if (was_empty) wake_epoll(S);
 }
 
 }  // namespace fe

@@ -629,9 +629,42 @@ PyObject* fe_complete_slow_py(PyObject*, PyObject* args) {
   int grpc_status;
   if (!PyArg_ParseTuple(args, "Ky*i", &req_id, &resp, &grpc_status)) return nullptr;
   fe::Server* S = fe::g_srv;
-  if (S != nullptr)
+  if (S != nullptr) {
+    // complete_slow contends on the server mutex with the epoll thread —
+    // release the GIL so that wait never blocks the Python slow lane
+    Py_BEGIN_ALLOW_THREADS
     fe::complete_slow(S, req_id, (const char*)resp.buf, (size_t)resp.len, grpc_status);
+    Py_END_ALLOW_THREADS
+  }
   PyBuffer_Release(&resp);
+  Py_RETURN_NONE;
+}
+
+// fe_complete_slow_many([(req_id, resp_bytes, grpc_status), ...]) — batch
+// completion: copies the payloads under the GIL, lands them all in two
+// lock rounds with the GIL released
+PyObject* fe_complete_slow_many_py(PyObject*, PyObject* args) {
+  PyObject* lst;
+  if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &lst)) return nullptr;
+  std::vector<fe::SlowDone> items;
+  items.reserve((size_t)PyList_GET_SIZE(lst));
+  for (Py_ssize_t i = 0; i < PyList_GET_SIZE(lst); ++i) {
+    PyObject* t = PyList_GET_ITEM(lst, i);
+    unsigned long long req_id;
+    Py_buffer resp;
+    int grpc_status;
+    if (!PyArg_ParseTuple(t, "Ky*i", &req_id, &resp, &grpc_status))
+      return nullptr;
+    items.push_back({req_id, std::string((const char*)resp.buf,
+                                         (size_t)resp.len), grpc_status});
+    PyBuffer_Release(&resp);
+  }
+  fe::Server* S = fe::g_srv;
+  if (S != nullptr && !items.empty()) {
+    Py_BEGIN_ALLOW_THREADS
+    fe::complete_slow_many(S, items);
+    Py_END_ALLOW_THREADS
+  }
   Py_RETURN_NONE;
 }
 
@@ -806,6 +839,8 @@ PyMethodDef methods[] = {
     {"fe_take_slow", fe_take_slow_py, METH_VARARGS, "take queued slow-lane requests"},
     {"fe_complete_batch", fe_complete_batch_py, METH_VARARGS, "complete a batch"},
     {"fe_complete_slow", fe_complete_slow_py, METH_VARARGS, "complete a slow request"},
+    {"fe_complete_slow_many", fe_complete_slow_many_py, METH_VARARGS,
+     "complete a batch of slow requests"},
     {"fe_add_variant", fe_add_variant_py, METH_VARARGS,
      "register a runtime credential plan variant"},
     {"fe_stats", fe_stats_py, METH_NOARGS, "frontend counters"},

@@ -1202,10 +1202,22 @@ def test_hybrid_lane_procedural_rego():
     spec = fast_lane_eligible(snap.by_id["ns/hyb"], snap.policy)
     assert spec is not None and spec.hybrid and spec.has_batch
 
+    def hyb_total():
+        from prometheus_client import REGISTRY
+
+        return sum(
+            s.value for m in REGISTRY.collect()
+            if m.name == "auth_server_authconfig"
+            for s in m.samples
+            if s.name == "auth_server_authconfig_total"
+            and s.labels.get("namespace") == "ns"
+            and s.labels.get("authconfig") == "hyb")
+
     fe = NativeFrontend(engine, port=0, max_batch=16, window_us=500)
     port = fe.start()
     holder, t = run_python_server(engine)
     try:
+        base_total = hyb_total()
         # kernel deny: answered natively, zero slow-lane work
         d = grpc_call(port, make_req("hyb.test", path="/abcdefg",
                                      headers={"x-tier": "wood"}))
@@ -1223,6 +1235,9 @@ def test_hybrid_lane_procedural_rego():
                                       headers={"x-tier": "gold"}))
         assert ok.status.code == 0
         assert fe.stats()["hybrid"] == 2
+        # one authconfig_total per REQUEST: kernel-allowed handoffs are
+        # counted by the pipeline only (no dispatch+pipeline double count)
+        assert hyb_total() - base_total == 3
         # differential vs the Python server across the whole matrix
         matrix = [
             make_req("hyb.test", path=p, headers=h)
