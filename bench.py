@@ -912,154 +912,160 @@ def run_mix_mode(args):
                            rules=ConfigRules(name=cfg_id, evaluators=[(cond, rule)]))
 
     # ---- class 1: single config, one header-eq rule -----------------------
-    engine = new_engine()
-    engine.apply_snapshot([pattern_entry(
-        engine, "ns/single", ["single.bench"],
-        Pattern("request.headers.x-org", Operator.EQ, "acme"))])
-    payloads = [payload("single.bench",
-                        {"x-org": "acme" if rng.random() < 0.5 else "evil"})
-                for _ in range(4096)]
-    results["c1_single_rule"] = wire_trial(engine, payloads, args, "c1")
+    if want("c1"):
+        engine = new_engine()
+        engine.apply_snapshot([pattern_entry(
+            engine, "ns/single", ["single.bench"],
+            Pattern("request.headers.x-org", Operator.EQ, "acme"))])
+        payloads = [payload("single.bench",
+                            {"x-org": "acme" if rng.random() < 0.5 else "evil"})
+                    for _ in range(4096)]
+        results["c1_single_rule"] = wire_trial(engine, payloads, args, "c1")
 
     # ---- class 2: when conditions + allOf/anyOf multi-rule ----------------
-    engine = new_engine()
-    n2 = 200
-    entries = []
-    for i in range(n2):
-        rule = All(
-            Pattern("request.headers.x-tier", Operator.EQ, f"t-{i}"),
-            Any_(Pattern("request.headers.x-role", Operator.EQ, "admin"),
-                 Pattern("request.headers.x-group", Operator.INCL, f"g-{i}")),
-        )
-        # evaluator-level `when` condition, compiled into the kernel the way
-        # translate.py does for real AuthConfigs
-        cond = Pattern("request.method", Operator.EQ, "POST")
-        entries.append(pattern_entry(engine, f"ns/cond-{i}", [f"cond-{i}.bench"],
-                                     rule, cond=cond))
-    engine.apply_snapshot(entries)
-    payloads = []
-    for j in range(4096):
-        i = j % n2
-        payloads.append(payload(
-            f"cond-{i}.bench",
-            {"x-tier": f"t-{i}", "x-role": "admin" if rng.random() < 0.5 else "user"},
-            method="POST" if rng.random() < 0.7 else "GET"))
-    results["c2_when_conditions"] = wire_trial(engine, payloads, args, "c2")
+    if want("c2"):
+        engine = new_engine()
+        n2 = 200
+        entries = []
+        for i in range(n2):
+            rule = All(
+                Pattern("request.headers.x-tier", Operator.EQ, f"t-{i}"),
+                Any_(Pattern("request.headers.x-role", Operator.EQ, "admin"),
+                     Pattern("request.headers.x-group", Operator.INCL, f"g-{i}")),
+            )
+            # evaluator-level `when` condition, compiled into the kernel the way
+            # translate.py does for real AuthConfigs
+            cond = Pattern("request.method", Operator.EQ, "POST")
+            entries.append(pattern_entry(engine, f"ns/cond-{i}", [f"cond-{i}.bench"],
+                                         rule, cond=cond))
+        engine.apply_snapshot(entries)
+        payloads = []
+        for j in range(4096):
+            i = j % n2
+            payloads.append(payload(
+                f"cond-{i}.bench",
+                {"x-tier": f"t-{i}", "x-role": "admin" if rng.random() < 0.5 else "user"},
+                method="POST" if rng.random() < 0.7 else "GET"))
+        results["c2_when_conditions"] = wire_trial(engine, payloads, args, "c2")
 
     # ---- class 3: OIDC JWT + claim patterns (verified-token cache) --------
-    idp = _start_bench_idp()
-    n3, n_tokens = 100, 1024
-    engine = new_engine()
-    oidc = OIDC("kc", idp["iss"])
-    entries = []
-    for i in range(n3):
-        cfg_id = f"ns/oidc-{i}"
-        rule = Pattern("auth.identity.realm_access.roles", Operator.INCL, f"r-{i}")
-        pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
-                             evaluator_slot=0)
-        entries.append(EngineEntry(
-            id=cfg_id, hosts=[f"oidc-{i}.bench"],
-            runtime=RuntimeAuthConfig(
-                identity=[IdentityConfig("kc", oidc)],
-                authorization=[AuthorizationConfig("rules", pm)]),
-            rules=ConfigRules(name=cfg_id, evaluators=[(None, rule)])))
-    engine.apply_snapshot(entries)
-    now = int(time.time())
-    log(f"[c3] minting {n_tokens} RS256 tokens...")
-    tokens = []
-    for k in range(n_tokens):
-        i = k % n3
-        roles = [f"r-{i}"] if rng.random() < 0.5 else ["viewer"]
-        tokens.append((i, jose.sign_jwt(
-            {"iss": idp["iss"], "sub": f"u{k}", "iat": now, "exp": now + 7200,
-             "realm_access": {"roles": roles}}, idp["key"], "RS256", kid="b1")))
-    payloads = [payload(f"oidc-{i}.bench", {"authorization": f"Bearer {tok}"})
-                for i, tok in (tokens[j % n_tokens] for j in range(4096))]
-    try:
-        results["c3_oidc_jwt"] = wire_trial(engine, payloads, args, "c3",
-                                            wait_stat=("dyn_add", n_tokens))
-    finally:
-        idp["loop"].call_soon_threadsafe(idp["stop"].set)
-        idp["thread"].join(timeout=10)
+    if want("c3"):
+        idp = _start_bench_idp()
+        n3, n_tokens = 100, 1024
+        engine = new_engine()
+        oidc = OIDC("kc", idp["iss"])
+        entries = []
+        for i in range(n3):
+            cfg_id = f"ns/oidc-{i}"
+            rule = Pattern("auth.identity.realm_access.roles", Operator.INCL, f"r-{i}")
+            pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
+                                 evaluator_slot=0)
+            entries.append(EngineEntry(
+                id=cfg_id, hosts=[f"oidc-{i}.bench"],
+                runtime=RuntimeAuthConfig(
+                    identity=[IdentityConfig("kc", oidc)],
+                    authorization=[AuthorizationConfig("rules", pm)]),
+                rules=ConfigRules(name=cfg_id, evaluators=[(None, rule)])))
+        engine.apply_snapshot(entries)
+        now = int(time.time())
+        log(f"[c3] minting {n_tokens} RS256 tokens...")
+        tokens = []
+        for k in range(n_tokens):
+            i = k % n3
+            roles = [f"r-{i}"] if rng.random() < 0.5 else ["viewer"]
+            tokens.append((i, jose.sign_jwt(
+                {"iss": idp["iss"], "sub": f"u{k}", "iat": now, "exp": now + 7200,
+                 "realm_access": {"roles": roles}}, idp["key"], "RS256", kid="b1")))
+        payloads = [payload(f"oidc-{i}.bench", {"authorization": f"Bearer {tok}"})
+                    for i, tok in (tokens[j % n_tokens] for j in range(4096))]
+        try:
+            results["c3_oidc_jwt"] = wire_trial(engine, payloads, args, "c3",
+                                                wait_stat=("dyn_add", n_tokens))
+        finally:
+            idp["loop"].call_soon_threadsafe(idp["stop"].set)
+            idp["thread"].join(timeout=10)
 
     # ---- class 4: the north-star corpus (1k × 10) -------------------------
-    engine = new_engine()
-    engine.apply_snapshot(build_wire_entries(args, engine.provider_for))
-    payloads = [make_wire_payload(external_auth_pb2, i, args.configs, rng)
-                for i in range(4096)]
-    results["c4_1k_configs_10_rules"] = wire_trial(engine, payloads, args, "c4")
+    if want("c4"):
+        engine = new_engine()
+        engine.apply_snapshot(build_wire_entries(args, engine.provider_for))
+        payloads = [make_wire_payload(external_auth_pb2, i, args.configs, rng)
+                    for i in range(4096)]
+        results["c4_1k_configs_10_rules"] = wire_trial(engine, payloads, args, "c4")
 
     # ---- class 5: patternMatching + inline Rego in one AuthConfig ---------
-    engine = new_engine()
-    n5 = 100
-    entries = []
-    for i in range(n5):
-        cfg_id = f"ns/mixed-{i}"
-        rule = Pattern("request.headers.x-tier", Operator.EQ, f"t-{i}")
-        pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
-                             evaluator_slot=0)
-        opa = OPA(cfg_id, inline_rego=(
-            'allow { input.request.method == "GET" }\n'
-            'allow { input.request.headers["x-root"] == "true" }'))
-        # decidable Rego lowers into the kernel corpus exactly as the
-        # translate path does (rego_lower; VERDICT r4 item 1) — the config
-        # rides the fast lane with BOTH evaluators kernel-decided
-        lowered = opa.lowered_verdict()
-        assert lowered is not None, "c5 rego must be lowerable"
-        opa.kernel_slot = 1
-        entries.append(EngineEntry(
-            id=cfg_id, hosts=[f"mixed-{i}.bench"],
-            runtime=RuntimeAuthConfig(
-                identity=[IdentityConfig("anon", Noop())],
-                authorization=[AuthorizationConfig("rules", pm),
-                               AuthorizationConfig("rego", opa)]),
-            rules=ConfigRules(name=cfg_id,
-                              evaluators=[(None, rule), (None, lowered)])))
-    engine.apply_snapshot(entries)
-    payloads = []
-    for j in range(4096):
-        i = j % n5
-        payloads.append(payload(f"mixed-{i}.bench", {"x-tier": f"t-{i}"},
-                                method="GET" if rng.random() < 0.8 else "DELETE"))
-    results["c5_mixed_opa"] = wire_trial(engine, payloads, args, "c5")
+    if want("c5"):
+        engine = new_engine()
+        n5 = 100
+        entries = []
+        for i in range(n5):
+            cfg_id = f"ns/mixed-{i}"
+            rule = Pattern("request.headers.x-tier", Operator.EQ, f"t-{i}")
+            pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
+                                 evaluator_slot=0)
+            opa = OPA(cfg_id, inline_rego=(
+                'allow { input.request.method == "GET" }\n'
+                'allow { input.request.headers["x-root"] == "true" }'))
+            # decidable Rego lowers into the kernel corpus exactly as the
+            # translate path does (rego_lower; VERDICT r4 item 1) — the config
+            # rides the fast lane with BOTH evaluators kernel-decided
+            lowered = opa.lowered_verdict()
+            assert lowered is not None, "c5 rego must be lowerable"
+            opa.kernel_slot = 1
+            entries.append(EngineEntry(
+                id=cfg_id, hosts=[f"mixed-{i}.bench"],
+                runtime=RuntimeAuthConfig(
+                    identity=[IdentityConfig("anon", Noop())],
+                    authorization=[AuthorizationConfig("rules", pm),
+                                   AuthorizationConfig("rego", opa)]),
+                rules=ConfigRules(name=cfg_id,
+                                  evaluators=[(None, rule), (None, lowered)])))
+        engine.apply_snapshot(entries)
+        payloads = []
+        for j in range(4096):
+            i = j % n5
+            payloads.append(payload(f"mixed-{i}.bench", {"x-tier": f"t-{i}"},
+                                    method="GET" if rng.random() < 0.8 else "DELETE"))
+        results["c5_mixed_opa"] = wire_trial(engine, payloads, args, "c5")
 
     # ---- class 6 (extra): API-key identities + auth.* patterns ------------
-    # (VERDICT r4 item 1 done-criterion: an API-key wire number; per-key
-    # plan variants resolve auth.identity.* to constants at refresh time)
-    engine = new_engine()
-    n6 = 200
-    entries = []
-    for i in range(n6):
-        cfg_id = f"ns/key-{i}"
-        ak = APIKey(f"keys-{i}", LabelSelector.from_spec(
-            {"matchLabels": {"app": f"svc-{i}"}}),
-            credentials=AuthCredentials(key_selector="APIKEY"))
-        for role, key in (("admin", f"adm-{i}-k"), ("user", f"usr-{i}-k")):
-            ak.add_k8s_secret_based_identity(Secret(
-                namespace="ns", name=f"{role}-{i}",
-                labels={"app": f"svc-{i}"}, annotations={"role": role},
-                data={"api_key": key.encode()}))
-        rule = Pattern("auth.identity.metadata.annotations.role",
-                       Operator.EQ, "admin")
-        pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
-                             evaluator_slot=0)
-        entries.append(EngineEntry(
-            id=cfg_id, hosts=[f"key-{i}.bench"],
-            runtime=RuntimeAuthConfig(
-                identity=[IdentityConfig(
-                    f"keys-{i}", ak,
-                    credentials=AuthCredentials(key_selector="APIKEY"))],
-                authorization=[AuthorizationConfig("rules", pm)]),
-            rules=ConfigRules(name=cfg_id, evaluators=[(None, rule)])))
-    engine.apply_snapshot(entries)
-    payloads = []
-    for j in range(4096):
-        i = j % n6
-        r = rng.random()
-        key = f"adm-{i}-k" if r < 0.5 else (f"usr-{i}-k" if r < 0.85 else "nope")
-        payloads.append(payload(f"key-{i}.bench",
-                                {"authorization": f"APIKEY {key}"}))
-    results["c6_api_key"] = wire_trial(engine, payloads, args, "c6")
+    if want("c6"):
+        # (VERDICT r4 item 1 done-criterion: an API-key wire number; per-key
+        # plan variants resolve auth.identity.* to constants at refresh time)
+        engine = new_engine()
+        n6 = 200
+        entries = []
+        for i in range(n6):
+            cfg_id = f"ns/key-{i}"
+            ak = APIKey(f"keys-{i}", LabelSelector.from_spec(
+                {"matchLabels": {"app": f"svc-{i}"}}),
+                credentials=AuthCredentials(key_selector="APIKEY"))
+            for role, key in (("admin", f"adm-{i}-k"), ("user", f"usr-{i}-k")):
+                ak.add_k8s_secret_based_identity(Secret(
+                    namespace="ns", name=f"{role}-{i}",
+                    labels={"app": f"svc-{i}"}, annotations={"role": role},
+                    data={"api_key": key.encode()}))
+            rule = Pattern("auth.identity.metadata.annotations.role",
+                           Operator.EQ, "admin")
+            pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
+                                 evaluator_slot=0)
+            entries.append(EngineEntry(
+                id=cfg_id, hosts=[f"key-{i}.bench"],
+                runtime=RuntimeAuthConfig(
+                    identity=[IdentityConfig(
+                        f"keys-{i}", ak,
+                        credentials=AuthCredentials(key_selector="APIKEY"))],
+                    authorization=[AuthorizationConfig("rules", pm)]),
+                rules=ConfigRules(name=cfg_id, evaluators=[(None, rule)])))
+        engine.apply_snapshot(entries)
+        payloads = []
+        for j in range(4096):
+            i = j % n6
+            r = rng.random()
+            key = f"adm-{i}-k" if r < 0.5 else (f"usr-{i}-k" if r < 0.85 else "nope")
+            payloads.append(payload(f"key-{i}.bench",
+                                    {"authorization": f"APIKEY {key}"}))
+        results["c6_api_key"] = wire_trial(engine, payloads, args, "c6")
 
     return results
 
@@ -1097,6 +1103,9 @@ def main():
                          "fake OTLP collector (head sampling at the frontend "
                          "default, 1-in-128) — "
                          "measures the cost of observability being ON")
+    ap.add_argument("--classes", default="",
+                    help="mix mode: comma-separated class filter (c1..c6); "
+                         "empty = all")
     ap.add_argument("--trials", type=int, default=3,
                     help="run the measured loop N times and report the best "
                          "— the tunnel to the device on this image has "
