@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "requires it; 'off' uses the Python grpc.aio server")
     s.add_argument("--evaluator-cache-size", type=int, default=env_var("EVALUATOR_CACHE_SIZE", 4096))
     s.add_argument("--deep-metrics-enabled", action="store_true", default=env_var("DEEP_METRICS_ENABLED", False))
+    s.add_argument("--debug-profile", action="store_true",
+                   default=env_var("DEBUG_PROFILE", False),
+                   help="Arm the /debug/profile?seconds=N endpoint (captures "
+                        "a jax.profiler trace to a temp dir on demand)")
     s.add_argument("--auth-config-label-selector", default=env_var("AUTH_CONFIG_LABEL_SELECTOR", ""))
     s.add_argument("--secret-label-selector", default=env_var("SECRET_LABEL_SELECTOR", "authorino.kuadrant.io/managed-by=authorino"))
     s.add_argument("--allow-superseding-host-subsets", action="store_true", default=env_var("ALLOW_SUPERSEDING_HOST_SUBSETS", False))
@@ -227,8 +231,14 @@ async def run_server(args) -> None:
     else:
         log.warning("no --watch-dir and not --in-cluster: serving an empty index")
 
-    # HTTP /check
-    app = build_app(engine, readiness=reconciler.ready, max_body=args.max_http_request_body_size)
+    # HTTP /check (+ /metrics, /debug/vars, /debug/profile).  The native
+    # frontend starts below, after this app — the holder closure lets
+    # /debug/vars see it once it exists
+    native_holder: dict = {}
+    app = build_app(engine, readiness=reconciler.ready,
+                    max_body=args.max_http_request_body_size,
+                    frontend=lambda: native_holder.get("fe"),
+                    enable_profile=bool(getattr(args, "debug_profile", False)))
     runner = web.AppRunner(app)
     await runner.setup()
     await web.TCPSite(runner, "0.0.0.0", args.ext_auth_http_port, ssl_context=ext_ssl).start()
@@ -263,6 +273,7 @@ async def run_server(args) -> None:
                 window_us=args.batch_window_us, bind_all=True,
             )
             native_fe.start()
+            native_holder["fe"] = native_fe  # /debug/vars picks it up
             log.info("native grpc ext_authz listening on :%d", args.ext_auth_grpc_port)
         except Exception as e:
             if native_fe is not None:

@@ -295,6 +295,7 @@ class ShardedPolicyModel:
         ``max_fallback`` of them per batch (beyond the cap: fail-closed
         deny + auth_server_host_fallback_shed_total)."""
         from ..models.policy_model import apply_host_fallback, host_results
+        from ..utils import metrics as metrics_mod
 
         enc = self.encode(docs, config_names, batch_pad=batch_pad)
         _, own_rule, own_skipped = self.apply_full(enc)
@@ -303,8 +304,10 @@ class ShardedPolicyModel:
             shard, row = self.locator[config_names[r]]
             return host_results(self.shards[shard], docs[r], int(row))[1:]
 
+        fallback_rows = np.nonzero(enc.host_fallback[: len(docs)])[0]
+        metrics_mod.batch_host_fallback.observe(len(fallback_rows))
         apply_host_fallback(
-            decide, np.nonzero(enc.host_fallback[: len(docs)])[0],
+            decide, fallback_rows,
             own_rule, own_skipped, max_fallback,
         )
         return own_rule, own_skipped

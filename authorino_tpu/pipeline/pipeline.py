@@ -375,23 +375,58 @@ class AuthPipeline:
         sc.inc()
         return result
 
+    def _phase_span(self, name: str, configs) -> Any:
+        """Child span for one pipeline phase — None whenever span export is
+        off, the request is unsampled, or the phase has nothing to run, so
+        untraced requests pay one attribute read per phase and nothing
+        else."""
+        span = self.span
+        if span is None or not configs:
+            return None
+        child = getattr(span, "child", None)
+        return child(name) if child is not None else None
+
     async def _evaluate_phases(self) -> AuthResult:
+        # every phase span ends in a finally: a cancelled/raising phase
+        # (request timeout, evaluator bug) must not leak a live SDK span
         result = AuthResult(code=OK)
-        identity_err = await self._evaluate_identity()
+        ph = self._phase_span("identity", self.config.identity)
+        identity_err = None
+        try:
+            identity_err = await self._evaluate_identity()
+        finally:
+            if ph is not None:
+                ph.end(error=identity_err)
         if identity_err is not None:
             result.code = UNAUTHENTICATED
             result.message = identity_err
             result.headers = self.config.challenge_headers()
             result = self._customize_deny_with(result, self.config.deny_with.unauthenticated)
         else:
-            await self._evaluate_fire_all(self.config.metadata, self.metadata_results)
-            authz_err = await self._evaluate_authorization()
+            ph = self._phase_span("metadata", self.config.metadata)
+            try:
+                await self._evaluate_fire_all(self.config.metadata, self.metadata_results)
+            finally:
+                if ph is not None:
+                    ph.end()
+            ph = self._phase_span("authorization", self.config.authorization)
+            authz_err = None
+            try:
+                authz_err = await self._evaluate_authorization()
+            finally:
+                if ph is not None:
+                    ph.end(error=authz_err)
             if authz_err is not None:
                 result.code = PERMISSION_DENIED
                 result.message = authz_err
                 result = self._customize_deny_with(result, self.config.deny_with.unauthorized)
             else:
-                headers, metadata = await self._evaluate_response()
+                ph = self._phase_span("response", self.config.response)
+                try:
+                    headers, metadata = await self._evaluate_response()
+                finally:
+                    if ph is not None:
+                        ph.end()
                 result.headers = [headers]
                 result.metadata = metadata
         # phase 5: callbacks always run (ref :492)

@@ -27,6 +27,8 @@ from ..compiler.encode import encode_batch
 from ..evaluators.base import RuntimeAuthConfig
 from ..index import HostIndex
 from ..pipeline.pipeline import AuthPipeline, AuthResult
+from ..utils import metrics as metrics_mod
+from ..utils import tracing as tracing_mod
 from ..utils.rpc import NOT_FOUND
 
 __all__ = ["PolicyEngine", "EngineEntry"]
@@ -73,6 +75,8 @@ class _Pending:
     doc: Any
     config_name: str
     future: asyncio.Future
+    span: Any = None              # RequestSpan (DeviceBatch span links)
+    t_enq: float = 0.0            # monotonic enqueue time (queue-wait hist)
 
 
 class PolicyEngine:
@@ -97,6 +101,7 @@ class PolicyEngine:
         default, since the compiled-closure oracle costs ~2µs/request,
         cheaper than the reference's normal per-request path."""
         self.index: HostIndex[EngineEntry] = HostIndex()
+        self.generation = 0  # bumped per apply_snapshot (gauge + /debug/vars)
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
         self.timeout_s = timeout_s
@@ -153,11 +158,41 @@ class PolicyEngine:
         with self._swap_lock:
             self._snapshot = snap
             self.index = new_index
+            self.generation += 1
+            metrics_mod.snapshot_generation.labels("engine").set(self.generation)
         self.notify_swap_listeners()
 
     def snapshot_policy(self) -> Optional[CompiledPolicy]:
         snap = self._snapshot
         return snap.policy if snap else None
+
+    def debug_vars(self) -> Dict[str, Any]:
+        """JSON-safe live state for the /debug/vars endpoint: config
+        generation, micro-batch queue depths per event loop, and the
+        compiled snapshot's shape.  Read-only, GIL-atomic reads."""
+        queues = {hex(id(loop)): len(q)
+                  for loop, q in list(self._pending.items())}
+        snap = self._snapshot
+        out: Dict[str, Any] = {
+            "generation": self.generation,
+            "max_batch": self.max_batch,
+            "max_delay_s": self.max_delay_s,
+            "members_k": self.members_k,
+            "queue_depth": sum(queues.values()),
+            "queues": queues,
+            "snapshot": None,
+        }
+        if snap is not None:
+            policy = snap.policy
+            out["snapshot"] = {
+                "configs": len(snap.by_id),
+                "sharded": snap.sharded is not None,
+                "compiled_configs": (len(policy.config_ids)
+                                     if policy is not None else 0),
+                "n_attrs": int(getattr(policy, "n_attrs", 0)) if policy else 0,
+                "n_leaves": int(getattr(policy, "n_leaves", 0)) if policy else 0,
+            }
+        return out
 
     # ---- request path ----------------------------------------------------
 
@@ -184,21 +219,26 @@ class PolicyEngine:
         PatternMatching evaluators at translate time."""
 
         async def provider(pipeline, evaluator_slot: int) -> Tuple[bool, bool]:
-            rule, skipped = await self.submit(pipeline.authorization_json(), config_name)
+            rule, skipped = await self.submit(
+                pipeline.authorization_json(), config_name, span=pipeline.span)
             e = evaluator_slot
             return bool(rule[e]), bool(skipped[e])
 
         return provider
 
-    async def submit(self, doc: Any, config_name: str) -> Tuple[np.ndarray, np.ndarray]:
+    async def submit(self, doc: Any, config_name: str,
+                     span: Any = None) -> Tuple[np.ndarray, np.ndarray]:
         """Queue one request for the next micro-batch; resolves to that
-        request's per-evaluator (rule_results [E], skipped [E])."""
+        request's per-evaluator (rule_results [E], skipped [E]).  ``span``
+        (the request's RequestSpan, optional) lets the batch's DeviceBatch
+        span link back to this request's trace."""
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         q = self._pending.get(loop)
         if q is None:
             q = self._pending[loop] = []
-        q.append(_Pending(doc, config_name, fut))
+        q.append(_Pending(doc, config_name, fut, span=span,
+                          t_enq=time.monotonic()))
         if len(q) >= self.max_batch:
             self._schedule_flush(loop)
         elif loop not in self._flush_handles:
@@ -226,34 +266,64 @@ class PolicyEngine:
                     p.future.set_exception(RuntimeError("no compiled policy snapshot"))
             return
         try:
-            own_rule, own_skipped = await asyncio.get_running_loop().run_in_executor(
+            own_rule, own_skipped, binfo = await asyncio.get_running_loop().run_in_executor(
                 _dispatch_pool(), self._run_batch, snap, batch)
         except Exception as e:
             for p in batch:
                 if not p.future.done():
                     p.future.set_exception(e)
             return
+        if tracing_mod.tracing_active():
+            # one DeviceBatch span per kernel launch, span-linked to every
+            # constituent request's trace (export only: a link list build
+            # per batch, nothing per request)
+            links = [(p.span.trace_id, p.span.span_id) for p in batch
+                     if p.span is not None and getattr(p.span, "sampled", True)]
+            if links:
+                tracing_mod.export_device_batch_span(
+                    binfo["batch_size"], binfo["pad"], binfo["eff"], links,
+                    binfo["start_ns"], binfo["duration_s"])
         for i, p in enumerate(batch):
             if not p.future.done():
                 p.future.set_result((own_rule[i], own_skipped[i]))
 
     def _run_batch(self, snap: _Snapshot, batch: List[_Pending]):
+        """Returns (own_rule [B,E], own_skipped [B,E], batch-info dict) —
+        the info dict feeds the DeviceBatch span and carries no tensors."""
+        n = len(batch)
+        pad = _bucket(n)
+        t0 = time.monotonic()
+        # batch[0] is the first enqueued: its wait bounds every member's
+        wait_s = (t0 - batch[0].t_enq) if batch[0].t_enq else None
+        binfo = {"batch_size": n, "pad": pad, "eff": 0,
+                 "start_ns": time.time_ns(), "duration_s": 0.0}
         if snap.sharded is not None:
-            return snap.sharded.run_full(
+            out = snap.sharded.run_full(
                 [p.doc for p in batch],
                 [p.config_name for p in batch],
-                batch_pad=_bucket(len(batch)),
+                batch_pad=pad,
                 max_fallback=self.max_fallback_per_batch,
             )
+            # encode+dispatch+readback wall (run_full observes its own
+            # per-batch fallback count into auth_server_batch_host_fallback)
+            binfo["duration_s"] = time.monotonic() - t0
+            metrics_mod.observe_batch("engine", n, pad, wait_s,
+                                      binfo["duration_s"])
+            return out[0], out[1], binfo
         from ..compiler.pack import pack_batch
         from ..ops.pattern_eval import eval_packed_jit
         import jax.numpy as jnp
 
         policy = snap.policy
         rows = [policy.config_ids[p.config_name] for p in batch]
-        enc = encode_batch(policy, [p.doc for p in batch], rows, batch_pad=_bucket(len(batch)))
+        enc = encode_batch(policy, [p.doc for p in batch], rows, batch_pad=pad)
         db = pack_batch(policy, enc)
         has_dfa = snap.params["dfa_tables"] is not None
+        binfo["eff"] = int(db.attr_bytes.shape[-1]) if has_dfa else 0
+        # span window = the device call itself (start_ns re-stamped here):
+        # encode/pack are host work that precedes the launch
+        binfo["start_ns"] = time.time_ns()
+        t_dev = time.monotonic()
         packed = np.asarray(eval_packed_jit(
             snap.params,
             jnp.asarray(db.attrs_val),
@@ -263,10 +333,12 @@ class PolicyEngine:
             jnp.asarray(db.attr_bytes) if has_dfa else None,
             jnp.asarray(db.byte_ovf) if has_dfa else None,
         ))
+        binfo["duration_s"] = time.monotonic() - t_dev
         E = policy.eval_rule.shape[1]
         own_rule = packed[:, 1:1 + E].copy()
         own_skipped = packed[:, 1 + E:1 + 2 * E].copy()
-        if db.host_fallback.any():
+        n_fallback = int(np.count_nonzero(db.host_fallback[:n]))
+        if n_fallback:
             # compact payload was lossy for these rows (membership overflow):
             # exact re-decision on host via the expression oracle, bounded
             # by the fallback cap (beyond it: deny fail-closed + counter)
@@ -277,7 +349,9 @@ class PolicyEngine:
                 np.nonzero(db.host_fallback[: len(batch)])[0],
                 own_rule, own_skipped, self.max_fallback_per_batch,
             )
-        return own_rule, own_skipped
+        metrics_mod.observe_batch("engine", n, pad, wait_s,
+                                  binfo["duration_s"], n_fallback)
+        return own_rule, own_skipped, binfo
 
 
 # dispatch pool, shared process-wide: asyncio.to_thread rides the loop's

@@ -60,6 +60,7 @@ from ..evaluators.identity.oidc import OIDC
 from ..pipeline.pipeline import AuthPipeline, AuthResult
 from ..utils import bucket_pow2
 from ..utils import metrics as metrics_mod
+from ..utils import tracing as tracing_mod
 from ..utils.rpc import (
     INVALID_ARGUMENT,
     NOT_FOUND,
@@ -642,6 +643,13 @@ class NativeFrontend:
         self.hist_drain_s = 2.0
         self._last_hist_drain = 0.0
         self.stage_totals: Dict[str, Any] = {}
+        # fe_stats() → Prometheus delta drain, owned by the periodic drain
+        # thread (single owner: delta state is unsynchronized by design)
+        self._stats_drain = metrics_mod.NativeStatsDrain()
+        self._drain_wake = threading.Event()
+        self._drain_lock = threading.Lock()
+        # cached label children for the per-(pad,eff) warm-cache counters
+        self._warm_children: Dict[Tuple[int, int, str], Any] = {}
         # live pre-warm/refresh helper threads (joined on stop); own lock —
         # trackers run both under _lock (refresh) and without it (notifier)
         self._prewarm_threads: List[threading.Thread] = []
@@ -684,6 +692,9 @@ class NativeFrontend:
         self._threads.append(
             threading.Thread(target=self._completer_loop,
                              name="atpu-fe-completer", daemon=True))
+        self._threads.append(
+            threading.Thread(target=self._metrics_drain_loop,
+                             name="atpu-fe-metrics-drain", daemon=True))
         for t in self._threads:
             t.start()
         self.refresh()
@@ -718,9 +729,11 @@ class NativeFrontend:
             try:
                 self._fold_fc_counts()
                 self.drain_histograms()  # final fold: short runs lose nothing
+                self.drain_native_stats()
             except Exception:
                 log.exception("final metric drain failed")
             self._mod.fe_stop()
+        self._drain_wake.set()
         for t in self._threads:
             t.join(timeout=5)
         # pre-warm compiles can't be interrupted mid-XLA; they bail between
@@ -733,6 +746,53 @@ class NativeFrontend:
 
     def stats(self) -> Dict[str, int]:
         return dict(self._mod.fe_stats()) if self._mod else {}
+
+    def drain_native_stats(self) -> None:
+        """Fold the C++ fe_stats() counters into Prometheus as deltas
+        (auth_server_native_frontend_events_total / _queue_depth).  Locked:
+        the periodic drain thread, stop()'s final fold, and on-demand
+        callers (bench, /debug scrapes) must not interleave delta reads."""
+        with self._drain_lock:
+            self._stats_drain.fold(self.stats())
+
+    def _metrics_drain_loop(self) -> None:
+        """Periodic telemetry drain: fe_stats() deltas → Prometheus on the
+        histogram cadence, independent of traffic (the dispatch loop only
+        drains when batch events wake it)."""
+        while self._running:
+            self._drain_wake.wait(self.hist_drain_s)
+            if not self._running:
+                return
+            try:
+                self.drain_native_stats()
+            except Exception:
+                log.exception("native stats drain failed")
+
+    def debug_vars(self) -> Dict[str, Any]:
+        """JSON-safe live state for /debug/vars: raw fe_stats counters and
+        backlog gauges, the serving snapshot id, its warmed jit grid, and
+        the frontend's batching knobs."""
+        rec = self._cur_rec
+        out: Dict[str, Any] = {
+            "running": self._running,
+            "stats": {k: int(v) for k, v in self.stats().items()},
+            "max_batch": self.max_batch,
+            "window_us": self.window_us,
+            "slots": self.slots,
+            "dispatch_threads": self.dispatch_threads,
+            "trace_sample_n": self.trace_sample_n,
+            "snapshot": None,
+        }
+        if rec is not None:
+            out["snapshot"] = {
+                "snap_id": rec.snap_id,
+                "warm": sorted([list(pe) for pe in rec.warm]),
+                "warm_done": rec.warm_done.is_set(),
+                "fast_configs": len(rec.row_labels),
+                "hybrid_configs": len(rec.hybrid_rows),
+                "dyn_registrations": len(rec.dyn_regs),
+            }
+        return out
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -943,15 +1003,30 @@ class NativeFrontend:
     def _pick_warm_shape(self, rec: _SnapRec, count: int, eff: int) -> Tuple[int, int]:
         """Smallest warmed (pad ≥ count, eff' ≥ eff); falls back to the
         exact bucket shape (inline compile) only when nothing fits — i.e.
-        cold start before the first variant finished compiling."""
+        cold start before the first variant finished compiling.  Each
+        consultation is counted per served (pad, eff) variant: hit = exact
+        shape warm, rounded = a larger warm shape absorbed the batch,
+        miss = inline XLA compile on a live batch."""
         pad = min(bucket_pow2(count), self.max_batch)
         if (pad, eff) in rec.warm:
+            self._count_warm(pad, eff, "hit")
             return pad, eff
         best: Optional[Tuple[int, int]] = None
         for p, e in tuple(rec.warm):  # snapshot: the prewarm thread appends
             if p >= count and e >= eff and (best is None or (p, e) < best):
                 best = (p, e)
-        return best if best is not None else (pad, eff)
+        if best is not None:
+            self._count_warm(best[0], best[1], "rounded")
+            return best
+        self._count_warm(pad, eff, "miss")
+        return pad, eff
+
+    def _count_warm(self, pad: int, eff: int, outcome: str) -> None:
+        ch = self._warm_children.get((pad, eff, outcome))
+        if ch is None:
+            ch = self._warm_children[(pad, eff, outcome)] = (
+                metrics_mod.jit_warm_cache.labels(str(pad), str(eff), outcome))
+        ch.inc()
 
     def wait_warm(self, timeout_s: float = 600.0) -> bool:
         """Block until every jit bucket variant of the newest snapshot is
@@ -1021,9 +1096,8 @@ class NativeFrontend:
         # it head-samples: every Nth request takes the slow lane with full
         # spans, the rest stay native (counted in stats trace_sampled —
         # enabling observability must not cost ~8x throughput wholesale)
-        from ..utils.tracing import tracing_active
-
-        spec["trace_every"] = self.trace_sample_n if tracing_active() else 0
+        spec["trace_every"] = (self.trace_sample_n
+                               if tracing_mod.tracing_active() else 0)
         if spec["trace_every"] > 1 and not self._trace_mode_logged:
             self._trace_mode_logged = True
             log.info(
@@ -1290,6 +1364,7 @@ class NativeFrontend:
             except Exception:
                 log.exception("jit pre-warm (swap gate) failed")
         mod.fe_swap(spec)
+        metrics_mod.snapshot_generation.labels("native_frontend").set(snap_id)
         if grid:
             # NON-daemon and tracked: a daemon thread mid-XLA-compile at
             # interpreter exit force-unwinds through native code and aborts
@@ -1511,6 +1586,8 @@ class NativeFrontend:
         # XLA compiles never land on live requests (rows past `count` carry
         # stale bytes from earlier batches; their results are discarded)
         pad, eff = self._pick_warm_shape(rec, count, eff)
+        t0 = time.monotonic()
+        t0_ns = time.time_ns()
         packed = np.asarray(eval_packed_jit(
             rec.params,
             jnp.asarray(a["attrs_val"][:pad]),
@@ -1521,11 +1598,21 @@ class NativeFrontend:
             if has_dfa else None,
             jnp.asarray(a["byte_ovf"][:pad].view(bool)) if has_dfa else None,
         ))
+        dispatch_s = time.monotonic() - t0
         verdict = np.ascontiguousarray(packed[:count, 0]).astype(np.uint8)
         # copy BEFORE completing: fe_complete_batch frees the slot, and the
         # C++ encoder may refill config_id while we're still attributing
         rows = a["config_id"][:count].copy()
         self._mod.fe_complete_batch(snap_id, slot, verdict.ctypes.data)
+        # per-batch telemetry AFTER completion: responses are already on
+        # their way to the wire (queue wait is C++-clocked — stage hists)
+        metrics_mod.observe_batch("native", count, pad, None, dispatch_s)
+        if tracing_mod.tracing_active():
+            # fast-lane requests have no Python spans to link (only sampled
+            # slow-lane ones do) — the DeviceBatch span still carries the
+            # launch's batch_size/pad/eff for pad-waste attribution
+            tracing_mod.export_device_batch_span(count, pad, eff, [],
+                                                 t0_ns, dispatch_s)
         # per-authconfig request metrics, same counters + labels the
         # pipeline bumps (ref pkg/service/auth_pipeline.go:26-36)
         n_per_row = np.bincount(rows)
@@ -1560,6 +1647,8 @@ class NativeFrontend:
         has_dfa = sh.has_dfa
         eff = _trim_bytes(a["attr_bytes"][:count]).shape[-1] if has_dfa else 0
         pad, eff = self._pick_warm_shape(rec, count, eff)
+        t0 = time.monotonic()
+        t0_ns = time.time_ns()
         packed = np.asarray(sh._step(
             sh.params,
             jnp.asarray(a["attrs_val"][:pad]),
@@ -1571,10 +1660,15 @@ class NativeFrontend:
             jnp.asarray(a["shard_of"][:pad]),
             jnp.asarray(a["config_id"][:pad]),
         ))
+        dispatch_s = time.monotonic() - t0
         verdict = np.ascontiguousarray(packed[:count, 0]).astype(np.uint8)
         rows = a["config_id"][:count].copy()
         shards_arr = a["shard_of"][:count].copy()
         self._mod.fe_complete_batch(snap_id, slot, verdict.ctypes.data)
+        metrics_mod.observe_batch("native", count, pad, None, dispatch_s)
+        if tracing_mod.tracing_active():
+            tracing_mod.export_device_batch_span(count, pad, eff, [],
+                                                 t0_ns, dispatch_s)
         # per-authconfig metrics, attributed by (shard, row)
         G = sh.configs_per_shard
         flat = shards_arr.astype(np.int64) * G + rows

@@ -9,6 +9,7 @@ source.certificate) and runs through the identical engine/pipeline."""
 from __future__ import annotations
 
 import json
+import os
 from typing import Optional
 
 from aiohttp import web
@@ -131,7 +132,13 @@ def make_check_handler(engine: PolicyEngine, max_body: int = DEFAULT_MAX_BODY):
     return check
 
 
-def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_BODY) -> web.Application:
+def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_BODY,
+              frontend=None, enable_profile: bool = False) -> web.Application:
+    """``frontend`` is the NativeFrontend instance (or a zero-arg callable
+    resolving to one — the CLI builds this app before the frontend starts)
+    whose live stats /debug/vars folds in.  ``enable_profile`` arms the
+    /debug/profile jax.profiler hook (opt-in: a trace capture costs real
+    device time and writes to disk)."""
     app = web.Application(client_max_size=max_body + 1024)
 
     async def healthz(_):
@@ -151,10 +158,78 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
         except Exception:
             return web.Response(status=501, text="prometheus_client unavailable")
 
+    def _frontend():
+        return frontend() if callable(frontend) else frontend
+
+    async def debug_vars(_):
+        """Live introspection snapshot (the expvar analog): engine queue
+        depths + config generation, compiled-snapshot shape, and — when the
+        native frontend serves — its raw fe_stats counters, slow-lane
+        backlog, and warmed jit grid.  Everything here is a GIL-atomic
+        read; safe to scrape under load."""
+        import time as _time
+
+        data = {
+            "engine": engine.debug_vars(),
+            "process": {"pid": os.getpid(), "time": _time.time()},
+        }
+        fe = _frontend()
+        if fe is not None:
+            try:
+                fe.drain_native_stats()  # /metrics reflects this scrape too
+            except Exception:
+                pass
+            data["native_frontend"] = fe.debug_vars()
+        return web.json_response(data)
+
+    profile_state = {"busy": False}
+
+    async def debug_profile(request: web.Request):
+        """Opt-in on-demand device profile: captures a jax.profiler trace
+        for ?seconds=N (cap 60) into a fresh temp dir and returns its path.
+        Single-flight — a capture in progress answers 409."""
+        if not enable_profile:
+            return web.Response(
+                status=403,
+                text="profiling disabled (start with --debug-profile)")
+        import math
+
+        try:
+            seconds = float(request.query.get("seconds", 1.0))
+        except ValueError:
+            return web.Response(status=400, text="bad seconds")
+        if not math.isfinite(seconds):
+            # NaN passes float() and poisons min/max + asyncio.sleep —
+            # the capture would never stop and busy would stick
+            return web.Response(status=400, text="bad seconds")
+        seconds = min(max(seconds, 0.1), 60.0)
+        if profile_state["busy"]:
+            return web.Response(status=409, text="profile capture in progress")
+        profile_state["busy"] = True
+        try:
+            import asyncio
+            import tempfile
+
+            import jax.profiler
+
+            trace_dir = tempfile.mkdtemp(prefix="authorino-tpu-profile-")
+            jax.profiler.start_trace(trace_dir)
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+            return web.json_response({"trace_dir": trace_dir, "seconds": seconds})
+        except Exception as e:
+            return web.Response(status=500, text=f"profile capture failed: {e}")
+        finally:
+            profile_state["busy"] = False
+
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", readyz)
     app.router.add_get("/metrics", server_metrics)
     app.router.add_get("/server-metrics", server_metrics)
+    app.router.add_get("/debug/vars", debug_vars)
+    app.router.add_get("/debug/profile", debug_profile)
     # catch-all LAST: Envoy's HTTP ext_authz filter forwards the ORIGINAL
     # request path (path_prefix + :path), so /check is just the conventional
     # prefix — any path must evaluate (ref: pkg/service/auth.go:89-177

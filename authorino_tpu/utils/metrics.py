@@ -11,7 +11,7 @@ from __future__ import annotations
 import logging
 
 try:
-    from prometheus_client import Counter, Histogram, REGISTRY
+    from prometheus_client import Counter, Gauge, Histogram, REGISTRY
 
     _PROM = True
 except Exception:  # pragma: no cover - prometheus is baked in, but stay safe
@@ -30,6 +30,9 @@ class _NoopMetric:
     def inc(self, *a):
         pass
 
+    def set(self, *a):
+        pass
+
     def observe(self, *a):
         pass
 
@@ -39,13 +42,29 @@ class _NoopMetric:
         return contextlib.nullcontext()
 
 
+def _existing_collector(name):
+    """The already-registered collector for ``name``, or None.  On module
+    re-import (tests, importlib.reload) the constructor raises ValueError —
+    returning a fresh _NoopMetric there would silently detach the process's
+    real series, so the duplicate resolves to the ORIGINAL collector."""
+    try:
+        by_name = REGISTRY._names_to_collectors
+    except AttributeError:  # pragma: no cover - library internals changed
+        return None
+    for candidate in (name, name + "_total", name + "_count"):
+        col = by_name.get(candidate)
+        if col is not None:
+            return col
+    return None
+
+
 def _counter(name, doc, labels):
     if not _PROM:
         return _NoopMetric()
     try:
         return Counter(name, doc, labels)
     except ValueError:  # already registered (module re-import in tests)
-        return _NoopMetric()
+        return _existing_collector(name) or _NoopMetric()
 
 
 def _histogram(name, doc, labels, buckets=None):
@@ -56,7 +75,16 @@ def _histogram(name, doc, labels, buckets=None):
             return Histogram(name, doc, labels, buckets=buckets)
         return Histogram(name, doc, labels)
     except ValueError:
+        return _existing_collector(name) or _NoopMetric()
+
+
+def _gauge(name, doc, labels):
+    if not _PROM:
         return _NoopMetric()
+    try:
+        return Gauge(name, doc, labels)
+    except ValueError:
+        return _existing_collector(name) or _NoopMetric()
 
 
 evaluator_total = _counter(
@@ -212,6 +240,156 @@ def observe_bucketed(hist_child, bucket_counts, sum_seconds) -> None:
         v = values[j][0]
         for _ in range(n):
             hist_child.observe(v)
+
+
+# ---------------------------------------------------------------------------
+# Batch-aware device/engine telemetry.  Everything here is recorded ONCE PER
+# MICRO-BATCH (or folded in bulk by a drain), never per request: the native
+# fast lane touches Python exactly once per kernel launch, and these series
+# ride that touch.  ``lane`` distinguishes the asyncio engine queue
+# (runtime/engine.py submit/_flush) from the C++ device-owner frontend's
+# dispatcher (runtime/native_frontend.py _dispatch).
+# ---------------------------------------------------------------------------
+
+_LANE_LABELS = ("lane",)
+
+# powers of two: batches pad to pow2 buckets (utils.bucket_pow2), so these
+# bounds land exactly on the pad grid
+BATCH_SIZE_BUCKETS = tuple(float(1 << i) for i in range(13))  # 1 .. 4096
+batch_size = _histogram(
+    "auth_server_batch_size",
+    "Requests per micro-batch at kernel launch (before padding).",
+    _LANE_LABELS,
+    buckets=BATCH_SIZE_BUCKETS,
+)
+OCCUPANCY_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
+                     0.95, 1.0)
+batch_pad_occupancy = _histogram(
+    "auth_server_batch_pad_occupancy",
+    "Per-batch occupancy of the chosen jit pad bucket (batch size / pad): "
+    "1.0 = a full bucket, low values = pad waste (device cycles spent on "
+    "discarded rows).",
+    _LANE_LABELS,
+    buckets=OCCUPANCY_BUCKETS,
+)
+batch_queue_wait = _histogram(
+    "auth_server_batch_queue_wait_seconds",
+    "Queue wait of the OLDEST request in each micro-batch (enqueue to "
+    "flush) — the per-batch upper bound of every member's wait.",
+    _LANE_LABELS,
+    buckets=STAGE_BUCKETS,
+)
+device_dispatch_duration = _histogram(
+    "auth_server_device_dispatch_seconds",
+    "Wall time of one kernel launch: operand upload + device execute + "
+    "verdict readback (on a tunneled device this is dominated by link RTT).",
+    _LANE_LABELS,
+    buckets=STAGE_BUCKETS,
+)
+FALLBACK_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                    512.0, 1024.0)
+batch_host_fallback = _histogram(
+    "auth_server_batch_host_fallback",
+    "Host-oracle fallback requests (membership overflow) per micro-batch.",
+    (),
+    buckets=FALLBACK_BUCKETS,
+)
+jit_warm_cache = _counter(
+    "auth_server_jit_warm_cache_total",
+    "Warm-compile cache consultations per kernel launch, by the (pad, eff) "
+    "variant served: hit = exact shape was pre-compiled, rounded = a larger "
+    "warmed shape absorbed the batch, miss = inline XLA compile landed on "
+    "live requests (cold start only).",
+    ("pad", "eff", "outcome"),
+)
+snapshot_generation = _gauge(
+    "auth_server_snapshot_generation",
+    "Monotonic generation of the serving snapshot, per component (engine = "
+    "compiled-corpus swaps via apply_snapshot; native_frontend = C++ "
+    "fe_swap snapshot id).",
+    ("component",),
+)
+
+
+_batch_children: dict = {}
+
+
+def observe_batch(lane, n, pad, queue_wait_s, dispatch_s,
+                  fallback_n=None) -> None:
+    """Record one kernel launch's batch telemetry (size, pad occupancy,
+    oldest-member queue wait, dispatch wall time, host-fallback rows).
+    Label children are cached: this runs on every micro-batch."""
+    ch = _batch_children.get(lane)
+    if ch is None:
+        ch = _batch_children[lane] = (
+            batch_size.labels(lane),
+            batch_pad_occupancy.labels(lane),
+            batch_queue_wait.labels(lane),
+            device_dispatch_duration.labels(lane),
+        )
+    ch[0].observe(n)
+    if pad:
+        ch[1].observe(n / pad)
+    if queue_wait_s is not None:
+        ch[2].observe(queue_wait_s)
+    ch[3].observe(dispatch_s)
+    if fallback_n is not None:
+        batch_host_fallback.observe(fallback_n)
+
+
+# ---------------------------------------------------------------------------
+# Native-frontend fe_stats() drain: the C++ server counts events in atomics
+# (native/frontend.cpp Server::n_*); a periodic drain folds the DELTAS into
+# one labelled counter family so /metrics finally tells the fast lane's
+# story without any per-request Python work.
+# ---------------------------------------------------------------------------
+
+# fe_stats() keys that are live backlog gauges, not monotonic counters
+NATIVE_QUEUE_KEYS = ("slow_pending", "slow_queued")
+
+native_frontend_events = _counter(
+    "auth_server_native_frontend_events_total",
+    "Native (C++) frontend event counters drained from fe_stats(): "
+    "fast/slow lane decisions, shed work, credential-cache traffic, "
+    "trace sampling, parse errors.",
+    ("event",),
+)
+native_frontend_queue_depth = _gauge(
+    "auth_server_native_frontend_queue_depth",
+    "Live backlog of the native frontend's slow lane (queued = awaiting "
+    "Python pickup, pending = in the pipeline).",
+    ("queue",),
+)
+
+
+class NativeStatsDrain:
+    """Folds successive fe_stats() snapshots into Prometheus as deltas.
+    Single-owner: exactly one thread may fold a given instance (delta state
+    is unsynchronized by design — the native frontend's drain thread)."""
+
+    def __init__(self):
+        self._last: dict = {}
+        self._children: dict = {}
+
+    def fold(self, stats) -> None:
+        if not stats:
+            return
+        for key, value in stats.items():
+            if key in NATIVE_QUEUE_KEYS:
+                child = self._children.get(key)
+                if child is None:
+                    child = self._children[key] = (
+                        native_frontend_queue_depth.labels(key))
+                child.set(value)
+                continue
+            delta = value - self._last.get(key, 0)
+            if delta > 0:
+                child = self._children.get(key)
+                if child is None:
+                    child = self._children[key] = (
+                        native_frontend_events.labels(key))
+                child.inc(delta)
+            self._last[key] = value
 
 
 host_fallback_total = _counter(

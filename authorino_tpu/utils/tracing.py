@@ -53,8 +53,15 @@ class NativeOtlpExporter:
         self.service_name = service_name
         self.flush_interval_s = flush_interval_s
         self.max_queue = max_queue
+        import threading
+
         self._queue: list = []
         self._task: Any = None
+        self._timer: Any = None  # threading.Timer for loop-less enqueues
+        # guards the queue swap in flush(): the timer thread and the event
+        # loop may both flush — without this, both could LOAD the same
+        # span list before either STOREs [] and export it twice
+        self._flush_lock = threading.Lock()
 
     def enqueue(self, span: dict) -> None:
         if len(self._queue) >= self.max_queue:
@@ -66,8 +73,46 @@ class NativeOtlpExporter:
             try:
                 loop = asyncio.get_running_loop()
             except RuntimeError:
-                return  # no loop (sync caller): exported on the next flush
+                # sync caller (dispatch executor, drain threads): no loop to
+                # ride.  A later loop-context enqueue flushes these too, but
+                # none may ever come — arm a one-shot timer thread so the
+                # spans are never stranded.
+                self._arm_timer()
+                return
             self._task = loop.create_task(self._run())
+
+    def _arm_timer(self) -> None:
+        import threading
+
+        t = self._timer
+        if t is not None and t.is_alive():
+            return
+        t = threading.Timer(self.flush_interval_s, self._thread_flush)
+        t.daemon = True
+        self._timer = t
+        t.start()
+
+    def _thread_flush(self) -> None:
+        """Timer-thread flush for spans enqueued outside any event loop:
+        a throwaway loop + private session (get_session binds sessions per
+        loop, which would leak one per flush here)."""
+        import asyncio
+
+        if self._task is not None and not self._task.done():
+            return  # a loop-context task owns the queue now
+        if self._queue:
+            try:
+                async def go():
+                    import aiohttp
+
+                    async with aiohttp.ClientSession() as session:
+                        await self.flush(session=session)
+
+                asyncio.run(go())
+            except Exception as e:
+                log.warning("OTLP timer flush failed: %s", e)
+        if self._queue:
+            self._arm_timer()  # more loop-less spans arrived meanwhile
 
     def _payload(self, spans: list) -> dict:
         return {
@@ -83,13 +128,15 @@ class NativeOtlpExporter:
             }]
         }
 
-    async def flush(self) -> None:
-        if not self._queue:
-            return
-        spans, self._queue = self._queue, []
-        from .http import get_session
+    async def flush(self, session=None) -> None:
+        with self._flush_lock:  # non-async: held only for the list swap
+            if not self._queue:
+                return
+            spans, self._queue = self._queue, []
+        if session is None:
+            from .http import get_session
 
-        session = get_session()
+            session = get_session()
         try:
             async with session.post(self.url, json=self._payload(spans),
                                     headers=self.headers) as resp:
@@ -121,6 +168,9 @@ async def shutdown_tracing() -> None:
         task = _native_exporter._task
         if task is not None and not task.done():
             task.cancel()
+        timer = _native_exporter._timer
+        if timer is not None:
+            timer.cancel()
         await _native_exporter.flush()
 
 
@@ -260,3 +310,119 @@ class RequestSpan:
                 "status": {"code": 2, "message": error} if error else {},
             }
             _native_exporter.enqueue(span)
+
+    def child(self, name: str) -> Optional["PhaseSpan"]:
+        """Child span for one pipeline phase (identity/metadata/
+        authorization/response).  None when span export is off or this
+        request is unsampled — phase spans must never cost an untraced
+        request more than this method call."""
+        if not self.sampled:
+            return None
+        if self._otel_span is not None:
+            child = PhaseSpan(
+                trace_id=self.trace_id,
+                span_id="%016x" % (_ID_RNG.getrandbits(64) | 1),
+                parent_span_id=self.span_id,
+                name=name,
+            )
+            try:
+                from opentelemetry import trace as otel_trace
+
+                child._otel_span = _otel_tracer.start_span(
+                    name, context=otel_trace.set_span_in_context(self._otel_span))
+            except Exception:
+                pass
+            return child
+        if _native_exporter is not None:
+            return PhaseSpan(
+                trace_id=self.trace_id,
+                span_id="%016x" % (_ID_RNG.getrandbits(64) | 1),
+                parent_span_id=self.span_id,
+                name=name,
+            )
+        return None
+
+
+@dataclass
+class PhaseSpan:
+    """One pipeline phase under a request span (the span tree the reference
+    only approximates with its single Check span — each phase's share of a
+    slow request becomes directly visible)."""
+
+    trace_id: str
+    span_id: str
+    parent_span_id: str
+    name: str
+    start: float = field(default_factory=time.monotonic)
+    start_ns: int = field(default_factory=time.time_ns)
+    _otel_span: Any = None
+
+    def end(self, error: Optional[str] = None) -> None:
+        if self._otel_span is not None:
+            try:
+                if error:
+                    self._otel_span.set_attribute("error", error)
+                self._otel_span.end()
+            except Exception:
+                pass
+        elif _native_exporter is not None:
+            _native_exporter.enqueue({
+                "traceId": self.trace_id,
+                "spanId": self.span_id,
+                "parentSpanId": self.parent_span_id,
+                "name": self.name,
+                "kind": 1,  # INTERNAL
+                "startTimeUnixNano": str(self.start_ns),
+                "endTimeUnixNano": str(
+                    self.start_ns + int((time.monotonic() - self.start) * 1e9)),
+                "status": {"code": 2, "message": error} if error else {},
+            })
+
+
+def export_device_batch_span(batch_size: int, pad: int, eff: int,
+                             links, start_ns: int,
+                             duration_s: float) -> None:
+    """One ``DeviceBatch`` span per kernel launch, span-LINKED (not
+    parented: a batch belongs to many traces at once) to the request spans
+    whose verdicts rode it.  ``links`` is [(trace_id_hex, span_id_hex)].
+    Carries batch_size / pad / eff so pad waste and jit-variant choice are
+    attributable per launch.  Supported by both export backends."""
+    end_ns = start_ns + int(duration_s * 1e9)
+    if _otel_tracer is not None:
+        try:
+            from opentelemetry.trace import Link, SpanContext, TraceFlags
+
+            olinks = [
+                Link(SpanContext(
+                    trace_id=int(t, 16), span_id=int(s, 16),
+                    is_remote=False, trace_flags=TraceFlags(0x01)))
+                for t, s in links
+            ]
+            span = _otel_tracer.start_span(
+                "DeviceBatch", links=olinks, start_time=start_ns,
+                attributes={"batch.size": int(batch_size),
+                            "batch.pad": int(pad),
+                            "batch.eff": int(eff)})
+            span.end(end_time=end_ns)
+        except Exception:
+            pass
+        return
+    if _native_exporter is None:
+        return
+    _native_exporter.enqueue({
+        # fresh trace: the batch is no single request's descendant — the
+        # links below stitch it to each constituent request trace
+        "traceId": "%032x" % (_ID_RNG.getrandbits(128) | 1),
+        "spanId": "%016x" % (_ID_RNG.getrandbits(64) | 1),
+        "name": "DeviceBatch",
+        "kind": 1,  # INTERNAL
+        "startTimeUnixNano": str(start_ns),
+        "endTimeUnixNano": str(end_ns),
+        "attributes": [
+            {"key": "batch.size", "value": {"intValue": str(int(batch_size))}},
+            {"key": "batch.pad", "value": {"intValue": str(int(pad))}},
+            {"key": "batch.eff", "value": {"intValue": str(int(eff))}},
+        ],
+        "links": [{"traceId": t, "spanId": s} for t, s in links],
+        "status": {},
+    })

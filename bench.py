@@ -498,6 +498,8 @@ def run_native_mode(args):
 
         best = None
         lat_light = None
+        obs_scrapes = []  # per-trial /metrics text (occupancy/RTT deltas)
+        obs_dvars = None
         for trial in range(args.trials):
             sat = lg(args.seconds, 2, sat_depth, sat_conns)
             light = lg(max(3.0, args.seconds / 2), 1, light_total // 2, 2)
@@ -507,6 +509,15 @@ def run_native_mode(args):
             if best is None or sat["rps"] > best["rps"]:
                 best = sat
                 lat_light = light
+            try:
+                # scrape the REAL observability endpoints after each trial:
+                # the BENCH json carries what an operator's dashboard would
+                metrics_text, obs_dvars = scrape_observability(engine, fe)
+                obs_scrapes.append(metrics_text)
+                tr = observability_summary([metrics_text], obs_dvars)["batch_occupancy"]
+                log(f"  occupancy so far: mean={tr['mean']} over {tr['batches']} batches")
+            except Exception as e:
+                log(f"  observability scrape failed: {e!r}")
         log(f"native frontend stats: {fe.stats()}")
 
         # the on-box latency ARTIFACT: per-request stage histograms clocked
@@ -628,11 +639,144 @@ def run_native_mode(args):
         "onbox_stages": onbox,
         "onbox_stages_light": onbox_light,
     }
+    if obs_scrapes:
+        try:
+            stats["observability"] = observability_summary(obs_scrapes, obs_dvars)
+        except Exception as e:
+            log(f"observability summary failed: {e!r}")
     if trace_cmp is not None:
         stats["tracing"] = trace_cmp
     log(f"device batch RTT p50 {batch_rtt_p50:.2f}ms p90 {batch_rtt_p90:.2f}ms → "
         f"light-load p99 net of RTT: {stats['light_load_p99_ms_net_of_device_rtt']:.2f}ms")
     return best["rps"], stats
+
+
+def _prom_samples(text, name):
+    """[(labels_dict, float_value)] for exactly-`name` samples, via the
+    prometheus_client exposition parser (handles label escaping and
+    exemplars that a hand-rolled line parser would not)."""
+    from prometheus_client.parser import text_string_to_metric_families
+
+    out = []
+    for fam in text_string_to_metric_families(text):
+        for s in fam.samples:
+            if s.name == name:
+                out.append((dict(s.labels), float(s.value)))
+    return out
+
+
+def _hist_lane(text, name, lane):
+    """(sum, count) of one labelled histogram's `lane` series."""
+    tot_s = sum(v for l, v in _prom_samples(text, name + "_sum")
+                if l.get("lane") == lane)
+    tot_c = sum(v for l, v in _prom_samples(text, name + "_count")
+                if l.get("lane") == lane)
+    return tot_s, tot_c
+
+
+def _hist_lane_pct(text, name, lane, q):
+    """Upper-bound quantile (seconds) from a cumulative-by-le histogram.
+    None when the quantile lands in the +Inf bucket (beyond the histogram's
+    range — reporting the top finite bound there would understate it)."""
+    buckets = sorted(
+        (float(l["le"]), v) for l, v in _prom_samples(text, name + "_bucket")
+        if l.get("lane") == lane and l.get("le") not in (None, "+Inf"))
+    _, total = _hist_lane(text, name, lane)  # _count: includes +Inf samples
+    if not total:
+        return 0.0
+    for le, cum in buckets:
+        if cum >= q * total:
+            return le
+    return None
+
+
+def scrape_observability(engine, fe):
+    """GET /metrics + /debug/vars off a throwaway aiohttp server wrapped
+    around the live engine/frontend — the bench records what an operator's
+    scrape would see, through the real endpoints, not in-process shortcuts.
+    Returns (metrics_text, debug_vars_dict)."""
+    import asyncio
+
+    async def go():
+        import aiohttp
+        from aiohttp import web as aweb
+
+        from authorino_tpu.service.http_server import build_app
+
+        fe.drain_native_stats()
+        fe.drain_histograms()
+        runner = aweb.AppRunner(build_app(engine, frontend=fe))
+        await runner.setup()
+        site = aweb.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(base + "/metrics") as r:
+                    metrics_text = await r.text()
+                async with s.get(base + "/debug/vars") as r:
+                    dvars = await r.json()
+        finally:
+            await runner.cleanup()
+        return metrics_text, dvars
+
+    return asyncio.run(go())
+
+
+def observability_summary(scrapes, final_dvars):
+    """The BENCH json's batch_occupancy / device_rtt block: per-trial means
+    derived from successive /metrics scrapes (histogram sum/count deltas)
+    plus the final cumulative distribution — so occupancy regressions are
+    trackable round over round alongside RPS."""
+    per_trial = []
+    prev_occ = prev_rtt = (0.0, 0.0)
+    final = scrapes[-1] if scrapes else ""
+    for text in scrapes:
+        occ = _hist_lane(text, "auth_server_batch_pad_occupancy", "native")
+        rtt = _hist_lane(text, "auth_server_device_dispatch_seconds", "native")
+        d_occ = (occ[0] - prev_occ[0], occ[1] - prev_occ[1])
+        d_rtt = (rtt[0] - prev_rtt[0], rtt[1] - prev_rtt[1])
+        per_trial.append({
+            "batches": int(d_occ[1]),
+            "occupancy_mean": round(d_occ[0] / d_occ[1], 4) if d_occ[1] else None,
+            "device_rtt_mean_ms": round(d_rtt[0] / d_rtt[1] * 1e3, 3)
+            if d_rtt[1] else None,
+        })
+        prev_occ, prev_rtt = occ, rtt
+    occ = _hist_lane(final, "auth_server_batch_pad_occupancy", "native")
+    rtt = _hist_lane(final, "auth_server_device_dispatch_seconds", "native")
+
+    def _pct_ms(text, q):
+        v = _hist_lane_pct(text, "auth_server_device_dispatch_seconds",
+                           "native", q)
+        return round(v * 1e3, 3) if v is not None else None
+
+    fe_vars = (final_dvars or {}).get("native_frontend") or {}
+    fe_stats = fe_vars.get("stats") or {}
+    snap = fe_vars.get("snapshot") or {}
+    return {
+        "batch_occupancy": {
+            "mean": round(occ[0] / occ[1], 4) if occ[1] else None,
+            "batches": int(occ[1]),
+            "per_trial": per_trial,
+        },
+        "device_rtt": {
+            "mean_ms": round(rtt[0] / rtt[1] * 1e3, 3) if rtt[1] else None,
+            # None = the quantile landed past the top histogram bound
+            "p50_ms_le": _pct_ms(final, 0.5),
+            "p99_ms_le": _pct_ms(final, 0.99),
+        },
+        "debug_vars": {
+            "engine_generation": ((final_dvars or {}).get("engine") or {}).get("generation"),
+            "queue_depth": ((final_dvars or {}).get("engine") or {}).get("queue_depth"),
+            "native_snap_id": snap.get("snap_id"),
+            "warm_variants": len(snap.get("warm") or []),
+            "slow_pending": fe_stats.get("slow_pending"),
+            "fast": fe_stats.get("fast"),
+            "slow": fe_stats.get("slow"),
+        },
+    }
 
 
 def hist_pct_ms(counts, bounds_ns, q):
