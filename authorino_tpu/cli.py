@@ -52,7 +52,19 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--timeout", type=int, default=env_var("TIMEOUT", 0), help="Per-request timeout in ms (0 = none)")
     s.add_argument("--max-http-request-body-size", type=int, default=env_var("MAX_HTTP_REQUEST_BODY_SIZE", 1024 * 1024))
     s.add_argument("--batch-size", type=int, default=env_var("BATCH_SIZE", 256), help="Max micro-batch size for TPU dispatch")
-    s.add_argument("--batch-window-us", type=int, default=env_var("BATCH_WINDOW_US", 500), help="Micro-batch window in microseconds")
+    s.add_argument("--batch-window-us", type=int, default=env_var("BATCH_WINDOW_US", 500),
+                   help="Micro-batch gather window in microseconds (native "
+                        "frontend's C++ batcher; the Python engine lane "
+                        "dispatches adaptively and does not wait on it)")
+    s.add_argument("--max-inflight-batches", type=int,
+                   default=env_var("MAX_INFLIGHT_BATCHES", 48),
+                   help="Device dispatch window: micro-batches in flight "
+                        "concurrently (launched, readback pending).  Size "
+                        "so window × batch-size ≥ device RTT × target RPS")
+    s.add_argument("--dispatch-workers", type=int,
+                   default=env_var("DISPATCH_WORKERS", 4),
+                   help="CPU workers for the encode stage of the pipelined "
+                        "dispatcher (host encode/pack + fused H2D staging)")
     s.add_argument("--native-frontend", choices=["auto", "on", "off"],
                    default=env_var("NATIVE_FRONTEND", "auto"),
                    help="Serve the ext_authz gRPC port from the C++ device-owner "
@@ -184,6 +196,8 @@ async def run_server(args) -> None:
         max_batch=args.batch_size,
         max_delay_s=args.batch_window_us / 1e6,
         timeout_s=(args.timeout / 1000.0) if args.timeout else None,
+        max_inflight_batches=args.max_inflight_batches,
+        dispatch_workers=args.dispatch_workers,
     )
 
     selector = LabelSelector.parse(args.auth_config_label_selector) if args.auth_config_label_selector else None

@@ -71,7 +71,9 @@ from ..compiler.compile import (
     CompiledPolicy,
 )
 
-__all__ = ["DevicePolicy", "to_device", "eval_verdicts", "eval_batch_jit"]
+__all__ = ["DevicePolicy", "to_device", "eval_verdicts", "eval_batch_jit",
+           "fuse_batch", "eval_fused_jit", "dispatch_fused",
+           "fused_h2d_supported"]
 
 # exact integer range of f32 accumulation — larger interners must use the
 # gather lane
@@ -524,6 +526,121 @@ def dispatch_packed(params, db) -> "jax.Array":
         jnp.asarray(db.attr_bytes) if has_dfa else None,
         jnp.asarray(db.byte_ovf) if has_dfa else None,
     )
+
+
+# ---------------------------------------------------------------------------
+# fused H2D staging: ONE host→device transfer per micro-batch
+# ---------------------------------------------------------------------------
+#
+# The compact payload is 5-7 small tensors; each jnp.asarray is its own
+# host→device transfer, and on a long link (the tunnel on this image; PCIe
+# doorbells on a co-located chip) per-transfer latency stacks.  The fused
+# path concatenates every operand's bytes into one contiguous uint8 staging
+# buffer on host, ships it in a single transfer, and bitcast-decodes the
+# operands back out INSIDE the jitted kernel (static layout → static slices;
+# the decode is free relative to the transfer it replaces).
+#
+# Bitcast byte order must match numpy's little-endian view; _fused_probe
+# verifies the round trip once per process and the engine falls back to
+# per-operand transfers if the backend disagrees (big-endian hosts).
+
+_FUSED_FIELDS = ("attrs_val", "members_c", "cpu_dense", "config_id",
+                 "attr_bytes", "byte_ovf")
+
+
+def fuse_batch(db) -> Tuple[np.ndarray, tuple]:
+    """(staging buffer [N] uint8, static layout) for one DeviceBatch.  The
+    layout — (field, dtype, shape, offset, nbytes) per operand — is
+    hashable and static per (pad, eff) bucket, so it adds no jit variants
+    beyond the existing shape grid."""
+    segs = []
+    layout = []
+    off = 0
+    for name in _FUSED_FIELDS:
+        arr = getattr(db, name)
+        if arr is None:
+            continue
+        a = np.ascontiguousarray(arr)
+        flat = a.view(np.uint8).reshape(-1)
+        layout.append((name, str(a.dtype), tuple(a.shape), off, flat.size))
+        segs.append(flat)
+        off += flat.size
+    return np.concatenate(segs), tuple(layout)
+
+
+def _defuse(buf, layout):
+    """Decode the staged operands out of the fused buffer (traced: static
+    slices + bitcasts, no data movement beyond the one transfer)."""
+    out = {}
+    for name, dt, shape, off, size in layout:
+        seg = jax.lax.slice_in_dim(buf, off, off + size)
+        if dt == "bool":
+            out[name] = seg.reshape(shape) != 0
+        elif dt == "uint8":
+            out[name] = seg.reshape(shape)
+        else:
+            npdt = np.dtype(dt)
+            out[name] = jax.lax.bitcast_convert_type(
+                seg.reshape(shape + (npdt.itemsize,)), npdt)
+    return out
+
+
+@partial(jax.jit, static_argnames=("layout",))
+def eval_fused_jit(params, buf, layout):
+    """eval_packed_jit over a fused staging buffer: one H2D transfer in,
+    one packed [B, 1+2E] readback out."""
+    ops = _defuse(buf, layout)
+    return eval_packed_jit(
+        params, ops["attrs_val"], ops["members_c"], ops["cpu_dense"],
+        ops["config_id"], ops.get("attr_bytes"), ops.get("byte_ovf"),
+    )
+
+
+_FUSED_OK: Optional[bool] = None
+
+
+@partial(jax.jit, static_argnames=("layout",))
+def _defuse_probe(buf, layout):
+    return tuple(_defuse(buf, layout).values())
+
+
+def fused_h2d_supported() -> bool:
+    """One-time probe that the backend's bitcast byte order matches numpy's
+    view (little-endian); the engine degrades to per-operand transfers —
+    never to wrong answers — when it does not."""
+    global _FUSED_OK
+    if _FUSED_OK is None:
+        try:
+            a16 = np.array([-7, 0, 1, 30000], dtype=np.int16)
+            a32 = np.array([1, -2, 1 << 20], dtype=np.int32)
+            buf = np.concatenate([a16.view(np.uint8).reshape(-1),
+                                  a32.view(np.uint8).reshape(-1)])
+            layout = (("attrs_val", "int16", (4,), 0, 8),
+                      ("config_id", "int32", (3,), 8, 12))
+            got16, got32 = _defuse_probe(jnp.asarray(buf), layout)
+            _FUSED_OK = (np.array_equal(np.asarray(got16), a16)
+                         and np.array_equal(np.asarray(got32), a32))
+        except Exception:
+            _FUSED_OK = False
+    return _FUSED_OK
+
+
+def dispatch_fused(params, db) -> "jax.Array":
+    """Non-blocking launch of one compact batch with a single fused H2D
+    transfer (falling back to per-operand transfers when the backend's
+    bitcast disagrees with numpy byte order).  Starts the device→host copy
+    of the packed result eagerly so a later np.asarray only waits, never
+    initiates."""
+    if fused_h2d_supported():
+        buf, layout = fuse_batch(db)
+        out = eval_fused_jit(params, jnp.asarray(buf), layout)
+    else:
+        out = dispatch_packed(params, db)
+    try:
+        out.copy_to_host_async()
+    except Exception:
+        pass  # readback degrades to a blocking copy at np.asarray time
+    return out
 
 
 def eval_batch_jit(params, db) -> Tuple[np.ndarray, np.ndarray]:

@@ -257,9 +257,11 @@ class ShardedPolicyModel:
             shard_of, row_of, host_fallback,
         )
 
-    def _run_step(self, encoded: _ShardedEncoded) -> np.ndarray:
-        """Packed own-rows result [B, 1+2E] — one small readback per batch
-        (own-config selection happens on device, inside the shard_map)."""
+    def dispatch_full(self, encoded: _ShardedEncoded):
+        """Non-blocking launch: returns the ON-DEVICE packed own-rows
+        result [B, 1+2E] (readback copy started eagerly), so the caller can
+        keep further batches in flight while this one rides the link — the
+        sharded mirror of the engine's pipelined dispatch window."""
         packed = self._step(
             self.params,
             jnp.asarray(encoded.attrs_val),
@@ -270,7 +272,16 @@ class ShardedPolicyModel:
             jnp.asarray(encoded.shard_of),
             jnp.asarray(encoded.row_of),
         )
-        return np.asarray(packed)
+        try:
+            packed.copy_to_host_async()
+        except Exception:
+            pass  # readback degrades to a blocking copy at np.asarray time
+        return packed
+
+    def _run_step(self, encoded: _ShardedEncoded) -> np.ndarray:
+        """Packed own-rows result [B, 1+2E] — one small readback per batch
+        (own-config selection happens on device, inside the shard_map)."""
+        return np.asarray(self.dispatch_full(encoded))
 
     def apply(self, encoded: _ShardedEncoded) -> np.ndarray:
         return self._run_step(encoded)[:, 0]
@@ -285,20 +296,22 @@ class ShardedPolicyModel:
         own_skipped = packed[:, 1 + E:1 + 2 * E].copy()
         return own, own_rule, own_skipped
 
-    def run_full(
-        self, docs: Sequence[Any], config_names: Sequence[str], batch_pad: int = 0,
-        max_fallback: Optional[int] = None,
+    def finalize_full(
+        self, packed, enc: _ShardedEncoded, docs: Sequence[Any],
+        config_names: Sequence[str], max_fallback: Optional[int] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
-        """Serving entry (PolicyEngine._run_batch contract): per-request
-        per-evaluator (rule_results [B, E], skipped [B, E]), with requests
-        the compact encoding cannot represent re-decided on host — at most
-        ``max_fallback`` of them per batch (beyond the cap: fail-closed
-        deny + auth_server_host_fallback_shed_total)."""
+        """Completion half of run_full: takes the (device or already-numpy)
+        packed result of ``dispatch_full(enc)`` and applies the host-oracle
+        fallback — at most ``max_fallback`` rows per batch (beyond the cap:
+        fail-closed deny + auth_server_host_fallback_shed_total).  Runs on
+        the engine's completion stage under pipelining."""
         from ..models.policy_model import apply_host_fallback, host_results
         from ..utils import metrics as metrics_mod
 
-        enc = self.encode(docs, config_names, batch_pad=batch_pad)
-        _, own_rule, own_skipped = self.apply_full(enc)
+        packed = np.asarray(packed)
+        E = int(self.shards[0].eval_rule.shape[1])
+        own_rule = packed[:, 1:1 + E].copy()
+        own_skipped = packed[:, 1 + E:1 + 2 * E].copy()
 
         def decide(r: int):
             shard, row = self.locator[config_names[r]]
@@ -311,6 +324,19 @@ class ShardedPolicyModel:
             own_rule, own_skipped, max_fallback,
         )
         return own_rule, own_skipped
+
+    def run_full(
+        self, docs: Sequence[Any], config_names: Sequence[str], batch_pad: int = 0,
+        max_fallback: Optional[int] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Serving entry (PolicyEngine batch contract): per-request
+        per-evaluator (rule_results [B, E], skipped [B, E]).  Blocking
+        convenience composition of encode → dispatch_full → finalize_full;
+        the engine's pipeline calls the three stages separately so batch
+        N+1 encodes while batch N is still on the wire."""
+        enc = self.encode(docs, config_names, batch_pad=batch_pad)
+        return self.finalize_full(self.dispatch_full(enc), enc, docs,
+                                  config_names, max_fallback=max_fallback)
 
     def decide(self, docs: Sequence[Any], config_names: Sequence[str]) -> List[bool]:
         from ..models.policy_model import host_results

@@ -1,7 +1,7 @@
 """PolicyEngine: the serving-time owner of the host index, the compiled rule
-corpus (double-buffered, atomically swapped on reconcile) and the
-micro-batching queue that dispatches (requests × rules) kernels to the
-device.
+corpus (double-buffered, atomically swapped on reconcile) and the pipelined
+micro-batch dispatcher that overlaps encode / H2D / kernel / readback across
+in-flight batches.
 
 This is the TPU-era replacement for the reference's per-request goroutine
 evaluation (SURVEY.md §5 "communication backend"): the gRPC/HTTP frontend
@@ -9,14 +9,37 @@ stays on host CPU; Check() contexts are encoded and batched here; one jitted
 kernel evaluates the batch against the whole corpus.  Reconcile-time
 compilation is the analog of the reference's OPA precompile
 (ref: pkg/evaluators/authorization/opa.go:141); the swap is the analog of
-index Set (ref: controllers/auth_config_controller.go:605-636)."""
+index Set (ref: controllers/auth_config_controller.go:605-636).
+
+Dispatch is an explicit three-stage software pipeline (one global dispatcher
+for all event loops; futures resolve loop-affinely):
+
+  1. encode stage — dispatch workers (shared CPU pool) run encode_batch /
+     pack_batch and build ONE fused H2D staging buffer per batch
+     (ops/pattern_eval.py fuse_batch) instead of 5-7 small transfers;
+  2. dispatch stream — the kernel launches WITHOUT blocking (JAX async
+     dispatch + copy_to_host_async); in-flight batches are tracked as a
+     bounded counter window (max_inflight_batches), not captive pool
+     threads, so throughput ≈ window × batch / RTT by construction;
+  3. completion stage — a shared completer thread detects each batch's
+     readback arrival (jax.Array.is_ready polling) and hands it to the
+     worker pool to finalize + resolve futures: completion is
+     FIFO-independent, and neither a slow readback nor a fallback-heavy
+     finalize convoys another batch.
+
+Flushing is adaptive: a free window slot + a non-empty queue dispatches
+immediately (light-load latency ≈ one device RTT, never a max_delay_s
+stack); with the window full, requests queue and each completion cuts the
+next batch — batch size grows with load instead of with a timer."""
 
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
 import time
-from dataclasses import dataclass, field
+from collections import deque
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -75,8 +98,37 @@ class _Pending:
     doc: Any
     config_name: str
     future: asyncio.Future
+    loop: Any                     # owning event loop (loop-affine resolution)
     span: Any = None              # RequestSpan (DeviceBatch span links)
     t_enq: float = 0.0            # monotonic enqueue time (queue-wait hist)
+
+
+class _Inflight:
+    """One launched micro-batch riding the device window: the on-device
+    result handle plus everything the completion stage needs to finalize
+    and resolve it.  ``handle`` only needs is_ready() (non-blocking) and
+    np.asarray-ability — tests substitute stubs for both."""
+
+    __slots__ = ("engine", "batch", "handle", "finalize", "binfo", "waits",
+                 "t_launch")
+
+    def __init__(self, engine, batch, handle, finalize, binfo, waits):
+        self.engine = engine
+        self.batch = batch
+        self.handle = handle
+        self.finalize = finalize
+        self.binfo = binfo
+        self.waits = waits
+        self.t_launch = time.monotonic()
+
+    def ready(self) -> bool:
+        is_ready = getattr(self.handle, "is_ready", None)
+        if is_ready is None:
+            return True  # no readiness probe: finalize blocks (degraded)
+        try:
+            return bool(is_ready())
+        except Exception:
+            return True  # let finalize surface the real error
 
 
 class PolicyEngine:
@@ -88,6 +140,8 @@ class PolicyEngine:
         members_k: int = 16,
         mesh: Any = "auto",
         max_fallback_per_batch: Optional[int] = None,
+        max_inflight_batches: int = 48,
+        dispatch_workers: int = 4,
     ):
         """``mesh="auto"`` shards the rule corpus over all visible devices
         when more than one is present (dp × mp ShardedPolicyModel);
@@ -99,7 +153,19 @@ class PolicyEngine:
         fallback requests are DENIED fail-closed and counted in
         auth_server_host_fallback_shed_total).  None = unbounded — safe by
         default, since the compiled-closure oracle costs ~2µs/request,
-        cheaper than the reference's normal per-request path."""
+        cheaper than the reference's normal per-request path.
+
+        ``max_delay_s`` no longer gates engine-lane dispatch (flushing is
+        adaptive: open window → immediate, full window → completion-driven);
+        it is retained for construction compatibility and /debug/vars, and
+        still feeds the native frontend's C++ gather window via the CLI.
+
+        ``max_inflight_batches`` is the dispatch-window depth: launched
+        batches awaiting readback.  Size it so window × max_batch ≥
+        device RTT × target RPS (the default 48 covers 100k RPS at 120ms
+        RTT with 256-request batches); it bounds device-side memory, not
+        host threads.  ``dispatch_workers`` sizes the shared encode-stage
+        CPU pool (first engine in the process wins)."""
         self.index: HostIndex[EngineEntry] = HostIndex()
         self.generation = 0  # bumped per apply_snapshot (gauge + /debug/vars)
         self.max_batch = max_batch
@@ -107,15 +173,23 @@ class PolicyEngine:
         self.timeout_s = timeout_s
         self.members_k = members_k
         self.max_fallback_per_batch = max_fallback_per_batch
+        self.max_inflight_batches = max(1, int(max_inflight_batches))
+        self.dispatch_workers = max(1, int(dispatch_workers))
         self._mesh = mesh
         self._snapshot: Optional[_Snapshot] = None
         self._swap_lock = threading.Lock()
-        # micro-batch queues are PER event loop: the gRPC/HTTP servers and
-        # the native frontend's slow lane may share one engine from
-        # different loops, and asyncio futures/timers are loop-owned
-        self._pending: Dict[Any, List[_Pending]] = {}
-        self._flush_handles: Dict[Any, asyncio.TimerHandle] = {}
+        # ONE global dispatcher queue for every event loop (the gRPC/HTTP
+        # servers and the native frontend's slow lane may share one engine
+        # from different loops): futures remember their owning loop and
+        # resolve via call_soon_threadsafe, so no per-loop queue/timer state
+        # exists to leak when tests/reconciles create loops freely
+        self._queue: deque = deque()
+        self._queue_lock = threading.Lock()
+        self._inflight = 0
+        self.inflight_peak = 0    # high-watermark (bench occupancy evidence)
         self._swap_listeners: List[Any] = []
+        self._g_inflight = metrics_mod.inflight_batches.labels("engine")
+        self._g_depth = metrics_mod.dispatch_queue_depth.labels("engine")
 
     # swap listeners: the native frontend rebuilds its C++ snapshot after
     # every corpus swap (runtime/native_frontend.py refresh)
@@ -168,18 +242,20 @@ class PolicyEngine:
 
     def debug_vars(self) -> Dict[str, Any]:
         """JSON-safe live state for the /debug/vars endpoint: config
-        generation, micro-batch queue depths per event loop, and the
-        compiled snapshot's shape.  Read-only, GIL-atomic reads."""
-        queues = {hex(id(loop)): len(q)
-                  for loop, q in list(self._pending.items())}
+        generation, the global dispatcher's backlog + in-flight window
+        occupancy, and the compiled snapshot's shape.  Read-only,
+        GIL-atomic reads."""
         snap = self._snapshot
         out: Dict[str, Any] = {
             "generation": self.generation,
             "max_batch": self.max_batch,
             "max_delay_s": self.max_delay_s,
             "members_k": self.members_k,
-            "queue_depth": sum(queues.values()),
-            "queues": queues,
+            "queue_depth": len(self._queue),
+            "inflight_batches": self._inflight,
+            "inflight_peak": self.inflight_peak,
+            "max_inflight_batches": self.max_inflight_batches,
+            "dispatch_workers": self.dispatch_workers,
             "snapshot": None,
         }
         if snap is not None:
@@ -231,149 +307,296 @@ class PolicyEngine:
         """Queue one request for the next micro-batch; resolves to that
         request's per-evaluator (rule_results [E], skipped [E]).  ``span``
         (the request's RequestSpan, optional) lets the batch's DeviceBatch
-        span link back to this request's trace."""
+        span link back to this request's trace.
+
+        The dispatch decision is deferred one loop iteration (call_soon):
+        every submit scheduled in the same iteration — a gather, a burst of
+        connection reads — lands in one batch cut, while a lone light-load
+        request still dispatches immediately after its iteration, never
+        waiting a delay timer."""
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        q = self._pending.get(loop)
-        if q is None:
-            q = self._pending[loop] = []
-        q.append(_Pending(doc, config_name, fut, span=span,
-                          t_enq=time.monotonic()))
-        if len(q) >= self.max_batch:
-            self._schedule_flush(loop)
-        elif loop not in self._flush_handles:
-            self._flush_handles[loop] = loop.call_later(
-                self.max_delay_s, self._schedule_flush, loop)
+        with self._queue_lock:
+            self._queue.append(_Pending(doc, config_name, fut, loop,
+                                        span=span, t_enq=time.monotonic()))
+        loop.call_soon(self._maybe_dispatch)
         return await fut
 
-    def _schedule_flush(self, loop) -> None:
-        # always runs on `loop` (its call_later, or a submit running on it),
-        # so the flush task + future completions stay loop-local
-        handle = self._flush_handles.pop(loop, None)
-        if handle is not None:
-            handle.cancel()
-        batch = self._pending.get(loop)
-        if not batch:
-            return
-        self._pending[loop] = []
-        asyncio.ensure_future(self._flush(batch))
+    # ---- pipelined dispatch ----------------------------------------------
 
-    async def _flush(self, batch: List[_Pending]) -> None:
-        snap = self._snapshot
-        if snap is None or (snap.policy is None and snap.sharded is None):
-            for p in batch:
-                if not p.future.done():
-                    p.future.set_exception(RuntimeError("no compiled policy snapshot"))
-            return
+    def _maybe_dispatch(self) -> None:
+        """Cut and launch batches while the window has free slots and the
+        queue is non-empty.  Runs on event loops (post-submit) AND on the
+        completion thread (post-readback) — redundant calls are cheap
+        no-ops, so no timer is ever needed: a full window guarantees a
+        future completion, and that completion cuts the next batch."""
+        while True:
+            with self._queue_lock:
+                if not self._queue or self._inflight >= self.max_inflight_batches:
+                    depth = len(self._queue)
+                    break
+                n = min(len(self._queue), self.max_batch)
+                batch = [self._queue.popleft() for _ in range(n)]
+                self._inflight += 1
+                if self._inflight > self.inflight_peak:
+                    self.inflight_peak = self._inflight
+                inflight = self._inflight
+            self._g_inflight.set(inflight)
+            snap = self._snapshot  # pinned per batch: double-buffer swap safety
+            _encode_pool(self.dispatch_workers).submit(
+                self._encode_launch_job, snap, batch)
+        self._g_depth.set(depth)
+
+    def _encode_launch_job(self, snap: Optional[_Snapshot],
+                           batch: List[_Pending]) -> None:
+        """Encode stage (dispatch-worker thread): host encode + fused H2D
+        staging + non-blocking kernel launch, then hand the in-flight batch
+        to the completion stage.  Never blocks on the device."""
         try:
-            own_rule, own_skipped, binfo = await asyncio.get_running_loop().run_in_executor(
-                _dispatch_pool(), self._run_batch, snap, batch)
+            if snap is None or (snap.policy is None and snap.sharded is None):
+                raise RuntimeError("no compiled policy snapshot")
+            item = self._encode_and_launch(snap, batch)
         except Exception as e:
-            for p in batch:
-                if not p.future.done():
-                    p.future.set_exception(e)
+            self._resolve_error(batch, e)
+            self._launch_done()
             return
-        if tracing_mod.tracing_active():
-            # one DeviceBatch span per kernel launch, span-linked to every
-            # constituent request's trace (export only: a link list build
-            # per batch, nothing per request)
-            links = [(p.span.trace_id, p.span.span_id) for p in batch
-                     if p.span is not None and getattr(p.span, "sampled", True)]
-            if links:
-                tracing_mod.export_device_batch_span(
-                    binfo["batch_size"], binfo["pad"], binfo["eff"], links,
-                    binfo["start_ns"], binfo["duration_s"])
-        for i, p in enumerate(batch):
-            if not p.future.done():
-                p.future.set_result((own_rule[i], own_skipped[i]))
+        _completer_submit(item)
 
-    def _run_batch(self, snap: _Snapshot, batch: List[_Pending]):
-        """Returns (own_rule [B,E], own_skipped [B,E], batch-info dict) —
-        the info dict feeds the DeviceBatch span and carries no tensors."""
+    def _encode_and_launch(self, snap: _Snapshot,
+                           batch: List[_Pending]) -> _Inflight:
+        """Encode + launch one micro-batch; returns the in-flight handle.
+        The finalize closure runs on the completion stage with the readback
+        as numpy and applies the host-fallback oracle there."""
         n = len(batch)
         pad = _bucket(n)
         t0 = time.monotonic()
-        # batch[0] is the first enqueued: its wait bounds every member's
-        wait_s = (t0 - batch[0].t_enq) if batch[0].t_enq else None
+        waits = np.array([(t0 - p.t_enq) if p.t_enq else 0.0 for p in batch])
         binfo = {"batch_size": n, "pad": pad, "eff": 0,
                  "start_ns": time.time_ns(), "duration_s": 0.0}
+        docs = [p.doc for p in batch]
+        names = [p.config_name for p in batch]
         if snap.sharded is not None:
-            out = snap.sharded.run_full(
-                [p.doc for p in batch],
-                [p.config_name for p in batch],
-                batch_pad=pad,
-                max_fallback=self.max_fallback_per_batch,
-            )
-            # encode+dispatch+readback wall (run_full observes its own
-            # per-batch fallback count into auth_server_batch_host_fallback)
-            binfo["duration_s"] = time.monotonic() - t0
-            metrics_mod.observe_batch("engine", n, pad, wait_s,
-                                      binfo["duration_s"])
-            return out[0], out[1], binfo
+            sharded = snap.sharded
+            enc = sharded.encode(docs, names, batch_pad=pad)
+            metrics_mod.observe_pipeline_stage(
+                "engine", "encode", time.monotonic() - t0)
+            t1 = time.monotonic()
+            binfo["start_ns"] = time.time_ns()
+            handle = sharded.dispatch_full(enc)
+            metrics_mod.observe_pipeline_stage(
+                "engine", "launch", time.monotonic() - t1)
+
+            def finalize(packed):
+                out = sharded.finalize_full(
+                    packed, enc, docs, names,
+                    max_fallback=self.max_fallback_per_batch)
+                # finalize_full observes the per-batch fallback count itself
+                return out[0], out[1], None
+
+            return _Inflight(self, batch, handle, finalize, binfo, waits)
         from ..compiler.pack import pack_batch
-        from ..ops.pattern_eval import eval_packed_jit
-        import jax.numpy as jnp
+        from ..ops.pattern_eval import dispatch_fused
 
         policy = snap.policy
-        rows = [policy.config_ids[p.config_name] for p in batch]
-        enc = encode_batch(policy, [p.doc for p in batch], rows, batch_pad=pad)
+        rows = [policy.config_ids[name] for name in names]
+        enc = encode_batch(policy, docs, rows, batch_pad=pad)
         db = pack_batch(policy, enc)
         has_dfa = snap.params["dfa_tables"] is not None
         binfo["eff"] = int(db.attr_bytes.shape[-1]) if has_dfa else 0
-        # span window = the device call itself (start_ns re-stamped here):
-        # encode/pack are host work that precedes the launch
+        metrics_mod.observe_pipeline_stage(
+            "engine", "encode", time.monotonic() - t0)
+        # span window opens at the launch: encode/pack are host work
+        t1 = time.monotonic()
         binfo["start_ns"] = time.time_ns()
-        t_dev = time.monotonic()
-        packed = np.asarray(eval_packed_jit(
-            snap.params,
-            jnp.asarray(db.attrs_val),
-            jnp.asarray(db.members_c),
-            jnp.asarray(db.cpu_dense),
-            jnp.asarray(db.config_id),
-            jnp.asarray(db.attr_bytes) if has_dfa else None,
-            jnp.asarray(db.byte_ovf) if has_dfa else None,
-        ))
-        binfo["duration_s"] = time.monotonic() - t_dev
+        handle = dispatch_fused(snap.params, db)
+        metrics_mod.observe_pipeline_stage(
+            "engine", "launch", time.monotonic() - t1)
         E = policy.eval_rule.shape[1]
-        own_rule = packed[:, 1:1 + E].copy()
-        own_skipped = packed[:, 1 + E:1 + 2 * E].copy()
-        n_fallback = int(np.count_nonzero(db.host_fallback[:n]))
-        if n_fallback:
-            # compact payload was lossy for these rows (membership overflow):
-            # exact re-decision on host via the expression oracle, bounded
-            # by the fallback cap (beyond it: deny fail-closed + counter)
-            from ..models.policy_model import apply_host_fallback, host_results
+        max_fallback = self.max_fallback_per_batch
 
-            apply_host_fallback(
-                lambda r: host_results(policy, batch[r].doc, rows[r])[1:],
-                np.nonzero(db.host_fallback[: len(batch)])[0],
-                own_rule, own_skipped, self.max_fallback_per_batch,
-            )
-        metrics_mod.observe_batch("engine", n, pad, wait_s,
-                                  binfo["duration_s"], n_fallback)
-        return own_rule, own_skipped, binfo
+        def finalize(packed):
+            own_rule = packed[:, 1:1 + E].copy()
+            own_skipped = packed[:, 1 + E:1 + 2 * E].copy()
+            n_fallback = int(np.count_nonzero(db.host_fallback[:n]))
+            if n_fallback:
+                # compact payload was lossy for these rows (membership
+                # overflow): exact re-decision on host via the expression
+                # oracle, bounded by the fallback cap (beyond it: deny
+                # fail-closed + counter)
+                from ..models.policy_model import apply_host_fallback, host_results
+
+                apply_host_fallback(
+                    lambda r: host_results(policy, docs[r], rows[r])[1:],
+                    np.nonzero(db.host_fallback[:n])[0],
+                    own_rule, own_skipped, max_fallback,
+                )
+            return own_rule, own_skipped, n_fallback
+
+        return _Inflight(self, batch, handle, finalize, binfo, waits)
+
+    def _complete(self, item: _Inflight) -> None:
+        """Completion stage (worker pool, handed off by the completer once
+        the readback arrived): finalize → loop-affine future resolution →
+        free the window slot (exactly once, whatever fails)."""
+        try:
+            t_done = time.monotonic()
+            packed = np.asarray(item.handle)
+            own_rule, own_skipped, fallback_n = item.finalize(packed)
+            binfo = item.binfo
+            binfo["duration_s"] = t_done - item.t_launch
+            metrics_mod.observe_pipeline_stage("engine", "device",
+                                               binfo["duration_s"])
+            metrics_mod.observe_batch(
+                "engine", binfo["batch_size"], binfo["pad"],
+                item.waits, binfo["duration_s"], fallback_n)
+            if tracing_mod.tracing_active():
+                # one DeviceBatch span per kernel launch, span-linked to
+                # every constituent request's trace (export only: a link
+                # list build per batch, nothing per request)
+                links = [(p.span.trace_id, p.span.span_id)
+                         for p in item.batch if p.span is not None
+                         and getattr(p.span, "sampled", True)]
+                if links:
+                    tracing_mod.export_device_batch_span(
+                        binfo["batch_size"], binfo["pad"], binfo["eff"],
+                        links, binfo["start_ns"], binfo["duration_s"])
+            by_loop: Dict[Any, list] = {}
+            for i, p in enumerate(item.batch):
+                by_loop.setdefault(p.loop, []).append(
+                    (p.future, own_rule[i], own_skipped[i]))
+            for loop, resolutions in by_loop.items():
+                try:
+                    loop.call_soon_threadsafe(_resolve_many, resolutions)
+                except RuntimeError:
+                    pass  # loop closed since submit: its futures are moot
+            metrics_mod.observe_pipeline_stage("engine", "resolve",
+                                               time.monotonic() - t_done)
+        except Exception as e:
+            # already-resolved futures skip set_exception — only requests
+            # that never got a verdict see the failure
+            self._resolve_error(item.batch, e)
+        finally:
+            self._launch_done()
+
+    def _resolve_error(self, batch: List[_Pending], exc: Exception) -> None:
+        by_loop: Dict[Any, list] = {}
+        for p in batch:
+            by_loop.setdefault(p.loop, []).append(p.future)
+        for loop, futs in by_loop.items():
+            try:
+                loop.call_soon_threadsafe(_fail_many, futs, exc)
+            except RuntimeError:
+                pass
+
+    def _launch_done(self) -> None:
+        with self._queue_lock:
+            self._inflight -= 1
+            inflight = self._inflight
+        self._g_inflight.set(inflight)
+        self._maybe_dispatch()
 
 
-# dispatch pool, shared process-wide: asyncio.to_thread rides the loop's
-# default executor (≈5 workers on a 1-CPU host), which caps the number of
-# micro-batches in flight — on a device behind a long link that cap IS the
-# slow-path throughput ceiling (in-flight batches × batch ≈ throughput ×
-# RTT).  One shared pool: engines are created freely (tests, reconciles)
-# and per-engine pools with no shutdown path would leak threads.
-_DISPATCH_POOL = None
-_DISPATCH_POOL_LOCK = threading.Lock()
+def _resolve_many(resolutions) -> None:
+    for fut, rule, skipped in resolutions:
+        if not fut.done():
+            fut.set_result((rule, skipped))
 
 
-def _dispatch_pool():
-    global _DISPATCH_POOL
-    if _DISPATCH_POOL is None:
+def _fail_many(futs, exc) -> None:
+    for fut in futs:
+        if not fut.done():
+            fut.set_exception(exc)
+
+
+# ---------------------------------------------------------------------------
+# shared pipeline stages.  Both are process-wide singletons: engines are
+# created freely (tests, reconciles) and per-engine threads with no shutdown
+# path would leak.
+#
+#   encode pool   — CPU workers for the encode stage AND per-batch finalize;
+#                   its size bounds host parallelism only, NOT the in-flight
+#                   device window (that is each engine's max_inflight_batches
+#                   counter)
+#   completer     — one thread that ONLY polls in-flight readbacks
+#                   (is_ready) and hands each arrived batch to the pool the
+#                   moment it lands — arrival order, not launch order, and
+#                   no finalize work that could convoy other arrivals
+# ---------------------------------------------------------------------------
+
+_ENCODE_POOL = None
+_ENCODE_POOL_LOCK = threading.Lock()
+
+
+def _encode_pool(workers: int = 4):
+    global _ENCODE_POOL
+    if _ENCODE_POOL is None:
         from concurrent.futures import ThreadPoolExecutor
 
-        with _DISPATCH_POOL_LOCK:
-            if _DISPATCH_POOL is None:
-                _DISPATCH_POOL = ThreadPoolExecutor(
-                    max_workers=16, thread_name_prefix="atpu-engine-dispatch")
-    return _DISPATCH_POOL
+        with _ENCODE_POOL_LOCK:
+            if _ENCODE_POOL is None:
+                _ENCODE_POOL = ThreadPoolExecutor(
+                    max_workers=max(1, workers),
+                    thread_name_prefix="atpu-engine-encode")
+    return _ENCODE_POOL
+
+
+_COMPLETER: Optional[threading.Thread] = None
+_COMPLETER_LOCK = threading.Lock()
+_COMPLETER_ITEMS: deque = deque()
+_COMPLETER_EVT = threading.Event()
+
+
+def _completer_submit(item: _Inflight) -> None:
+    _ensure_completer()
+    _COMPLETER_ITEMS.append(item)
+    _COMPLETER_EVT.set()
+
+
+def _ensure_completer() -> None:
+    global _COMPLETER
+    if _COMPLETER is None or not _COMPLETER.is_alive():
+        with _COMPLETER_LOCK:
+            if _COMPLETER is None or not _COMPLETER.is_alive():
+                t = threading.Thread(target=_completer_loop,
+                                     name="atpu-engine-completer", daemon=True)
+                t.start()
+                _COMPLETER = t
+
+
+def _completer_loop() -> None:
+    log = logging.getLogger("authorino_tpu.engine")
+    pending: List[_Inflight] = []
+    while True:
+        while _COMPLETER_ITEMS:
+            try:
+                pending.append(_COMPLETER_ITEMS.popleft())
+            except IndexError:
+                break
+        if not pending:
+            _COMPLETER_EVT.wait()
+            _COMPLETER_EVT.clear()
+            continue
+        progressed = False
+        for item in list(pending):
+            if item.ready():
+                pending.remove(item)
+                progressed = True
+                try:
+                    # finalize on the worker pool, NOT here: the host-
+                    # fallback oracle can be O(batch) work, and one heavy
+                    # batch must not convoy the resolution of other already-
+                    # arrived batches.  _complete handles its own failures
+                    # and releases the window slot exactly once.
+                    _encode_pool(item.engine.dispatch_workers).submit(
+                        item.engine._complete, item)
+                except Exception:
+                    log.exception("batch completion handoff failed")
+        if not progressed:
+            # nothing ready: sub-ms poll — noise against the link RTT each
+            # in-flight batch is waiting out, and it keeps resolution
+            # FIFO-independent (no blocking on the oldest launch)
+            _COMPLETER_EVT.wait(0.0005)
+            _COMPLETER_EVT.clear()
 
 
 from ..utils import bucket_pow2 as _bucket  # noqa: E402 — shared bucketing policy

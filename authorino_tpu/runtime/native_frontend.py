@@ -625,10 +625,9 @@ class NativeFrontend:
         self.window_us = int(window_us)
         self.slots = int(slots)
         self.slow_cap = int(slow_cap)
-        # several dispatchers keep multiple batches in flight: jax dispatch
-        # is async, but the readback blocks — with one thread the device
-        # link RTT serializes batches (the engine's bench uses the same
-        # worker-thread overlap, bench.py run_pipelined)
+        # dispatchers only ENCODE + LAUNCH (readback rides the dedicated
+        # readback thread), so a couple of threads saturate the C++ batch
+        # queue; the in-flight window is the slot count, not this number
         self.dispatch_threads = int(dispatch_threads)
         self._mod = None
         self._snaps: Dict[int, _SnapRec] = {}
@@ -665,6 +664,19 @@ class NativeFrontend:
 
         self._done_buf = _deque()
         self._done_evt = threading.Event()
+        # pipelined readback: dispatchers launch kernels WITHOUT blocking on
+        # the device→host copy and park the in-flight batch here; a readback
+        # thread completes each batch as its result arrives (is_ready), so
+        # the in-flight window is bounded by the C++ slot count, not by how
+        # many Python threads are captive in np.asarray
+        self._rb_q = _deque()
+        self._rb_evt = threading.Event()
+        self._rb_lock = threading.Lock()
+        self._rb_inflight = 0
+        self.rb_inflight_peak = 0
+        self._fe_stopped = False  # set just before fe_stop(): readback must
+        # never complete a batch into the torn-down C++ server
+        self._g_native_inflight = metrics_mod.inflight_batches.labels("native")
 
     # ------------------------------------------------------------------
     def start(self) -> int:
@@ -687,6 +699,9 @@ class NativeFrontend:
                              name=f"atpu-fe-dispatch-{i}", daemon=True)
             for i in range(self.dispatch_threads)
         ]
+        self._threads.append(
+            threading.Thread(target=self._readback_loop,
+                             name="atpu-fe-readback", daemon=True))
         self._threads.append(
             threading.Thread(target=self._slow_loop, name="atpu-fe-slow", daemon=True))
         self._threads.append(
@@ -714,7 +729,13 @@ class NativeFrontend:
                              and s.get("slow_queued", 0) == 0):
                     break
                 time.sleep(0.05)
+            # in-flight device batches must land (fe_complete_batch) while
+            # the C++ server is still alive
+            deadline = time.monotonic() + drain_s
+            while self._rb_inflight and time.monotonic() < deadline:
+                time.sleep(0.02)
         self._running = False
+        self._rb_evt.set()
         if self._mod is not None:
             self.engine.remove_swap_listener(self.refresh)
         # unwire AFTER the swap listener is gone and under _lock, so a
@@ -732,6 +753,7 @@ class NativeFrontend:
                 self.drain_native_stats()
             except Exception:
                 log.exception("final metric drain failed")
+            self._fe_stopped = True
             self._mod.fe_stop()
         self._drain_wake.set()
         for t in self._threads:
@@ -780,6 +802,8 @@ class NativeFrontend:
             "window_us": self.window_us,
             "slots": self.slots,
             "dispatch_threads": self.dispatch_threads,
+            "inflight_batches": self._rb_inflight,
+            "inflight_peak": self.rb_inflight_peak,
             "trace_sample_n": self.trace_sample_n,
             "snapshot": None,
         }
@@ -1571,42 +1595,161 @@ class NativeFrontend:
                 break
 
     def _dispatch(self, snap_id: int, slot: int, count: int) -> None:
+        """Launch stage: non-blocking kernel dispatch for one C++-encoded
+        slot, then park the in-flight batch on the readback queue.  The
+        dispatcher thread is immediately free to launch the next slot, so
+        the in-flight window is the C++ slot count — batches overlap on the
+        link instead of serializing per thread."""
         import jax.numpy as jnp
 
         from ..ops.pattern_eval import eval_packed_jit
 
         rec = self._snaps[snap_id]
         a = rec.arrays[slot]
+        shards_arr = None
         if rec.sharded is not None:
-            self._dispatch_sharded(rec, a, snap_id, slot, count)
+            # one shard_map dispatch per micro-batch: the C++ encoder
+            # already laid each request into its owning shard's [B, S, ...]
+            # slice (packed column 0 = own verdict, psum-merged over 'mp')
+            sh = rec.sharded
+            has_dfa = sh.has_dfa
+            eff = _trim_bytes(a["attr_bytes"][:count]).shape[-1] if has_dfa else 0
+            pad, eff = self._pick_warm_shape(rec, count, eff)
+            t0 = time.monotonic()
+            t0_ns = time.time_ns()
+            packed = sh._step(
+                sh.params,
+                jnp.asarray(a["attrs_val"][:pad]),
+                jnp.asarray(a["members"][:pad]),
+                jnp.asarray(a["cpu_dense"][:pad].view(bool)),
+                jnp.asarray(np.ascontiguousarray(a["attr_bytes"][:pad, :, :, :eff]))
+                if has_dfa else None,
+                jnp.asarray(a["byte_ovf"][:pad].view(bool)) if has_dfa else None,
+                jnp.asarray(a["shard_of"][:pad]),
+                jnp.asarray(a["config_id"][:pad]),
+            )
+            shards_arr = a["shard_of"][:count].copy()
+        else:
+            has_dfa = rec.params["dfa_tables"] is not None
+            eff = _trim_bytes(a["attr_bytes"][:count]).shape[-1] if has_dfa else 0
+            # round the batch/byte buckets up to an already-compiled variant
+            # so XLA compiles never land on live requests (rows past `count`
+            # carry stale bytes from earlier batches; results discarded)
+            pad, eff = self._pick_warm_shape(rec, count, eff)
+            t0 = time.monotonic()
+            t0_ns = time.time_ns()
+            packed = eval_packed_jit(
+                rec.params,
+                jnp.asarray(a["attrs_val"][:pad]),
+                jnp.asarray(a["members"][:pad]),
+                jnp.asarray(a["cpu_dense"][:pad].view(bool)),
+                jnp.asarray(a["config_id"][:pad]),
+                jnp.asarray(np.ascontiguousarray(a["attr_bytes"][:pad, :, :eff]))
+                if has_dfa else None,
+                jnp.asarray(a["byte_ovf"][:pad].view(bool)) if has_dfa else None,
+            )
+        try:
+            packed.copy_to_host_async()
+        except Exception:
+            pass
+        # copy attribution rows BEFORE the slot can complete: once
+        # fe_complete_batch runs, the C++ encoder may refill them
+        rows = a["config_id"][:count].copy()
+        with self._rb_lock:
+            self._rb_inflight += 1
+            if self._rb_inflight > self.rb_inflight_peak:
+                self.rb_inflight_peak = self._rb_inflight
+            inflight = self._rb_inflight
+        self._g_native_inflight.set(inflight)
+        self._rb_q.append((rec, snap_id, slot, count, pad, eff, rows,
+                           shards_arr, packed, t0, t0_ns))
+        self._rb_evt.set()
+
+    def _readback_loop(self) -> None:
+        """Completion stage: finalize in-flight batches as their readbacks
+        arrive (is_ready polling — a slow batch never convoys a fast one),
+        completing each into C++ and folding its telemetry."""
+        pending: List[tuple] = []
+        while True:
+            while self._rb_q:
+                try:
+                    pending.append(self._rb_q.popleft())
+                except IndexError:
+                    break
+            if not pending:
+                if not self._running:
+                    return
+                self._rb_evt.wait(0.2)
+                self._rb_evt.clear()
+                continue
+            progressed = False
+            for item in list(pending):
+                is_ready = getattr(item[8], "is_ready", None)
+                try:
+                    ready = is_ready is None or bool(is_ready())
+                except Exception:
+                    ready = True  # surface the real error in completion
+                if not ready:
+                    continue
+                pending.remove(item)
+                progressed = True
+                try:
+                    self._complete_device_batch(*item)
+                except Exception:
+                    log.exception("native batch completion failed")
+                    try:
+                        # fail closed: deny the whole batch (never into a
+                        # stopped server — see _complete_device_batch)
+                        if not self._fe_stopped:
+                            deny = np.zeros(item[3], dtype=np.uint8)
+                            self._mod.fe_complete_batch(item[1], item[2],
+                                                        deny.ctypes.data)
+                    except Exception:
+                        pass
+                finally:
+                    with self._rb_lock:
+                        self._rb_inflight -= 1
+                        inflight = self._rb_inflight
+                    self._g_native_inflight.set(inflight)
+            if not progressed:
+                # sub-ms poll while results ride the link (noise vs RTT)
+                self._rb_evt.wait(0.0005)
+                self._rb_evt.clear()
+
+    def _complete_device_batch(self, rec: _SnapRec, snap_id: int, slot: int,
+                               count: int, pad: int, eff: int,
+                               rows: np.ndarray,
+                               shards_arr: Optional[np.ndarray],
+                               packed, t0: float, t0_ns: int) -> None:
+        if self._fe_stopped:
+            # stop()'s drain deadline expired with this batch still on the
+            # wire and fe_stop has run: completing into the torn-down C++
+            # server would be a native use-after-stop
             return
-        has_dfa = rec.params["dfa_tables"] is not None
-        eff = _trim_bytes(a["attr_bytes"][:count]).shape[-1] if has_dfa else 0
-        # round the batch/byte buckets up to an already-compiled variant so
-        # XLA compiles never land on live requests (rows past `count` carry
-        # stale bytes from earlier batches; their results are discarded)
-        pad, eff = self._pick_warm_shape(rec, count, eff)
-        t0 = time.monotonic()
-        t0_ns = time.time_ns()
-        packed = np.asarray(eval_packed_jit(
-            rec.params,
-            jnp.asarray(a["attrs_val"][:pad]),
-            jnp.asarray(a["members"][:pad]),
-            jnp.asarray(a["cpu_dense"][:pad].view(bool)),
-            jnp.asarray(a["config_id"][:pad]),
-            jnp.asarray(np.ascontiguousarray(a["attr_bytes"][:pad, :, :eff]))
-            if has_dfa else None,
-            jnp.asarray(a["byte_ovf"][:pad].view(bool)) if has_dfa else None,
-        ))
+        packed = np.asarray(packed)
         dispatch_s = time.monotonic() - t0
         verdict = np.ascontiguousarray(packed[:count, 0]).astype(np.uint8)
-        # copy BEFORE completing: fe_complete_batch frees the slot, and the
-        # C++ encoder may refill config_id while we're still attributing
-        rows = a["config_id"][:count].copy()
         self._mod.fe_complete_batch(snap_id, slot, verdict.ctypes.data)
+        # the slot is COMPLETED from here on: an exception below must not
+        # propagate to the readback loop's fail-closed deny, which would
+        # fe_complete_batch the same slot twice — by then possibly refilled
+        # with a fresh live batch
+        try:
+            self._post_complete_telemetry(rec, count, pad, eff, rows,
+                                          shards_arr, verdict, dispatch_s,
+                                          t0_ns)
+        except Exception:
+            log.exception("post-completion telemetry failed")
+
+    def _post_complete_telemetry(self, rec: _SnapRec, count: int, pad: int,
+                                 eff: int, rows: np.ndarray,
+                                 shards_arr: Optional[np.ndarray],
+                                 verdict: np.ndarray, dispatch_s: float,
+                                 t0_ns: int) -> None:
         # per-batch telemetry AFTER completion: responses are already on
         # their way to the wire (queue wait is C++-clocked — stage hists)
         metrics_mod.observe_batch("native", count, pad, None, dispatch_s)
+        metrics_mod.observe_pipeline_stage("native", "device", dispatch_s)
         if tracing_mod.tracing_active():
             # fast-lane requests have no Python spans to link (only sampled
             # slow-lane ones do) — the DeviceBatch span still carries the
@@ -1615,70 +1758,25 @@ class NativeFrontend:
                                                  t0_ns, dispatch_s)
         # per-authconfig request metrics, same counters + labels the
         # pipeline bumps (ref pkg/service/auth_pipeline.go:26-36)
-        n_per_row = np.bincount(rows)
-        ok_per_row = np.bincount(rows, weights=verdict).astype(np.int64)
-        for row in np.nonzero(n_per_row)[0]:
-            n, n_ok = int(n_per_row[row]), int(ok_per_row[row])
-            ns, name = rec.row_labels.get(int(row), ("", ""))
-            if int(row) in rec.hybrid_rows:
+        if shards_arr is not None:
+            G = rec.sharded.configs_per_shard
+            flat = shards_arr.astype(np.int64) * G + rows
+            n_per = np.bincount(flat)
+            ok_per = np.bincount(flat, weights=verdict).astype(np.int64)
+            keys = [(int(f // G), int(f % G)) for f in np.nonzero(n_per)[0]]
+            idxs = np.nonzero(n_per)[0]
+        else:
+            n_per = np.bincount(rows)
+            ok_per = np.bincount(rows, weights=verdict).astype(np.int64)
+            idxs = np.nonzero(n_per)[0]
+            keys = [int(f) for f in idxs]
+        for f, key in zip(idxs, keys):
+            n, n_ok = int(n_per[f]), int(ok_per[f])
+            ns, name = rec.row_labels.get(key, ("", ""))
+            if key in rec.hybrid_rows:
                 # kernel-allowed hybrid requests continue into the
                 # pipeline, which observes them itself — only the native
                 # denials are final here
-                n = n - n_ok
-                n_ok = 0
-                if not n:
-                    continue
-            metrics_mod.authconfig_total.labels(ns, name).inc(n)
-            if n_ok:
-                metrics_mod.authconfig_response_status.labels(ns, name, "OK").inc(n_ok)
-            if n - n_ok:
-                metrics_mod.authconfig_response_status.labels(
-                    ns, name, "PERMISSION_DENIED").inc(n - n_ok)
-
-    def _dispatch_sharded(self, rec: _SnapRec, a: Dict[str, np.ndarray],
-                          snap_id: int, slot: int, count: int) -> None:
-        """One shard_map dispatch per micro-batch: the C++ encoder already
-        laid each request into its owning shard's [B, S, ...] slice, so the
-        operands feed parallel/sharded_eval's step directly (packed column
-        0 = own-config verdict, psum-merged over 'mp')."""
-        import jax.numpy as jnp
-
-        sh = rec.sharded
-        has_dfa = sh.has_dfa
-        eff = _trim_bytes(a["attr_bytes"][:count]).shape[-1] if has_dfa else 0
-        pad, eff = self._pick_warm_shape(rec, count, eff)
-        t0 = time.monotonic()
-        t0_ns = time.time_ns()
-        packed = np.asarray(sh._step(
-            sh.params,
-            jnp.asarray(a["attrs_val"][:pad]),
-            jnp.asarray(a["members"][:pad]),
-            jnp.asarray(a["cpu_dense"][:pad].view(bool)),
-            jnp.asarray(np.ascontiguousarray(a["attr_bytes"][:pad, :, :, :eff]))
-            if has_dfa else None,
-            jnp.asarray(a["byte_ovf"][:pad].view(bool)) if has_dfa else None,
-            jnp.asarray(a["shard_of"][:pad]),
-            jnp.asarray(a["config_id"][:pad]),
-        ))
-        dispatch_s = time.monotonic() - t0
-        verdict = np.ascontiguousarray(packed[:count, 0]).astype(np.uint8)
-        rows = a["config_id"][:count].copy()
-        shards_arr = a["shard_of"][:count].copy()
-        self._mod.fe_complete_batch(snap_id, slot, verdict.ctypes.data)
-        metrics_mod.observe_batch("native", count, pad, None, dispatch_s)
-        if tracing_mod.tracing_active():
-            tracing_mod.export_device_batch_span(count, pad, eff, [],
-                                                 t0_ns, dispatch_s)
-        # per-authconfig metrics, attributed by (shard, row)
-        G = sh.configs_per_shard
-        flat = shards_arr.astype(np.int64) * G + rows
-        n_per = np.bincount(flat)
-        ok_per = np.bincount(flat, weights=verdict).astype(np.int64)
-        for f in np.nonzero(n_per)[0]:
-            n, n_ok = int(n_per[f]), int(ok_per[f])
-            key = (int(f // G), int(f % G))
-            ns, name = rec.row_labels.get(key, ("", ""))
-            if key in rec.hybrid_rows:
                 n = n - n_ok
                 n_ok = 0
                 if not n:

@@ -274,8 +274,10 @@ batch_pad_occupancy = _histogram(
 )
 batch_queue_wait = _histogram(
     "auth_server_batch_queue_wait_seconds",
-    "Queue wait of the OLDEST request in each micro-batch (enqueue to "
-    "flush) — the per-batch upper bound of every member's wait.",
+    "Per-request queue wait (enqueue to dispatch cut), engine lane only — "
+    "every member's wait is folded per batch (bucketed, O(buckets)/batch).  "
+    "The native lane's queue wait is C++-clocked instead: see "
+    "auth_server_frontend_stage_duration_seconds{stage=\"wait\"}.",
     _LANE_LABELS,
     buckets=STAGE_BUCKETS,
 )
@@ -309,16 +311,64 @@ snapshot_generation = _gauge(
     "fe_swap snapshot id).",
     ("component",),
 )
+inflight_batches = _gauge(
+    "auth_server_inflight_batches",
+    "Micro-batches currently in flight on the device (launched, readback "
+    "not yet resolved).  The dispatch window bounds this at "
+    "max_inflight_batches; sustained values near the bound mean the device "
+    "link, not the host, is the ceiling (throughput ≈ window × batch / RTT).",
+    _LANE_LABELS,
+)
+dispatch_queue_depth = _gauge(
+    "auth_server_dispatch_queue_depth",
+    "Requests queued for the next micro-batch cut (global dispatcher "
+    "backlog, sampled at each dispatch/completion).",
+    _LANE_LABELS,
+)
+pipeline_stage_duration = _histogram(
+    "auth_server_pipeline_stage_seconds",
+    "Per-batch wall time of each async-dispatch pipeline stage: encode = "
+    "host encode/pack + fused staging build; launch = non-blocking kernel "
+    "dispatch call (operand H2D enqueue); device = launch to readback "
+    "arrival (link RTT + kernel); resolve = readback to future resolution.",
+    _LANE_LABELS + ("stage",),
+    buckets=STAGE_BUCKETS,
+)
 
 
 _batch_children: dict = {}
+_stage_children: dict = {}
 
 
-def observe_batch(lane, n, pad, queue_wait_s, dispatch_s,
-                  fallback_n=None) -> None:
-    """Record one kernel launch's batch telemetry (size, pad occupancy,
-    oldest-member queue wait, dispatch wall time, host-fallback rows).
-    Label children are cached: this runs on every micro-batch."""
+def observe_pipeline_stage(lane, stage, seconds) -> None:
+    """Record one pipeline-stage wall-time sample (cached label children:
+    this runs up to four times per micro-batch)."""
+    ch = _stage_children.get((lane, stage))
+    if ch is None:
+        ch = _stage_children[(lane, stage)] = (
+            pipeline_stage_duration.labels(lane, stage))
+    ch.observe(seconds)
+
+
+def fold_queue_waits(lane, waits) -> None:
+    """Fold TRUE per-request queue waits (seconds, array-like) into the
+    batch_queue_wait histogram in O(buckets) via observe_bucketed — a
+    per-request observe() loop would put Python back on the per-request
+    path the batch design exists to avoid."""
+    import numpy as np
+
+    waits = np.asarray(waits, dtype=np.float64)
+    if waits.size == 0:
+        return
+    ch = _batch_children.get(lane)
+    if ch is None:
+        ch = _ensure_batch_children(lane)
+    edges = [0.0] + list(STAGE_BUCKETS) + [np.inf]
+    counts, _ = np.histogram(np.clip(waits, 0.0, None), bins=edges)
+    observe_bucketed(ch[2], counts.tolist(), float(waits.sum()))
+
+
+def _ensure_batch_children(lane):
     ch = _batch_children.get(lane)
     if ch is None:
         ch = _batch_children[lane] = (
@@ -327,11 +377,25 @@ def observe_batch(lane, n, pad, queue_wait_s, dispatch_s,
             batch_queue_wait.labels(lane),
             device_dispatch_duration.labels(lane),
         )
+    return ch
+
+
+def observe_batch(lane, n, pad, queue_wait_s, dispatch_s,
+                  fallback_n=None) -> None:
+    """Record one kernel launch's batch telemetry (size, pad occupancy,
+    queue wait, dispatch wall time, host-fallback rows).  ``queue_wait_s``
+    may be a scalar (one representative wait) or an array of TRUE
+    per-request waits (folded in O(buckets), not O(batch)).  Label children
+    are cached: this runs on every micro-batch."""
+    ch = _ensure_batch_children(lane)
     ch[0].observe(n)
     if pad:
         ch[1].observe(n / pad)
     if queue_wait_s is not None:
-        ch[2].observe(queue_wait_s)
+        if hasattr(queue_wait_s, "__len__"):
+            fold_queue_waits(lane, queue_wait_s)
+        else:
+            ch[2].observe(queue_wait_s)
     ch[3].observe(dispatch_s)
     if fallback_n is not None:
         batch_host_fallback.observe(fallback_n)

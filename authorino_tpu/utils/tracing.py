@@ -98,8 +98,23 @@ class NativeOtlpExporter:
         loop, which would leak one per flush here)."""
         import asyncio
 
-        if self._task is not None and not self._task.done():
-            return  # a loop-context task owns the queue now
+        # clear the handle FIRST: this method runs on the timer thread, so
+        # is_alive() in _arm_timer would see it and skip every re-arm —
+        # stranding any span enqueued while the flush is in flight
+        self._timer = None
+        task = self._task
+        if task is not None and not task.done():
+            alive = True
+            try:
+                alive = not task.get_loop().is_closed()
+            except RuntimeError:
+                alive = False
+            if alive:
+                return  # a loop-context task owns the queue now
+            # the task's loop closed without draining it (embedder teardown
+            # skipped shutdown_tracing): it will never run — the timer owns
+            # the queue from here on
+            self._task = None
         if self._queue:
             try:
                 async def go():
