@@ -65,6 +65,18 @@ def build_parser() -> argparse.ArgumentParser:
                    default=env_var("DISPATCH_WORKERS", 4),
                    help="CPU workers for the encode stage of the pipelined "
                         "dispatcher (host encode/pack + fused H2D staging)")
+    s.add_argument("--verdict-cache-size", type=int,
+                   default=env_var("VERDICT_CACHE_SIZE", 32768),
+                   help="Entries in the snapshot-scoped verdict LRU keyed by "
+                        "(generation, encoded-row digest); 0 disables it.  "
+                        "Exactness-preserving: invalidation is structural "
+                        "(generation bump on snapshot swap)")
+    s.add_argument("--no-batch-dedup", action="store_true",
+                   default=not env_var("BATCH_DEDUP", True),
+                   help="Disable within-micro-batch row dedup (by default "
+                        "duplicate encoded rows collapse to one device "
+                        "evaluation + a scatter map; set BATCH_DEDUP=0 for "
+                        "the env-var equivalent)")
     s.add_argument("--native-frontend", choices=["auto", "on", "off"],
                    default=env_var("NATIVE_FRONTEND", "auto"),
                    help="Serve the ext_authz gRPC port from the C++ device-owner "
@@ -198,6 +210,8 @@ async def run_server(args) -> None:
         timeout_s=(args.timeout / 1000.0) if args.timeout else None,
         max_inflight_batches=args.max_inflight_batches,
         dispatch_workers=args.dispatch_workers,
+        verdict_cache_size=args.verdict_cache_size,
+        batch_dedup=not args.no_batch_dedup,
     )
 
     selector = LabelSelector.parse(args.auth_config_label_selector) if args.auth_config_label_selector else None
@@ -285,6 +299,8 @@ async def run_server(args) -> None:
                 engine, port=args.ext_auth_grpc_port,
                 max_batch=max(args.batch_size, 64),
                 window_us=args.batch_window_us, bind_all=True,
+                verdict_cache_size=args.verdict_cache_size,
+                batch_dedup=not args.no_batch_dedup,
             )
             native_fe.start()
             native_holder["fe"] = native_fe  # /debug/vars picks it up

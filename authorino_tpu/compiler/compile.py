@@ -66,6 +66,25 @@ FALSE_SLOT = 1
 _LEAF_BASE = 2
 _DFA_MISS = object()
 
+# Selectors whose value is unique per request or time-dependent: rows of
+# configs referencing them (almost) never repeat on the wire, so caching
+# their verdicts only evicts useful entries from the snapshot-scoped verdict
+# cache.  Correctness NEVER depends on this bit — the cache key is the full
+# encoded operand digest (runtime/engine.py, runtime/native_frontend.py);
+# this is purely a cache-pollution dial.
+_UNCACHEABLE_SELECTOR_PREFIXES = (
+    "request.id",
+    "request.time",
+    "context.request.time",
+    "context.request.http.id",
+)
+
+
+def _selector_uncacheable(selector_str: str) -> bool:
+    head = selector_str.split("|", 1)[0].split("#", 1)[0].strip()
+    return any(head == p or head.startswith(p + ".")
+               for p in _UNCACHEABLE_SELECTOR_PREFIXES)
+
 
 @dataclass
 class ShapeTargets:
@@ -85,6 +104,9 @@ class ShapeTargets:
     n_dfa_rows: int = 1
     n_dfa_states: int = 1
     n_byte_attrs: int = 0
+    # unique DFA transition tables (rows sharing a determinized automaton
+    # point at one table through dfa_table_of_row — rule-tensor compaction)
+    n_dfa_tables: int = 1
     # eval-table rows (configs per shard) — unified so per-shard device
     # pytrees (incl. the matmul lane's [G*E, cursor] one-hots) stack
     n_configs: int = 1
@@ -107,6 +129,7 @@ class ShapeTargets:
             n_dfa_rows=max(s.n_dfa_rows for s in shapes),
             n_dfa_states=max(s.n_dfa_states for s in shapes),
             n_byte_attrs=max(s.n_byte_attrs for s in shapes),
+            n_dfa_tables=max(s.n_dfa_tables for s in shapes),
             n_configs=max(s.n_configs for s in shapes),
         )
 
@@ -150,8 +173,14 @@ class CompiledPolicy:
     eval_has_cond: np.ndarray  # [G, E] bool
 
     # --- device regex lane (empty arrays when no DFA-compilable regexes) ---
-    dfa_tables: np.ndarray     # [R, S, 256] uint8 — per-leaf transition tables
-    dfa_accept: np.ndarray     # [R, S] bool
+    # transition tables are stored DEDUPED: rows whose regexes determinize to
+    # the same automaton (same pattern on different attrs, or structurally
+    # identical patterns across AuthConfigs) share one [S, 256] table and
+    # point at it through dfa_table_of_row — rule-tensor compaction that
+    # shrinks both the device corpus upload and per-snapshot host memory
+    dfa_tables: np.ndarray     # [T, S, 256] uint8 — UNIQUE transition tables
+    dfa_accept: np.ndarray     # [T, S] bool
+    dfa_table_of_row: np.ndarray  # [R] int32 — dfa row → unique table
     dfa_leaf_attr: np.ndarray  # [R] int32 — attr idx of each dfa row
     leaf_dfa_row: np.ndarray   # [L] int32 — leaf → dfa row (0 for others)
     attr_byte_slot: np.ndarray  # [A] int32 — attr → byte-tensor slot (-1 none)
@@ -181,6 +210,23 @@ class CompiledPolicy:
     # original expressions per config evaluator — the host-fallback oracle
     # for requests the compact encoding cannot represent (membership overflow)
     config_exprs: List[List[Tuple[Optional[Expression], Expression]]]
+
+    # per-config verdict-cache eligibility: False for configs whose rules
+    # reference request-unique/time-dependent selectors (their rows never
+    # repeat, so caching them only evicts useful entries).  Correctness
+    # never depends on it — cache keys are full encoded-row digests.
+    config_cacheable: np.ndarray = None  # [G] bool
+
+    @property
+    def dfa_tables_by_row(self) -> np.ndarray:
+        """Transition tables expanded back to the per-row axis [R, S, 256]
+        (consumers that index by dfa row host-side: the matmul-lane operand
+        build and the native C++ encoder)."""
+        return self.dfa_tables[self.dfa_table_of_row]
+
+    @property
+    def dfa_accept_by_row(self) -> np.ndarray:
+        return self.dfa_accept[self.dfa_table_of_row]
 
     @property
     def n_leaves(self) -> int:
@@ -218,9 +264,10 @@ class CompiledPolicy:
             levels=tuple((int(c.shape[0]), int(c.shape[1])) for c, _ in self.levels),
             n_member_attrs=self.n_member_attrs,
             n_cpu_leaves=self.n_cpu_leaves,
-            n_dfa_rows=int(self.dfa_tables.shape[0]),
+            n_dfa_rows=int(self.dfa_table_of_row.shape[0]),
             n_dfa_states=int(self.dfa_tables.shape[1]),
             n_byte_attrs=self.n_byte_attrs,
+            n_dfa_tables=int(self.dfa_tables.shape[0]),
             n_configs=self.n_configs,
         )
 
@@ -248,6 +295,11 @@ class _Lowerer:
         self.nodes: List[Tuple[int, bool, List[int]]] = []
         self.depth_of: Dict[int, int] = {TRUE_SLOT: 0, FALSE_SLOT: 0}
         self.tree_leaf_by_expr: Dict[int, int] = {}
+        # structural And/Or node dedup across ALL configs: two configs
+        # lowering the identical subtree share one node row (and thus one
+        # result-buffer slot), shrinking the per-level matrices and the
+        # whole padded buffer — rule-tensor compaction at the circuit level
+        self.node_dedupe: Dict[Tuple[bool, Tuple[int, ...]], int] = {}
         # regex determinization is the most expensive part of compilation;
         # a caller-shared cache lets the sharded model's two-pass compile
         # (and all its shards) determinize each distinct regex once
@@ -327,12 +379,17 @@ class _Lowerer:
             return TRUE_SLOT if is_and else FALSE_SLOT
         if len(children) == 1:
             return children[0]
+        dedupe_key = (is_and, tuple(children))
+        hit = self.node_dedupe.get(dedupe_key)
+        if hit is not None:
+            return hit
         depth = 1 + max(self.depth_of[c] for c in children)
         node_id = len(self.nodes)
         self.nodes.append((depth, is_and, children))
         # buffer position assigned later (after level grouping); use a
         # placeholder key: negative ids -(node_id+1)
         self.depth_of[-(node_id + 1)] = depth
+        self.node_dedupe[dedupe_key] = -(node_id + 1)
         return -(node_id + 1)
 
 
@@ -478,9 +535,13 @@ def compile_corpus(
         assert targets.n_attrs >= n_attrs, "targets.n_attrs too small"
         Ap = targets.n_attrs
 
-    # device regex lane tables (stacked per leaf, states padded to max).
-    # Targets force R/S/NB so independently-compiled shards stack (padded
-    # rows are never referenced by any leaf; padded states self-loop).
+    # device regex lane tables (states padded to max).  Rows whose regexes
+    # determinized to the same automaton — the same pattern on different
+    # attrs, or byte-identical tables across AuthConfigs — share ONE
+    # [S, 256] table; rows reach it through dfa_table_of_row (rule-tensor
+    # compaction).  Targets force R/S/NB/T so independently-compiled shards
+    # stack (padded rows/tables are never referenced; padded states
+    # self-loop).
     R = len(dfa_rows)
     S = max((d.n_states for _, d in dfa_rows), default=1)
     Rp = max(R, 1)
@@ -488,22 +549,40 @@ def compile_corpus(
         assert targets.n_dfa_rows >= Rp, "targets.n_dfa_rows too small"
         assert targets.n_dfa_states >= S, "targets.n_dfa_states too small"
         Rp, S = targets.n_dfa_rows, targets.n_dfa_states
-    dfa_tables = np.zeros((Rp, S, 256), dtype=np.uint8)
-    dfa_accept = np.zeros((Rp, S), dtype=bool)
+    dfa_table_of_row = np.zeros((Rp,), dtype=np.int32)
     dfa_leaf_attr = np.zeros((Rp,), dtype=np.int32)
     attr_byte_slot = np.full((Ap,), -1, dtype=np.int32)
     n_byte_attrs = 0
+    table_idx: Dict[Any, int] = {}
+    table_dfas: List[Any] = []
     for r_i, (attr, dfa) in enumerate(dfa_rows):
-        s = dfa.n_states
-        dfa_tables[r_i, :s] = dfa.trans
-        # padded states self-loop so they can never be reached anyway
-        for extra in range(s, S):
-            dfa_tables[r_i, extra] = extra
-        dfa_accept[r_i, :s] = dfa.accept
+        tkey = (dfa.trans.tobytes(), dfa.accept.tobytes())
+        t_i = table_idx.get(tkey)
+        if t_i is None:
+            t_i = table_idx[tkey] = len(table_dfas)
+            table_dfas.append(dfa)
+        dfa_table_of_row[r_i] = t_i
         dfa_leaf_attr[r_i] = attr
         if attr_byte_slot[attr] < 0:
             attr_byte_slot[attr] = n_byte_attrs
             n_byte_attrs += 1
+    T = len(table_dfas)
+    Tp = max(T, 1)
+    if targets is not None:
+        assert targets.n_dfa_tables >= Tp, "targets.n_dfa_tables too small"
+        Tp = targets.n_dfa_tables
+    dfa_tables = np.zeros((Tp, S, 256), dtype=np.uint8)
+    dfa_accept = np.zeros((Tp, S), dtype=bool)
+    for t_i, dfa in enumerate(table_dfas):
+        s = dfa.n_states
+        dfa_tables[t_i, :s] = dfa.trans
+        # padded states self-loop so they can never be reached anyway
+        for extra in range(s, S):
+            dfa_tables[t_i, extra] = extra
+        dfa_accept[t_i, :s] = dfa.accept
+    for t_i in range(T, Tp):
+        # padded tables (mesh targets): self-loop everywhere, never referenced
+        dfa_tables[t_i] = np.arange(S, dtype=np.uint8)[:, None]
     if targets is not None:
         assert targets.n_byte_attrs >= n_byte_attrs, "targets.n_byte_attrs too small"
         # force a uniform (possibly dummy) byte-tensor axis so shards whose
@@ -559,6 +638,18 @@ def compile_corpus(
     config_attrs += [[] for _ in range(Gp - n_configs)]
     config_cpu_leaves += [[] for _ in range(Gp - n_configs)]
 
+    # verdict-cache eligibility: a config referencing any request-unique /
+    # time-dependent selector produces rows that never repeat — exclude it
+    # from the snapshot-scoped verdict cache (pollution dial, not a
+    # correctness gate: the cache key is the full encoded-row digest)
+    attr_uncacheable = np.zeros((Ap,), dtype=bool)
+    for sel_str, a_idx in lw.attrs.items():
+        attr_uncacheable[a_idx] = _selector_uncacheable(sel_str)
+    config_cacheable = np.ones((Gp,), dtype=bool)
+    for row, attrs_l in enumerate(config_attrs):
+        if any(attr_uncacheable[a_i] for a_i in attrs_l):
+            config_cacheable[row] = False
+
     # 7. transfer-compaction metadata: which attrs' membership vectors the
     # kernel can ever read (incl/excl leaves), and which leaves ride the
     # dense CPU lane (true-CPU regex/tree leaves; DFA leaves' columns are
@@ -591,6 +682,7 @@ def compile_corpus(
         eval_has_cond=eval_has_cond,
         dfa_tables=dfa_tables,
         dfa_accept=dfa_accept,
+        dfa_table_of_row=dfa_table_of_row,
         dfa_leaf_attr=dfa_leaf_attr,
         leaf_dfa_row=leaf_dfa_row,
         attr_byte_slot=attr_byte_slot,
@@ -611,4 +703,5 @@ def compile_corpus(
         n_cpu_leaves=C,
         config_exprs=[list(cfg.evaluators) for cfg in configs]
         + [[] for _ in range(Gp - n_configs)],
+        config_cacheable=config_cacheable,
     )

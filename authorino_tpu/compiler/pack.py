@@ -21,7 +21,7 @@ via the expression oracle (models/policy_model.py host_decide)."""
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -29,7 +29,8 @@ from .compile import CompiledPolicy
 from .encode import EncodedBatch
 from .intern import PAD
 
-__all__ = ["DeviceBatch", "pack_batch"]
+__all__ = ["DeviceBatch", "pack_batch", "row_key_bytes", "dedup_rows",
+           "batch_row_keys", "select_rows"]
 
 
 @dataclass
@@ -111,3 +112,87 @@ def pack_batch(policy: CompiledPolicy, enc: EncodedBatch,
         byte_ovf=enc.byte_ovf if has_dfa else None,
         host_fallback=host_fallback,
     )
+
+
+# ---------------------------------------------------------------------------
+# batch row dedup: canonical row keys + within-batch collapse
+# ---------------------------------------------------------------------------
+#
+# The kernel is a pure function of each request's encoded operand row, so
+# two rows with identical operand bytes MUST produce identical verdicts —
+# the device only needs to evaluate unique rows, and the completion stage
+# fans verdicts back out through the inverse map.  The canonical key is the
+# raw concatenated operand bytes (config_id + attrs + members + CPU lane +
+# DFA bytes/overflow + the host_fallback flag): exact by construction, no
+# hash-collision risk.  host_fallback rides the key because the compact
+# encoding is LOSSY for overflow rows — without it, an overflow request
+# could alias a non-overflow request with the same visible prefix.
+
+
+def row_key_bytes(arrays: Sequence[Optional[np.ndarray]], n: int) -> List[bytes]:
+    """Per-row canonical keys over the first ``n`` rows of each array
+    (None entries skipped; every array's axis 0 is the row axis)."""
+    parts = []
+    for a in arrays:
+        if a is None:
+            continue
+        c = np.ascontiguousarray(a[:n])
+        parts.append(c.view(np.uint8).reshape(n, -1) if n else
+                     c.view(np.uint8).reshape(0, 0))
+    if not parts:
+        return [b""] * n
+    rows = np.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+    rows = np.ascontiguousarray(rows)
+    width = rows.shape[1]
+    if width == 0:
+        return [b""] * n
+    void_rows = rows.view(np.dtype((np.void, width))).ravel()
+    return [v.tobytes() for v in void_rows]
+
+
+def batch_row_keys(db: DeviceBatch, n: int) -> List[bytes]:
+    """Canonical row keys for one DeviceBatch (dedup + verdict-cache keys)."""
+    return row_key_bytes(
+        [db.config_id, db.attrs_val, db.members_c, db.cpu_dense,
+         db.attr_bytes, db.byte_ovf, db.host_fallback], n)
+
+
+def select_rows(db: DeviceBatch, rows: Sequence[int],
+                batch_pad: int = 0) -> DeviceBatch:
+    """Row-subset DeviceBatch for dedup dispatch: the unique rows re-padded
+    to ``batch_pad`` by repeating the first row (padding verdicts are
+    discarded by the inverse fan-out).  One definition of the subset
+    contract, so a new DeviceBatch field can't be forgotten at one of the
+    call sites."""
+    u = len(rows)
+    pad = max(batch_pad, u, 1)
+    fill = rows[0] if u else 0
+    idx = np.asarray(list(rows) + [fill] * (pad - u))
+
+    def take(a):
+        return a[idx] if a is not None else None
+
+    return DeviceBatch(
+        attrs_val=take(db.attrs_val), members_c=take(db.members_c),
+        cpu_dense=take(db.cpu_dense), config_id=take(db.config_id),
+        attr_bytes=take(db.attr_bytes), byte_ovf=take(db.byte_ovf),
+        host_fallback=take(db.host_fallback))
+
+
+def dedup_rows(keys: Sequence[bytes],
+               rows: Sequence[int]) -> Tuple[List[int], np.ndarray]:
+    """Collapse ``rows`` (original row indices) by their canonical keys:
+    returns (unique_rows, inverse) with unique_rows[inverse[j]] the
+    representative of rows[j].  First occurrence wins (order-stable, so
+    all-unique batches come back in submission order)."""
+    uniq_of_key: dict = {}
+    unique_rows: List[int] = []
+    inverse = np.empty(len(rows), dtype=np.int64)
+    for j, r in enumerate(rows):
+        k = keys[r]
+        u = uniq_of_key.get(k)
+        if u is None:
+            u = uniq_of_key[k] = len(unique_rows)
+            unique_rows.append(r)
+        inverse[j] = u
+    return unique_rows, inverse

@@ -345,9 +345,34 @@ def _eps_closure(nfa: _NFA, states: FrozenSet[int]) -> FrozenSet[int]:
     return frozenset(seen)
 
 
+# process-wide determinization memo: subset construction is the most
+# expensive compile step, and reconcile-time snapshot rebuilds re-lower the
+# same patterns over and over.  The per-compile dfa_cache (compiler/
+# compile.py) spans one corpus; this memo spans the process, so a snapshot
+# swap re-determinizes nothing.  DFAs are immutable once built (the
+# compiler copies their tables into the dense tensors), so sharing one
+# object across snapshots is safe — and it is exactly what lets the
+# compiler's table dedup collapse identical patterns to one [S, 256] table.
+_DFA_MEMO: Dict[str, Optional[DFA]] = {}
+_DFA_MEMO_MAX = 8192
+_DFA_MEMO_MISS = object()
+
+
 def compile_regex_dfa(pattern: str) -> Optional[DFA]:
     """Compile to a DFA, or None when the pattern is outside the subset /
-    exceeds MAX_STATES (caller falls back to the CPU regex lane)."""
+    exceeds MAX_STATES (caller falls back to the CPU regex lane).
+    Memoized per process (patterns repeat across snapshot generations)."""
+    hit = _DFA_MEMO.get(pattern, _DFA_MEMO_MISS)
+    if hit is not _DFA_MEMO_MISS:
+        return hit
+    dfa = _compile_regex_dfa(pattern)
+    if len(_DFA_MEMO) >= _DFA_MEMO_MAX:  # unbounded hostile corpora: reset
+        _DFA_MEMO.clear()
+    _DFA_MEMO[pattern] = dfa
+    return dfa
+
+
+def _compile_regex_dfa(pattern: str) -> Optional[DFA]:
     anchored_start = pattern.startswith("^")
     anchored_end = pattern.endswith("$") and not pattern.endswith("\\$")
     body = pattern[1 if anchored_start else 0 : len(pattern) - (1 if anchored_end else 0)]

@@ -73,7 +73,8 @@ from ..compiler.compile import (
 
 __all__ = ["DevicePolicy", "to_device", "eval_verdicts", "eval_batch_jit",
            "fuse_batch", "eval_fused_jit", "dispatch_fused",
-           "fused_h2d_supported"]
+           "fused_h2d_supported", "eval_bitpacked_jit", "unpack_verdicts",
+           "packed_width"]
 
 # exact integer range of f32 accumulation — larger interners must use the
 # gather lane
@@ -149,9 +150,13 @@ def _matmul_operands(policy: CompiledPolicy, row_slot: np.ndarray, device=None) 
         "cond_m": cond_m.astype(cdt),
     }
 
-    # device regex lane: matmul-form transition tables + spread one-hots
+    # device regex lane: matmul-form transition tables + spread one-hots.
+    # The compiled tables are table-deduped ([T, S, 256] + row→table map);
+    # the matmul lane's einsum contracts over the row axis, so the tables
+    # expand back to per-row here (host-side — the one-hot spread matrices
+    # dominate this lane's operand footprint anyway)
     if policy.n_byte_attrs:
-        R = policy.dfa_tables.shape[0]
+        R = policy.dfa_table_of_row.shape[0]
         NB = policy.n_byte_attrs
         slot_row_oh = np.zeros((NB, R), dtype=np.float32)
         slot_row_oh[row_slot, np.arange(R)] = 1.0
@@ -164,8 +169,8 @@ def _matmul_operands(policy: CompiledPolicy, row_slot: np.ndarray, device=None) 
         out.update(
             {
                 # next-state values ≤ 255 and state count ≤ 256: exact in bf16
-                "dfa_tables_f": policy.dfa_tables.astype(cdt),
-                "dfa_accept_f": policy.dfa_accept.astype(cdt),
+                "dfa_tables_f": policy.dfa_tables_by_row.astype(cdt),
+                "dfa_accept_f": policy.dfa_accept_by_row.astype(cdt),
                 "slot_row_oh": slot_row_oh.astype(cdt),
                 "row_leaf_oh": row_leaf_oh.astype(cdt),
                 "slot_leaf_oh": slot_leaf_oh.astype(cdt),
@@ -226,9 +231,14 @@ def to_device(policy: CompiledPolicy, device=None, lane: Optional[str] = None,
         "eval_has_cond": put(policy.eval_has_cond),
         # device regex lane; None (a static pytree node, not a traced leaf)
         # when the corpus has no DFA-compilable regexes, so the kernel's
-        # python-level `is None` check specializes at trace time
+        # python-level `is None` check specializes at trace time.  Tables
+        # travel DEDUPED ([T, S, 256] + dfa_table_of_row): the gather lane
+        # indexes through the row→table map on device, so identical regexes
+        # across AuthConfigs upload exactly one transition table.
         "dfa_tables": put(policy.dfa_tables) if policy.n_byte_attrs else None,
         "dfa_accept": put(policy.dfa_accept) if policy.n_byte_attrs else None,
+        "dfa_table_of_row": put(policy.dfa_table_of_row)
+        if policy.n_byte_attrs else None,
         "dfa_byte_slot": put(dfa_byte_slot.astype(np.int32)) if policy.n_byte_attrs else None,
         "leaf_dfa_row": put(policy.leaf_dfa_row) if policy.n_byte_attrs else None,
     }
@@ -394,20 +404,20 @@ def _eval_verdicts_gather(params, attrs_val, members_c, cpu_dense,
 
     # ---- device regex lane: DFA scan over value bytes --------------------
     if params["dfa_tables"] is not None and attr_bytes is not None:
-        tables = params["dfa_tables"]          # [R, S, 256] uint8
-        R = tables.shape[0]
-        row_idx = jnp.arange(R)[None, :]
+        tables = params["dfa_tables"]          # [T, S, 256] uint8 (deduped)
+        # per-row table index: rows sharing an automaton share one table
+        tab_idx = params["dfa_table_of_row"][None, :]        # [1, R]
         row_bytes = jnp.take(attr_bytes, params["dfa_byte_slot"], axis=1)  # [B, R, LB]
 
         def dfa_step(states, byte_col):  # states [B,R] i32, byte_col [B,R] u8
-            nxt = tables[row_idx, states, byte_col.astype(jnp.int32)]
+            nxt = tables[tab_idx, states, byte_col.astype(jnp.int32)]
             return nxt.astype(jnp.int32), None
 
         # init carry derived from a varying input (zero-multiplied) so its
         # manual-mesh "varying" type matches inside shard_map
         init = (row_bytes[:, :, 0] * 0).astype(jnp.int32)
         final, _ = jax.lax.scan(dfa_step, init, jnp.transpose(row_bytes, (2, 0, 1)))
-        dfa_row_res = params["dfa_accept"][row_idx, final]   # [B, R]
+        dfa_row_res = params["dfa_accept"][tab_idx, final]   # [B, R]
         leaf_dfa = jnp.take(dfa_row_res, params["leaf_dfa_row"], axis=1)  # [B, L]
         leaf_slot = jnp.take(params["dfa_byte_slot"], params["leaf_dfa_row"])
         leaf_bovf = jnp.take(byte_ovf, leaf_slot, axis=1)    # [B, L]
@@ -511,13 +521,59 @@ def eval_packed_jit(params, attrs_val, members_c, cpu_dense, config_id,
     return jnp.concatenate([own[:, None], own_rule, own_skipped], axis=1)
 
 
-def dispatch_packed(params, db) -> "jax.Array":
+# ---------------------------------------------------------------------------
+# packed u8 bitmask readback: 8 verdicts per byte on the D2H link
+# ---------------------------------------------------------------------------
+#
+# The packed [B, 1+2E] bool result still crosses the link as one byte per
+# element (JAX bools are 1-byte).  On the RTT-bound tunnel the readback
+# bytes are pure overhead, so the serving dispatchers read back a [B, W]
+# uint8 bitmask instead (W = ceil((1+2E)/8)): ~8x fewer D2H bytes per
+# batch.  Bit order is LITTLE (bit j of byte k = column k*8+j), matching
+# np.unpackbits(bitorder="little") for the host-side decode — round-trip
+# bit-exactness is pinned by tests/test_eval_lanes.py.
+
+def packed_width(n_cols: int) -> int:
+    """Bitmask bytes per row for an ``n_cols``-wide packed bool result."""
+    return (n_cols + 7) // 8
+
+
+def _bitpack_rows(mat):
+    """Traced [B, C] bool → [B, ceil(C/8)] uint8 (little bit order)."""
+    B, C = mat.shape
+    W = packed_width(C)
+    padded = jnp.zeros((B, W * 8), dtype=bool).at[:, :C].set(mat)
+    weights = (1 << jnp.arange(8, dtype=jnp.int32))[None, None, :]
+    return (padded.reshape(B, W, 8).astype(jnp.int32) * weights).sum(
+        axis=-1).astype(jnp.uint8)
+
+
+def unpack_verdicts(arr, n_cols: int) -> np.ndarray:
+    """Host-side decode of a [B, W] uint8 bitmask readback back to the
+    [B, n_cols] bool matrix eval_packed_jit would have returned."""
+    a = np.asarray(arr)
+    return np.unpackbits(a, axis=1, bitorder="little")[:, :n_cols].astype(bool)
+
+
+@partial(jax.jit, static_argnames=())
+def eval_bitpacked_jit(params, attrs_val, members_c, cpu_dense, config_id,
+                       attr_bytes=None, byte_ovf=None):
+    """eval_packed_jit with the result bit-packed on device: the D2H
+    readback is [B, ceil((1+2E)/8)] uint8 instead of [B, 1+2E] bool."""
+    return _bitpack_rows(eval_packed_jit(
+        params, attrs_val, members_c, cpu_dense, config_id,
+        attr_bytes, byte_ovf))
+
+
+def dispatch_packed(params, db, bitpack: bool = False) -> "jax.Array":
     """Enqueue one compact batch (compiler/pack.py DeviceBatch) without
-    blocking; returns the on-device packed [B, 1+2E] result for a deferred
-    readback (jax async dispatch = transfer/compute of batch N+1 overlaps
-    the readback of batch N)."""
+    blocking; returns the on-device packed [B, 1+2E] result — or the
+    [B, W] uint8 bitmask with ``bitpack=True`` — for a deferred readback
+    (jax async dispatch = transfer/compute of batch N+1 overlaps the
+    readback of batch N)."""
     has_dfa = params["dfa_tables"] is not None
-    return eval_packed_jit(
+    fn = eval_bitpacked_jit if bitpack else eval_packed_jit
+    return fn(
         params,
         jnp.asarray(db.attrs_val),
         jnp.asarray(db.members_c),
@@ -587,13 +643,14 @@ def _defuse(buf, layout):
 
 @partial(jax.jit, static_argnames=("layout",))
 def eval_fused_jit(params, buf, layout):
-    """eval_packed_jit over a fused staging buffer: one H2D transfer in,
-    one packed [B, 1+2E] readback out."""
+    """eval over a fused staging buffer: ONE H2D transfer in, one
+    bit-packed [B, ceil((1+2E)/8)] uint8 readback out (decode host-side
+    with ``unpack_verdicts``)."""
     ops = _defuse(buf, layout)
-    return eval_packed_jit(
+    return _bitpack_rows(eval_packed_jit(
         params, ops["attrs_val"], ops["members_c"], ops["cpu_dense"],
         ops["config_id"], ops.get("attr_bytes"), ops.get("byte_ovf"),
-    )
+    ))
 
 
 _FUSED_OK: Optional[bool] = None
@@ -628,14 +685,15 @@ def fused_h2d_supported() -> bool:
 def dispatch_fused(params, db) -> "jax.Array":
     """Non-blocking launch of one compact batch with a single fused H2D
     transfer (falling back to per-operand transfers when the backend's
-    bitcast disagrees with numpy byte order).  Starts the device→host copy
-    of the packed result eagerly so a later np.asarray only waits, never
+    bitcast disagrees with numpy byte order).  The result is the BIT-PACKED
+    [B, W] uint8 readback (decode with ``unpack_verdicts``); the device→
+    host copy starts eagerly so a later np.asarray only waits, never
     initiates."""
     if fused_h2d_supported():
         buf, layout = fuse_batch(db)
         out = eval_fused_jit(params, jnp.asarray(buf), layout)
     else:
-        out = dispatch_packed(params, db)
+        out = dispatch_packed(params, db, bitpack=True)
     try:
         out.copy_to_host_async()
     except Exception:

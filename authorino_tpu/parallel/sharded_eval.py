@@ -39,7 +39,12 @@ from ..compiler.compile import (
 )
 from ..compiler.encode import encode_batch
 from ..compiler.intern import StringInterner
-from ..ops.pattern_eval import eval_verdicts, to_device
+from ..ops.pattern_eval import (
+    _bitpack_rows,
+    eval_verdicts,
+    to_device,
+    unpack_verdicts,
+)
 
 __all__ = ["ShardedPolicyModel", "build_mesh"]
 
@@ -102,19 +107,25 @@ def _sharded_step(mesh: Mesh, has_dfa: bool, has_matmul: bool, n_levels: int, sp
         if has_dfa
         else (None, None)
     )
-    step = jax.jit(
-        jax.shard_map(
-            local_eval,
-            mesh=mesh,
-            in_specs=(
-                specs,
-                P("dp", "mp", None),
-                P("dp", "mp", None, None),
-                P("dp", "mp", None),
-            ) + byte_specs + (P("dp"), P("dp")),
-            out_specs=P("dp"),
-        )
+    mapped = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(
+            specs,
+            P("dp", "mp", None),
+            P("dp", "mp", None, None),
+            P("dp", "mp", None),
+        ) + byte_specs + (P("dp"), P("dp")),
+        out_specs=P("dp"),
     )
+
+    def bitpacked_step(params, *operands):
+        # D2H readback rides the link as a u8 bitmask (8 verdicts/byte —
+        # decode host-side with ops.pattern_eval.unpack_verdicts), same
+        # packed-readback contract as the single-corpus eval_fused_jit
+        return _bitpack_rows(mapped(params, *operands))
+
+    step = jax.jit(bitpacked_step)
     _STEP_CACHE[key] = step
     return step
 
@@ -176,6 +187,10 @@ class ShardedPolicyModel:
         # the whole per-shard device pytree — gather lane, matmul lane, DFA
         # lane — stacks on a leading [S] axis with one tree.map
         self.configs_per_shard = self.shards[0].n_configs
+        # [S, G] verdict-cache eligibility, indexed (shard_of, row_of) by
+        # the engine's dedup/cache encode stage
+        self.config_cacheable = np.stack(
+            [p.config_cacheable for p in self.shards])
         # host-side staging: stack numpy operands, then ONE mesh-sharded
         # device_put per leaf — each shard's slice transfers straight to its
         # devices (no transient 2-3x corpus copy on device 0)
@@ -257,6 +272,40 @@ class ShardedPolicyModel:
             shard_of, row_of, host_fallback,
         )
 
+    def row_keys(self, encoded: _ShardedEncoded, n: int):
+        """Canonical per-row keys for dedup + the verdict cache: the full
+        operand bytes plus shard_of/row_of (config identity on the mesh)
+        and the lossy-row flag (compiler/pack.py row_key_bytes doc)."""
+        from ..compiler.pack import row_key_bytes
+
+        return row_key_bytes(
+            [encoded.shard_of, encoded.row_of, encoded.attrs_val,
+             encoded.members_c, encoded.cpu_dense, encoded.attr_bytes,
+             encoded.byte_ovf, encoded.host_fallback], n)
+
+    def select_rows(self, encoded: _ShardedEncoded, rows: Sequence[int],
+                    batch_pad: int = 0) -> _ShardedEncoded:
+        """Row-subset view for dedup dispatch: the unique rows re-padded to
+        ``batch_pad`` (dp-aligned like encode) by repeating the first row —
+        padding rows' verdicts are discarded by the inverse fan-out."""
+        u = len(rows)
+        B = max(u, 1, batch_pad)
+        dp = self.mesh.shape["dp"]
+        if B % dp:
+            B += dp - B % dp
+        fill = rows[0] if u else 0
+        idx = np.asarray(list(rows) + [fill] * (B - u))
+
+        def take(a):
+            return a[idx] if a is not None else None
+
+        return _ShardedEncoded(
+            take(encoded.attrs_val), take(encoded.members_c),
+            take(encoded.cpu_dense), take(encoded.attr_bytes),
+            take(encoded.byte_ovf), take(encoded.shard_of),
+            take(encoded.row_of), take(encoded.host_fallback),
+        )
+
     def dispatch_full(self, encoded: _ShardedEncoded):
         """Non-blocking launch: returns the ON-DEVICE packed own-rows
         result [B, 1+2E] (readback copy started eagerly), so the caller can
@@ -279,9 +328,12 @@ class ShardedPolicyModel:
         return packed
 
     def _run_step(self, encoded: _ShardedEncoded) -> np.ndarray:
-        """Packed own-rows result [B, 1+2E] — one small readback per batch
-        (own-config selection happens on device, inside the shard_map)."""
-        return np.asarray(self.dispatch_full(encoded))
+        """Own-rows result [B, 1+2E] bool, decoded from the bit-packed
+        readback — one small (u8 bitmask) transfer per batch (own-config
+        selection happens on device, inside the shard_map)."""
+        E = int(self.shards[0].eval_rule.shape[1])
+        return unpack_verdicts(
+            np.asarray(self.dispatch_full(encoded)), 1 + 2 * E)
 
     def apply(self, encoded: _ShardedEncoded) -> np.ndarray:
         return self._run_step(encoded)[:, 0]
@@ -296,33 +348,45 @@ class ShardedPolicyModel:
         own_skipped = packed[:, 1 + E:1 + 2 * E].copy()
         return own, own_rule, own_skipped
 
+    def apply_fallback(self, host_fallback: np.ndarray, docs: Sequence[Any],
+                       config_names: Sequence[str], own_rule: np.ndarray,
+                       own_skipped: np.ndarray,
+                       max_fallback: Optional[int] = None) -> None:
+        """Host-oracle completion for membership-overflow rows — the ONE
+        definition shared by finalize_full and the engine's pipelined
+        (dedup-aware) finalize, so fallback semantics can't drift between
+        the blocking and serving paths.  Mutates own_rule/own_skipped in
+        place; at most ``max_fallback`` rows re-decide (beyond the cap:
+        fail-closed deny + auth_server_host_fallback_shed_total)."""
+        from ..models.policy_model import apply_host_fallback, host_results
+        from ..utils import metrics as metrics_mod
+
+        def decide(r: int):
+            shard, row = self.locator[config_names[r]]
+            return host_results(self.shards[shard], docs[r], int(row))[1:]
+
+        fallback_rows = np.nonzero(host_fallback[: len(docs)])[0]
+        metrics_mod.batch_host_fallback.observe(len(fallback_rows))
+        apply_host_fallback(
+            decide, fallback_rows,
+            own_rule, own_skipped, max_fallback,
+        )
+
     def finalize_full(
         self, packed, enc: _ShardedEncoded, docs: Sequence[Any],
         config_names: Sequence[str], max_fallback: Optional[int] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Completion half of run_full: takes the (device or already-numpy)
         packed result of ``dispatch_full(enc)`` and applies the host-oracle
-        fallback — at most ``max_fallback`` rows per batch (beyond the cap:
-        fail-closed deny + auth_server_host_fallback_shed_total).  Runs on
-        the engine's completion stage under pipelining."""
-        from ..models.policy_model import apply_host_fallback, host_results
-        from ..utils import metrics as metrics_mod
-
+        fallback.  Runs on the engine's completion stage under pipelining."""
         packed = np.asarray(packed)
         E = int(self.shards[0].eval_rule.shape[1])
+        if packed.dtype == np.uint8:
+            packed = unpack_verdicts(packed, 1 + 2 * E)  # bit-packed readback
         own_rule = packed[:, 1:1 + E].copy()
         own_skipped = packed[:, 1 + E:1 + 2 * E].copy()
-
-        def decide(r: int):
-            shard, row = self.locator[config_names[r]]
-            return host_results(self.shards[shard], docs[r], int(row))[1:]
-
-        fallback_rows = np.nonzero(enc.host_fallback[: len(docs)])[0]
-        metrics_mod.batch_host_fallback.observe(len(fallback_rows))
-        apply_host_fallback(
-            decide, fallback_rows,
-            own_rule, own_skipped, max_fallback,
-        )
+        self.apply_fallback(enc.host_fallback, docs, config_names,
+                            own_rule, own_skipped, max_fallback)
         return own_rule, own_skipped
 
     def run_full(

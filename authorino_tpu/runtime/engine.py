@@ -53,6 +53,7 @@ from ..pipeline.pipeline import AuthPipeline, AuthResult
 from ..utils import metrics as metrics_mod
 from ..utils import tracing as tracing_mod
 from ..utils.rpc import NOT_FOUND
+from ..utils.verdict_cache import VerdictCache
 
 __all__ = ["PolicyEngine", "EngineEntry"]
 
@@ -83,6 +84,12 @@ class _Snapshot:
         self.policy: Optional[CompiledPolicy] = None
         self.params = None
         self.sharded = None
+        # engine generation this snapshot serves under — the verdict-cache
+        # key prefix, set inside apply_snapshot's swap lock.  In-flight
+        # batches pin their snapshot, so they insert AND serve under the
+        # generation they were encoded against: a swap can never let a
+        # stale verdict leak into the new generation's lookups.
+        self.generation = 0
         if rules:
             if mesh is not None:
                 from ..parallel import ShardedPolicyModel
@@ -142,6 +149,8 @@ class PolicyEngine:
         max_fallback_per_batch: Optional[int] = None,
         max_inflight_batches: int = 48,
         dispatch_workers: int = 4,
+        verdict_cache_size: int = 32768,
+        batch_dedup: bool = True,
     ):
         """``mesh="auto"`` shards the rule corpus over all visible devices
         when more than one is present (dp × mp ShardedPolicyModel);
@@ -165,7 +174,15 @@ class PolicyEngine:
         device RTT × target RPS (the default 48 covers 100k RPS at 120ms
         RTT with 256-request batches); it bounds device-side memory, not
         host threads.  ``dispatch_workers`` sizes the shared encode-stage
-        CPU pool (first engine in the process wins)."""
+        CPU pool (first engine in the process wins).
+
+        ``batch_dedup`` collapses duplicate encoded rows within each
+        micro-batch before dispatch (the device evaluates unique rows
+        only; verdicts fan back out on completion — bit-identical by
+        construction, the kernel is a pure per-row function).
+        ``verdict_cache_size`` bounds the snapshot-scoped verdict LRU
+        keyed by (generation, encoded-row digest); 0 disables it.  Both
+        are exactness-preserving: see docs/performance.md."""
         self.index: HostIndex[EngineEntry] = HostIndex()
         self.generation = 0  # bumped per apply_snapshot (gauge + /debug/vars)
         self.max_batch = max_batch
@@ -175,6 +192,9 @@ class PolicyEngine:
         self.max_fallback_per_batch = max_fallback_per_batch
         self.max_inflight_batches = max(1, int(max_inflight_batches))
         self.dispatch_workers = max(1, int(dispatch_workers))
+        self.batch_dedup = bool(batch_dedup)
+        self._verdict_cache = (VerdictCache(verdict_cache_size)
+                               if verdict_cache_size else None)
         self._mesh = mesh
         self._snapshot: Optional[_Snapshot] = None
         self._swap_lock = threading.Lock()
@@ -230,9 +250,13 @@ class PolicyEngine:
             for host in e.hosts:
                 new_index.set(e.id, host, e, override=override)
         with self._swap_lock:
+            self.generation += 1
+            # the verdict cache keys on snap.generation: in-flight batches
+            # of the OLD snapshot keep inserting/serving under the old
+            # generation, so the swap structurally invalidates without TTLs
+            snap.generation = self.generation
             self._snapshot = snap
             self.index = new_index
-            self.generation += 1
             metrics_mod.snapshot_generation.labels("engine").set(self.generation)
         self.notify_swap_listeners()
 
@@ -256,6 +280,9 @@ class PolicyEngine:
             "inflight_peak": self.inflight_peak,
             "max_inflight_batches": self.max_inflight_batches,
             "dispatch_workers": self.dispatch_workers,
+            "batch_dedup": self.batch_dedup,
+            "verdict_cache": (self._verdict_cache.counts()
+                              if self._verdict_cache is not None else None),
             "snapshot": None,
         }
         if snap is not None:
@@ -362,11 +389,62 @@ class PolicyEngine:
             return
         _completer_submit(item)
 
+    def _dedup_plan(self, keys, n, gen, eligible):
+        """Shared cache-lookup + within-batch-collapse plan for one
+        micro-batch.  ``eligible(r)`` gates verdict-cache participation
+        (cacheable config AND not a lossy host-fallback row — the
+        fallback flag itself already rides the row keys).  Returns
+        (cached {row: value}, miss_rows, unique_rows, inverse,
+        eligible_misses)."""
+        from ..compiler.pack import dedup_rows
+
+        cache = self._verdict_cache
+        cached: Dict[int, Any] = {}
+        eligible_misses = 0
+        if cache is not None and keys is not None:
+            miss_rows: List[int] = []
+            for r in range(n):
+                if eligible(r):
+                    v = cache.get((gen, keys[r]))
+                    if v is not None:
+                        cached[r] = v
+                        continue
+                    eligible_misses += 1
+                miss_rows.append(r)
+        else:
+            miss_rows = list(range(n))
+        if self.batch_dedup and keys is not None:
+            unique_rows, inverse = dedup_rows(keys, miss_rows)
+        else:
+            unique_rows, inverse = miss_rows, np.arange(len(miss_rows))
+        return cached, miss_rows, unique_rows, inverse, eligible_misses
+
+    def _cache_insert(self, keys, gen, unique_rows, eligible,
+                      own_rule, own_skipped) -> int:
+        """Insert freshly-evaluated unique rows; returns the eviction delta
+        for this batch's metrics fold."""
+        cache = self._verdict_cache
+        if cache is None or keys is None:
+            return 0
+        evict0 = cache.evictions
+        for r in unique_rows:
+            if eligible(r):
+                cache.put((gen, keys[r]),
+                          (own_rule[r].copy(), own_skipped[r].copy()))
+        return cache.evictions - evict0
+
     def _encode_and_launch(self, snap: _Snapshot,
                            batch: List[_Pending]) -> _Inflight:
         """Encode + launch one micro-batch; returns the in-flight handle.
         The finalize closure runs on the completion stage with the readback
-        as numpy and applies the host-fallback oracle there."""
+        as numpy and applies the host-fallback oracle there.
+
+        Between encode and launch sit the two hot-path cuts of ISSUE 3:
+        rows whose (generation, row-digest) verdict is cached resolve
+        WITHOUT the device, and the remaining rows collapse to unique rows
+        only — the fused H2D buffer carries unique work, verdicts fan back
+        out through the inverse map on completion (bit-identical: the
+        kernel is a pure per-row function of the operand bytes)."""
         n = len(batch)
         pad = _bucket(n)
         t0 = time.monotonic()
@@ -376,47 +454,66 @@ class PolicyEngine:
         docs = [p.doc for p in batch]
         names = [p.config_name for p in batch]
         if snap.sharded is not None:
-            sharded = snap.sharded
-            enc = sharded.encode(docs, names, batch_pad=pad)
-            metrics_mod.observe_pipeline_stage(
-                "engine", "encode", time.monotonic() - t0)
-            t1 = time.monotonic()
-            binfo["start_ns"] = time.time_ns()
-            handle = sharded.dispatch_full(enc)
-            metrics_mod.observe_pipeline_stage(
-                "engine", "launch", time.monotonic() - t1)
-
-            def finalize(packed):
-                out = sharded.finalize_full(
-                    packed, enc, docs, names,
-                    max_fallback=self.max_fallback_per_batch)
-                # finalize_full observes the per-batch fallback count itself
-                return out[0], out[1], None
-
-            return _Inflight(self, batch, handle, finalize, binfo, waits)
-        from ..compiler.pack import pack_batch
-        from ..ops.pattern_eval import dispatch_fused
+            return self._encode_and_launch_sharded(
+                snap, batch, docs, names, n, pad, t0, binfo, waits)
+        from ..compiler.pack import batch_row_keys, pack_batch, select_rows
+        from ..ops.pattern_eval import dispatch_fused, unpack_verdicts
 
         policy = snap.policy
         rows = [policy.config_ids[name] for name in names]
         enc = encode_batch(policy, docs, rows, batch_pad=pad)
         db = pack_batch(policy, enc)
         has_dfa = snap.params["dfa_tables"] is not None
-        binfo["eff"] = int(db.attr_bytes.shape[-1]) if has_dfa else 0
+        gen = snap.generation
+        cacheable = policy.config_cacheable
+        keys = (batch_row_keys(db, n)
+                if n and (self.batch_dedup or self._verdict_cache is not None)
+                else None)
+
+        def eligible(r: int) -> bool:
+            return bool(cacheable[rows[r]]) and not bool(db.host_fallback[r])
+
+        cached, miss_rows, unique_rows, inverse, elig_miss = self._dedup_plan(
+            keys, n, gen, eligible)
+        u = len(unique_rows)
+        if u == n:
+            db_u, pad_u = db, pad  # nothing collapsed: ship the batch as-is
+        elif u:
+            pad_u = _bucket(u)
+            db_u = select_rows(db, unique_rows, batch_pad=pad_u)
+        else:
+            db_u, pad_u = None, 0  # every row cache-resolved: no dispatch
+        binfo["pad"] = pad_u
+        binfo["device_rows"] = u
+        binfo["eff"] = (int(db_u.attr_bytes.shape[-1])
+                        if has_dfa and db_u is not None else 0)
         metrics_mod.observe_pipeline_stage(
             "engine", "encode", time.monotonic() - t0)
         # span window opens at the launch: encode/pack are host work
         t1 = time.monotonic()
         binfo["start_ns"] = time.time_ns()
-        handle = dispatch_fused(snap.params, db)
+        if db_u is not None:
+            handle = dispatch_fused(snap.params, db_u)
+        else:
+            handle = np.zeros((0, 1), dtype=np.uint8)  # completes instantly
         metrics_mod.observe_pipeline_stage(
             "engine", "launch", time.monotonic() - t1)
-        E = policy.eval_rule.shape[1]
+        E = int(policy.eval_rule.shape[1])
         max_fallback = self.max_fallback_per_batch
 
         def finalize(packed):
-            own_rule = packed[:, 1:1 + E].copy()
-            own_skipped = packed[:, 1 + E:1 + 2 * E].copy()
+            # padded eval columns are TRUE_SLOT/False — same tail semantics
+            # as the kernel's own padded rows
+            own_rule = np.ones((n, E), dtype=bool)
+            own_skipped = np.zeros((n, E), dtype=bool)
+            if u:
+                unpacked = unpack_verdicts(packed, 1 + 2 * E)
+                mr = np.asarray(miss_rows)
+                own_rule[mr] = unpacked[inverse, 1:1 + E]
+                own_skipped[mr] = unpacked[inverse, 1 + E:1 + 2 * E]
+            for r, (c_rule, c_skip) in cached.items():
+                own_rule[r] = c_rule
+                own_skipped[r] = c_skip
             n_fallback = int(np.count_nonzero(db.host_fallback[:n]))
             if n_fallback:
                 # compact payload was lossy for these rows (membership
@@ -430,7 +527,77 @@ class PolicyEngine:
                     np.nonzero(db.host_fallback[:n])[0],
                     own_rule, own_skipped, max_fallback,
                 )
+            evict_d = self._cache_insert(keys, gen, unique_rows, eligible,
+                                         own_rule, own_skipped)
+            metrics_mod.observe_dedup("engine", n, u, len(cached),
+                                      elig_miss, evict_d)
             return own_rule, own_skipped, n_fallback
+
+        return _Inflight(self, batch, handle, finalize, binfo, waits)
+
+    def _encode_and_launch_sharded(self, snap, batch, docs, names, n, pad,
+                                   t0, binfo, waits) -> _Inflight:
+        """Mesh-sharded mirror of the dedup/cache encode stage: the row key
+        additionally folds in shard_of/row_of (config identity on the
+        mesh), and the unique sub-batch re-pads to the dp-aligned bucket."""
+        from ..ops.pattern_eval import unpack_verdicts
+
+        sharded = snap.sharded
+        enc = sharded.encode(docs, names, batch_pad=pad)
+        gen = snap.generation
+        keys = (sharded.row_keys(enc, n)
+                if n and (self.batch_dedup or self._verdict_cache is not None)
+                else None)
+
+        def eligible(r: int) -> bool:
+            return (bool(sharded.config_cacheable[enc.shard_of[r],
+                                                  enc.row_of[r]])
+                    and not bool(enc.host_fallback[r]))
+
+        cached, miss_rows, unique_rows, inverse, elig_miss = self._dedup_plan(
+            keys, n, gen, eligible)
+        u = len(unique_rows)
+        binfo["device_rows"] = u
+        if u == n:
+            enc_u = enc
+            binfo["pad"] = int(enc.attrs_val.shape[0])
+        elif u:
+            enc_u = sharded.select_rows(enc, unique_rows, batch_pad=_bucket(u))
+            binfo["pad"] = int(enc_u.attrs_val.shape[0])
+        else:
+            enc_u = None
+            binfo["pad"] = 0
+        metrics_mod.observe_pipeline_stage(
+            "engine", "encode", time.monotonic() - t0)
+        t1 = time.monotonic()
+        binfo["start_ns"] = time.time_ns()
+        if enc_u is not None:
+            handle = sharded.dispatch_full(enc_u)
+        else:
+            handle = np.zeros((0, 1), dtype=np.uint8)
+        metrics_mod.observe_pipeline_stage(
+            "engine", "launch", time.monotonic() - t1)
+        E = int(sharded.shards[0].eval_rule.shape[1])
+        max_fallback = self.max_fallback_per_batch
+
+        def finalize(packed):
+            own_rule = np.ones((n, E), dtype=bool)
+            own_skipped = np.zeros((n, E), dtype=bool)
+            if u:
+                unpacked = unpack_verdicts(np.asarray(packed), 1 + 2 * E)
+                mr = np.asarray(miss_rows)
+                own_rule[mr] = unpacked[inverse, 1:1 + E]
+                own_skipped[mr] = unpacked[inverse, 1 + E:1 + 2 * E]
+            for r, (c_rule, c_skip) in cached.items():
+                own_rule[r] = c_rule
+                own_skipped[r] = c_skip
+            sharded.apply_fallback(enc.host_fallback, docs, names,
+                                   own_rule, own_skipped, max_fallback)
+            evict_d = self._cache_insert(keys, gen, unique_rows, eligible,
+                                         own_rule, own_skipped)
+            metrics_mod.observe_dedup("engine", n, u, len(cached),
+                                      elig_miss, evict_d)
+            return own_rule, own_skipped, None
 
         return _Inflight(self, batch, handle, finalize, binfo, waits)
 
@@ -448,7 +615,8 @@ class PolicyEngine:
                                                binfo["duration_s"])
             metrics_mod.observe_batch(
                 "engine", binfo["batch_size"], binfo["pad"],
-                item.waits, binfo["duration_s"], fallback_n)
+                item.waits, binfo["duration_s"], fallback_n,
+                device_rows=binfo.get("device_rows"))
             if tracing_mod.tracing_active():
                 # one DeviceBatch span per kernel launch, span-linked to
                 # every constituent request's trace (export only: a link

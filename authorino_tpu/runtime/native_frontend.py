@@ -61,6 +61,7 @@ from ..pipeline.pipeline import AuthPipeline, AuthResult
 from ..utils import bucket_pow2
 from ..utils import metrics as metrics_mod
 from ..utils import tracing as tracing_mod
+from ..utils.verdict_cache import VerdictCache
 from ..utils.rpc import (
     INVALID_ARGUMENT,
     NOT_FOUND,
@@ -596,6 +597,9 @@ class _SnapRec:
     # attribution must count only their native denials — kernel-allowed
     # requests continue into the pipeline, which observes them itself
     hybrid_rows: set = field(default_factory=set)
+    # verdict-cache eligibility per kernel row: [G] bool (single corpus) or
+    # [S, G] (mesh) — compiler/compile.py config_cacheable
+    cacheable: Optional[np.ndarray] = None
 
 
 class NativeFrontend:
@@ -604,8 +608,17 @@ class NativeFrontend:
     def __init__(self, engine, port: int = 0, max_batch: int = 1024,
                  window_us: int = 2000, slots: int = 16, slow_cap: int = 65536,
                  dispatch_threads: int = 6, bind_all: bool = False,
-                 dyn_ttl_s: float = 600.0, trace_sample_n: int = 128):
+                 dyn_ttl_s: float = 600.0, trace_sample_n: int = 128,
+                 verdict_cache_size: int = 32768, batch_dedup: bool = True):
         self.engine = engine
+        # batch row dedup + snapshot-scoped verdict cache, mirroring the
+        # engine lane (runtime/engine.py): the device evaluates unique rows
+        # only, and cached (snap_id, row-digest) verdicts skip it entirely.
+        # Cache hits/misses/adds are folded into the frontend's dyn_hit/
+        # dyn_miss/dyn_add stats keys (see stats()).
+        self.batch_dedup = bool(batch_dedup)
+        self._verdict_cache = (VerdictCache(verdict_cache_size)
+                               if verdict_cache_size else None)
         # verified-token cache entries live at most this long (and never
         # past the token's own exp claim)
         self.dyn_ttl_s = float(dyn_ttl_s)
@@ -767,7 +780,25 @@ class NativeFrontend:
             t.join(timeout=300)
 
     def stats(self) -> Dict[str, int]:
-        return dict(self._mod.fe_stats()) if self._mod else {}
+        """fe_stats() plus the Python-side verdict-cache counters.  The
+        verdict cache's hit/miss/add traffic is FOLDED into the dyn_hit/
+        dyn_miss/dyn_add keys (the credential cache's counters — one
+        combined 'cached decision' story on /metrics), and additionally
+        exported under its own vdict_* keys so the two caches stay
+        distinguishable; the periodic drain turns every key into a
+        labelled auth_server_native_frontend_events_total series."""
+        s = dict(self._mod.fe_stats()) if self._mod else {}
+        vc = self._verdict_cache
+        if s and vc is not None:
+            counts = vc.counts()
+            s["dyn_hit"] = s.get("dyn_hit", 0) + counts["hits"]
+            s["dyn_miss"] = s.get("dyn_miss", 0) + counts["misses"]
+            s["dyn_add"] = s.get("dyn_add", 0) + counts["adds"]
+            s["vdict_hit"] = counts["hits"]
+            s["vdict_miss"] = counts["misses"]
+            s["vdict_add"] = counts["adds"]
+            s["vdict_evict"] = counts["evictions"]
+        return s
 
     def drain_native_stats(self) -> None:
         """Fold the C++ fe_stats() counters into Prometheus as deltas
@@ -805,6 +836,9 @@ class NativeFrontend:
             "inflight_batches": self._rb_inflight,
             "inflight_peak": self.rb_inflight_peak,
             "trace_sample_n": self.trace_sample_n,
+            "batch_dedup": self.batch_dedup,
+            "verdict_cache": (self._verdict_cache.counts()
+                              if self._verdict_cache is not None else None),
             "snapshot": None,
         }
         if rec is not None:
@@ -970,7 +1004,7 @@ class NativeFrontend:
         import jax
         import jax.numpy as jnp
 
-        from ..ops.pattern_eval import eval_packed_jit
+        from ..ops.pattern_eval import eval_bitpacked_jit
 
         if rec.sharded is not None:
             sh = rec.sharded
@@ -995,7 +1029,7 @@ class NativeFrontend:
         dt = wire_dtype(policy)
         A, M, K = policy.n_attrs, policy.n_member_attrs, policy.members_k
         C, NB = policy.n_cpu_leaves, max(policy.n_byte_attrs, 1)
-        out = eval_packed_jit(
+        out = eval_bitpacked_jit(
             rec.params,
             jnp.asarray(np.zeros((pad, A), dtype=dt)),
             jnp.asarray(np.full((pad, M, K), PAD, dtype=dt)),
@@ -1150,9 +1184,15 @@ class NativeFrontend:
                 rec.keepalive += [ams, abs_v]
                 spec["attr_member_slot_addr"] = ams.ctypes.data
                 spec["attr_byte_slot_addr"] = abs_v.ctypes.data
+                rec.cacheable = policy.config_cacheable
                 if policy.n_byte_attrs > 0 and policy.dfa_tables.size:
-                    dt_tr = np.ascontiguousarray(policy.dfa_tables, dtype=np.uint8)
-                    dt_ac = np.ascontiguousarray(policy.dfa_accept, dtype=np.uint8)
+                    # C++ indexes transition tables BY ROW: expand the
+                    # compiler's deduped [T, S, 256] store through
+                    # dfa_table_of_row for the native encoder
+                    dt_tr = np.ascontiguousarray(policy.dfa_tables_by_row,
+                                                 dtype=np.uint8)
+                    dt_ac = np.ascontiguousarray(policy.dfa_accept_by_row,
+                                                 dtype=np.uint8)
                     rec.keepalive += [dt_tr, dt_ac]
                     spec.update(dfa_R=int(dt_tr.shape[0]), dfa_S=int(dt_tr.shape[1]),
                                 dfa_trans_addr=dt_tr.ctypes.data,
@@ -1215,15 +1255,21 @@ class NativeFrontend:
                 spec["attr_byte_slot_addr"] = abs_v.ctypes.data
                 # per-shard DFA tables stack on the row axis (targets unify
                 # R and the state count); attr_dfas rows are globalized
+                rec.cacheable = np.stack(
+                    [p.config_cacheable for p in sharded.shards])
                 attr_dfas: List[List[Tuple[int, int]]] = [
                     [] for _ in range(S_sh * A)]
                 if p0.n_byte_attrs > 0 and p0.dfa_tables.size:
-                    R = int(p0.dfa_tables.shape[0])
+                    # per-row expansion of the deduped table store, stacked
+                    # on the (shard-globalized) row axis for C++
+                    R = int(p0.dfa_table_of_row.shape[0])
                     dt_tr = np.ascontiguousarray(
-                        np.concatenate([p.dfa_tables for p in sharded.shards]),
+                        np.concatenate([p.dfa_tables_by_row
+                                        for p in sharded.shards]),
                         dtype=np.uint8)
                     dt_ac = np.ascontiguousarray(
-                        np.concatenate([p.dfa_accept for p in sharded.shards]),
+                        np.concatenate([p.dfa_accept_by_row
+                                        for p in sharded.shards]),
                         dtype=np.uint8)
                     rec.keepalive += [dt_tr, dt_ac]
                     spec.update(dfa_R=int(dt_tr.shape[0]),
@@ -1594,67 +1640,148 @@ class NativeFrontend:
             elif kind == EV_STOPPED:
                 break
 
+    def _dedup_plan(self, rec: _SnapRec, a: Dict[str, np.ndarray],
+                    count: int, rows: np.ndarray,
+                    shards_arr: Optional[np.ndarray]):
+        """Cache-lookup + within-batch row collapse for one C++-encoded
+        slot.  Keys are the raw encoded operand bytes of each row (exact:
+        the kernel is a pure per-row function; the native path has no
+        lossy host-fallback rows).  Returns (keys, eligible [count] bool,
+        cached {row: verdict}, miss_rows, unique_rows, inverse,
+        eligible_misses) — or None when both features are off."""
+        cache = self._verdict_cache
+        if not self.batch_dedup and cache is None:
+            return None
+        from ..compiler.pack import dedup_rows, row_key_bytes
+
+        arrays = [a["config_id"], a["attrs_val"], a["members"],
+                  a["cpu_dense"], a["attr_bytes"], a["byte_ovf"]]
+        if shards_arr is not None:
+            arrays.insert(0, a["shard_of"])
+        keys = row_key_bytes(arrays, count)
+        if rec.cacheable is None:
+            eligible = np.zeros((count,), dtype=bool)
+        elif shards_arr is not None:
+            eligible = rec.cacheable[shards_arr, rows]
+        else:
+            eligible = rec.cacheable[rows]
+        cached: Dict[int, int] = {}
+        elig_miss = 0
+        if cache is not None:
+            miss_rows: List[int] = []
+            snap_id = rec.snap_id
+            for r in range(count):
+                if eligible[r]:
+                    v = cache.get((snap_id, keys[r]))
+                    if v is not None:
+                        cached[r] = v
+                        continue
+                    elig_miss += 1
+                miss_rows.append(r)
+        else:
+            miss_rows = list(range(count))
+        if self.batch_dedup:
+            unique_rows, inverse = dedup_rows(keys, miss_rows)
+        else:
+            unique_rows, inverse = miss_rows, np.arange(len(miss_rows))
+        return keys, eligible, cached, miss_rows, unique_rows, inverse, elig_miss
+
     def _dispatch(self, snap_id: int, slot: int, count: int) -> None:
         """Launch stage: non-blocking kernel dispatch for one C++-encoded
         slot, then park the in-flight batch on the readback queue.  The
         dispatcher thread is immediately free to launch the next slot, so
         the in-flight window is the C++ slot count — batches overlap on the
-        link instead of serializing per thread."""
+        link instead of serializing per thread.
+
+        Before the launch, cached (snap_id, row-digest) verdicts resolve
+        without the device and the remaining rows collapse to UNIQUE rows
+        (ISSUE 3): the H2D payload carries only unique work, and the
+        readback thread fans verdicts back out through the inverse map.
+        The readback itself is the bit-packed u8 bitmask (8 verdicts/
+        byte), so D2H bytes shrink ~8x on the RTT-bound link too."""
         import jax.numpy as jnp
 
-        from ..ops.pattern_eval import eval_packed_jit
+        from ..ops.pattern_eval import eval_bitpacked_jit
 
         rec = self._snaps[snap_id]
         a = rec.arrays[slot]
-        shards_arr = None
-        if rec.sharded is not None:
-            # one shard_map dispatch per micro-batch: the C++ encoder
-            # already laid each request into its owning shard's [B, S, ...]
-            # slice (packed column 0 = own verdict, psum-merged over 'mp')
-            sh = rec.sharded
-            has_dfa = sh.has_dfa
-            eff = _trim_bytes(a["attr_bytes"][:count]).shape[-1] if has_dfa else 0
-            pad, eff = self._pick_warm_shape(rec, count, eff)
-            t0 = time.monotonic()
-            t0_ns = time.time_ns()
-            packed = sh._step(
-                sh.params,
-                jnp.asarray(a["attrs_val"][:pad]),
-                jnp.asarray(a["members"][:pad]),
-                jnp.asarray(a["cpu_dense"][:pad].view(bool)),
-                jnp.asarray(np.ascontiguousarray(a["attr_bytes"][:pad, :, :, :eff]))
-                if has_dfa else None,
-                jnp.asarray(a["byte_ovf"][:pad].view(bool)) if has_dfa else None,
-                jnp.asarray(a["shard_of"][:pad]),
-                jnp.asarray(a["config_id"][:pad]),
-            )
-            shards_arr = a["shard_of"][:count].copy()
-        else:
-            has_dfa = rec.params["dfa_tables"] is not None
-            eff = _trim_bytes(a["attr_bytes"][:count]).shape[-1] if has_dfa else 0
-            # round the batch/byte buckets up to an already-compiled variant
-            # so XLA compiles never land on live requests (rows past `count`
-            # carry stale bytes from earlier batches; results discarded)
-            pad, eff = self._pick_warm_shape(rec, count, eff)
-            t0 = time.monotonic()
-            t0_ns = time.time_ns()
-            packed = eval_packed_jit(
-                rec.params,
-                jnp.asarray(a["attrs_val"][:pad]),
-                jnp.asarray(a["members"][:pad]),
-                jnp.asarray(a["cpu_dense"][:pad].view(bool)),
-                jnp.asarray(a["config_id"][:pad]),
-                jnp.asarray(np.ascontiguousarray(a["attr_bytes"][:pad, :, :eff]))
-                if has_dfa else None,
-                jnp.asarray(a["byte_ovf"][:pad].view(bool)) if has_dfa else None,
-            )
-        try:
-            packed.copy_to_host_async()
-        except Exception:
-            pass
         # copy attribution rows BEFORE the slot can complete: once
         # fe_complete_batch runs, the C++ encoder may refill them
         rows = a["config_id"][:count].copy()
+        shards_arr = (a["shard_of"][:count].copy()
+                      if rec.sharded is not None else None)
+        fan = self._dedup_plan(rec, a, count, rows, shards_arr)
+        if fan is not None:
+            unique_rows = fan[4]
+            u = len(unique_rows)
+        else:
+            unique_rows, u = list(range(count)), count
+
+        def sel(name):
+            """Unique-row operand view: the slot arrays sliced [:pad] when
+            nothing collapsed (stale pad rows discarded, as before), else
+            fancy-indexed unique rows padded by repeating the first (a
+            copy — the slot refills once the batch completes)."""
+            return a[name][:pad] if u == count else a[name][idx]
+
+        if rec.sharded is not None:
+            # one shard_map dispatch per micro-batch: the C++ encoder
+            # already laid each request into its owning shard's [B, S, ...]
+            # slice (packed bit 0 = own verdict, psum-merged over 'mp')
+            sh = rec.sharded
+            has_dfa = sh.has_dfa
+        else:
+            has_dfa = rec.params["dfa_tables"] is not None
+        if u == 0:
+            # every row cache-resolved: complete through the readback queue
+            # with no device work at all
+            pad = eff = 0
+            packed = np.zeros((0, 1), dtype=np.uint8)
+            t0 = time.monotonic()
+            t0_ns = time.time_ns()
+        else:
+            eff = (_trim_bytes(a["attr_bytes"][:count] if u == count
+                               else a["attr_bytes"][unique_rows]).shape[-1]
+                   if has_dfa else 0)
+            # round the batch/byte buckets up to an already-compiled variant
+            # so XLA compiles never land on live requests (rows past the
+            # unique count carry stale/repeated operands; results discarded)
+            pad, eff = self._pick_warm_shape(rec, u, eff)
+            idx = (np.asarray(unique_rows + [unique_rows[0]] * (pad - u))
+                   if u != count else None)
+            t0 = time.monotonic()
+            t0_ns = time.time_ns()
+            if rec.sharded is not None:
+                packed = sh._step(
+                    sh.params,
+                    jnp.asarray(sel("attrs_val")),
+                    jnp.asarray(sel("members")),
+                    jnp.asarray(sel("cpu_dense").view(bool)),
+                    jnp.asarray(np.ascontiguousarray(
+                        sel("attr_bytes")[..., :eff]))
+                    if has_dfa else None,
+                    jnp.asarray(sel("byte_ovf").view(bool))
+                    if has_dfa else None,
+                    jnp.asarray(sel("shard_of")),
+                    jnp.asarray(sel("config_id")),
+                )
+            else:
+                packed = eval_bitpacked_jit(
+                    rec.params,
+                    jnp.asarray(sel("attrs_val")),
+                    jnp.asarray(sel("members")),
+                    jnp.asarray(sel("cpu_dense").view(bool)),
+                    jnp.asarray(sel("config_id")),
+                    jnp.asarray(np.ascontiguousarray(
+                        sel("attr_bytes")[..., :eff]))
+                    if has_dfa else None,
+                    jnp.asarray(sel("byte_ovf").view(bool))
+                    if has_dfa else None,
+                )
+            try:
+                packed.copy_to_host_async()
+            except Exception:
+                pass
         with self._rb_lock:
             self._rb_inflight += 1
             if self._rb_inflight > self.rb_inflight_peak:
@@ -1662,7 +1789,7 @@ class NativeFrontend:
             inflight = self._rb_inflight
         self._g_native_inflight.set(inflight)
         self._rb_q.append((rec, snap_id, slot, count, pad, eff, rows,
-                           shards_arr, packed, t0, t0_ns))
+                           shards_arr, packed, t0, t0_ns, fan))
         self._rb_evt.set()
 
     def _readback_loop(self) -> None:
@@ -1720,7 +1847,8 @@ class NativeFrontend:
                                count: int, pad: int, eff: int,
                                rows: np.ndarray,
                                shards_arr: Optional[np.ndarray],
-                               packed, t0: float, t0_ns: int) -> None:
+                               packed, t0: float, t0_ns: int,
+                               fan=None) -> None:
         if self._fe_stopped:
             # stop()'s drain deadline expired with this batch still on the
             # wire and fe_stop has run: completing into the torn-down C++
@@ -1728,16 +1856,44 @@ class NativeFrontend:
             return
         packed = np.asarray(packed)
         dispatch_s = time.monotonic() - t0
-        verdict = np.ascontiguousarray(packed[:count, 0]).astype(np.uint8)
+        if fan is None:
+            # dedup/cache off: packed is the bit-masked result of the full
+            # slot; own verdict = bit 0 of byte 0
+            verdict = np.ascontiguousarray(
+                packed[:count, 0] & 1).astype(np.uint8)
+            u = count
+            cached_n = elig_miss_n = evict_d = 0
+        else:
+            keys, eligible, cached, miss_rows, unique_rows, inverse, \
+                elig_miss_n = fan
+            u = len(unique_rows)
+            verdict = np.zeros((count,), dtype=np.uint8)
+            if u:
+                uniq_v = (packed[:, 0] & 1).astype(np.uint8)
+                verdict[np.asarray(miss_rows)] = uniq_v[inverse]
+            for r, v in cached.items():
+                verdict[r] = v
+            verdict = np.ascontiguousarray(verdict)
+            cached_n = len(cached)
+            evict_d = 0
         self._mod.fe_complete_batch(snap_id, slot, verdict.ctypes.data)
         # the slot is COMPLETED from here on: an exception below must not
         # propagate to the readback loop's fail-closed deny, which would
         # fe_complete_batch the same slot twice — by then possibly refilled
         # with a fresh live batch
         try:
+            cache = self._verdict_cache
+            if fan is not None and cache is not None:
+                evict0 = cache.evictions
+                for r in fan[4]:  # unique rows: freshly evaluated
+                    if fan[1][r]:
+                        cache.put((snap_id, fan[0][r]), int(verdict[r]))
+                evict_d = cache.evictions - evict0
+            metrics_mod.observe_dedup("native", count, u, cached_n,
+                                      elig_miss_n, evict_d)
             self._post_complete_telemetry(rec, count, pad, eff, rows,
                                           shards_arr, verdict, dispatch_s,
-                                          t0_ns)
+                                          t0_ns, device_rows=u)
         except Exception:
             log.exception("post-completion telemetry failed")
 
@@ -1745,10 +1901,12 @@ class NativeFrontend:
                                  eff: int, rows: np.ndarray,
                                  shards_arr: Optional[np.ndarray],
                                  verdict: np.ndarray, dispatch_s: float,
-                                 t0_ns: int) -> None:
+                                 t0_ns: int,
+                                 device_rows: Optional[int] = None) -> None:
         # per-batch telemetry AFTER completion: responses are already on
         # their way to the wire (queue wait is C++-clocked — stage hists)
-        metrics_mod.observe_batch("native", count, pad, None, dispatch_s)
+        metrics_mod.observe_batch("native", count, pad, None, dispatch_s,
+                                  device_rows=device_rows)
         metrics_mod.observe_pipeline_stage("native", "device", dispatch_s)
         if tracing_mod.tracing_active():
             # fast-lane requests have no Python spans to link (only sampled

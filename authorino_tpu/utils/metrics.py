@@ -335,6 +335,64 @@ pipeline_stage_duration = _histogram(
     buckets=STAGE_BUCKETS,
 )
 
+# ---------------------------------------------------------------------------
+# Batch row dedup + snapshot-scoped verdict cache (ISSUE 3): the device
+# evaluates only UNIQUE rows per micro-batch, and rows whose (generation,
+# row-digest) verdict is already cached skip the device entirely.
+# ---------------------------------------------------------------------------
+
+batch_dedup_ratio = _histogram(
+    "auth_server_batch_dedup_ratio",
+    "Per-micro-batch fraction of rows collapsed before device dispatch "
+    "(1 - unique_rows / rows, cache-resolved rows included): 0 = all rows "
+    "shipped, 0.9 = the device evaluated one row in ten.",
+    _LANE_LABELS,
+    buckets=OCCUPANCY_BUCKETS,
+)
+verdict_cache_hits = _counter(
+    "auth_server_verdict_cache_hits_total",
+    "Rows resolved from the snapshot-scoped verdict cache without touching "
+    "the device (keyed by generation + encoded-row digest).",
+    _LANE_LABELS,
+)
+verdict_cache_misses = _counter(
+    "auth_server_verdict_cache_misses_total",
+    "Cache-eligible rows whose verdict was not cached (evaluated on device, "
+    "then inserted).",
+    _LANE_LABELS,
+)
+verdict_cache_evictions = _counter(
+    "auth_server_verdict_cache_evictions_total",
+    "Verdict-cache entries dropped by the LRU bound (raise "
+    "--verdict-cache-size if this grows at steady state).",
+    _LANE_LABELS,
+)
+
+_dedup_children: dict = {}
+
+
+def observe_dedup(lane, n_rows, n_device_rows, cache_hits, cache_misses,
+                  evictions_delta=0) -> None:
+    """Fold one micro-batch's dedup/cache outcome: ``n_device_rows`` of
+    ``n_rows`` actually shipped (after cache hits AND within-batch
+    collapse).  Cached label children — runs once per micro-batch."""
+    ch = _dedup_children.get(lane)
+    if ch is None:
+        ch = _dedup_children[lane] = (
+            batch_dedup_ratio.labels(lane),
+            verdict_cache_hits.labels(lane),
+            verdict_cache_misses.labels(lane),
+            verdict_cache_evictions.labels(lane),
+        )
+    if n_rows:
+        ch[0].observe(1.0 - n_device_rows / n_rows)
+    if cache_hits:
+        ch[1].inc(cache_hits)
+    if cache_misses:
+        ch[2].inc(cache_misses)
+    if evictions_delta:
+        ch[3].inc(evictions_delta)
+
 
 _batch_children: dict = {}
 _stage_children: dict = {}
@@ -381,16 +439,20 @@ def _ensure_batch_children(lane):
 
 
 def observe_batch(lane, n, pad, queue_wait_s, dispatch_s,
-                  fallback_n=None) -> None:
+                  fallback_n=None, device_rows=None) -> None:
     """Record one kernel launch's batch telemetry (size, pad occupancy,
     queue wait, dispatch wall time, host-fallback rows).  ``queue_wait_s``
     may be a scalar (one representative wait) or an array of TRUE
-    per-request waits (folded in O(buckets), not O(batch)).  Label children
-    are cached: this runs on every micro-batch."""
+    per-request waits (folded in O(buckets), not O(batch)).
+    ``device_rows`` is the row count that actually shipped after batch
+    dedup / verdict-cache hits (defaults to ``n``): occupancy stays the
+    device-true ratio ≤ 1 — the dedup win is its own series
+    (auth_server_batch_dedup_ratio).  Label children are cached: this runs
+    on every micro-batch."""
     ch = _ensure_batch_children(lane)
     ch[0].observe(n)
     if pad:
-        ch[1].observe(n / pad)
+        ch[1].observe((n if device_rows is None else device_rows) / pad)
     if queue_wait_s is not None:
         if hasattr(queue_wait_s, "__len__"):
             fold_queue_waits(lane, queue_wait_s)
@@ -410,6 +472,14 @@ def observe_batch(lane, n, pad, queue_wait_s, dispatch_s,
 
 # fe_stats() keys that are live backlog gauges, not monotonic counters
 NATIVE_QUEUE_KEYS = ("slow_pending", "slow_queued")
+
+# event keys whose labelled series must EXIST on /metrics even before they
+# first move (the drain otherwise skips zero-delta keys, which is how the
+# credential-cache counters stayed invisible across 3.9M requests): the
+# C++ credential cache's dyn_* counters plus the Python-side verdict-cache
+# traffic the native frontend folds into the same drain
+NATIVE_ENSURE_KEYS = ("dyn_hit", "dyn_miss", "dyn_add",
+                      "vdict_hit", "vdict_miss", "vdict_add", "vdict_evict")
 
 native_frontend_events = _counter(
     "auth_server_native_frontend_events_total",
@@ -438,6 +508,11 @@ class NativeStatsDrain:
     def fold(self, stats) -> None:
         if not stats:
             return
+        for key in NATIVE_ENSURE_KEYS:
+            # materialize the labelled series at 0 so dashboards see the
+            # cache counters from the first scrape, not the first hit
+            if key not in self._children:
+                self._children[key] = native_frontend_events.labels(key)
         for key, value in stats.items():
             if key in NATIVE_QUEUE_KEYS:
                 child = self._children.get(key)

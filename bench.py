@@ -169,7 +169,8 @@ def run_pipelined(model, docs, rows, B, seconds, workers):
             t0 = time.perf_counter()
             db = model.encode_json(parts[lo : lo + B], rows[lo : lo + B], batch_pad=B)
             t1 = time.perf_counter()
-            np.asarray(dispatch_packed(model.params, db))
+            # bit-packed readback: the same D2H shape the serving engine reads
+            np.asarray(dispatch_packed(model.params, db, bitpack=True))
             t2 = time.perf_counter()
             with lock:
                 lat.append(t2 - t0)
@@ -386,6 +387,50 @@ def run_grpc_mode(args):
     return sum(totals), measured[0], lat, None, None
 
 
+def zipf_repeat(payloads, key_repeat, seed=9):
+    """--key-repeat workload shaping: draw the wire payload sequence
+    zipfian over the base pool (rank 1 = hottest key), so repeated request
+    keys exercise the batch row dedup + verdict cache the way production
+    traffic (hot tenants, hot tokens) does.  ``key_repeat`` is the zipf
+    s-parameter (> 1; 0/off = the uniform base pool unchanged)."""
+    if not key_repeat:
+        return payloads
+    if key_repeat <= 1.0:
+        raise SystemExit("--key-repeat must be > 1.0 (zipf exponent) or 0")
+    import numpy as np
+
+    ranks = np.random.default_rng(seed).zipf(key_repeat, size=len(payloads))
+    return [payloads[(int(r) - 1) % len(payloads)] for r in ranks]
+
+
+def _dedup_cache_delta(metrics_text, prev_hist, fe_stats, prev_stats, W):
+    """Per-trial dedup_cache block from successive /metrics + fe.stats()
+    deltas: dedup ratio, verdict-cache hit rate, and D2H readback bytes
+    per batch at the packed-bitmask width W."""
+    ratio = _hist_lane(metrics_text, "auth_server_batch_dedup_ratio", "native")
+    size = _hist_lane(metrics_text, "auth_server_batch_size", "native")
+    d_ratio = (ratio[0] - prev_hist[0][0], ratio[1] - prev_hist[0][1])
+    d_size = (size[0] - prev_hist[1][0], size[1] - prev_hist[1][1])
+    hits = fe_stats.get("vdict_hit", 0) - prev_stats.get("vdict_hit", 0)
+    misses = fe_stats.get("vdict_miss", 0) - prev_stats.get("vdict_miss", 0)
+    ratio_mean = (d_ratio[0] / d_ratio[1]) if d_ratio[1] else None
+    size_mean = (d_size[0] / d_size[1]) if d_size[1] else None
+    block = {
+        "dedup_ratio_mean": round(ratio_mean, 4) if ratio_mean is not None else None,
+        "cache_hits": int(hits),
+        "cache_misses": int(misses),
+        "cache_hit_rate": round(hits / (hits + misses), 4)
+        if (hits + misses) else None,
+        "readback_bytes_per_row": W,
+        # device rows per batch ≈ wire rows × (1 - dedup ratio); times the
+        # packed row width = D2H bytes per batch on the RTT-bound link
+        "d2h_bytes_per_batch_mean": round(
+            size_mean * (1.0 - ratio_mean) * W, 1)
+        if (size_mean is not None and ratio_mean is not None) else None,
+    }
+    return block, (ratio, size)
+
+
 def _start_fake_collector():
     """OTLP/HTTP trace sink on a background loop thread: bench --trace
     measures the fast lane with span export ACTIVE (head-sampled 1-in-N to
@@ -470,9 +515,11 @@ def run_native_mode(args):
     port = fe.start()
     log(f"native frontend on :{port} (fast configs: see stats below)")
 
+    base_payloads = [make_wire_payload(external_auth_pb2, i, n_cfg, rng)
+                     for i in range(4096)]
+    wire_payloads = zipf_repeat(base_payloads, args.key_repeat)
     with tempfile.NamedTemporaryFile(suffix=".payloads", delete=False) as f:
-        for i in range(4096):
-            b = make_wire_payload(external_auth_pb2, i, n_cfg, rng)
+        for b in wire_payloads:
             f.write(struct.pack(">I", len(b)) + b)
         payload_path = f.name
 
@@ -492,15 +539,38 @@ def run_native_mode(args):
     sat_conns = max(2, (8 * B + sat_depth - 1) // sat_depth)
     light_total = max(128, B // 4)  # light pass: ~one partial batch in flight
 
+    # packed-bitmask readback width (bytes/row) for the dedup_cache block
+    E_pol = engine.snapshot_policy()
+    W_row = ((1 + 2 * int(E_pol.eval_rule.shape[1]) + 7) // 8
+             if E_pol is not None else None)
+
     try:
-        # warmup: prime XLA bucket shapes + the page cache through the wire
+        # warm-up phase BEFORE trial 1: a full-length saturation pass (not
+        # just the 2s shape-priming burst) so trial 1 measures the same
+        # steady thermal/tunnel state as trials 2..N — BENCH_r05's monotone
+        # trial decay (100k → 86k → 78k) made best-of-trials read as a
+        # cold-start artifact rather than capacity
         lg(2, max(5.0, args.seconds / 2), sat_depth, sat_conns)
+        log("warm-up saturation pass (full trial length) ...")
+        lg(args.seconds, 1, sat_depth, sat_conns)
 
         best = None
         lat_light = None
         obs_scrapes = []  # per-trial /metrics text (occupancy/RTT deltas)
         obs_dvars = None
         trials_detail = []  # EVERY trial's numbers ride the artifact
+        # baseline BOTH delta sources post-warm-up, so trial 1's
+        # dedup_cache block covers exactly trial 1 (not the priming burst)
+        prev_dc_hist = ((0.0, 0.0), (0.0, 0.0))
+        try:
+            warm_text, _ = scrape_observability(engine, fe)
+            prev_dc_hist = (
+                _hist_lane(warm_text, "auth_server_batch_dedup_ratio",
+                           "native"),
+                _hist_lane(warm_text, "auth_server_batch_size", "native"))
+        except Exception as e:
+            log(f"warm-up scrape failed: {e!r}")
+        prev_dc_stats = fe.stats()
         for trial in range(args.trials):
             sat = lg(args.seconds, 2, sat_depth, sat_conns)
             light = lg(max(3.0, args.seconds / 2), 1, light_total // 2, 2)
@@ -523,6 +593,16 @@ def run_native_mode(args):
                 obs_scrapes.append(metrics_text)
                 tr = observability_summary([metrics_text], obs_dvars)["batch_occupancy"]
                 log(f"  occupancy so far: mean={tr['mean']} over {tr['batches']} batches")
+                if W_row is not None:
+                    cur_stats = fe.stats()
+                    dc, prev_dc_hist = _dedup_cache_delta(
+                        metrics_text, prev_dc_hist, cur_stats,
+                        prev_dc_stats, W_row)
+                    prev_dc_stats = cur_stats
+                    trials_detail[-1]["dedup_cache"] = dc
+                    log(f"  dedup ratio={dc['dedup_ratio_mean']} "
+                        f"cache hit rate={dc['cache_hit_rate']} "
+                        f"d2h/batch={dc['d2h_bytes_per_batch_mean']}B")
             except Exception as e:
                 log(f"  observability scrape failed: {e!r}")
         log(f"native frontend stats: {fe.stats()}")
@@ -602,7 +682,9 @@ def run_native_mode(args):
         if snap_rec.params is not None and snap_rec.arrays:
             import jax.numpy as jnp
 
-            from authorino_tpu.ops.pattern_eval import eval_packed_jit
+            # the serving dispatchers read back the packed u8 bitmask, so
+            # the RTT probe must time the same D2H shape
+            from authorino_tpu.ops.pattern_eval import eval_bitpacked_jit
 
             from authorino_tpu.compiler.pack import _trim_bytes
 
@@ -611,7 +693,7 @@ def run_native_mode(args):
             has_dfa = snap_rec.params["dfa_tables"] is not None
             for _ in range(14):
                 t0 = time.perf_counter()
-                np.asarray(eval_packed_jit(
+                np.asarray(eval_bitpacked_jit(
                     snap_rec.params,
                     jnp.asarray(a["attrs_val"][:pad]), jnp.asarray(a["members"][:pad]),
                     jnp.asarray(a["cpu_dense"][:pad].view(bool)),
@@ -626,6 +708,8 @@ def run_native_mode(args):
         rtts = rtts[1:] if len(rtts) > 1 else rtts  # drop the compile-warm first
         batch_rtt_p50 = rtts[len(rtts) // 2] * 1e3 if rtts else 0.0
         batch_rtt_p90 = rtts[int(len(rtts) * 0.9)] * 1e3 if rtts else 0.0
+        fe_final_stats = fe.stats()
+        fe_dedup_enabled = fe.batch_dedup
     finally:
         fe.stop()
         os.unlink(payload_path)
@@ -645,9 +729,20 @@ def run_native_mode(args):
         # measured on-box stages (C++ clocked, histogram upper bounds)
         "onbox_stages": onbox,
         "onbox_stages_light": onbox_light,
-        # best-of is the headline; the artifact keeps every trial so tunnel
-        # swings are distinguishable from real regressions round over round
+        # best-of is the headline; the artifact keeps every trial PLUS the
+        # median so tunnel swings are distinguishable from real
+        # regressions round over round (trials warm-started: see above)
+        "rps_median": sorted(t["rps"] for t in trials_detail)[
+            len(trials_detail) // 2] if trials_detail else None,
         "trials": trials_detail,
+        "key_repeat": args.key_repeat or None,
+        "dedup_cache": {
+            "readback_bytes_per_row": W_row,
+            "verdict_cache": {
+                k: int(v) for k, v in fe_final_stats.items()
+                if k.startswith("vdict_")},
+            "batch_dedup": fe_dedup_enabled,
+        },
     }
     if obs_scrapes:
         try:
@@ -1083,6 +1178,10 @@ def run_mix_mode(args):
     external_auth_pb2 = protos.external_auth_pb2
     rng = random.Random(5)
     results = {}
+    selected = {c.strip() for c in args.classes.split(",") if c.strip()}
+
+    def want(cls: str) -> bool:
+        return not selected or cls in selected
 
     def new_engine():
         return PolicyEngine(max_batch=args.batch, max_delay_s=args.window_us / 1e6,
@@ -1303,6 +1402,11 @@ def main():
     ap.add_argument("--classes", default="",
                     help="mix mode: comma-separated class filter (c1..c6); "
                          "empty = all")
+    ap.add_argument("--key-repeat", type=float, default=0.0,
+                    help="native mode: zipf exponent (> 1) shaping the wire "
+                         "payload sequence so request keys REPEAT (hot "
+                         "tenants/tokens) — exercises batch row dedup and "
+                         "the verdict cache; 0 = uniform (off)")
     ap.add_argument("--trials", type=int, default=3,
                     help="run the measured loop N times and report the best "
                          "— the tunnel to the device on this image has "

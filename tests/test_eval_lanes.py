@@ -113,6 +113,40 @@ def test_matmul_lane_bf16_matches_gather_lane(monkeypatch):
     np.testing.assert_array_equal(np.asarray(v_mm), np.asarray(v_g))
 
 
+def test_bitpacked_readback_roundtrips_both_lanes(monkeypatch):
+    """The packed u8 bitmask readback (8 verdicts/byte, little bit order)
+    must round-trip exactly against the unpacked [B, 1+2E] verdict arrays
+    on BOTH the matmul and gather lanes — the D2H compression can never
+    change an answer."""
+    policy = compile_corpus(_mixed_corpus(), members_k=4)
+    params_mm, params_g = _both_lane_params(policy, monkeypatch)
+    docs = _docs(64)
+    rows = [i % policy.n_configs for i in range(len(docs))]
+    db = pack_batch(policy, encode_batch_py(policy, docs, rows, batch_pad=64))
+    args = (
+        jnp.asarray(db.attrs_val),
+        jnp.asarray(db.members_c),
+        jnp.asarray(db.cpu_dense),
+        jnp.asarray(db.config_id),
+        jnp.asarray(db.attr_bytes),
+        jnp.asarray(db.byte_ovf),
+    )
+    E = int(policy.eval_rule.shape[1])
+    cols = 1 + 2 * E
+    for params in (params_mm, params_g):
+        reference = np.asarray(pe.eval_packed_jit(params, *args))
+        packed = np.asarray(pe.eval_bitpacked_jit(params, *args))
+        assert packed.dtype == np.uint8
+        assert packed.shape == (reference.shape[0], pe.packed_width(cols))
+        np.testing.assert_array_equal(
+            pe.unpack_verdicts(packed, cols), reference)
+    # bits past the verdict columns are zero padding (byte-stable wire)
+    tail_bits = pe.packed_width(cols) * 8 - cols
+    if tail_bits:
+        full = np.unpackbits(packed, axis=1, bitorder="little")
+        assert not full[:, cols:].any()
+
+
 def test_interner_overflow_falls_back_to_gather(monkeypatch):
     policy = compile_corpus(_mixed_corpus(5), members_k=4)
     monkeypatch.setenv("AUTHORINO_TPU_EVAL_LANE", "matmul")

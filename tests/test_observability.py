@@ -471,6 +471,69 @@ class TestStageBucketParity:
 
 
 # ---------------------------------------------------------------------------
+# satellite (ISSUE 3): dedup/cache stat keys must not drift between the
+# Python stats() merge, the C++ fe_stats exporter, and the drain's
+# series-materialization list
+# ---------------------------------------------------------------------------
+
+class TestDedupCacheStatKeyParity:
+    # the C++ credential-cache counters the verdict cache folds into
+    CPP_KEYS = ("dyn_hit", "dyn_miss", "dyn_add")
+    # Python-side verdict-cache keys merged into stats() next to them
+    PY_KEYS = ("vdict_hit", "vdict_miss", "vdict_add", "vdict_evict")
+
+    def test_cpp_exports_every_dyn_key(self):
+        pymod = (Path(__file__).resolve().parent.parent
+                 / "native" / "pymod.cpp").read_text()
+        for key in self.CPP_KEYS:
+            assert re.search(r'put\("%s"' % key, pymod), (
+                f"native/pymod.cpp fe_stats no longer exports {key!r} — "
+                "the verdict cache folds into these keys (native_frontend."
+                "stats()) and the drain labels series by them")
+
+    def test_python_stats_merge_uses_the_same_keys(self):
+        # source-scan (not import: runtime/native_frontend.py needs
+        # cryptography via the evaluator tree)
+        src = (Path(__file__).resolve().parent.parent / "authorino_tpu"
+               / "runtime" / "native_frontend.py").read_text()
+        for key in self.CPP_KEYS + self.PY_KEYS:
+            assert re.search(r'"%s"' % key, src), (
+                f"native_frontend.stats() no longer references {key!r}")
+
+    def test_drain_materializes_every_key(self):
+        for key in self.CPP_KEYS + self.PY_KEYS:
+            assert key in metrics_mod.NATIVE_ENSURE_KEYS, (
+                f"{key!r} missing from NATIVE_ENSURE_KEYS — its "
+                "auth_server_native_frontend_events_total series would "
+                "not exist on /metrics until the first delta")
+
+    def test_drain_creates_zero_valued_series(self):
+        from prometheus_client import REGISTRY
+
+        drain = metrics_mod.NativeStatsDrain()
+        drain.fold({"fast": 1})  # any fold materializes the ensure list
+        for key in metrics_mod.NATIVE_ENSURE_KEYS:
+            # raw registry read: the series must EXIST (0.0), not be absent
+            assert REGISTRY.get_sample_value(
+                "auth_server_native_frontend_events_total",
+                {"event": key}) is not None
+
+    def test_verdict_cache_series_exist(self):
+        metrics_mod.observe_dedup("testlane", 10, 4, 3, 3, 1)
+        assert sample("auth_server_verdict_cache_hits_total",
+                      {"lane": "testlane"}) == 3.0
+        assert sample("auth_server_verdict_cache_misses_total",
+                      {"lane": "testlane"}) == 3.0
+        assert sample("auth_server_verdict_cache_evictions_total",
+                      {"lane": "testlane"}) == 1.0
+        # dedup ratio histogram: 10 rows → 4 device rows = 0.6 collapsed
+        assert sample("auth_server_batch_dedup_ratio_sum",
+                      {"lane": "testlane"}) == pytest.approx(0.6)
+        assert sample("auth_server_batch_dedup_ratio_count",
+                      {"lane": "testlane"}) == 1.0
+
+
+# ---------------------------------------------------------------------------
 # drain plumbing details
 # ---------------------------------------------------------------------------
 

@@ -426,6 +426,7 @@ def test_fused_h2d_staging_matches_per_operand_path():
         fuse_batch,
         fused_h2d_supported,
         to_device,
+        unpack_verdicts,
     )
 
     assert fused_h2d_supported()  # little-endian bitcast probe
@@ -449,4 +450,9 @@ def test_fused_h2d_staging_matches_per_operand_path():
     buf, layout = fuse_batch(db)
     assert buf.dtype == np.uint8 and buf.ndim == 1  # ONE staging buffer
     fused = np.asarray(eval_fused_jit(params, jnp.asarray(buf), layout))
-    assert np.array_equal(reference, fused)
+    # the fused readback is the BIT-PACKED u8 bitmask (8 verdicts/byte);
+    # decoding it must reproduce the per-operand bool result exactly
+    assert fused.dtype == np.uint8
+    E = int(policy.eval_rule.shape[1])
+    assert fused.shape[1] == (1 + 2 * E + 7) // 8
+    assert np.array_equal(reference, unpack_verdicts(fused, 1 + 2 * E))
