@@ -1,0 +1,136 @@
+"""Analysis CLI: ``python -m authorino_tpu.analysis``.
+
+Modes (both run when neither flag is given):
+
+  --self-lint         async-hazard code lint over authorino_tpu/ (or the
+                      given paths) — exit 1 on any finding
+  --verify-fixtures   compile the fixture AuthConfigs, tensor-lint the
+                      snapshot + a packed batch + a dedup scatter plan, and
+                      prove the semantic analyzer still sees the planted
+                      findings (a blind analyzer is itself a failure)
+
+``--json`` emits one machine-readable report object on stdout.  Import-light
+by construction: no identity tree, no native frontend; runs under
+JAX_PLATFORMS=cpu and without ``cryptography``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from . import Finding, findings_to_json
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_self_lint(paths: List[str]) -> List[Finding]:
+    from .code_lint import lint_paths
+
+    return lint_paths(paths or [_PKG_ROOT])
+
+
+def _run_verify_fixtures() -> List[Finding]:
+    """Tensor-lint a real compiled snapshot end to end; returns ERROR
+    findings only (planted policy-analysis warnings are expected and
+    checked for presence, not absence)."""
+    from ..compiler.encode import encode_batch_py
+    from ..compiler.pack import batch_row_keys, dedup_rows, pack_batch
+    from .fixtures import (
+        finding_fixture_configs,
+        fixture_policy,
+    )
+    from .policy_analysis import analyze_policy
+    from .tensor_lint import lint_device_batch, lint_scatter_plan, tensor_lint
+
+    errors: List[Finding] = []
+    policy = fixture_policy()
+    errors += tensor_lint(policy)
+
+    docs = [
+        {"request": {"method": "GET", "url_path": "/api/v1/x",
+                     "host": "h", "headers": {"x-tag": "aa"}},
+         "auth": {"identity": {"org": "acme", "roles": ["admin"],
+                               "groups": []}}},
+        {"request": {"method": "TRACE", "url_path": "/other",
+                     "host": "h", "headers": {"x-tag": "b"}},
+         "auth": {"identity": {"org": "evil", "roles": [],
+                               "groups": ["banned"]}}},
+    ] * 4
+    rows = [0, 1] * 4
+    enc = encode_batch_py(policy, docs, rows, batch_pad=8)
+    db = pack_batch(policy, enc)
+    errors += lint_device_batch(policy, db)
+    keys = batch_row_keys(db, len(docs))
+    all_rows = list(range(len(docs)))
+    unique_rows, inverse = dedup_rows(keys, all_rows)
+    errors += lint_scatter_plan(keys, all_rows, unique_rows, inverse)
+    if len(unique_rows) != 2:
+        errors.append(Finding(
+            kind="scatter-cover", layer="tensor_lint",
+            message=f"fixture batch of 2 distinct rows deduped to "
+                    f"{len(unique_rows)} unique rows", location="fixtures"))
+
+    from ..compiler.compile import compile_corpus
+
+    findings, _ = analyze_policy(compile_corpus(finding_fixture_configs()))
+    got = {f.kind for f in findings}
+    want = {"constant-allow", "constant-deny", "shadowed-rule",
+            "duplicate-rule"}
+    if not want <= got:
+        errors.append(Finding(
+            kind="analysis-blind", layer="policy_analysis",
+            message=f"semantic analyzer missed planted findings: "
+                    f"{sorted(want - got)}", location="fixtures"))
+    return errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m authorino_tpu.analysis",
+        description="Static analysis: code lint + compiled-snapshot verify")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs for --self-lint (default: the package)")
+    ap.add_argument("--self-lint", action="store_true",
+                    help="async-hazard code lint")
+    ap.add_argument("--verify-fixtures", action="store_true",
+                    help="tensor-lint a snapshot compiled from fixture "
+                         "AuthConfigs (+ analyzer self-test)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    run_lint = args.self_lint or not args.verify_fixtures
+    run_fixtures = args.verify_fixtures or not args.self_lint
+
+    findings: List[Finding] = []
+    report = {"ok": True, "layers": []}
+    if run_lint:
+        f = _run_self_lint(list(args.paths))
+        findings += f
+        report["layers"].append({"layer": "code_lint",
+                                 "paths": args.paths or [_PKG_ROOT],
+                                 "findings": len(f)})
+    if run_fixtures:
+        f = _run_verify_fixtures()
+        findings += f
+        report["layers"].append({"layer": "fixture_verify",
+                                 "findings": len(f)})
+
+    report["ok"] = not findings
+    report["findings"] = findings_to_json(findings)
+    if args.as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(str(f))
+        print(f"{'OK' if report['ok'] else 'FAIL'}: "
+              f"{len(findings)} finding(s)")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

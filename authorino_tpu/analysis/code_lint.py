@@ -1,0 +1,323 @@
+"""Async-hazard code lint: AST checks for this repo's own bug classes.
+
+The serving path is a braid of asyncio loops (gRPC/HTTP frontends, the slow
+lane), free-running threads (dispatchers, completer, readback, drains) and
+jitted JAX code — each with a hazard class generic linters don't know:
+
+  blocking-in-async   a blocking call (time.sleep, sync jax device reads,
+                      threading-lock .acquire) inside ``async def``: stalls
+                      every request sharing that event loop
+  lock-across-await   a *threading* lock held across ``await``: the loop
+                      suspends mid-critical-section while dispatcher/
+                      completer threads contend on the same lock (deadlock
+                      or convoy; asyncio locks via ``async with`` are fine)
+  tracer-branch       a Python ``if``/``while`` comparing a traced value
+                      inside a jit-decorated function: TracerBoolConversion
+                      at best, silent trace-time specialization at worst
+  bare-except         ``except:`` catches KeyboardInterrupt/SystemExit —
+                      on completer/drain threads it turns shutdown into a
+                      hang (``except Exception`` is the repo idiom)
+
+Suppression (docs/static_analysis.md): append ``# lint-ok: <kind>`` to the
+flagged line — with a reason after ``--`` by convention.  A bare
+``# lint-ok`` suppresses every kind on that line; ``# lint: skip-file``
+anywhere in the first 5 lines skips the whole file.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import Finding
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "iter_py_files",
+           "HAZARD_KINDS"]
+
+_LAYER = "code_lint"
+
+HAZARD_KINDS = ("blocking-in-async", "lock-across-await", "tracer-branch",
+                "bare-except")
+
+# calls that block the calling thread; flagged inside async def unless
+# awaited (module.attr form, or bare attribute for methods)
+_BLOCKING_MODULE_CALLS = {("time", "sleep"), ("jax", "device_get"),
+                          ("jax", "block_until_ready")}
+_BLOCKING_METHOD_CALLS = {"acquire", "block_until_ready"}
+
+_LOCKISH = re.compile(r"(lock|mutex|sem)$|^_?lock", re.IGNORECASE)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
+
+_SUPPRESS = re.compile(r"#\s*lint-ok(?::\s*(?P<kinds>[\w\-, ]+?))?\s*(?:--.*)?$")
+_SKIP_FILE = re.compile(r"#\s*lint:\s*skip-file")
+
+
+def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line → suppressed kinds (None = all kinds)."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        if "#" not in line:
+            continue
+        m = _SUPPRESS.search(line)
+        if m is None:
+            continue
+        kinds = m.group("kinds")
+        out[i] = (None if not kinds else
+                  {k.strip() for k in kinds.split(",") if k.strip()})
+    return out
+
+
+def _dotted(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """('jax', 'device_get') for jax.device_get; None for anything deeper
+    than Name/Attribute chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """jax.jit / jit / partial(jax.jit, ...) / jax.jit(...)."""
+    d = _dotted(dec)
+    if d is not None and d[-1] == "jit":
+        return True
+    if isinstance(dec, ast.Call):
+        f = _dotted(dec.func)
+        if f is not None and f[-1] == "jit":
+            return True
+        if f is not None and f[-1] == "partial" and dec.args:
+            a = _dotted(dec.args[0])
+            return a is not None and a[-1] == "jit"
+    return False
+
+
+class _FuncVisitor(ast.NodeVisitor):
+    """One pass; function contexts tracked explicitly so nested defs reset
+    the async / jit context (a sync helper defined inside an async def runs
+    wherever it is *called*, which this lexical linter cannot know)."""
+
+    def __init__(self, path: str, suppress: Dict[int, Optional[Set[str]]]):
+        self.path = path
+        self.suppress = suppress
+        self.findings: List[Finding] = []
+        self._async_depth = 0
+        self._jit_params: Optional[Set[str]] = None
+        self._await_parents: Set[int] = set()
+
+    # -- reporting ---------------------------------------------------------
+
+    def _report(self, kind: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if line in self.suppress:
+            kinds = self.suppress[line]
+            if kinds is None or not kinds or kind in kinds:
+                return
+        self.findings.append(Finding(
+            kind=kind, message=message, layer=_LAYER, severity="error",
+            location=f"{self.path}:{line}"))
+
+    # -- function context --------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_function(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter_function(node, is_async=True)
+
+    def _enter_function(self, node, is_async: bool) -> None:
+        for dec in node.decorator_list:
+            self.visit(dec)
+        prev_async, prev_jit = self._async_depth, self._jit_params
+        self._async_depth = 1 if is_async else 0
+        if any(_is_jit_decorator(d) for d in node.decorator_list):
+            args = node.args
+            self._jit_params = {
+                a.arg for a in (args.posonlyargs + args.args
+                                + args.kwonlyargs)}
+            if args.vararg:
+                self._jit_params.add(args.vararg.arg)
+        else:
+            self._jit_params = None
+        for child in node.body:
+            self.visit(child)
+        self._async_depth, self._jit_params = prev_async, prev_jit
+
+    # -- blocking-in-async -------------------------------------------------
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if isinstance(node.value, ast.Call):
+            self._await_parents.add(id(node.value))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._async_depth and id(node) not in self._await_parents:
+            d = _dotted(node.func)
+            if d is not None and len(d) >= 2 \
+                    and (d[-2], d[-1]) in _BLOCKING_MODULE_CALLS:
+                self._report(
+                    "blocking-in-async", node,
+                    f"blocking call {'.'.join(d)}() inside async def "
+                    "stalls the event loop (move to a worker thread or "
+                    "await an async equivalent)")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _BLOCKING_METHOD_CALLS:
+                self._report(
+                    "blocking-in-async", node,
+                    f".{node.func.attr}() inside async def blocks the "
+                    "event loop (threading-lock acquire / sync device "
+                    "read; await the async form or offload)")
+        self.generic_visit(node)
+
+    # -- lock-across-await -------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        lockish = any(
+            (n := _terminal_name(item.context_expr)) is not None
+            and _LOCKISH.search(n)
+            for item in node.items)
+        if lockish and self._contains_await(node.body):
+            self._report(
+                "lock-across-await", node,
+                "threading lock held across await: the loop suspends "
+                "mid-critical-section while other threads contend (use an "
+                "asyncio lock, or release before awaiting)")
+        self.generic_visit(node)
+
+    @classmethod
+    def _contains_await(cls, body: Sequence[ast.stmt]) -> bool:
+        return any(cls._awaits(stmt) for stmt in body)
+
+    @classmethod
+    def _awaits(cls, node: ast.AST) -> bool:
+        """Await anywhere under ``node``, pruning ONLY nested def/lambda
+        subtrees (their awaits run in THEIR call context) — siblings after
+        a nested def must still be seen."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Await) or cls._awaits(child):
+                return True
+        return False
+
+    # -- tracer-branch -----------------------------------------------------
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node.test, node)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node.test, node)
+        self.generic_visit(node)
+
+    def _check_branch(self, test: ast.AST, node: ast.AST) -> None:
+        if self._jit_params is None:
+            return
+        for cmp in ast.walk(test):
+            if not isinstance(cmp, ast.Compare):
+                continue
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in cmp.ops):
+                continue  # `x is None` = static pytree-structure dispatch
+            for side in [cmp.left] + list(cmp.comparators):
+                if self._traced_side(side):
+                    self._report(
+                        "tracer-branch", node,
+                        "Python branch on a traced value inside a jitted "
+                        "function: the condition is baked in at trace "
+                        "time (use jnp.where / lax.cond, or branch on "
+                        "static .shape/.dtype)")
+                    return
+
+    def _traced_side(self, side: ast.AST) -> bool:
+        """A compare side is traced-ish when it reaches a jit parameter
+        without passing through a static accessor (.shape/.dtype/len).
+        Static accessors prune only THEIR subtree — `x + y.shape[0]` is
+        still traced through `x`."""
+        params = self._jit_params or ()
+
+        def traced(node: ast.AST) -> bool:
+            if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+                return False
+            if isinstance(node, ast.Call):
+                f = _dotted(node.func)
+                if f is not None and f[-1] in ("len", "isinstance",
+                                               "getattr"):
+                    return False
+            if isinstance(node, ast.Name):
+                return node.id in params
+            return any(traced(c) for c in ast.iter_child_nodes(node))
+
+        return traced(side)
+
+    # -- bare-except -------------------------------------------------------
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._report(
+                "bare-except", node,
+                "bare `except:` also swallows KeyboardInterrupt/SystemExit "
+                "— on completer/drain threads that turns shutdown into a "
+                "hang (catch Exception)")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str = "<string>") -> List[Finding]:
+    head = "\n".join(source.splitlines()[:5])
+    if _SKIP_FILE.search(head):
+        return []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(kind="syntax-error", message=str(e), layer=_LAYER,
+                        severity="error", location=f"{path}:{e.lineno}")]
+    v = _FuncVisitor(path, _suppressions(source))
+    v.visit(tree)
+
+    def line_key(f: Finding):
+        p, _, ln = f.location.rpartition(":")
+        return (p, int(ln) if ln.isdigit() else 0)
+
+    v.findings.sort(key=line_key)
+    return v.findings
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def iter_py_files(root: str) -> List[str]:
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git", "node_modules")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for f in iter_py_files(p):
+                findings += lint_file(f)
+        else:
+            findings += lint_file(p)
+    return findings

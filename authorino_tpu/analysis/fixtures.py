@@ -1,0 +1,68 @@
+"""Fixture AuthConfigs for the analysis CLI and the corruption tests.
+
+A deliberately feature-dense miniature corpus: nested And/Or, every
+operator, a DFA-compilable regex, a CPU-lane regex, shared subtrees across
+configs (exercises node dedup), duplicate regexes (exercises DFA table
+dedup) and a config pair with semantic findings (tautology, unsat,
+shadowing) so ``--verify-fixtures`` proves both layers see real structure.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..compiler.compile import CompiledPolicy, ConfigRules, compile_corpus
+from ..expressions import All, Any_, Operator, Pattern
+
+__all__ = ["fixture_configs", "fixture_policy", "finding_fixture_configs"]
+
+
+def fixture_configs() -> List[ConfigRules]:
+    """A clean corpus: compiles, packs, and passes every tensor-lint check."""
+    role = Pattern("auth.identity.roles", Operator.INCL, "admin")
+    org = Pattern("auth.identity.org", Operator.EQ, "acme")
+    path_rx = Pattern("request.url_path", Operator.MATCHES, r"^/api/v[0-9]+/")
+    method = Pattern("request.method", Operator.NEQ, "TRACE")
+    banned = Pattern("auth.identity.groups", Operator.EXCL, "banned")
+    # backreference: not DFA-compilable, rides the CPU regex lane
+    cpu_rx = Pattern("request.headers.x-tag", Operator.MATCHES, r"^(a+)\1$")
+    shared = All(org, Any_(role, banned))
+    return [
+        ConfigRules(name="api", evaluators=[
+            (None, All(method, path_rx, shared)),
+            (path_rx, Any_(role, cpu_rx)),
+        ]),
+        ConfigRules(name="admin", evaluators=[
+            # identical subtree to "api"'s → circuit-level node dedup
+            (None, shared),
+            # identical regex on a different selector → DFA table dedup
+            (None, Pattern("request.host", Operator.MATCHES,
+                           r"^/api/v[0-9]+/")),
+        ]),
+        ConfigRules(name="public", evaluators=[(None, All())]),
+    ]
+
+
+def finding_fixture_configs() -> List[ConfigRules]:
+    """Configs with known semantic findings (policy_analysis layer):
+    a tautology, an unsat rule, a shadowed rule, a duplicate rule."""
+    eq = Pattern("auth.identity.org", Operator.EQ, "acme")
+    neq = Pattern("auth.identity.org", Operator.NEQ, "acme")
+    role = Pattern("auth.identity.roles", Operator.INCL, "admin")
+    return [
+        ConfigRules(name="vacuous", evaluators=[
+            (None, Any_(eq, neq)),          # constant-allow
+        ]),
+        ConfigRules(name="blocked", evaluators=[
+            (None, All(eq, neq)),           # constant-deny
+            (None, role),                   # shadowed-rule
+        ]),
+        ConfigRules(name="doubled", evaluators=[
+            (None, role),
+            (None, role),                   # duplicate-rule
+        ]),
+    ]
+
+
+def fixture_policy(members_k: int = 8) -> CompiledPolicy:
+    return compile_corpus(fixture_configs(), members_k=members_k)

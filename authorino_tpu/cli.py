@@ -77,6 +77,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "duplicate encoded rows collapse to one device "
                         "evaluation + a scatter map; set BATCH_DEDUP=0 for "
                         "the env-var equivalent)")
+    s.add_argument("--strict-verify", action="store_true",
+                   default=env_var("STRICT_VERIFY", False),
+                   help="Tensor-lint every compiled snapshot before the "
+                        "swap/generation bump (analysis/tensor_lint.py): a "
+                        "snapshot with structural findings is rejected and "
+                        "the previous one keeps serving (counted in "
+                        "auth_server_snapshot_rejected_total)")
     s.add_argument("--native-frontend", choices=["auto", "on", "off"],
                    default=env_var("NATIVE_FRONTEND", "auto"),
                    help="Serve the ext_authz gRPC port from the C++ device-owner "
@@ -212,6 +219,7 @@ async def run_server(args) -> None:
         dispatch_workers=args.dispatch_workers,
         verdict_cache_size=args.verdict_cache_size,
         batch_dedup=not args.no_batch_dedup,
+        strict_verify=args.strict_verify,
     )
 
     selector = LabelSelector.parse(args.auth_config_label_selector) if args.auth_config_label_selector else None
@@ -301,6 +309,7 @@ async def run_server(args) -> None:
                 window_us=args.batch_window_us, bind_all=True,
                 verdict_cache_size=args.verdict_cache_size,
                 batch_dedup=not args.no_batch_dedup,
+                strict_verify=args.strict_verify,
             )
             native_fe.start()
             native_holder["fe"] = native_fe  # /debug/vars picks it up

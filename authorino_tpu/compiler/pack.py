@@ -29,8 +29,17 @@ from .compile import CompiledPolicy
 from .encode import EncodedBatch
 from .intern import PAD
 
-__all__ = ["DeviceBatch", "pack_batch", "row_key_bytes", "dedup_rows",
-           "batch_row_keys", "select_rows"]
+__all__ = ["DeviceBatch", "PackError", "pack_batch", "row_key_bytes",
+           "dedup_rows", "batch_row_keys", "select_rows"]
+
+
+class PackError(ValueError):
+    """An operand exceeds its padded device grid.  Raised INSTEAD of the
+    silent failure modes numpy would otherwise pick (int16 wire-dtype
+    wraparound, broadcast errors deep inside slicing): the packer and the
+    tensor lint (analysis/tensor_lint.py) must agree on what is invalid,
+    and an invalid batch must fail loudly host-side — never ship wrong
+    operand bytes to the kernel."""
 
 
 @dataclass
@@ -83,6 +92,31 @@ def pack_batch(policy: CompiledPolicy, enc: EncodedBatch,
 
     member_attrs = policy.member_attrs
     m_real = member_attrs.shape[0]
+    c_real = policy.cpu_leaf_list.shape[0]
+    if m_real > M:
+        raise PackError(
+            f"{m_real} member attrs exceed the padded grid M={M} "
+            "(compile targets too small for this corpus)")
+    if c_real > C:
+        raise PackError(
+            f"{c_real} CPU-lane leaves exceed the padded grid C={C}")
+    if dt == np.int16:
+        # the wire narrows ids to int16 when the interner fits; an id past
+        # that range would silently WRAP on .astype — a wrong operand, not
+        # an error.  O(B·A[, K]) max-scans, trivial next to the row-key
+        # build the dedup stage already does per batch.
+        lim = np.iinfo(np.int16).max
+        if (enc.attrs_val.size and int(enc.attrs_val.max()) > lim) or (
+                enc.attrs_members.size
+                and int(enc.attrs_members.max()) > lim):
+            raise PackError(
+                f"encoded id exceeds the int16 wire dtype (> {lim}): "
+                "interner/encoder disagree on the id range")
+    if enc.attr_bytes is not None and policy.n_byte_attrs > 0 and \
+            enc.attr_bytes.shape[1] < policy.n_byte_attrs:
+        raise PackError(
+            f"byte tensor carries {enc.attr_bytes.shape[1]} slots < "
+            f"n_byte_attrs={policy.n_byte_attrs} DFA byte attrs")
     if M == m_real:
         members_c = np.ascontiguousarray(enc.attrs_members[:, member_attrs], dtype=dt)
     else:
@@ -90,7 +124,6 @@ def pack_batch(policy: CompiledPolicy, enc: EncodedBatch,
         members_c[:, :m_real] = enc.attrs_members[:, member_attrs]
 
     cpu_list = policy.cpu_leaf_list
-    c_real = cpu_list.shape[0]
     if C == c_real:
         cpu_dense = np.ascontiguousarray(enc.cpu_lane[:, cpu_list])
     else:

@@ -55,7 +55,21 @@ from ..utils import tracing as tracing_mod
 from ..utils.rpc import NOT_FOUND
 from ..utils.verdict_cache import VerdictCache
 
-__all__ = ["PolicyEngine", "EngineEntry"]
+__all__ = ["PolicyEngine", "EngineEntry", "SnapshotRejected"]
+
+log = logging.getLogger("authorino_tpu.engine")
+
+
+class SnapshotRejected(RuntimeError):
+    """A compiled snapshot failed --strict-verify tensor lint at swap time.
+    The previously-serving snapshot stays live (the reconciler records
+    CachingError and retries on the next resync)."""
+
+    def __init__(self, findings):
+        self.findings = findings
+        super().__init__(
+            f"snapshot rejected by tensor lint ({len(findings)} finding(s)): "
+            + "; ".join(str(f) for f in findings[:3]))
 
 
 @dataclass
@@ -76,7 +90,8 @@ class _Snapshot:
     successor of the reference's label-selector instance sharding
     (ref: controllers/label_selector.go:14-45)."""
 
-    def __init__(self, entries: Sequence[EngineEntry], members_k: int = 16, mesh=None):
+    def __init__(self, entries: Sequence[EngineEntry], members_k: int = 16,
+                 mesh=None, strict_verify: bool = False):
         from ..ops.pattern_eval import to_device
 
         self.by_id: Dict[str, EngineEntry] = {e.id: e for e in entries}
@@ -90,14 +105,40 @@ class _Snapshot:
         # generation they were encoded against: a swap can never let a
         # stale verdict leak into the new generation's lookups.
         self.generation = 0
+        # set by a passing _verify(): downstream strict-verify consumers
+        # (the native frontend's refresh) skip re-linting an already-vetted
+        # snapshot — the lint rebuilds both lanes' host operand pytrees,
+        # too heavy to repeat per swap listener
+        self.lint_ok = False
         if rules:
             if mesh is not None:
                 from ..parallel import ShardedPolicyModel
 
                 self.sharded = ShardedPolicyModel(rules, mesh, members_k=members_k)
+                if strict_verify:
+                    # sharded caveat: ShardedPolicyModel compiles AND stages
+                    # per-shard operands internally, so this lint runs after
+                    # the device upload (unlike the single-corpus branch
+                    # below) — rejection still precedes the swap, so a
+                    # corrupt corpus never SERVES, but the upload itself is
+                    # not gated on this path
+                    self._verify()
             else:
                 self.policy = compile_corpus(rules, members_k=members_k)
+                if strict_verify:
+                    # lint BEFORE the device upload: a corrupt corpus is
+                    # rejected host-side, never staged on the device (and
+                    # never crashes mid-operand-build with a raw IndexError)
+                    self._verify()
                 self.params = to_device(self.policy)
+
+    def _verify(self) -> None:
+        from ..analysis.tensor_lint import lint_snapshot
+
+        findings = lint_snapshot(self)
+        if findings:
+            raise SnapshotRejected(findings)
+        self.lint_ok = True
 
 
 @dataclass
@@ -151,6 +192,8 @@ class PolicyEngine:
         dispatch_workers: int = 4,
         verdict_cache_size: int = 32768,
         batch_dedup: bool = True,
+        strict_verify: bool = False,
+        analyze_policies: bool = True,
     ):
         """``mesh="auto"`` shards the rule corpus over all visible devices
         when more than one is present (dp × mp ShardedPolicyModel);
@@ -182,7 +225,16 @@ class PolicyEngine:
         construction, the kernel is a pure per-row function).
         ``verdict_cache_size`` bounds the snapshot-scoped verdict LRU
         keyed by (generation, encoded-row digest); 0 disables it.  Both
-        are exactness-preserving: see docs/performance.md."""
+        are exactness-preserving: see docs/performance.md.
+
+        ``strict_verify`` runs the tensor-IR lint (analysis/tensor_lint.py)
+        on every compiled snapshot BEFORE the generation bump: a snapshot
+        with any structural finding is rejected (SnapshotRejected raised,
+        auth_server_snapshot_rejected_total bumped) and the previous one
+        keeps serving.  ``analyze_policies`` runs the Cedar-style semantic
+        pass (analysis/policy_analysis.py) once per reconcile — advisory
+        warnings on /debug/vars + metrics, never a gate.  Both are
+        reconcile-path costs only; see docs/static_analysis.md."""
         self.index: HostIndex[EngineEntry] = HostIndex()
         self.generation = 0  # bumped per apply_snapshot (gauge + /debug/vars)
         self.max_batch = max_batch
@@ -193,6 +245,10 @@ class PolicyEngine:
         self.max_inflight_batches = max(1, int(max_inflight_batches))
         self.dispatch_workers = max(1, int(dispatch_workers))
         self.batch_dedup = bool(batch_dedup)
+        self.strict_verify = bool(strict_verify)
+        self.analyze_policies = bool(analyze_policies)
+        # latest reconcile's policy-analysis report (JSON-safe; /debug/vars)
+        self._analysis: Optional[Dict[str, Any]] = None
         self._verdict_cache = (VerdictCache(verdict_cache_size)
                                if verdict_cache_size else None)
         self._mesh = mesh
@@ -243,8 +299,23 @@ class PolicyEngine:
     def apply_snapshot(self, entries: Sequence[EngineEntry], override: bool = True) -> None:
         """Compile the new corpus off the serving path, then atomically swap
         snapshot + index (double buffering: in-flight batches keep the old
-        params alive until their futures resolve)."""
-        snap = _Snapshot(entries, members_k=self.members_k, mesh=self._resolve_mesh())
+        params alive until their futures resolve).
+
+        With ``strict_verify`` the compiled snapshot is tensor-linted HERE,
+        before the generation bump: a corrupt snapshot raises
+        SnapshotRejected and the old snapshot/index keep serving (the
+        reconciler maps the raise to CachingError + retry)."""
+        try:
+            snap = _Snapshot(entries, members_k=self.members_k,
+                             mesh=self._resolve_mesh(),
+                             strict_verify=self.strict_verify)
+        except SnapshotRejected as e:
+            metrics_mod.snapshot_rejected.labels("engine").inc()
+            log.error(
+                "snapshot REJECTED by tensor lint (previous generation %d "
+                "keeps serving): %s", self.generation,
+                "; ".join(str(f) for f in e.findings[:5]))
+            raise
         new_index: HostIndex[EngineEntry] = HostIndex()
         for e in entries:
             for host in e.hosts:
@@ -258,7 +329,45 @@ class PolicyEngine:
             self._snapshot = snap
             self.index = new_index
             metrics_mod.snapshot_generation.labels("engine").set(self.generation)
+        # listeners (the native frontend rebuilding its C++ snapshot) fire
+        # BEFORE the advisory analysis: a revoking reconcile must propagate
+        # at swap speed, not wait out a bounded-evaluation pass
         self.notify_swap_listeners()
+        if self.analyze_policies:
+            self._run_policy_analysis(entries, snap)
+
+    def _run_policy_analysis(self, entries: Sequence[EngineEntry],
+                             snap: "_Snapshot") -> None:
+        """Cedar-style semantic pass, once per reconcile (never per
+        request): constant-allow/deny rules, shadowed/duplicate rules,
+        duplicate-host routing.  Findings are logged ONCE here, counted in
+        auth_server_policy_analysis_findings_total{kind,authconfig}, and
+        kept JSON-safe for /debug/vars.  Advisory only — a failure inside
+        the analyzer must never fail the reconcile."""
+        try:
+            from ..analysis.policy_analysis import analyze_snapshot
+
+            findings, summary = analyze_snapshot(
+                entries, snap.policy, sharded=snap.sharded)
+            for f in findings:
+                metrics_mod.policy_analysis_findings.labels(
+                    f.kind, str(f.detail.get("config", ""))).inc()
+            if findings:
+                by_kind: Dict[str, int] = {}
+                for f in findings:
+                    by_kind[f.kind] = by_kind.get(f.kind, 0) + 1
+                log.warning(
+                    "policy analysis (generation %d): %d finding(s) %s — "
+                    "first: %s (full list on /debug/vars)",
+                    snap.generation, len(findings), by_kind,
+                    findings[0])
+            self._analysis = {
+                "generation": snap.generation,
+                "findings": [f.to_json() for f in findings],
+                "summary": summary,
+            }
+        except Exception:
+            log.exception("policy analysis failed (reconcile unaffected)")
 
     def snapshot_policy(self) -> Optional[CompiledPolicy]:
         snap = self._snapshot
@@ -283,6 +392,8 @@ class PolicyEngine:
             "batch_dedup": self.batch_dedup,
             "verdict_cache": (self._verdict_cache.counts()
                               if self._verdict_cache is not None else None),
+            "strict_verify": self.strict_verify,
+            "policy_analysis": self._analysis,
             "snapshot": None,
         }
         if snap is not None:

@@ -609,8 +609,13 @@ class NativeFrontend:
                  window_us: int = 2000, slots: int = 16, slow_cap: int = 65536,
                  dispatch_threads: int = 6, bind_all: bool = False,
                  dyn_ttl_s: float = 600.0, trace_sample_n: int = 128,
-                 verdict_cache_size: int = 32768, batch_dedup: bool = True):
+                 verdict_cache_size: int = 32768, batch_dedup: bool = True,
+                 strict_verify: bool = False):
         self.engine = engine
+        # --strict-verify: tensor-lint every snapshot in refresh() BEFORE
+        # fe_swap — a corrupt corpus never becomes the serving C++ snapshot
+        # (the old one keeps serving; auth_server_snapshot_rejected_total)
+        self.strict_verify = bool(strict_verify)
         # batch row dedup + snapshot-scoped verdict cache, mirroring the
         # engine lane (runtime/engine.py): the device evaluates unique rows
         # only, and cached (snap_id, row-digest) verdicts skip it entirely.
@@ -837,6 +842,7 @@ class NativeFrontend:
             "inflight_peak": self.rb_inflight_peak,
             "trace_sample_n": self.trace_sample_n,
             "batch_dedup": self.batch_dedup,
+            "strict_verify": self.strict_verify,
             "verdict_cache": (self._verdict_cache.counts()
                               if self._verdict_cache is not None else None),
             "snapshot": None,
@@ -1120,6 +1126,28 @@ class NativeFrontend:
         policy = snap.policy if snap is not None else None
         sharded = snap.sharded if snap is not None else None
         mod = self._mod
+
+        if self.strict_verify and snap is not None and (
+                policy is not None or sharded is not None) and not getattr(
+                snap, "lint_ok", False):
+            # lint_ok marks snapshots the engine's own strict-verify already
+            # vetted at compile time: re-linting here (under _lock, per
+            # refresh) would rebuild both lanes' operand pytrees for zero
+            # added protection.  This path fires only when the frontend is
+            # strict but the engine is not.
+            from ..analysis.tensor_lint import lint_snapshot
+
+            findings = lint_snapshot(snap)
+            if findings:
+                # no snap_id minted, no fe_swap: the previous C++ snapshot
+                # (and its credential variants) keeps serving untouched
+                metrics_mod.snapshot_rejected.labels("native_frontend").inc()
+                log.error(
+                    "native snapshot REJECTED by tensor lint (snapshot %d "
+                    "keeps serving): %s",
+                    self._cur_rec.snap_id if self._cur_rec else 0,
+                    "; ".join(str(f) for f in findings[:5]))
+                return
 
         snap_id = self._next_snap_id
         self._next_snap_id += 1

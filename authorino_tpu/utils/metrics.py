@@ -531,6 +531,27 @@ class NativeStatsDrain:
             self._last[key] = value
 
 
+# ---------------------------------------------------------------------------
+# Compile-time verification (analysis/): snapshot rejection under
+# --strict-verify and reconcile-time policy semantic findings.
+# ---------------------------------------------------------------------------
+
+snapshot_rejected = _counter(
+    "auth_server_snapshot_rejected_total",
+    "Compiled snapshots rejected by --strict-verify tensor lint at swap "
+    "time, per component (engine = apply_snapshot, native_frontend = C++ "
+    "fe_swap refresh).  The previously-serving snapshot stays live.",
+    ("component",),
+)
+policy_analysis_findings = _counter(
+    "auth_server_policy_analysis_findings_total",
+    "Reconcile-time policy semantic-analysis findings (Cedar-style): "
+    "constant-allow/constant-deny rules, shadowed/duplicate rules, hosts "
+    "routed to more than one AuthConfig.  Recorded once per reconcile, "
+    "never per request.",
+    ("kind", "authconfig"),
+)
+
 host_fallback_total = _counter(
     "auth_server_host_fallback_total",
     "Requests re-decided by the host expression oracle because the compact "

@@ -191,6 +191,26 @@ def run_pipelined(model, docs, rows, B, seconds, workers):
     return total, elapsed, lat, sum(enc_times) / len(enc_times), None
 
 
+def maybe_verify_snapshot(args, engine=None, policy=None):
+    """--verify-snapshot: tensor-lint the benchmark's compiled snapshot
+    BEFORE trial 1 (analysis/tensor_lint.py) — a malformed corpus must
+    abort the run, not produce a fast wrong number."""
+    if not getattr(args, "verify_snapshot", False):
+        return
+    from authorino_tpu.analysis.tensor_lint import lint_snapshot, tensor_lint
+
+    t0 = time.perf_counter()
+    findings = (lint_snapshot(engine._snapshot) if engine is not None
+                else tensor_lint(policy))
+    if findings:
+        for f in findings:
+            log(f"verify-snapshot: {f}")
+        raise SystemExit(
+            f"--verify-snapshot: {len(findings)} tensor-lint finding(s); "
+            "refusing to run trials on a malformed snapshot")
+    log(f"verify-snapshot: OK ({time.perf_counter() - t0:.2f}s)")
+
+
 def build_engine(configs, args):
     from authorino_tpu.runtime import EngineEntry, PolicyEngine
 
@@ -509,6 +529,7 @@ def run_native_mode(args):
     engine = PolicyEngine(max_batch=args.batch, max_delay_s=args.window_us / 1e6,
                           mesh=None)
     engine.apply_snapshot(build_wire_entries(args, engine.provider_for))
+    maybe_verify_snapshot(args, engine=engine)
     B = min(args.batch, 4096)
     fe = NativeFrontend(engine, port=0, max_batch=B, window_us=args.window_us,
                         slots=24, dispatch_threads=10)
@@ -1407,6 +1428,10 @@ def main():
                          "payload sequence so request keys REPEAT (hot "
                          "tenants/tokens) — exercises batch row dedup and "
                          "the verdict cache; 0 = uniform (off)")
+    ap.add_argument("--verify-snapshot", action="store_true",
+                    help="tensor-lint the compiled benchmark snapshot "
+                         "before trial 1 (analysis/tensor_lint.py); abort "
+                         "on any structural finding")
     ap.add_argument("--trials", type=int, default=3,
                     help="run the measured loop N times and report the best "
                          "— the tunnel to the device on this image has "
@@ -1478,6 +1503,7 @@ def main():
             rng = random.Random(3)
             rows = [rng.randrange(args.configs) for _ in range(args.docs)]
             engine = build_engine(configs, args)
+            maybe_verify_snapshot(args, engine=engine)
         best = None
         trial_rps = []
         for trial in range(args.trials):
@@ -1527,6 +1553,7 @@ def main():
     model = PolicyModel.from_configs(configs, members_k=8)
     t_compile = time.perf_counter() - t0
     p = model.policy
+    maybe_verify_snapshot(args, policy=p)
     log(
         f"corpus: {args.configs} configs × {args.rules} rules → "
         f"{p.n_leaves} leaf slots, {p.n_attrs} attrs, buffer {p.buffer_size} "
