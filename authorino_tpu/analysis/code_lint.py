@@ -17,6 +17,12 @@ jitted JAX code — each with a hazard class generic linters don't know:
   bare-except         ``except:`` catches KeyboardInterrupt/SystemExit —
                       on completer/drain threads it turns shutdown into a
                       hang (``except Exception`` is the repo idiom)
+  unbounded-wait      a ``.wait()`` / ``.join()`` with no timeout (or an
+                      awaited asyncio ``.wait()``) inside a breaker/drain/
+                      shutdown-path function: graceful degradation code
+                      exists for the case where a peer is WEDGED — an
+                      unbounded wait there turns the recovery path itself
+                      into the hang it guards against (ISSUE 5)
 
 Suppression (docs/static_analysis.md): append ``# lint-ok: <kind>`` to the
 flagged line — with a reason after ``--`` by convention.  A bare
@@ -39,7 +45,7 @@ __all__ = ["lint_source", "lint_file", "lint_paths", "iter_py_files",
 _LAYER = "code_lint"
 
 HAZARD_KINDS = ("blocking-in-async", "lock-across-await", "tracer-branch",
-                "bare-except")
+                "bare-except", "unbounded-wait")
 
 # calls that block the calling thread; flagged inside async def unless
 # awaited (module.attr form, or bare attribute for methods)
@@ -49,6 +55,14 @@ _BLOCKING_METHOD_CALLS = {"acquire", "block_until_ready"}
 
 _LOCKISH = re.compile(r"(lock|mutex|sem)$|^_?lock", re.IGNORECASE)
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
+
+# functions on the graceful-degradation path: drain/stop/shutdown/breaker/
+# watchdog/probe code runs exactly when a peer may be wedged, so its waits
+# must be bounded (unbounded-wait kind)
+_DRAIN_PATH = re.compile(
+    r"(drain|stop|shutdown|teardown|close|probe|watchdog|breaker)",
+    re.IGNORECASE)
+_WAITISH_METHODS = {"wait", "join"}
 
 _SUPPRESS = re.compile(r"#\s*lint-ok(?::\s*(?P<kinds>[\w\-, ]+?))?\s*(?:--.*)?$")
 _SKIP_FILE = re.compile(r"#\s*lint:\s*skip-file")
@@ -117,6 +131,7 @@ class _FuncVisitor(ast.NodeVisitor):
         self._async_depth = 0
         self._jit_params: Optional[Set[str]] = None
         self._await_parents: Set[int] = set()
+        self._drain_path = False
 
     # -- reporting ---------------------------------------------------------
 
@@ -142,7 +157,11 @@ class _FuncVisitor(ast.NodeVisitor):
         for dec in node.decorator_list:
             self.visit(dec)
         prev_async, prev_jit = self._async_depth, self._jit_params
+        prev_drain = self._drain_path
         self._async_depth = 1 if is_async else 0
+        # nested defs take their OWN name's verdict (consistent with the
+        # async/jit context reset: a helper runs where it is called)
+        self._drain_path = bool(_DRAIN_PATH.search(node.name))
         if any(_is_jit_decorator(d) for d in node.decorator_list):
             args = node.args
             self._jit_params = {
@@ -155,6 +174,7 @@ class _FuncVisitor(ast.NodeVisitor):
         for child in node.body:
             self.visit(child)
         self._async_depth, self._jit_params = prev_async, prev_jit
+        self._drain_path = prev_drain
 
     # -- blocking-in-async -------------------------------------------------
 
@@ -180,6 +200,19 @@ class _FuncVisitor(ast.NodeVisitor):
                     f".{node.func.attr}() inside async def blocks the "
                     "event loop (threading-lock acquire / sync device "
                     "read; await the async form or offload)")
+        # unbounded-wait: a timeoutless .wait()/.join() (threading or an
+        # awaited asyncio Event.wait, which HAS no timeout form) inside a
+        # drain/stop/shutdown/breaker-path function — the code that runs
+        # exactly when a peer may be wedged must bound its waits
+        if self._drain_path and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _WAITISH_METHODS \
+                and not node.args and not node.keywords:
+            self._report(
+                "unbounded-wait", node,
+                f"timeoutless .{node.func.attr}() on a drain/shutdown/"
+                "breaker path: a wedged peer turns the recovery path into "
+                "the hang it guards against (pass a timeout, or "
+                "asyncio.wait_for the await)")
         self.generic_visit(node)
 
     # -- lock-across-await -------------------------------------------------

@@ -77,6 +77,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "duplicate encoded rows collapse to one device "
                         "evaluation + a scatter map; set BATCH_DEDUP=0 for "
                         "the env-var equivalent)")
+    s.add_argument("--device-timeout", type=int,
+                   default=env_var("DEVICE_TIMEOUT_MS", 30000),
+                   help="Completer watchdog in ms: an in-flight micro-batch "
+                        "whose readback never arrives is abandoned after "
+                        "this long, counted as a circuit-breaker failure, "
+                        "and retried/degraded host-side (0 disables)")
+    s.add_argument("--breaker-threshold", type=int,
+                   default=env_var("BREAKER_THRESHOLD", 5),
+                   help="Consecutive micro-batch failures that trip the "
+                        "device circuit breaker OPEN (whole batches decided "
+                        "host-side; see docs/robustness.md)")
+    s.add_argument("--breaker-reset", type=float,
+                   default=env_var("BREAKER_RESET_S", 5.0),
+                   help="Seconds an OPEN circuit waits before admitting one "
+                        "half-open probe batch to test device recovery")
+    s.add_argument("--drain-timeout", type=float,
+                   default=env_var("DRAIN_TIMEOUT_S", 10.0),
+                   help="Graceful-shutdown bound in seconds: SIGTERM stops "
+                        "admission, then in-flight requests/batches get this "
+                        "long to complete before the process exits")
+    s.add_argument("--fault-profile", default=env_var("AUTHORINO_TPU_FAULTS", ""),
+                   help="ARM THE FAULT-INJECTION PLANE (testing/chaos only): "
+                        "a named profile (device-down, flaky, flap, "
+                        "slow-device, wedge) or a rule spec — see "
+                        "runtime/faults.py and docs/robustness.md")
     s.add_argument("--strict-verify", action="store_true",
                    default=env_var("STRICT_VERIFY", False),
                    help="Tensor-lint every compiled snapshot before the "
@@ -211,6 +236,15 @@ async def run_server(args) -> None:
 
         setup_tracing(args.tracing_service_endpoint, insecure=args.tracing_service_insecure)
 
+    fault_profile = str(getattr(args, "fault_profile", "") or "")
+    if fault_profile:
+        from .runtime import faults
+
+        faults.FAULTS.arm(fault_profile)
+        log.warning("fault injection ARMED via --fault-profile (%s): this "
+                    "is a chaos/testing mode", fault_profile)
+
+    device_timeout_ms = int(getattr(args, "device_timeout", 0) or 0)
     engine = PolicyEngine(
         max_batch=args.batch_size,
         max_delay_s=args.batch_window_us / 1e6,
@@ -220,6 +254,9 @@ async def run_server(args) -> None:
         verdict_cache_size=args.verdict_cache_size,
         batch_dedup=not args.no_batch_dedup,
         strict_verify=args.strict_verify,
+        device_timeout_s=(device_timeout_ms / 1000.0) or None,
+        breaker_threshold=int(getattr(args, "breaker_threshold", 5)),
+        breaker_reset_s=float(getattr(args, "breaker_reset", 5.0)),
     )
 
     selector = LabelSelector.parse(args.auth_config_label_selector) if args.auth_config_label_selector else None
@@ -310,6 +347,9 @@ async def run_server(args) -> None:
                 verdict_cache_size=args.verdict_cache_size,
                 batch_dedup=not args.no_batch_dedup,
                 strict_verify=args.strict_verify,
+                device_timeout_s=(device_timeout_ms / 1000.0) or None,
+                breaker_threshold=int(getattr(args, "breaker_threshold", 5)),
+                breaker_reset_s=float(getattr(args, "breaker_reset", 5.0)),
             )
             native_fe.start()
             native_holder["fe"] = native_fe  # /debug/vars picks it up
@@ -347,13 +387,31 @@ async def run_server(args) -> None:
     try:
         await stop.wait()
     finally:
-        # runs on signal AND on task cancellation (embedders/tests cancel
-        # the serve task): the native frontend's threads must stop before
-        # interpreter teardown or they race the atexit executor shutdown
-        # (RuntimeError in the slow loop, C++ aborts mid-wait).  Every step
-        # is isolated — a second cancellation or one failing stop must not
-        # skip the remaining teardown (esp. native_fe.stop)
-        log.info("shutting down")
+        # graceful drain (ISSUE 5, docs/robustness.md): SIGTERM → stop
+        # admitting (readyz flips 503 so the LB stops routing here; new
+        # engine submits fail fast UNAVAILABLE), let in-flight RPCs and
+        # device batches complete within --drain-timeout, flush telemetry,
+        # then exit.  Runs on signal AND on task cancellation (embedders/
+        # tests cancel the serve task): the native frontend's threads must
+        # stop before interpreter teardown or they race the atexit executor
+        # shutdown.  Every step is isolated — a second cancellation or one
+        # failing stop must not skip the remaining teardown (esp.
+        # native_fe.stop)
+        import time as _time
+
+        drain_s = float(getattr(args, "drain_timeout", 10.0))
+        # ONE shared deadline across every drain stage: the gRPC grace, the
+        # native frontend's drain loops and the engine drain each consume
+        # only what is left, so SIGTERM-to-exit stays ≈ --drain-timeout
+        # (not stages × timeout — a k8s terminationGracePeriodSeconds just
+        # above the flag must always suffice)
+        drain_deadline = _time.monotonic() + drain_s
+
+        def drain_left() -> float:
+            return max(0.5, drain_deadline - _time.monotonic())
+
+        log.info("shutting down: draining (bound %.1fs)", drain_s)
+        engine.begin_drain()
 
         async def best_effort(awaitable) -> None:
             try:
@@ -361,15 +419,32 @@ async def run_server(args) -> None:
             except (Exception, asyncio.CancelledError) as e:
                 log.warning("shutdown step failed: %r", e)
 
+        loop = asyncio.get_running_loop()
+        # control plane first: no new snapshots compile mid-drain
         if status_updater is not None:
             await best_effort(status_updater.stop())
         if source is not None:
             await best_effort(source.stop())
-        if native_fe is not None:
-            await best_effort(asyncio.get_running_loop().run_in_executor(
-                None, native_fe.stop))
+        # the gRPC servers stop ACCEPTING and wait out in-flight Checks;
+        # native stop() drains its slow lane + in-flight device batches and
+        # runs the final telemetry fold before fe_stop
         if grpc_server is not None:
-            await best_effort(grpc_server.stop(2))
+            await best_effort(grpc_server.stop(drain_left()))
+        if native_fe is not None:
+            # stop() runs two internally-bounded drain loops; halve the
+            # remaining budget so their sum stays inside it
+            await best_effort(loop.run_in_executor(
+                None, lambda: native_fe.stop(drain_left() / 2)))
+        # the engine dispatcher: every queued request and in-flight batch
+        # resolves (host-degraded if the device is wedged) before exit
+        drained = True
+        try:
+            drained = await loop.run_in_executor(None, engine.drain,
+                                                 drain_left())
+        except Exception as e:
+            log.warning("engine drain failed: %r", e)
+        log.info("drain %s", "complete" if drained else
+                 "TIMED OUT (undrained work abandoned)")
         await best_effort(runner.cleanup())
         await best_effort(oidc_runner.cleanup())
         from .utils.tracing import shutdown_tracing

@@ -348,6 +348,18 @@ class ShardedPolicyModel:
         own_skipped = packed[:, 1 + E:1 + 2 * E].copy()
         return own, own_rule, own_skipped
 
+    def host_decide(self, config_name: str, doc: Any) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact host-oracle decision for ONE request of this mesh corpus:
+        (rule_results [E], skipped [E]) with the kernel's padding/tail
+        semantics.  The engine's degraded lane (runtime/engine.py
+        _degrade_batch) re-decides whole batches through this when the
+        device path fails or the circuit breaker is open — the sharded
+        mirror of host_results on the single corpus."""
+        from ..models.policy_model import host_results
+
+        shard, row = self.locator[config_name]
+        return host_results(self.shards[shard], doc, int(row))[1:]
+
     def apply_fallback(self, host_fallback: np.ndarray, docs: Sequence[Any],
                        config_names: Sequence[str], own_rule: np.ndarray,
                        own_skipped: np.ndarray,

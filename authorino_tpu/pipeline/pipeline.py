@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json as _json
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -34,7 +35,14 @@ from ..evaluators.base import (
     wrap_responses,
 )
 from ..utils import metrics as metrics_mod
-from ..utils.rpc import OK, PERMISSION_DENIED, UNAUTHENTICATED
+from ..utils.rpc import (
+    DEADLINE_EXCEEDED,
+    OK,
+    PERMISSION_DENIED,
+    UNAUTHENTICATED,
+    UNAVAILABLE,
+    CheckAbort,
+)
 
 __all__ = ["AuthPipeline", "AuthResult"]
 
@@ -66,11 +74,16 @@ class AuthPipeline:
         config: RuntimeAuthConfig,
         timeout: Optional[float] = None,
         span=None,
+        deadline: Optional[float] = None,
     ):
         self.request = request
         self.config = config
         self.timeout = timeout
         self.span = span  # RequestSpan for outbound W3C propagation
+        # propagated Check() deadline (monotonic seconds): bounds the whole
+        # pipeline below --timeout AND rides into the batch dispatcher,
+        # where deadline-aware shedding fails doomed requests before encode
+        self.deadline = deadline
         self.identity_results: Dict[Any, Any] = {}
         self.metadata_results: Dict[Any, Any] = {}
         self.authorization_results: Dict[Any, Any] = {}
@@ -199,7 +212,7 @@ class AuthPipeline:
                     obj = await self._call_one(conf)
                 except _Skip:
                     continue
-                except asyncio.CancelledError:
+                except (asyncio.CancelledError, CheckAbort):
                     raise
                 except Exception as e:
                     if count == 1:
@@ -230,6 +243,8 @@ class AuthPipeline:
                             continue
                         except asyncio.CancelledError:
                             continue
+                        except CheckAbort:
+                            raise
                         except Exception as e:
                             if count == 1:
                                 return str(e)
@@ -277,7 +292,7 @@ class AuthPipeline:
                 except _Skip:
                     self._sync_auth()
                     continue
-                except asyncio.CancelledError:
+                except (asyncio.CancelledError, CheckAbort):
                     raise
                 except Exception as e:
                     self._sync_auth()
@@ -301,6 +316,8 @@ class AuthPipeline:
                             continue
                         except asyncio.CancelledError:
                             continue
+                        except CheckAbort:
+                            raise
                         except Exception as e:
                             failure = str(e)
                             break
@@ -360,12 +377,39 @@ class AuthPipeline:
                 alabels, {})
         mc[0].inc()
 
+        # effective bound = min(--timeout, time left on the propagated
+        # Check() deadline); an already-expired deadline fails fast without
+        # running a single phase
+        timeout = self.timeout
+        expired = False
+        if self.deadline is not None:
+            remaining = self.deadline - time.monotonic()
+            if remaining <= 0:
+                expired = True
+            else:
+                timeout = remaining if timeout is None else min(timeout, remaining)
+
         with mc[1].time():
             try:
-                async with asyncio.timeout(self.timeout) if self.timeout else _null_async_ctx():
+                if expired:
+                    raise asyncio.TimeoutError()
+                if timeout:
+                    # wait_for, not asyncio.timeout: this runs on 3.10
+                    # (where asyncio.timeout does not exist — the old path
+                    # raised AttributeError the first time --timeout fired)
+                    result = await asyncio.wait_for(
+                        self._evaluate_phases(), timeout)
+                else:
                     result = await self._evaluate_phases()
-            except TimeoutError:
-                result = AuthResult(code=PERMISSION_DENIED, message="context deadline exceeded")
+            except (TimeoutError, asyncio.TimeoutError):
+                # DEADLINE_EXCEEDED (rpc.py maps it to HTTP 504), NOT a
+                # PERMISSION_DENIED masquerading as a timeout
+                result = AuthResult(code=DEADLINE_EXCEEDED, message="context deadline exceeded")
+            except CheckAbort as e:
+                # typed fail-closed abort from the serving runtime (shed
+                # deadline, drain admission stop, device path unavailable):
+                # the code travels as-is, the message is operator-written
+                result = AuthResult(code=e.code, message=e.message)
 
         code = _code_name(result.code)
         sc = mc[3].get(code)
@@ -451,15 +495,10 @@ class AuthPipeline:
         return result
 
 
-class _null_async_ctx:
-    async def __aenter__(self):
-        return self
-
-    async def __aexit__(self, *a):
-        return False
-
-
-_CODE_NAMES = {OK: "OK", UNAUTHENTICATED: "UNAUTHENTICATED", PERMISSION_DENIED: "PERMISSION_DENIED"}
+_CODE_NAMES = {OK: "OK", UNAUTHENTICATED: "UNAUTHENTICATED",
+               PERMISSION_DENIED: "PERMISSION_DENIED",
+               DEADLINE_EXCEEDED: "DEADLINE_EXCEEDED",
+               UNAVAILABLE: "UNAVAILABLE"}
 
 
 def _code_name(code: int) -> str:

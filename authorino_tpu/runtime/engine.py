@@ -30,7 +30,19 @@ for all event loops; futures resolve loop-affinely):
 Flushing is adaptive: a free window slot + a non-empty queue dispatches
 immediately (light-load latency ≈ one device RTT, never a max_delay_s
 stack); with the window full, requests queue and each completion cuts the
-next batch — batch size grows with load instead of with a timer."""
+next batch — batch size grows with load instead of with a timer.
+
+Fault tolerance (ISSUE 5, docs/robustness.md): a failed in-flight batch is
+retried ONCE on a fresh dispatch, then every request is re-decided exactly
+through the host expression oracle (models/policy_model.host_results — the
+kernel's differential-test reference); consecutive batch failures trip a
+circuit breaker (runtime/breaker.py) that routes whole batches host-side
+with half-open probing; requests that cannot make their propagated Check()
+deadline are shed BEFORE encode (typed DEADLINE_EXCEEDED); a completer
+watchdog times out batches wedged in is_ready (--device-timeout) and feeds
+them the same retry/degrade path; and SIGTERM drains the queue + in-flight
+window before exit.  No request ever observes a raw exception: failures
+that cannot degrade resolve as typed CheckAbort(UNAVAILABLE)."""
 
 from __future__ import annotations
 
@@ -52,8 +64,10 @@ from ..index import HostIndex
 from ..pipeline.pipeline import AuthPipeline, AuthResult
 from ..utils import metrics as metrics_mod
 from ..utils import tracing as tracing_mod
-from ..utils.rpc import NOT_FOUND
+from ..utils.rpc import DEADLINE_EXCEEDED, NOT_FOUND, UNAVAILABLE, CheckAbort
 from ..utils.verdict_cache import VerdictCache
+from . import faults
+from .breaker import CircuitBreaker
 
 __all__ = ["PolicyEngine", "EngineEntry", "SnapshotRejected"]
 
@@ -149,6 +163,7 @@ class _Pending:
     loop: Any                     # owning event loop (loop-affine resolution)
     span: Any = None              # RequestSpan (DeviceBatch span links)
     t_enq: float = 0.0            # monotonic enqueue time (queue-wait hist)
+    deadline: Optional[float] = None  # monotonic Check() deadline (shedding)
 
 
 class _Inflight:
@@ -158,9 +173,10 @@ class _Inflight:
     np.asarray-ability — tests substitute stubs for both."""
 
     __slots__ = ("engine", "batch", "handle", "finalize", "binfo", "waits",
-                 "t_launch")
+                 "t_launch", "snap", "attempt")
 
-    def __init__(self, engine, batch, handle, finalize, binfo, waits):
+    def __init__(self, engine, batch, handle, finalize, binfo, waits,
+                 snap=None, attempt=0):
         self.engine = engine
         self.batch = batch
         self.handle = handle
@@ -168,6 +184,8 @@ class _Inflight:
         self.binfo = binfo
         self.waits = waits
         self.t_launch = time.monotonic()
+        self.snap = snap          # pinned snapshot (retry/degrade path)
+        self.attempt = attempt    # 0 = first dispatch, 1 = the one retry
 
     def ready(self) -> bool:
         is_ready = getattr(self.handle, "is_ready", None)
@@ -177,6 +195,12 @@ class _Inflight:
             return bool(is_ready())
         except Exception:
             return True  # let finalize surface the real error
+
+    def expired(self) -> bool:
+        """Watchdog probe: True once this batch has been wedged in the
+        in-flight window past the engine's --device-timeout."""
+        t = self.engine.device_timeout_s
+        return bool(t) and (time.monotonic() - self.t_launch) > t
 
 
 class PolicyEngine:
@@ -194,6 +218,9 @@ class PolicyEngine:
         batch_dedup: bool = True,
         strict_verify: bool = False,
         analyze_policies: bool = True,
+        device_timeout_s: Optional[float] = None,
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 5.0,
     ):
         """``mesh="auto"`` shards the rule corpus over all visible devices
         when more than one is present (dp × mp ShardedPolicyModel);
@@ -234,7 +261,15 @@ class PolicyEngine:
         keeps serving.  ``analyze_policies`` runs the Cedar-style semantic
         pass (analysis/policy_analysis.py) once per reconcile — advisory
         warnings on /debug/vars + metrics, never a gate.  Both are
-        reconcile-path costs only; see docs/static_analysis.md."""
+        reconcile-path costs only; see docs/static_analysis.md.
+
+        ``device_timeout_s`` arms the completer watchdog: an in-flight
+        batch whose readback never arrives is abandoned after this long,
+        counted as a circuit-breaker failure, and fed the retry/degrade
+        path (None/0 = off).  ``breaker_threshold`` consecutive batch
+        failures trip the device circuit breaker OPEN (whole batches
+        decided host-side); after ``breaker_reset_s`` one half-open probe
+        batch tests recovery.  See docs/robustness.md."""
         self.index: HostIndex[EngineEntry] = HostIndex()
         self.generation = 0  # bumped per apply_snapshot (gauge + /debug/vars)
         self.max_batch = max_batch
@@ -266,6 +301,17 @@ class PolicyEngine:
         self._swap_listeners: List[Any] = []
         self._g_inflight = metrics_mod.inflight_batches.labels("engine")
         self._g_depth = metrics_mod.dispatch_queue_depth.labels("engine")
+        # fault tolerance (ISSUE 5): device circuit breaker, completer
+        # watchdog, deadline shedding headroom, graceful-drain admission
+        self.device_timeout_s = (float(device_timeout_s)
+                                 if device_timeout_s else None)
+        self.breaker = CircuitBreaker("engine", threshold=breaker_threshold,
+                                      reset_s=breaker_reset_s)
+        self._draining = False
+        # EWMA of the device stage (launch→readback) — the shedding
+        # headroom: a request whose deadline lands inside one expected
+        # device round trip cannot be answered in time
+        self._device_ewma = 0.0
 
     # swap listeners: the native frontend rebuilds its C++ snapshot after
     # every corpus swap (runtime/native_frontend.py refresh)
@@ -394,6 +440,12 @@ class PolicyEngine:
                               if self._verdict_cache is not None else None),
             "strict_verify": self.strict_verify,
             "policy_analysis": self._analysis,
+            "breaker": self.breaker.to_json(),
+            "draining": self._draining,
+            "device_timeout_s": self.device_timeout_s,
+            "device_rtt_ewma_s": self._device_ewma,
+            "faults": (faults.FAULTS.describe() if faults.ACTIVE else
+                       {"armed": False}),
             "snapshot": None,
         }
         if snap is not None:
@@ -418,12 +470,17 @@ class PolicyEngine:
             entry = self.index.get(host.rsplit(":", 1)[0])
         return entry
 
-    async def check(self, request: CheckRequestModel, span=None) -> AuthResult:
-        """Full request-time flow (ref: pkg/service/auth.go:239-310)."""
+    async def check(self, request: CheckRequestModel, span=None,
+                    deadline: Optional[float] = None) -> AuthResult:
+        """Full request-time flow (ref: pkg/service/auth.go:239-310).
+        ``deadline`` is the propagated Envoy Check() deadline (monotonic
+        seconds): it bounds the pipeline and arms deadline-aware shedding
+        in the batch dispatcher."""
         entry = self.lookup(request.host())
         if entry is None:
             return AuthResult(code=NOT_FOUND, message="Service not found")
-        pipeline = AuthPipeline(request, entry.runtime, timeout=self.timeout_s, span=span)
+        pipeline = AuthPipeline(request, entry.runtime, timeout=self.timeout_s,
+                                span=span, deadline=deadline)
         return await pipeline.evaluate()
 
     # ---- micro-batching verdicts ----------------------------------------
@@ -434,29 +491,39 @@ class PolicyEngine:
 
         async def provider(pipeline, evaluator_slot: int) -> Tuple[bool, bool]:
             rule, skipped = await self.submit(
-                pipeline.authorization_json(), config_name, span=pipeline.span)
+                pipeline.authorization_json(), config_name, span=pipeline.span,
+                deadline=getattr(pipeline, "deadline", None))
             e = evaluator_slot
             return bool(rule[e]), bool(skipped[e])
 
         return provider
 
-    async def submit(self, doc: Any, config_name: str,
-                     span: Any = None) -> Tuple[np.ndarray, np.ndarray]:
+    async def submit(self, doc: Any, config_name: str, span: Any = None,
+                     deadline: Optional[float] = None,
+                     ) -> Tuple[np.ndarray, np.ndarray]:
         """Queue one request for the next micro-batch; resolves to that
         request's per-evaluator (rule_results [E], skipped [E]).  ``span``
         (the request's RequestSpan, optional) lets the batch's DeviceBatch
-        span link back to this request's trace.
+        span link back to this request's trace.  ``deadline`` (monotonic
+        seconds, the propagated Check() deadline) arms deadline-aware
+        shedding: a request that cannot make it is failed fast with a
+        typed DEADLINE_EXCEEDED before encode, never a wasted kernel.
 
         The dispatch decision is deferred one loop iteration (call_soon):
         every submit scheduled in the same iteration — a gather, a burst of
         connection reads — lands in one batch cut, while a lone light-load
         request still dispatches immediately after its iteration, never
         waiting a delay timer."""
+        if self._draining:
+            # graceful drain: stop admitting — already-queued work keeps
+            # flowing, but nothing new may extend the drain
+            raise CheckAbort(UNAVAILABLE, "server draining")
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         with self._queue_lock:
             self._queue.append(_Pending(doc, config_name, fut, loop,
-                                        span=span, t_enq=time.monotonic()))
+                                        span=span, t_enq=time.monotonic(),
+                                        deadline=deadline))
         loop.call_soon(self._maybe_dispatch)
         return await fut
 
@@ -486,19 +553,167 @@ class PolicyEngine:
         self._g_depth.set(depth)
 
     def _encode_launch_job(self, snap: Optional[_Snapshot],
-                           batch: List[_Pending]) -> None:
+                           batch: List[_Pending], attempt: int = 0) -> None:
         """Encode stage (dispatch-worker thread): host encode + fused H2D
         staging + non-blocking kernel launch, then hand the in-flight batch
-        to the completion stage.  Never blocks on the device."""
-        try:
-            if snap is None or (snap.policy is None and snap.sharded is None):
-                raise RuntimeError("no compiled policy snapshot")
-            item = self._encode_and_launch(snap, batch)
-        except Exception as e:
-            self._resolve_error(batch, e)
+        to the completion stage.  Never blocks on the device.
+
+        Fault-tolerant (ISSUE 5): expired-deadline requests are shed before
+        encode; an open circuit breaker skips the device and decides the
+        whole batch through the host oracle; any launch failure routes to
+        the retry-once-then-degrade path (_batch_failed)."""
+        batch = self._shed_expired(batch)
+        if not batch:
             self._launch_done()
             return
+        if snap is None or (snap.policy is None and snap.sharded is None):
+            self._resolve_error(batch, CheckAbort(
+                UNAVAILABLE, "no compiled policy snapshot"))
+            self._launch_done()
+            return
+        if not self.breaker.allow_device():
+            self._degrade_batch(snap, batch, reason="breaker-open")
+            self._launch_done()
+            return
+        try:
+            if faults.ACTIVE:
+                faults.FAULTS.check("encode", "engine")
+            item = self._encode_and_launch(snap, batch)
+            item.snap = snap
+            item.attempt = attempt
+        except Exception as e:
+            self._batch_failed(snap, batch, attempt, e)
+            return
         _completer_submit(item)
+
+    def _shed_expired(self, batch: List[_Pending]) -> List[_Pending]:
+        """Deadline-aware admission: requests whose propagated Check()
+        deadline cannot be met — it lands inside one expected device round
+        trip (EWMA) — fail fast with a typed DEADLINE_EXCEEDED instead of
+        riding (and wasting) a kernel launch whose answer arrives dead."""
+        if all(p.deadline is None for p in batch):
+            return batch
+        now = time.monotonic()
+        horizon = now + self._device_ewma
+        live = [p for p in batch if p.deadline is None or p.deadline > horizon]
+        shed = [p for p in batch if p.deadline is not None
+                and p.deadline <= horizon]
+        if shed:
+            metrics_mod.deadline_shed.labels("engine").inc(len(shed))
+            self._resolve_error(shed, CheckAbort(
+                DEADLINE_EXCEEDED,
+                "request shed before dispatch: deadline cannot be met"))
+        return live
+
+    def _batch_failed(self, snap: _Snapshot, batch: List[_Pending],
+                      attempt: int, exc: Exception) -> None:
+        """One launched (or launching) micro-batch failed: count it against
+        the circuit breaker, retry ONCE on a fresh dispatch, then re-decide
+        every request exactly through the host expression oracle.  The
+        in-flight window slot stays held until the batch finally resolves
+        (the retry owns it; _launch_done runs exactly once per cut)."""
+        self.breaker.record_failure()
+        if attempt == 0:
+            metrics_mod.batch_retries.labels("engine").inc()
+            log.warning("micro-batch of %d failed (%r): retrying once on a "
+                        "fresh dispatch", len(batch), exc)
+            _encode_pool(self.dispatch_workers).submit(
+                self._encode_launch_job, snap, batch, 1)
+            return
+        self._degrade_batch(snap, batch, exc=exc)
+        self._launch_done()
+
+    def _degrade_batch(self, snap: _Snapshot, batch: List[_Pending],
+                       exc: Optional[Exception] = None,
+                       reason: str = "device-failure") -> None:
+        """Final fallback lane: every request re-decided row-by-row through
+        the host expression oracle (exactness preserved — host_results is
+        the kernel's differential-test reference, membership overflow
+        included).  Fail-closed typed UNAVAILABLE ONLY for rows where the
+        oracle itself fails."""
+        from ..models.policy_model import host_results
+
+        by_loop: Dict[Any, list] = {}
+        failed: Dict[Any, list] = {}
+        n_ok = 0
+        for p in batch:
+            try:
+                if snap.sharded is not None:
+                    rule, skipped = snap.sharded.host_decide(
+                        p.config_name, p.doc)
+                else:
+                    row = snap.policy.config_ids[p.config_name]
+                    _, rule, skipped = host_results(snap.policy, p.doc, row)
+            except Exception:
+                log.exception("host-oracle degrade failed for config %r "
+                              "(fail-closed UNAVAILABLE)", p.config_name)
+                failed.setdefault(p.loop, []).append(p.future)
+                continue
+            n_ok += 1
+            by_loop.setdefault(p.loop, []).append((p.future, rule, skipped))
+        if n_ok:
+            metrics_mod.degraded_decisions.labels("engine").inc(n_ok)
+            if exc is not None:
+                log.warning("micro-batch of %d re-decided host-side after "
+                            "device failure (%r)", len(batch), exc)
+        for loop, resolutions in by_loop.items():
+            try:
+                loop.call_soon_threadsafe(_resolve_many, resolutions)
+            except RuntimeError:
+                pass  # loop closed since submit: its futures are moot
+        for loop, futs in failed.items():
+            try:
+                loop.call_soon_threadsafe(_fail_many, futs, CheckAbort(
+                    UNAVAILABLE, "policy evaluation unavailable"))
+            except RuntimeError:
+                pass
+
+    def _watchdog_fire(self, item: "_Inflight") -> None:
+        """Completer watchdog hand-off: an in-flight batch wedged past
+        --device-timeout is abandoned (its readback may still arrive — the
+        handle is simply dropped) and fed the retry/degrade path as a
+        breaker-counted failure."""
+        metrics_mod.watchdog_timeouts.labels("engine").inc()
+        log.warning("device batch (%d requests, attempt %d) wedged past "
+                    "--device-timeout %.3fs: abandoning the handle",
+                    len(item.batch), item.attempt, self.device_timeout_s)
+        self._batch_failed(item.snap, item.batch, item.attempt,
+                           TimeoutError("device readback watchdog timeout"))
+
+    # ---- graceful drain --------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting new requests (submit fails fast with a typed
+        UNAVAILABLE; /readyz flips to 503 so the LB stops routing here).
+        Queued and in-flight work keeps flowing to completion."""
+        if not self._draining:
+            self._draining = True
+            log.info("engine draining: admission stopped "
+                     "(queue=%d, inflight=%d)", len(self._queue),
+                     self._inflight)
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Block until every queued request and in-flight batch has
+        resolved (or the timeout expires — False).  Call from a worker
+        thread (the CLI's SIGTERM path runs it via run_in_executor);
+        begin_drain() is implied."""
+        self.begin_drain()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._queue_lock:
+                idle = not self._queue and self._inflight == 0
+            if idle:
+                return True
+            time.sleep(0.01)
+        with self._queue_lock:
+            log.warning("engine drain timed out after %.1fs "
+                        "(queue=%d, inflight=%d)", timeout_s,
+                        len(self._queue), self._inflight)
+        return False
 
     def _dedup_plan(self, keys, n, gen, eligible):
         """Shared cache-lookup + within-batch-collapse plan for one
@@ -604,7 +819,12 @@ class PolicyEngine:
         t1 = time.monotonic()
         binfo["start_ns"] = time.time_ns()
         if db_u is not None:
+            if faults.ACTIVE:
+                faults.FAULTS.check("h2d", "engine")
+                faults.FAULTS.check("kernel", "engine")
             handle = dispatch_fused(snap.params, db_u)
+            if faults.ACTIVE:
+                handle = faults.FAULTS.wrap_handle(handle, "engine")
         else:
             handle = np.zeros((0, 1), dtype=np.uint8)  # completes instantly
         metrics_mod.observe_pipeline_stage(
@@ -683,7 +903,12 @@ class PolicyEngine:
         t1 = time.monotonic()
         binfo["start_ns"] = time.time_ns()
         if enc_u is not None:
+            if faults.ACTIVE:
+                faults.FAULTS.check("h2d", "engine")
+                faults.FAULTS.check("kernel", "engine")
             handle = sharded.dispatch_full(enc_u)
+            if faults.ACTIVE:
+                handle = faults.FAULTS.wrap_handle(handle, "engine")
         else:
             handle = np.zeros((0, 1), dtype=np.uint8)
         metrics_mod.observe_pipeline_stage(
@@ -715,11 +940,34 @@ class PolicyEngine:
     def _complete(self, item: _Inflight) -> None:
         """Completion stage (worker pool, handed off by the completer once
         the readback arrived): finalize → loop-affine future resolution →
-        free the window slot (exactly once, whatever fails)."""
+        free the window slot.  A readback/finalize failure is a DEVICE
+        failure and rides the retry-once-then-degrade path (which owns the
+        slot until the batch resolves); anything that fails AFTER the
+        device provably answered — telemetry, tracing, resolution — is a
+        host-side bug and must never feed the breaker or re-dispatch a
+        succeeded batch."""
         try:
             t_done = time.monotonic()
+            if faults.ACTIVE:
+                faults.FAULTS.check("readback", "engine")
             packed = np.asarray(item.handle)
             own_rule, own_skipped, fallback_n = item.finalize(packed)
+        except Exception as e:
+            # device/readback failure: retry once, then host-oracle degrade
+            self._batch_failed(item.snap, item.batch, item.attempt, e)
+            return
+        try:
+            # the device answered: clear the breaker's consecutive-failure
+            # count (and close a half-open probe) BEFORE resolution work.
+            # A fully cache-resolved batch (zero device rows) proves
+            # nothing about the device — it only releases a claimed probe.
+            if item.binfo.get("device_rows", 1) == 0:
+                self.breaker.release_probe()
+            else:
+                self.breaker.record_success()
+            dur = t_done - item.t_launch
+            self._device_ewma = (dur if not self._device_ewma
+                                 else 0.8 * self._device_ewma + 0.2 * dur)
             binfo = item.binfo
             binfo["duration_s"] = t_done - item.t_launch
             metrics_mod.observe_pipeline_stage("engine", "device",
@@ -751,13 +999,26 @@ class PolicyEngine:
             metrics_mod.observe_pipeline_stage("engine", "resolve",
                                                time.monotonic() - t_done)
         except Exception as e:
-            # already-resolved futures skip set_exception — only requests
-            # that never got a verdict see the failure
+            # post-device-success host bug (telemetry exporter, metrics
+            # label, resolution plumbing): fail any still-unresolved
+            # futures typed — already-resolved ones keep their verdicts —
+            # and free the slot.  Retrying here would re-run a healthy
+            # device and could walk the breaker open off exporter noise.
+            log.exception("post-completion work failed (batch verdicts "
+                          "already computed)")
             self._resolve_error(item.batch, e)
         finally:
             self._launch_done()
 
     def _resolve_error(self, batch: List[_Pending], exc: Exception) -> None:
+        """Fail unresolved requests with a TYPED CheckAbort — never the raw
+        exception, whose repr would otherwise serve as a deny reason
+        string through the gRPC/HTTP layer (ISSUE 5 satellite).  Raw causes
+        are logged here; callers with a degrade path never reach this."""
+        if not isinstance(exc, CheckAbort):
+            log.error("batch of %d failed without a degrade path: %r",
+                      len(batch), exc)
+            exc = CheckAbort(UNAVAILABLE, "policy evaluation unavailable")
         by_loop: Dict[Any, list] = {}
         for p in batch:
             by_loop.setdefault(p.loop, []).append(p.future)
@@ -870,6 +1131,18 @@ def _completer_loop() -> None:
                         item.engine._complete, item)
                 except Exception:
                     log.exception("batch completion handoff failed")
+            elif item.expired():
+                # watchdog: the readback is wedged past --device-timeout —
+                # abandon the handle and feed the batch the retry/degrade
+                # path (a breaker-counted failure).  A late arrival on the
+                # dropped handle is harmless: nothing materializes it.
+                pending.remove(item)
+                progressed = True
+                try:
+                    _encode_pool(item.engine.dispatch_workers).submit(
+                        item.engine._watchdog_fire, item)
+                except Exception:
+                    log.exception("watchdog handoff failed")
         if not progressed:
             # nothing ready: sub-ms poll — noise against the link RTT each
             # in-flight batch is waiting out, and it keeps resolution

@@ -69,6 +69,8 @@ from ..utils.rpc import (
     PERMISSION_DENIED,
     UNAUTHENTICATED,
 )
+from . import faults
+from .breaker import CircuitBreaker
 
 log = logging.getLogger("authorino_tpu.native_frontend")
 
@@ -600,6 +602,9 @@ class _SnapRec:
     # verdict-cache eligibility per kernel row: [G] bool (single corpus) or
     # [S, G] (mesh) — compiler/compile.py config_cacheable
     cacheable: Optional[np.ndarray] = None
+    # lazily-built host (numpy) operand pytree for the degraded lane: the
+    # same kernel on the CPU backend when the device path fails/trips
+    host_params: Any = None
 
 
 class NativeFrontend:
@@ -610,8 +615,19 @@ class NativeFrontend:
                  dispatch_threads: int = 6, bind_all: bool = False,
                  dyn_ttl_s: float = 600.0, trace_sample_n: int = 128,
                  verdict_cache_size: int = 32768, batch_dedup: bool = True,
-                 strict_verify: bool = False):
+                 strict_verify: bool = False,
+                 device_timeout_s: Optional[float] = None,
+                 breaker_threshold: int = 5, breaker_reset_s: float = 5.0):
         self.engine = engine
+        # fault tolerance (ISSUE 5, docs/robustness.md): a failed device
+        # batch retries once, then degrades to the SAME kernel on the CPU
+        # backend (fail-closed deny only if that fails too); consecutive
+        # failures trip the breaker and whole batches skip the device; the
+        # readback watchdog abandons batches wedged past --device-timeout
+        self.breaker = CircuitBreaker("native", threshold=breaker_threshold,
+                                      reset_s=breaker_reset_s)
+        self.device_timeout_s = (float(device_timeout_s)
+                                 if device_timeout_s else None)
         # --strict-verify: tensor-lint every snapshot in refresh() BEFORE
         # fe_swap — a corrupt corpus never becomes the serving C++ snapshot
         # (the old one keeps serving; auth_server_snapshot_rejected_total)
@@ -845,6 +861,8 @@ class NativeFrontend:
             "strict_verify": self.strict_verify,
             "verdict_cache": (self._verdict_cache.counts()
                               if self._verdict_cache is not None else None),
+            "breaker": self.breaker.to_json(),
+            "device_timeout_s": self.device_timeout_s,
             "snapshot": None,
         }
         if rec is not None:
@@ -1653,13 +1671,14 @@ class NativeFrontend:
             if kind == EV_BATCH:
                 try:
                     self._dispatch(int(a), int(b), int(c))
-                except Exception:
+                except Exception as e:
                     log.exception("native batch dispatch failed")
-                    # fail closed: deny the whole batch
-                    rec = self._snaps.get(int(a))
-                    if rec is not None:
-                        deny = np.zeros(int(c), dtype=np.uint8)
-                        mod.fe_complete_batch(int(a), int(b), deny.ctypes.data)
+                    # retry once, then degrade (CPU-backend kernel) — fail
+                    # closed deny only when the degraded lane fails too
+                    try:
+                        self._native_batch_failed(int(a), int(b), int(c), 0, e)
+                    except Exception:
+                        log.exception("native batch failure handling failed")
             elif kind == EV_SNAP_RETIRED:
                 # GIL-atomic pop, deliberately NOT under _lock: refresh holds
                 # _lock across its swap-gate jit compile, and blocking here
@@ -1714,7 +1733,8 @@ class NativeFrontend:
             unique_rows, inverse = miss_rows, np.arange(len(miss_rows))
         return keys, eligible, cached, miss_rows, unique_rows, inverse, elig_miss
 
-    def _dispatch(self, snap_id: int, slot: int, count: int) -> None:
+    def _dispatch(self, snap_id: int, slot: int, count: int,
+                  attempt: int = 0) -> None:
         """Launch stage: non-blocking kernel dispatch for one C++-encoded
         slot, then park the in-flight batch on the readback queue.  The
         dispatcher thread is immediately free to launch the next slot, so
@@ -1726,12 +1746,19 @@ class NativeFrontend:
         (ISSUE 3): the H2D payload carries only unique work, and the
         readback thread fans verdicts back out through the inverse map.
         The readback itself is the bit-packed u8 bitmask (8 verdicts/
-        byte), so D2H bytes shrink ~8x on the RTT-bound link too."""
+        byte), so D2H bytes shrink ~8x on the RTT-bound link too.
+
+        ``attempt`` is the retry generation (0 = first dispatch, 1 = the
+        one retry after a device failure); an OPEN circuit breaker skips
+        the device entirely and decides the slot on the CPU backend."""
         import jax.numpy as jnp
 
         from ..ops.pattern_eval import eval_bitpacked_jit
 
         rec = self._snaps[snap_id]
+        if not self.breaker.allow_device():
+            self._degrade_slot(rec, snap_id, slot, count)
+            return
         a = rec.arrays[slot]
         # copy attribution rows BEFORE the slot can complete: once
         # fe_complete_batch runs, the C++ encoder may refill them
@@ -1779,6 +1806,9 @@ class NativeFrontend:
                    if u != count else None)
             t0 = time.monotonic()
             t0_ns = time.time_ns()
+            if faults.ACTIVE:
+                faults.FAULTS.check("h2d", "native")
+                faults.FAULTS.check("kernel", "native")
             if rec.sharded is not None:
                 packed = sh._step(
                     sh.params,
@@ -1806,6 +1836,8 @@ class NativeFrontend:
                     jnp.asarray(sel("byte_ovf").view(bool))
                     if has_dfa else None,
                 )
+            if faults.ACTIVE:
+                packed = faults.FAULTS.wrap_handle(packed, "native")
             try:
                 packed.copy_to_host_async()
             except Exception:
@@ -1817,7 +1849,7 @@ class NativeFrontend:
             inflight = self._rb_inflight
         self._g_native_inflight.set(inflight)
         self._rb_q.append((rec, snap_id, slot, count, pad, eff, rows,
-                           shards_arr, packed, t0, t0_ns, fan))
+                           shards_arr, packed, t0, t0_ns, fan, attempt))
         self._rb_evt.set()
 
     def _readback_loop(self) -> None:
@@ -1845,22 +1877,45 @@ class NativeFrontend:
                 except Exception:
                     ready = True  # surface the real error in completion
                 if not ready:
+                    t = self.device_timeout_s
+                    if t and time.monotonic() - item[9] > t:
+                        # watchdog: readback wedged past --device-timeout —
+                        # abandon the handle, count a breaker failure, and
+                        # feed the slot the retry/degrade path
+                        pending.remove(item)
+                        progressed = True
+                        metrics_mod.watchdog_timeouts.labels("native").inc()
+                        log.warning(
+                            "native batch (slot %d, %d requests, attempt %d)"
+                            " wedged past --device-timeout %.3fs",
+                            item[2], item[3], item[12], t)
+                        try:
+                            self._fail_async(
+                                item[1], item[2], item[3], item[12],
+                                TimeoutError("device readback watchdog "
+                                             "timeout"))
+                        except Exception:
+                            log.exception("native watchdog handling failed")
+                        finally:
+                            with self._rb_lock:
+                                self._rb_inflight -= 1
+                                inflight = self._rb_inflight
+                            self._g_native_inflight.set(inflight)
                     continue
                 pending.remove(item)
                 progressed = True
                 try:
                     self._complete_device_batch(*item)
-                except Exception:
+                except Exception as e:
                     log.exception("native batch completion failed")
                     try:
-                        # fail closed: deny the whole batch (never into a
-                        # stopped server — see _complete_device_batch)
-                        if not self._fe_stopped:
-                            deny = np.zeros(item[3], dtype=np.uint8)
-                            self._mod.fe_complete_batch(item[1], item[2],
-                                                        deny.ctypes.data)
+                        # retry once, then degrade on the CPU backend (deny
+                        # fail-closed only when that fails too; never into
+                        # a stopped server — see _complete_device_batch)
+                        self._fail_async(item[1], item[2], item[3],
+                                         item[12], e)
                     except Exception:
-                        pass
+                        log.exception("native batch failure handling failed")
                 finally:
                     with self._rb_lock:
                         self._rb_inflight -= 1
@@ -1876,13 +1931,23 @@ class NativeFrontend:
                                rows: np.ndarray,
                                shards_arr: Optional[np.ndarray],
                                packed, t0: float, t0_ns: int,
-                               fan=None) -> None:
+                               fan=None, attempt: int = 0) -> None:
         if self._fe_stopped:
             # stop()'s drain deadline expired with this batch still on the
             # wire and fe_stop has run: completing into the torn-down C++
             # server would be a native use-after-stop
             return
+        if faults.ACTIVE:
+            faults.FAULTS.check("readback", "native")
         packed = np.asarray(packed)
+        if pad:
+            # the device answered (cache-only batches with pad == 0 never
+            # touched it): clear the breaker's consecutive-failure count
+            self.breaker.record_success()
+        else:
+            # a cache-only batch proves nothing about the device — just
+            # release a half-open probe slot it may have claimed
+            self.breaker.release_probe()
         dispatch_s = time.monotonic() - t0
         if fan is None:
             # dedup/cache off: packed is the bit-masked result of the full
@@ -1924,6 +1989,105 @@ class NativeFrontend:
                                           t0_ns, device_rows=u)
         except Exception:
             log.exception("post-completion telemetry failed")
+
+    def _fail_async(self, snap_id: int, slot: int, count: int,
+                    attempt: int, exc: Exception) -> None:
+        """Hand a failed batch to its own worker thread: the retry dispatch
+        and the CPU-backend degrade (whose first use jit-compiles) must not
+        stall the single readback thread that completes every other
+        in-flight batch.  Bounded: at most one live thread per C++ slot —
+        a slot cannot fail again until fe_complete_batch refills it."""
+        threading.Thread(target=self._native_batch_failed,
+                         args=(snap_id, slot, count, attempt, exc),
+                         name="atpu-fe-degrade", daemon=True).start()
+
+    def _native_batch_failed(self, snap_id: int, slot: int, count: int,
+                             attempt: int, exc: Exception) -> None:
+        """One device batch failed (launch, readback, or watchdog): count a
+        breaker failure, retry ONCE on a fresh dispatch from the same slot
+        (the C++ operands are intact until fe_complete_batch), then decide
+        the slot on the degraded lane — the native mirror of the engine's
+        _batch_failed."""
+        self.breaker.record_failure()
+        rec = self._snaps.get(snap_id)
+        if attempt == 0 and rec is not None:
+            metrics_mod.batch_retries.labels("native").inc()
+            log.warning("native batch (slot %d, %d requests) failed (%r): "
+                        "retrying once on a fresh dispatch", slot, count, exc)
+            try:
+                self._dispatch(snap_id, slot, count, attempt=1)
+                return
+            except Exception as e2:
+                log.exception("native batch retry dispatch failed")
+                self.breaker.record_failure()
+                exc = e2
+        self._degrade_slot(rec, snap_id, slot, count, exc=exc)
+
+    def _degrade_slot(self, rec: Optional[_SnapRec], snap_id: int, slot: int,
+                      count: int, exc: Optional[Exception] = None) -> None:
+        """Degraded lane: evaluate the slot's already-encoded operands with
+        the SAME kernel on the CPU backend (exactness preserved — the
+        kernel is a pure per-row function; only the execution device
+        changes).  Deny fail-closed ONLY when the degraded evaluation
+        itself is impossible (no CPU backend, mesh-sharded corpus, retired
+        snapshot)."""
+        if rec is None:
+            # the C++ side already retired this snapshot (EV_SNAP_RETIRED
+            # raced the failure): its slots are gone — completing into it
+            # would be a native use-after-retire, so drop the batch
+            log.warning("native batch failure for retired snapshot %d "
+                        "(slot %d, %d requests): dropped", snap_id, slot,
+                        count)
+            return
+        verdict: Optional[np.ndarray] = None
+        if rec.sharded is None and rec.policy is not None:
+            try:
+                verdict = self._host_eval(rec, slot, count)
+            except Exception:
+                log.exception("native host degrade failed (fail-closed deny)")
+        if verdict is not None:
+            metrics_mod.degraded_decisions.labels("native").inc(count)
+            if exc is not None:
+                log.warning("native batch (slot %d, %d requests) decided on "
+                            "the CPU backend after device failure (%r)",
+                            slot, count, exc)
+        else:
+            verdict = np.zeros(count, dtype=np.uint8)
+        if not self._fe_stopped:
+            self._mod.fe_complete_batch(snap_id, slot, verdict.ctypes.data)
+
+    def _host_eval(self, rec: _SnapRec, slot: int, count: int) -> np.ndarray:
+        """CPU-backend kernel evaluation of one C++-encoded slot → own
+        verdicts [count] uint8.  The host operand pytree is built lazily
+        once per snapshot; each (pad, eff) shape compiles on first use —
+        a degraded-mode cost, never on the healthy path."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.pattern_eval import eval_bitpacked_jit, to_device
+
+        a = rec.arrays[slot]
+        if rec.host_params is None:
+            rec.host_params = to_device(rec.policy, host=True)
+        has_dfa = rec.host_params["dfa_tables"] is not None
+        pad = min(bucket_pow2(count), self.max_batch)
+        eff = (_trim_bytes(a["attr_bytes"][:count]).shape[-1]
+               if has_dfa else 0)
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            packed = eval_bitpacked_jit(
+                rec.host_params,
+                jnp.asarray(a["attrs_val"][:pad]),
+                jnp.asarray(a["members"][:pad]),
+                jnp.asarray(a["cpu_dense"][:pad].view(bool)),
+                jnp.asarray(a["config_id"][:pad]),
+                jnp.asarray(np.ascontiguousarray(
+                    a["attr_bytes"][:pad, :, :eff])) if has_dfa else None,
+                jnp.asarray(a["byte_ovf"][:pad].view(bool))
+                if has_dfa else None,
+            )
+            out = np.asarray(packed)
+        return np.ascontiguousarray(out[:count, 0] & 1).astype(np.uint8)
 
     def _post_complete_telemetry(self, rec: _SnapRec, count: int, pad: int,
                                  eff: int, rows: np.ndarray,

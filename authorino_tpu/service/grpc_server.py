@@ -10,8 +10,10 @@ raw-HTTP adapter."""
 from __future__ import annotations
 
 import asyncio
+import math
 import os
 import random
+import time
 from typing import Dict, Optional
 
 import grpc
@@ -166,9 +168,19 @@ def build_server(
             )
         from ..utils.tracing import RequestSpan
 
+        # propagate Envoy's Check() deadline into the dispatch queue:
+        # deadline-aware shedding fails doomed requests BEFORE encode
+        # instead of wasting a kernel on an answer that arrives dead
+        deadline = None
+        try:
+            remaining = context.time_remaining()
+            if remaining is not None and math.isfinite(remaining) and remaining > 0:
+                deadline = time.monotonic() + remaining
+        except Exception:
+            pass
         span = RequestSpan.from_headers(model.http.headers, model.http.id)
         try:
-            result = await engine.check(model, span=span)
+            result = await engine.check(model, span=span, deadline=deadline)
         finally:
             span.end()
         return check_response_from_result(result)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -91,11 +92,23 @@ def make_check_handler(engine: PolicyEngine, max_body: int = DEFAULT_MAX_BODY):
         check_request = synthesize_check_request(request, body)
         from ..utils.tracing import RequestSpan
 
+        # Envoy's HTTP ext_authz filter forwards its route timeout in
+        # x-envoy-expected-rq-timeout-ms: propagate it as the Check()
+        # deadline so the dispatcher can shed doomed requests before encode
+        deadline = None
+        timeout_ms = check_request.http.headers.get(
+            "x-envoy-expected-rq-timeout-ms")
+        if timeout_ms:
+            try:
+                deadline = time.monotonic() + max(float(timeout_ms), 0.0) / 1e3
+            except ValueError:
+                pass
         span = RequestSpan.from_headers(
             check_request.http.headers, check_request.http.id
         )
         try:
-            result = await engine.check(check_request, span=span)
+            result = await engine.check(check_request, span=span,
+                                        deadline=deadline)
         finally:
             span.end(error=None)
 
@@ -146,7 +159,23 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
 
     async def readyz(request: web.Request):
         # readiness aggregates reconciler state (ref pkg/health/health.go:48-71)
+        # plus the fault-tolerance surfaces (docs/robustness.md): a draining
+        # server answers 503 so the LB stops routing here while in-flight
+        # work completes; a tripped device circuit is SURFACED but stays
+        # ready — host-degraded verdicts are exact, removing the endpoint
+        # would only shift load onto healthy peers' devices
+        if getattr(engine, "draining", False):
+            return web.Response(status=503, text="draining")
         if readiness is None or readiness():
+            degraded = []
+            for lane, owner in (("engine", engine), ("native", _frontend())):
+                breaker = getattr(owner, "breaker", None) if owner else None
+                if breaker is not None and breaker.state != "closed":
+                    degraded.append(
+                        f"{lane} device circuit {breaker.state}")
+            if degraded:
+                return web.Response(
+                    text=f"ok (degraded: {'; '.join(degraded)})")
             return web.Response(text="ok")
         return web.Response(status=503, text="not ready")
 

@@ -552,6 +552,59 @@ policy_analysis_findings = _counter(
     ("kind", "authconfig"),
 )
 
+# ---------------------------------------------------------------------------
+# Fault-injected graceful degradation (ISSUE 5): device circuit breaker,
+# per-batch retry + host-oracle degrade, deadline-aware shedding, completer
+# watchdog, and the injectable fault plane's own evidence counter.
+# ---------------------------------------------------------------------------
+
+circuit_state = _gauge(
+    "auth_server_circuit_state",
+    "Device circuit-breaker state per lane: 0 = closed (device serving), "
+    "1 = half-open (one probe batch in flight), 2 = open (batches decided "
+    "host-side until the cooldown probe succeeds).",
+    _LANE_LABELS,
+)
+circuit_transitions = _counter(
+    "auth_server_circuit_transitions_total",
+    "Circuit-breaker state transitions per lane (state = the state entered).",
+    _LANE_LABELS + ("state",),
+)
+batch_retries = _counter(
+    "auth_server_batch_retries_total",
+    "Failed in-flight micro-batches retried once on a fresh device dispatch "
+    "before degrading to the host oracle.",
+    _LANE_LABELS,
+)
+degraded_decisions = _counter(
+    "auth_server_degraded_decisions_total",
+    "Requests decided host-side because the device path failed (retry "
+    "exhausted) or the circuit breaker was open.  Engine lane: exact "
+    "re-decision via the expression oracle; native lane: the same kernel "
+    "on the CPU backend.",
+    _LANE_LABELS,
+)
+deadline_shed = _counter(
+    "auth_server_deadline_shed_total",
+    "Requests failed fast (DEADLINE_EXCEEDED) before encode because their "
+    "propagated Check() deadline could not be met (queue wait + estimated "
+    "device RTT exceed the time remaining).",
+    _LANE_LABELS,
+)
+watchdog_timeouts = _counter(
+    "auth_server_device_watchdog_timeouts_total",
+    "In-flight micro-batches abandoned by the completer watchdog because "
+    "their readback never arrived within --device-timeout (counted as "
+    "circuit-breaker failures; the batch retries/degrades).",
+    _LANE_LABELS,
+)
+injected_faults = _counter(
+    "auth_server_injected_faults_total",
+    "Faults fired by the injection plane (runtime/faults.py) — non-zero "
+    "only under --fault-profile / bench --chaos / tests.",
+    ("stage", "mode", "lane"),
+)
+
 host_fallback_total = _counter(
     "auth_server_host_fallback_total",
     "Requests re-decided by the host expression oracle because the compact "

@@ -38,3 +38,19 @@ def http_status_for(code: int, override: int = 0) -> int:
     if override:
         return override
     return HTTP_STATUS.get(code, 403)
+
+
+class CheckAbort(Exception):
+    """Typed fail-closed abort of one Check(): carries the rpc code the
+    response must use instead of the generic PERMISSION_DENIED mapping.
+
+    Raised by the serving runtime (engine dispatch failures that could not
+    degrade → UNAVAILABLE, deadline-aware shedding → DEADLINE_EXCEEDED,
+    drain admission stop → UNAVAILABLE) and resolved into an AuthResult by
+    AuthPipeline.evaluate — a raw exception must never leak its repr into
+    a deny reason (ISSUE 5)."""
+
+    def __init__(self, code: int, message: str):
+        self.code = code
+        self.message = message
+        super().__init__(message)

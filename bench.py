@@ -214,13 +214,64 @@ def maybe_verify_snapshot(args, engine=None, policy=None):
 def build_engine(configs, args):
     from authorino_tpu.runtime import EngineEntry, PolicyEngine
 
+    kw = {}
+    if getattr(args, "chaos", ""):
+        # chaos runs need the watchdog armed and a short breaker cooldown,
+        # or a flap profile can't show a recovery inside one trial
+        kw = dict(device_timeout_s=5.0, breaker_reset_s=1.0)
     engine = PolicyEngine(
-        max_batch=args.batch, max_delay_s=args.window_us / 1e6
+        max_batch=args.batch, max_delay_s=args.window_us / 1e6, **kw
     )
     engine.apply_snapshot(
         [EngineEntry(id=c.name, hosts=[c.name], runtime=None, rules=c) for c in configs]
     )
     return engine
+
+
+# ---------------------------------------------------------------------------
+# --chaos: arm the fault-injection plane (authorino_tpu/runtime/faults.py)
+# around the measured window and emit a degradation block into the artifact —
+# shed rate, retry count, degraded decisions, watchdog fires, breaker
+# transitions, and the latency percentiles measured UNDER the faults.
+# ---------------------------------------------------------------------------
+
+_DEGRADATION_COUNTERS = {
+    "shed": "auth_server_deadline_shed_total",
+    "retries": "auth_server_batch_retries_total",
+    "degraded_decisions": "auth_server_degraded_decisions_total",
+    "watchdog_timeouts": "auth_server_device_watchdog_timeouts_total",
+}
+
+
+def degradation_counters(lane):
+    from prometheus_client import REGISTRY
+
+    out = {}
+    for key, name in _DEGRADATION_COUNTERS.items():
+        v = REGISTRY.get_sample_value(name, {"lane": lane})
+        out[key] = 0.0 if v is None else v
+    return out
+
+
+def degradation_block(args, lane, before, breaker, total=None):
+    """The --chaos artifact block: counter deltas over the measured window
+    plus the breaker's transition trail and what the fault plane fired."""
+    from authorino_tpu.runtime import faults
+
+    after = degradation_counters(lane)
+    out = {
+        "profile": args.chaos,
+        "lane": lane,
+        **{k: int(after[k] - before.get(k, 0.0)) for k in after},
+        "injected": dict(faults.FAULTS.fired),
+        "breaker_state": breaker.state,
+        "breaker_transitions": list(breaker.transitions),
+    }
+    if total:
+        # shed requests never count toward measured throughput: rate them
+        # against everything offered (completed + shed)
+        out["shed_rate"] = round(out["shed"] / (total + out["shed"]), 4)
+    return out
 
 
 def run_engine_mode(engine, docs, rows, args):
@@ -531,8 +582,10 @@ def run_native_mode(args):
     engine.apply_snapshot(build_wire_entries(args, engine.provider_for))
     maybe_verify_snapshot(args, engine=engine)
     B = min(args.batch, 4096)
+    fe_kw = ({"device_timeout_s": 5.0, "breaker_reset_s": 1.0}
+             if args.chaos else {})
     fe = NativeFrontend(engine, port=0, max_batch=B, window_us=args.window_us,
-                        slots=24, dispatch_threads=10)
+                        slots=24, dispatch_threads=10, **fe_kw)
     port = fe.start()
     log(f"native frontend on :{port} (fast configs: see stats below)")
 
@@ -574,6 +627,16 @@ def run_native_mode(args):
         lg(2, max(5.0, args.seconds / 2), sat_depth, sat_conns)
         log("warm-up saturation pass (full trial length) ...")
         lg(args.seconds, 1, sat_depth, sat_conns)
+
+        chaos_before = None
+        if args.chaos:
+            # chaos window covers the measured trials only (warm-up stays
+            # clean so the jit grid is fully compiled before faults land)
+            from authorino_tpu.runtime import faults as faults_mod
+
+            chaos_before = degradation_counters("native")
+            faults_mod.FAULTS.arm(args.chaos)
+            log(f"chaos ARMED for the measured window: {args.chaos}")
 
         best = None
         lat_light = None
@@ -626,6 +689,15 @@ def run_native_mode(args):
                         f"d2h/batch={dc['d2h_bytes_per_batch_mean']}B")
             except Exception as e:
                 log(f"  observability scrape failed: {e!r}")
+        chaos_block = None
+        if chaos_before is not None:
+            from authorino_tpu.runtime import faults as faults_mod
+
+            faults_mod.FAULTS.disarm()
+            chaos_block = degradation_block(args, "native", chaos_before,
+                                            fe.breaker)
+            chaos_block["p99_ms_under_faults"] = best["p99_ms"]
+            log(f"degradation: {chaos_block}")
         log(f"native frontend stats: {fe.stats()}")
 
         # the on-box latency ARTIFACT: per-request stage histograms clocked
@@ -772,6 +844,8 @@ def run_native_mode(args):
             log(f"observability summary failed: {e!r}")
     if trace_cmp is not None:
         stats["tracing"] = trace_cmp
+    if chaos_block is not None:
+        stats["degradation"] = chaos_block
     log(f"device batch RTT p50 {batch_rtt_p50:.2f}ms p90 {batch_rtt_p90:.2f}ms → "
         f"light-load p99 net of RTT: {stats['light_load_p99_ms_net_of_device_rtt']:.2f}ms")
     return best["rps"], stats
@@ -1428,6 +1502,13 @@ def main():
                          "payload sequence so request keys REPEAT (hot "
                          "tenants/tokens) — exercises batch row dedup and "
                          "the verdict cache; 0 = uniform (off)")
+    ap.add_argument("--chaos", default="",
+                    help="arm a fault-injection profile (runtime/faults.py: "
+                         "device-down, flaky, flap, slow-device, wedge, or a "
+                         "rule spec) for the measured window and emit a "
+                         "degradation block — shed rate, retries, degraded "
+                         "decisions, breaker transitions, p99 under faults — "
+                         "into the artifact (engine and native modes)")
     ap.add_argument("--verify-snapshot", action="store_true",
                     help="tensor-lint the compiled benchmark snapshot "
                          "before trial 1 (analysis/tensor_lint.py); abort "
@@ -1504,6 +1585,13 @@ def main():
             rows = [rng.randrange(args.configs) for _ in range(args.docs)]
             engine = build_engine(configs, args)
             maybe_verify_snapshot(args, engine=engine)
+        chaos_before = None
+        if args.chaos and args.mode == "engine":
+            from authorino_tpu.runtime import faults as faults_mod
+
+            chaos_before = degradation_counters("engine")
+            faults_mod.FAULTS.arm(args.chaos)
+            log(f"chaos ARMED for the measured window: {args.chaos}")
         best = None
         trial_rps = []
         for trial in range(args.trials):
@@ -1541,6 +1629,15 @@ def main():
                 "max_inflight_batches": dv["max_inflight_batches"],
                 "dispatch_workers": dv["dispatch_workers"],
             }
+            if chaos_before is not None:
+                from authorino_tpu.runtime import faults as faults_mod
+
+                faults_mod.FAULTS.disarm()
+                detail["degradation"] = degradation_block(
+                    args, "engine", chaos_before, engine.breaker,
+                    total=sum(int(r * args.seconds) for r in trial_rps) or None)
+                detail["degradation"]["p99_ms_under_faults"] = round(p99, 3)
+                log(f"degradation: {detail['degradation']}")
         print(json.dumps(detail))
         return
 

@@ -1,0 +1,675 @@
+"""Fault-injected graceful degradation (ISSUE 5): the injectable fault
+plane, the device circuit breaker with half-open probing, batch
+retry-then-host-oracle degradation (exactness preserved), the completer
+watchdog, deadline-aware shedding, typed fail-closed errors, graceful
+drain, and the unbounded-wait code-lint extension.
+
+Deliberately import-light: collects on images without `cryptography`
+(no evaluators.identity / native_frontend imports)."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from authorino_tpu.compiler import ConfigRules
+from authorino_tpu.expressions import All, Operator, Pattern
+from authorino_tpu.runtime import EngineEntry, PolicyEngine
+from authorino_tpu.runtime import engine as engine_mod
+from authorino_tpu.runtime import faults
+from authorino_tpu.runtime.breaker import CircuitBreaker
+from authorino_tpu.utils.rpc import (
+    DEADLINE_EXCEEDED,
+    UNAVAILABLE,
+    CheckAbort,
+    http_status_for,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Every test leaves the process-wide fault plane OFF."""
+    yield
+    faults.FAULTS.disarm()
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def sample(name, labels=None):
+    from prometheus_client import REGISTRY
+
+    v = REGISTRY.get_sample_value(name, labels or {})
+    return 0.0 if v is None else v
+
+
+RULE = All(
+    Pattern("auth.identity.roles", Operator.INCL, "admin"),
+    Pattern("auth.identity.groups", Operator.EXCL, "banned"),
+)
+
+
+def build_engine(**kw) -> PolicyEngine:
+    # verdict cache off by default here: cached verdicts legitimately skip
+    # the device, which would mask whether a fault path actually ran
+    kw.setdefault("verdict_cache_size", 0)
+    kw.setdefault("max_batch", 8)
+    engine = PolicyEngine(members_k=4, mesh=None, **kw)
+    engine.apply_snapshot([
+        EngineEntry(id="c", hosts=["c"], runtime=None,
+                    rules=ConfigRules(name="c", evaluators=[(None, RULE)]))
+    ])
+    return engine
+
+
+def doc(i: int, allow: bool) -> dict:
+    # per-index distinct docs so no two rows dedup-collapse
+    return {"auth": {"identity": {
+        "roles": ["admin", f"r{i}"] if allow else [f"r{i}"],
+        "groups": []}}}
+
+
+async def submit_all(engine, docs, **kw):
+    outs = await asyncio.gather(
+        *(engine.submit(d, "c", **kw) for d in docs))
+    return [bool(rule[0]) for rule, _ in outs]
+
+
+# ---------------------------------------------------------------------------
+# fault plane
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlane:
+    def test_off_by_default_and_zero_cost_gate(self):
+        faults.FAULTS.disarm()
+        assert faults.ACTIVE is False
+        assert faults.FAULTS.describe()["armed"] is False
+
+    def test_profile_expansion_and_spec_keys(self):
+        faults.FAULTS.arm("device-down")
+        d = faults.FAULTS.describe()
+        assert d["armed"] and d["rules"] == ["kernel:raise"]
+        faults.FAULTS.arm("kernel:delay:delay_ms=20:p=0.5:n=3:lane=native")
+        r = faults.FAULTS._rules[0]
+        assert (r.stage, r.mode, r.lane) == ("kernel", "delay", "native")
+        assert r.delay_s == pytest.approx(0.02)
+        assert r.p == 0.5 and r.n == 3
+        # "dispatch" is an alias for the kernel stage
+        faults.FAULTS.arm("dispatch:raise")
+        assert faults.FAULTS._rules[0].stage == "kernel"
+
+    def test_bad_specs_raise(self):
+        for bad in ("kernel", "kernel:explode", "nostage:raise",
+                    "kernel:raise:zzz=1"):
+            with pytest.raises(ValueError):
+                faults.FAULTS.arm(bad)
+
+    def test_firing_limit_and_lane_filter(self):
+        faults.FAULTS.arm("kernel:raise:n=2:lane=engine")
+        with pytest.raises(faults.InjectedFault):
+            faults.FAULTS.check("kernel", "engine")
+        # other lane and other stages never match
+        faults.FAULTS.check("kernel", "native")
+        faults.FAULTS.check("readback", "engine")
+        with pytest.raises(faults.InjectedFault):
+            faults.FAULTS.check("kernel", "engine")
+        # n=2 exhausted: the rule goes quiet
+        faults.FAULTS.check("kernel", "engine")
+        assert faults.FAULTS.fired == {"kernel:raise:engine": 2}
+
+    def test_time_window(self):
+        faults.FAULTS.arm("kernel:raise:for=0.05")
+        with pytest.raises(faults.InjectedFault):
+            faults.FAULTS.check("kernel", "engine")
+        time.sleep(0.08)
+        faults.FAULTS.check("kernel", "engine")  # window closed: no fault
+
+    def test_hung_handle_wrap_and_release(self):
+        class H:
+            def is_ready(self):
+                return True
+
+            def __array__(self, dtype=None):
+                return np.zeros((1, 1))
+
+        faults.FAULTS.arm("kernel:hang")
+        h = faults.FAULTS.wrap_handle(H(), "engine")
+        assert isinstance(h, faults.HungHandle)
+        assert h.is_ready() is False
+        with pytest.raises(faults.InjectedFault):
+            np.asarray(h)  # permanent wedge must not deadlock the caller
+        # bounded wedge: the real handle shows through after the window
+        h2 = faults.HungHandle(H(), release_at=time.monotonic() - 1)
+        assert h2.is_ready() is True
+        assert np.asarray(h2).shape == (1, 1)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_trips_after_threshold_and_recovers_via_probe(self):
+        br = CircuitBreaker("t1", threshold=3, reset_s=0.05)
+        assert br.state == "closed"
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == "closed" and br.allow_device()
+        br.record_failure()
+        assert br.state == "open"
+        assert br.allow_device() is False  # cooldown not elapsed
+        time.sleep(0.06)
+        assert br.allow_device() is True   # the half-open probe slot
+        assert br.state == "half-open"
+        assert br.allow_device() is False  # ONE probe at a time
+        br.record_success()
+        assert br.state == "closed"
+        assert br.allow_device() is True
+
+    def test_probe_failure_reopens(self):
+        br = CircuitBreaker("t2", threshold=1, reset_s=0.05)
+        br.record_failure()
+        assert br.state == "open"
+        time.sleep(0.06)
+        assert br.allow_device() is True
+        br.record_failure()
+        assert br.state == "open"
+        assert br.allow_device() is False  # cooldown restarted
+        states = [t["state"] for t in br.to_json()["transitions"]]
+        assert states == ["open", "half-open", "open"]
+
+    def test_release_probe_frees_the_slot_without_a_verdict(self):
+        # a batch admitted as the half-open probe may turn out fully
+        # verdict-cache-resolved — it proved nothing about the device and
+        # must release the slot (NOT close the circuit, NOT wedge it)
+        br = CircuitBreaker("t4", threshold=1, reset_s=0.01)
+        br.record_failure()
+        time.sleep(0.02)
+        assert br.allow_device() is True       # probe claimed
+        br.release_probe()
+        assert br.state == "half-open"         # no verdict recorded
+        assert br.allow_device() is True       # next batch can probe again
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_cache_resolved_batch_never_closes_the_circuit(self):
+        engine = build_engine(verdict_cache_size=1024, breaker_threshold=1,
+                              breaker_reset_s=0.05)
+        d = doc(0, True)
+        assert run(submit_all(engine, [d])) == [True]  # seeds the cache
+        engine.breaker.record_failure()
+        assert engine.breaker.state == "open"
+        time.sleep(0.06)
+        # the cached doc resolves without the device: still correct, and
+        # the breaker must NOT flip closed off it
+        assert run(submit_all(engine, [d])) == [True]
+        assert engine.breaker.state == "half-open"
+        # a fresh (uncached) doc is the real probe
+        assert run(submit_all(engine, [doc(1, False)])) == [False]
+        assert engine.breaker.state == "closed"
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker("t3", threshold=2, reset_s=10)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == "closed"  # never two CONSECUTIVE failures
+
+
+# ---------------------------------------------------------------------------
+# engine lane: retry, degrade, breaker, watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestEngineDegradation:
+    def test_transient_fault_retried_once_device_answers(self):
+        engine = build_engine()
+        retries0 = sample("auth_server_batch_retries_total",
+                          {"lane": "engine"})
+        degraded0 = sample("auth_server_degraded_decisions_total",
+                           {"lane": "engine"})
+        faults.FAULTS.arm("kernel:raise:n=1")
+        assert run(submit_all(engine, [doc(0, True)])) == [True]
+        assert sample("auth_server_batch_retries_total",
+                      {"lane": "engine"}) == retries0 + 1
+        # the RETRY succeeded on the device: nothing degraded, breaker closed
+        assert sample("auth_server_degraded_decisions_total",
+                      {"lane": "engine"}) == degraded0
+        assert engine.breaker.state == "closed"
+
+    def test_persistent_failure_serves_exact_verdicts_and_recovers(self):
+        """The acceptance scenario: under a persistent device fault every
+        request keeps being answered with ORACLE-EXACT verdicts (no request
+        ever observes a raw exception), the breaker trips, and once the
+        fault clears the half-open probe restores device serving."""
+        engine = build_engine(breaker_threshold=2, breaker_reset_s=0.2)
+        degraded0 = sample("auth_server_degraded_decisions_total",
+                           {"lane": "engine"})
+        faults.FAULTS.arm("device-down")
+
+        docs = [doc(i, i % 3 != 0) for i in range(24)]
+        expected = [RULE.matches(d) for d in docs]
+
+        async def staggered():
+            out = []
+            for d in docs:  # sequential: multiple batches → breaker trips
+                rule, _ = await engine.submit(d, "c")
+                out.append(bool(rule[0]))
+            return out
+
+        assert run(staggered()) == expected
+        assert engine.breaker.state == "open"
+        assert sample("auth_server_degraded_decisions_total",
+                      {"lane": "engine"}) >= degraded0 + 24
+        # breaker OPEN: batches skip the device entirely — the fault plane
+        # stops seeing kernel attempts while the oracle keeps answering
+        fired_open = dict(faults.FAULTS.fired)
+        assert run(submit_all(engine, [doc(100, True), doc(101, False)])) \
+            == [True, False]
+        assert faults.FAULTS.fired == fired_open
+
+        # fault clears → cooldown elapses → half-open probe → CLOSED
+        faults.FAULTS.disarm()
+        time.sleep(0.25)
+        assert run(submit_all(engine, [doc(200, True)])) == [True]
+        assert engine.breaker.state == "closed"
+        states = [t["state"] for t in engine.breaker.transitions]
+        assert states[-2:] == ["half-open", "closed"]
+
+    def test_flap_profile_recovers_without_operator_action(self):
+        # the flap fault class: device down for a window, then healthy —
+        # the breaker must ride it out and re-close on its own
+        engine = build_engine(breaker_threshold=2, breaker_reset_s=0.15)
+        faults.FAULTS.arm("kernel:raise:for=0.2")
+
+        async def staggered(docs_):
+            out = []
+            for d in docs_:
+                rule, _ = await engine.submit(d, "c")
+                out.append(bool(rule[0]))
+            return out
+
+        # every request answered correctly THROUGH the flap
+        assert run(staggered([doc(i, True) for i in range(6)])) == [True] * 6
+        time.sleep(0.4)  # fault window closed AND cooldown elapsed
+        assert run(submit_all(engine, [doc(10, True)])) == [True]
+        assert engine.breaker.state == "closed"
+
+    def test_degrade_is_oracle_exact_on_membership_overflow(self):
+        # overflow rows (roles > members_k) are the kernel's lossy case —
+        # the degraded lane must stay exact there too (the oracle ignores
+        # the compact payload entirely)
+        engine = build_engine(breaker_threshold=100)
+        faults.FAULTS.arm("device-down")
+        over = {"auth": {"identity": {
+            "roles": [f"r{k}" for k in range(10)] + ["admin"],
+            "groups": []}}}
+        assert run(submit_all(engine, [over])) == [RULE.matches(over)]
+
+    def test_watchdog_times_out_wedged_batches(self):
+        engine = build_engine(device_timeout_s=0.15, breaker_threshold=100)
+        wd0 = sample("auth_server_device_watchdog_timeouts_total",
+                     {"lane": "engine"})
+        faults.FAULTS.arm("wedge")  # readbacks never arrive
+        t0 = time.monotonic()
+        assert run(submit_all(engine, [doc(0, True)])) == [True]
+        elapsed = time.monotonic() - t0
+        # attempt 0 wedges (0.15s) → retry wedges (0.15s) → oracle degrade
+        assert sample("auth_server_device_watchdog_timeouts_total",
+                      {"lane": "engine"}) == wd0 + 2
+        assert 0.25 < elapsed < 5.0
+
+    def test_no_snapshot_is_typed_unavailable(self):
+        engine = PolicyEngine(members_k=4, mesh=None, verdict_cache_size=0)
+
+        async def one():
+            with pytest.raises(CheckAbort) as ei:
+                await engine.submit(doc(0, True), "c")
+            return ei.value
+
+        e = run(one())
+        assert e.code == UNAVAILABLE
+        assert "unavailable" in str(e).lower() or "snapshot" in str(e)
+
+
+# ---------------------------------------------------------------------------
+# deadline-aware shedding
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlineShedding:
+    def test_expired_deadline_is_shed_typed_before_dispatch(self):
+        engine = build_engine()
+        shed0 = sample("auth_server_deadline_shed_total", {"lane": "engine"})
+
+        async def one():
+            with pytest.raises(CheckAbort) as ei:
+                await engine.submit(doc(0, True), "c",
+                                    deadline=time.monotonic() - 0.01)
+            return ei.value
+
+        e = run(one())
+        assert e.code == DEADLINE_EXCEEDED
+        assert http_status_for(e.code) == 504
+        assert sample("auth_server_deadline_shed_total",
+                      {"lane": "engine"}) == shed0 + 1
+
+    def test_headroom_uses_device_rtt_estimate(self):
+        engine = build_engine()
+        # a warm request seeds the EWMA; then force a huge estimate — a
+        # deadline inside one expected RTT cannot be met and must shed
+        assert run(submit_all(engine, [doc(0, True)])) == [True]
+        engine._device_ewma = 5.0
+
+        async def one():
+            with pytest.raises(CheckAbort) as ei:
+                await engine.submit(doc(1, True), "c",
+                                    deadline=time.monotonic() + 1.0)
+            return ei.value
+
+        assert run(one()).code == DEADLINE_EXCEEDED
+        # a comfortable deadline still rides the device
+        engine._device_ewma = 0.0
+        assert run(submit_all(engine, [doc(2, True)],
+                              deadline=time.monotonic() + 30)) == [True]
+
+    def test_mixed_batch_sheds_only_the_expired(self):
+        engine = build_engine()
+
+        async def mixed():
+            past = time.monotonic() - 0.01
+            live = engine.submit(doc(0, True), "c",
+                                 deadline=time.monotonic() + 30)
+            dead = engine.submit(doc(1, True), "c", deadline=past)
+            r = await asyncio.gather(live, dead, return_exceptions=True)
+            return r
+
+        live, dead = run(mixed())
+        assert bool(live[0][0]) is True
+        assert isinstance(dead, CheckAbort) and dead.code == DEADLINE_EXCEEDED
+
+
+# ---------------------------------------------------------------------------
+# pipeline: typed codes end to end
+# ---------------------------------------------------------------------------
+
+
+def make_runtime(provider):
+    from authorino_tpu.evaluators.authorization import PatternMatching
+    from authorino_tpu.evaluators.base import (
+        AuthorizationConfig,
+        RuntimeAuthConfig,
+    )
+
+    ev = PatternMatching(RULE, batched_provider=provider, evaluator_slot=0)
+    return RuntimeAuthConfig(
+        labels={"namespace": "ns", "name": "cfg"},
+        authorization=[AuthorizationConfig(name="authz", evaluator=ev)])
+
+
+def make_request():
+    from authorino_tpu.authjson.wellknown import (
+        CheckRequestModel,
+        HttpRequestAttributes,
+    )
+
+    return CheckRequestModel(
+        http=HttpRequestAttributes(id="r1", method="GET", path="/",
+                                   host="c", headers={}))
+
+
+class TestPipelineTypedCodes:
+    def test_timeout_maps_to_deadline_exceeded_504(self):
+        from authorino_tpu.pipeline.pipeline import AuthPipeline
+
+        async def never(pipeline, slot):
+            await asyncio.sleep(30)
+
+        pipeline = AuthPipeline(make_request(), make_runtime(never),
+                                timeout=0.02)
+        result = run(pipeline.evaluate())
+        assert result.code == DEADLINE_EXCEEDED
+        assert result.message == "context deadline exceeded"
+        assert http_status_for(result.code) == 504
+
+    def test_expired_deadline_fails_fast(self):
+        from authorino_tpu.pipeline.pipeline import AuthPipeline
+
+        async def never(pipeline, slot):  # must never be reached
+            raise AssertionError("phase ran past an expired deadline")
+
+        pipeline = AuthPipeline(make_request(), make_runtime(never),
+                                deadline=time.monotonic() - 1)
+        result = run(pipeline.evaluate())
+        assert result.code == DEADLINE_EXCEEDED
+
+    def test_checkabort_resolves_typed_not_raw(self):
+        from authorino_tpu.pipeline.pipeline import AuthPipeline
+
+        async def aborting(pipeline, slot):
+            raise CheckAbort(UNAVAILABLE, "policy evaluation unavailable")
+
+        pipeline = AuthPipeline(make_request(), make_runtime(aborting))
+        result = run(pipeline.evaluate())
+        assert result.code == UNAVAILABLE
+        assert result.message == "policy evaluation unavailable"
+        assert http_status_for(result.code) == 503
+
+    def test_engine_check_end_to_end_degraded_never_raw(self):
+        """Full service path under a persistent device fault: engine.check
+        answers OK/denied per the oracle — never an exception, never a raw
+        exception repr in the deny reason."""
+        engine = build_engine(breaker_threshold=100)
+        rt = make_runtime(engine.provider_for("c"))
+        engine.index.set("c", "c", EngineEntry(
+            id="c", hosts=["c"], runtime=rt, rules=None), override=True)
+        faults.FAULTS.arm("device-down")
+
+        async def checks():
+            allowed = await engine.check(make_request())
+            req2 = make_request()
+            req2.http.headers["x"] = "y"
+            return allowed
+
+        result = run(checks())
+        assert result.code in (0, 7)  # OK or a clean deny — oracle-decided
+        assert "InjectedFault" not in (result.message or "")
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------------
+
+
+class FakeHandle:
+    def __init__(self, ready_at):
+        self.ready_at = ready_at
+
+    def is_ready(self):
+        return time.monotonic() >= self.ready_at
+
+    def __array__(self, dtype=None):
+        return np.zeros((1, 1))
+
+
+class SlowStubDevice:
+    """Replaces _encode_and_launch with a stub whose batches complete after
+    a fixed latency — in-flight work a drain must wait out."""
+
+    def __init__(self, engine, latency_s):
+        self.engine = engine
+        self.latency_s = latency_s
+        self.launched = 0
+        engine._encode_and_launch = self._launch
+
+    def _launch(self, snap, batch):
+        n = len(batch)
+        self.launched += n
+        binfo = {"batch_size": n, "pad": n, "eff": 0,
+                 "start_ns": time.time_ns(), "duration_s": 0.0}
+
+        def finalize(packed):
+            rule = np.ones((n, 1), dtype=bool)
+            return rule, np.zeros((n, 1), dtype=bool), None
+
+        return engine_mod._Inflight(
+            self.engine, batch,
+            FakeHandle(time.monotonic() + self.latency_s),
+            finalize, binfo, np.zeros(n))
+
+
+class TestGracefulDrain:
+    def test_drain_resolves_all_inflight_then_blocks_admission(self):
+        engine = build_engine(max_batch=4, max_inflight_batches=4)
+        stub = SlowStubDevice(engine, latency_s=0.15)
+
+        async def scenario():
+            inflight = [asyncio.ensure_future(engine.submit(doc(i, True), "c"))
+                        for i in range(16)]
+            await asyncio.sleep(0.03)  # let batches cut and launch
+            engine.begin_drain()
+            # drain stops ADMISSION...
+            with pytest.raises(CheckAbort) as ei:
+                await engine.submit(doc(99, True), "c")
+            assert ei.value.code == UNAVAILABLE
+            # ...while every already-admitted request still resolves
+            done = await asyncio.gather(*inflight)
+            loop = asyncio.get_running_loop()
+            drained = await loop.run_in_executor(None, engine.drain, 5.0)
+            return done, drained
+
+        done, drained = run(scenario())
+        assert drained is True
+        assert len(done) == 16 and all(bool(r[0]) for r, _ in done)
+        assert engine._inflight == 0 and not engine._queue
+        assert stub.launched == 16
+
+    def test_drain_times_out_on_wedged_device(self):
+        engine = build_engine(max_batch=4)
+        SlowStubDevice(engine, latency_s=60)
+
+        async def scenario():
+            fut = asyncio.ensure_future(engine.submit(doc(0, True), "c"))
+            await asyncio.sleep(0.03)
+            loop = asyncio.get_running_loop()
+            drained = await loop.run_in_executor(None, engine.drain, 0.1)
+            fut.cancel()
+            return drained
+
+        assert run(scenario()) is False
+
+    def test_readyz_surfaces_drain_and_degraded_circuit(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from authorino_tpu.service.http_server import build_app
+
+        engine = build_engine(breaker_threshold=1)
+
+        async def scenario():
+            app = build_app(engine, readiness=lambda: True)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get("/readyz")
+                ok_body = await r.text()
+                ok_status = r.status
+                # tripped breaker: surfaced, but STILL ready (host-degraded
+                # verdicts are exact; shifting load helps nobody)
+                engine.breaker.record_failure()
+                r = await client.get("/readyz")
+                degraded_body, degraded_status = await r.text(), r.status
+                engine.breaker.record_success()
+                engine.begin_drain()
+                r = await client.get("/readyz")
+                drain_body, drain_status = await r.text(), r.status
+                dv = await (await client.get("/debug/vars")).json()
+                return (ok_status, ok_body, degraded_status, degraded_body,
+                        drain_status, drain_body, dv)
+            finally:
+                await client.close()
+
+        (ok_status, ok_body, degraded_status, degraded_body, drain_status,
+         drain_body, dv) = run(scenario())
+        assert (ok_status, ok_body) == (200, "ok")
+        assert degraded_status == 200 and "degraded" in degraded_body
+        assert drain_status == 503 and "draining" in drain_body
+        assert dv["engine"]["draining"] is True
+        assert dv["engine"]["breaker"]["state"] == "closed"
+
+
+# ---------------------------------------------------------------------------
+# code lint: unbounded-wait on breaker/drain paths
+# ---------------------------------------------------------------------------
+
+
+class TestUnboundedWaitLint:
+    def lint(self, src):
+        from authorino_tpu.analysis.code_lint import lint_source
+
+        return lint_source(src, "planted.py")
+
+    def test_flags_timeoutless_wait_and_join_on_drain_paths(self):
+        src = (
+            "def drain(self):\n"
+            "    self._evt.wait()\n"
+            "def stop(self):\n"
+            "    self._thread.join()\n"
+            "async def shutdown(self):\n"
+            "    await self._done.wait()\n"
+        )
+        found = self.lint(src)
+        assert [f.kind for f in found] == ["unbounded-wait"] * 3
+        assert [f.location for f in found] == [
+            "planted.py:2", "planted.py:4", "planted.py:6"]
+
+    def test_bounded_or_off_path_waits_are_clean(self):
+        src = (
+            "def drain(self):\n"
+            "    self._evt.wait(0.2)\n"
+            "def stop(self):\n"
+            "    self._thread.join(timeout=5)\n"
+            "def completer_poll(self):\n"
+            "    self._evt.wait()\n"          # not a drain-path name
+            "def stop_all(self):\n"
+            "    p = os.path.join('a', 'b')\n"  # args present: not waitish
+        )
+        assert self.lint(src) == []
+
+    def test_nested_def_takes_its_own_name(self):
+        src = (
+            "def drain(self):\n"
+            "    def poll():\n"
+            "        evt.wait()\n"   # nested non-drain name: clean
+            "    self._evt.wait()\n"  # the drain body itself: flagged
+        )
+        found = self.lint(src)
+        assert [f.location for f in found] == ["planted.py:4"]
+
+    def test_suppression(self):
+        src = (
+            "def drain(self):\n"
+            "    self._evt.wait()  # lint-ok: unbounded-wait -- bounded by "
+            "caller\n"
+        )
+        assert self.lint(src) == []
+
+    def test_repo_drain_paths_stay_clean(self):
+        import os
+
+        from authorino_tpu.analysis.code_lint import lint_paths
+
+        root = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "authorino_tpu")
+        assert [str(f) for f in lint_paths([root])
+                if f.kind == "unbounded-wait"] == []
