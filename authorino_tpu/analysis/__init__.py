@@ -2,13 +2,22 @@
 policy corpus is as valuable as a fast one — "A New Language for Expressive,
 Fast, Safe, and Analyzable Authorization", PAPERS.md).
 
-Three independent layers, each pure-host and import-light:
+Four independent layers, each pure-host and import-light:
 
   - ``tensor_lint``   — structural invariants of a compiled snapshot that the
                         device kernels silently assume (index ranges, circuit
                         topology, lane dtype/shape contracts, scatter covers).
                         Runs at reconcile time under ``--strict-verify`` so a
                         malformed snapshot is rejected before it serves.
+  - ``translation_validate`` — per-config certificates that the compiled
+                        circuits and DFA tables DECIDE identically to the
+                        host expression oracle (truth-table equivalence +
+                        DFA witness cross-checks), keyed by canonical
+                        semantic fingerprints with a process-wide cache so
+                        unchanged configs skip re-validation; plus the
+                        fast/slow-lane lowerability report.  Gates under
+                        ``--strict-verify``; proven non-blind by a mutation
+                        self-test.
   - ``policy_analysis`` — Cedar-style semantic findings over the compiled
                         boolean circuits: constant-allow / constant-deny
                         rules, shadowed and duplicate rules, hosts routed to

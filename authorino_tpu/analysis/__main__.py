@@ -1,13 +1,20 @@
 """Analysis CLI: ``python -m authorino_tpu.analysis``.
 
-Modes (both run when neither flag is given):
+Modes (lint + fixtures both run when no mode flag is given):
 
   --self-lint         async-hazard code lint over authorino_tpu/ (or the
                       given paths) — exit 1 on any finding
   --verify-fixtures   compile the fixture AuthConfigs, tensor-lint the
-                      snapshot + a packed batch + a dedup scatter plan, and
+                      snapshot + a packed batch + a dedup scatter plan,
                       prove the semantic analyzer still sees the planted
-                      findings (a blind analyzer is itself a failure)
+                      findings, certify the snapshot against the host
+                      expression oracle (translation validation), and run
+                      the mutation self-test — a validator blind to any
+                      planted miscompile class is itself a failure
+  --coverage-report   lowerability report over the fixture corpus: which
+                      configs ride the kernel fast lane vs the interpreter
+                      slow lane, with reason codes
+                      (docs/static_analysis.md catalogue)
 
 ``--json`` emits one machine-readable report object on stdout.  Import-light
 by construction: no identity tree, no native frontend; runs under
@@ -85,7 +92,26 @@ def _run_verify_fixtures() -> List[Finding]:
             kind="analysis-blind", layer="policy_analysis",
             message=f"semantic analyzer missed planted findings: "
                     f"{sorted(want - got)}", location="fixtures"))
+
+    # translation validation (ISSUE 6): mutation_self_test certifies the
+    # clean fixture corpus as its baseline pass, then demands every
+    # planted miscompile class is REJECTED — one pass, both proofs; a
+    # blind validator fails this command, and with it the tier-1 gate
+    from .translation_validate import mutation_self_test
+
+    errors += mutation_self_test(policy)
     return errors
+
+
+def _run_coverage_report() -> dict:
+    """Lowerability report over the fixture corpus (ISSUE 6 layer 3)."""
+    from ..compiler.compile import compile_corpus
+    from .fixtures import lowerability_fixture_entries
+    from .translation_validate import lowerability_report
+
+    entries = lowerability_fixture_entries()
+    rules = [e.rules for e in entries if e.rules is not None]
+    return lowerability_report(entries, compile_corpus(rules))
 
 
 def main(argv=None) -> int:
@@ -98,13 +124,18 @@ def main(argv=None) -> int:
                     help="async-hazard code lint")
     ap.add_argument("--verify-fixtures", action="store_true",
                     help="tensor-lint a snapshot compiled from fixture "
-                         "AuthConfigs (+ analyzer self-test)")
+                         "AuthConfigs (+ analyzer and translation-validator "
+                         "self-tests)")
+    ap.add_argument("--coverage-report", action="store_true",
+                    help="fast-lane vs slow-lane lowerability report with "
+                         "reason codes over the fixture corpus")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
 
-    run_lint = args.self_lint or not args.verify_fixtures
-    run_fixtures = args.verify_fixtures or not args.self_lint
+    any_mode = args.self_lint or args.verify_fixtures or args.coverage_report
+    run_lint = args.self_lint or not any_mode
+    run_fixtures = args.verify_fixtures or not any_mode
 
     findings: List[Finding] = []
     report = {"ok": True, "layers": []}
@@ -119,6 +150,13 @@ def main(argv=None) -> int:
         findings += f
         report["layers"].append({"layer": "fixture_verify",
                                  "findings": len(f)})
+    coverage = None
+    if args.coverage_report:
+        coverage = _run_coverage_report()
+        report["layers"].append({"layer": "coverage_report",
+                                 "fast": coverage["fast"],
+                                 "slow": coverage["slow"]})
+        report["coverage"] = coverage
 
     report["ok"] = not findings
     report["findings"] = findings_to_json(findings)
@@ -127,6 +165,13 @@ def main(argv=None) -> int:
     else:
         for f in findings:
             print(str(f))
+        if coverage is not None:
+            print(f"lowerability: {coverage['fast']} fast-lane / "
+                  f"{coverage['slow']} slow-lane config(s)")
+            for name, info in coverage["configs"].items():
+                reasons = (" [" + ", ".join(info["reasons"]) + "]"
+                           if info["reasons"] else "")
+                print(f"  {info['lane']:<5} {name}{reasons}")
         print(f"{'OK' if report['ok'] else 'FAIL'}: "
               f"{len(findings)} finding(s)")
     return 0 if report["ok"] else 1

@@ -9,12 +9,15 @@ shadowing) so ``--verify-fixtures`` proves both layers see real structure.
 
 from __future__ import annotations
 
-from typing import List
+from dataclasses import dataclass, field
+from types import SimpleNamespace
+from typing import Any, List, Optional
 
 from ..compiler.compile import CompiledPolicy, ConfigRules, compile_corpus
 from ..expressions import All, Any_, Operator, Pattern
 
-__all__ = ["fixture_configs", "fixture_policy", "finding_fixture_configs"]
+__all__ = ["fixture_configs", "fixture_policy", "finding_fixture_configs",
+           "FixtureEntry", "lowerability_fixture_entries"]
 
 
 def fixture_configs() -> List[ConfigRules]:
@@ -66,3 +69,52 @@ def finding_fixture_configs() -> List[ConfigRules]:
 
 def fixture_policy(members_k: int = 8) -> CompiledPolicy:
     return compile_corpus(fixture_configs(), members_k=members_k)
+
+
+@dataclass
+class FixtureEntry:
+    """Duck-typed EngineEntry (id/hosts/rules/runtime) so the analysis CLI
+    can exercise the lowerability classifier without importing the runtime
+    engine (import-light contract)."""
+
+    id: str
+    hosts: List[str] = field(default_factory=list)
+    rules: Optional[ConfigRules] = None
+    runtime: Any = None
+
+
+def lowerability_fixture_entries() -> List[FixtureEntry]:
+    """A corpus spanning the lowerability reason-code catalogue: pure
+    fast-lane configs, fast-lane configs with CPU assists (cpu-regex /
+    invalid-regex-fallback / cpu-grid-overflow), and slow-lane residents
+    (no rules, non-lowerable OPA, external authorization, metadata)."""
+    entries = [FixtureEntry(id=c.name, hosts=[c.name], rules=c)
+               for c in fixture_configs()]
+    entries.append(FixtureEntry(
+        id="bad-regex", hosts=["bad-regex"],
+        rules=ConfigRules(name="bad-regex", evaluators=[
+            (None, Pattern("request.path", Operator.MATCHES, "(["))])))
+    entries.append(FixtureEntry(id="interpreter-only",
+                                hosts=["interpreter-only"]))
+    entries.append(FixtureEntry(
+        id="opa-unsupported", hosts=["opa-unsupported"],
+        runtime=SimpleNamespace(
+            metadata=[],
+            authorization=[SimpleNamespace(
+                type="OPA",
+                evaluator=SimpleNamespace(kernel_slot=None))])))
+    entries.append(FixtureEntry(
+        id="metadata-bound", hosts=["metadata-bound"],
+        rules=ConfigRules(name="metadata-bound", evaluators=[
+            (None, Pattern("request.method", Operator.EQ, "GET"))]),
+        runtime=SimpleNamespace(
+            metadata=[SimpleNamespace(type="METADATA_GENERIC_HTTP")],
+            authorization=[SimpleNamespace(
+                type="PATTERN_MATCHING", evaluator=SimpleNamespace())])))
+    entries.append(FixtureEntry(
+        id="external-az", hosts=["external-az"],
+        runtime=SimpleNamespace(
+            metadata=[],
+            authorization=[SimpleNamespace(
+                type="SPICEDB", evaluator=SimpleNamespace())])))
+    return entries

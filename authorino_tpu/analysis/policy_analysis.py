@@ -180,7 +180,12 @@ def analyze_policy(policy: Optional[CompiledPolicy],
     reconcile (never per request); bounded evaluation keeps it linear in
     evaluators."""
     findings: List[Finding] = []
-    summary = {"evaluators": 0, "skipped_wide": 0, "configs": 0}
+    # ``skipped`` lists every wide-support skip (config/evaluator/atom
+    # count, bounded) so skipped rules are visible on /debug/vars and in
+    # auth_server_policy_analysis_skipped_total instead of silently
+    # dropping out of the analysis with only an aggregate count
+    summary: Dict[str, Any] = {"evaluators": 0, "skipped_wide": 0,
+                               "configs": 0, "skipped": []}
     if policy is None:
         return findings, summary
     circ = _Circuit(policy)
@@ -222,6 +227,9 @@ def analyze_policy(policy: Optional[CompiledPolicy],
             verdict, n_atoms = _classify(circ, cond, rule, smemo)
             if verdict is None and n_atoms > MAX_ATOMS:
                 summary["skipped_wide"] += 1
+                if len(summary["skipped"]) < 100:
+                    summary["skipped"].append(
+                        {"config": name, "evaluator": e, "atoms": n_atoms})
             elif verdict == "constant-allow":
                 findings.append(_warn(
                     "constant-allow",
@@ -271,10 +279,13 @@ def analyze_snapshot(entries: Sequence[Any],
         f, summary = analyze_policy(policy)
         findings += f
     elif sharded is not None:
-        summary = {"evaluators": 0, "skipped_wide": 0, "configs": 0}
+        summary = {"evaluators": 0, "skipped_wide": 0, "configs": 0,
+                   "skipped": []}
         for shard in getattr(sharded, "shards", ()):
             f, s = analyze_policy(shard)
             findings += f
             for k in ("evaluators", "skipped_wide", "configs"):
                 summary[k] += s.get(k, 0)
+            summary["skipped"] += s.get("skipped", [])[
+                : max(0, 100 - len(summary["skipped"]))]
     return findings, summary

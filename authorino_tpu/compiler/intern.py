@@ -45,6 +45,11 @@ class StringInterner:
     def __len__(self) -> int:
         return len(self._table)
 
+    def reverse(self) -> Dict[int, str]:
+        """id → string view (analysis-time only: fingerprints serialize
+        constant *strings*, never ids, so they survive interning reorders)."""
+        return {i: s for s, i in self._table.items()}
+
     def freeze_copy(self) -> "StringInterner":
         out = StringInterner()
         out._table = dict(self._table)

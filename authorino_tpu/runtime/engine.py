@@ -124,6 +124,11 @@ class _Snapshot:
         # snapshot — the lint rebuilds both lanes' host operand pytrees,
         # too heavy to repeat per swap listener
         self.lint_ok = False
+        # translation-validation stats from _verify (None when strict
+        # verify is off): validated / cache_hits / failed / sampled —
+        # the /debug/vars evidence that the fingerprint cache is
+        # actually incremental across reconciles
+        self.translation: Optional[Dict[str, int]] = None
         if rules:
             if mesh is not None:
                 from ..parallel import ShardedPolicyModel
@@ -152,6 +157,27 @@ class _Snapshot:
         findings = lint_snapshot(self)
         if findings:
             raise SnapshotRejected(findings)
+        # translation validation (ISSUE 6): beyond structural sanity, the
+        # compiled circuits/DFA tables must DECIDE identically to the host
+        # expression oracle.  Per-config fingerprints + the process-wide
+        # certificate cache make this incremental: an unchanged config is
+        # a cache hit, never a re-validation (ROADMAP item 1).
+        from ..analysis.translation_validate import (
+            certify_snapshot,
+            snapshot_policies,
+        )
+
+        stats = {"validated": 0, "cache_hits": 0, "failed": 0,
+                 "sampled": 0, "dfa_witnesses": 0}
+        failures = []
+        for pol in snapshot_policies(self):
+            _, fails, st = certify_snapshot(pol)
+            failures += fails
+            for k in stats:
+                stats[k] += st.get(k, 0)
+        self.translation = stats
+        if failures:
+            raise SnapshotRejected(failures)
         self.lint_ok = True
 
 
@@ -284,6 +310,9 @@ class PolicyEngine:
         self.analyze_policies = bool(analyze_policies)
         # latest reconcile's policy-analysis report (JSON-safe; /debug/vars)
         self._analysis: Optional[Dict[str, Any]] = None
+        # latest reconcile's lowerability report (ISSUE 6: fast/slow lane
+        # classification per config, with reason codes; /debug/vars)
+        self._lowerability: Optional[Dict[str, Any]] = None
         self._verdict_cache = (VerdictCache(verdict_cache_size)
                                if verdict_cache_size else None)
         self._mesh = mesh
@@ -381,6 +410,7 @@ class PolicyEngine:
         self.notify_swap_listeners()
         if self.analyze_policies:
             self._run_policy_analysis(entries, snap)
+            self._run_lowerability(entries, snap)
 
     def _run_policy_analysis(self, entries: Sequence[EngineEntry],
                              snap: "_Snapshot") -> None:
@@ -407,6 +437,16 @@ class PolicyEngine:
                     "first: %s (full list on /debug/vars)",
                     snap.generation, len(findings), by_kind,
                     findings[0])
+            skipped = summary.get("skipped", [])
+            for s in skipped:
+                metrics_mod.policy_analysis_skipped.labels(
+                    str(s.get("config", ""))).inc()
+            # the per-config list is bounded (100 entries); any remainder
+            # still counts, attributed to the catch-all label so the total
+            # always equals skipped_wide
+            extra = int(summary.get("skipped_wide", 0)) - len(skipped)
+            if extra > 0:
+                metrics_mod.policy_analysis_skipped.labels("").inc(extra)
             self._analysis = {
                 "generation": snap.generation,
                 "findings": [f.to_json() for f in findings],
@@ -414,6 +454,29 @@ class PolicyEngine:
             }
         except Exception:
             log.exception("policy analysis failed (reconcile unaffected)")
+
+    def _run_lowerability(self, entries: Sequence[EngineEntry],
+                          snap: "_Snapshot") -> None:
+        """Lowerability report (ISSUE 6 layer 3): classify every config as
+        fast-lane or slow-lane with a reason code, once per reconcile.
+        Advisory only — surfaced on /debug/vars, counted per (lane,
+        reason) in auth_server_lowerability_configs_total, and never a
+        reconcile failure."""
+        try:
+            from ..analysis.translation_validate import (
+                lowerability_report,
+                snapshot_policies,
+            )
+
+            # mesh snapshots compile per-shard policies; the classifier
+            # reads each config's CPU-assist leaves from its owning shard
+            report = lowerability_report(entries, snapshot_policies(snap))
+            for lane, reason, n in report["series"]:
+                metrics_mod.lowerability_configs.labels(lane, reason).inc(n)
+            report["generation"] = snap.generation
+            self._lowerability = report
+        except Exception:
+            log.exception("lowerability report failed (reconcile unaffected)")
 
     def snapshot_policy(self) -> Optional[CompiledPolicy]:
         snap = self._snapshot
@@ -440,6 +503,9 @@ class PolicyEngine:
                               if self._verdict_cache is not None else None),
             "strict_verify": self.strict_verify,
             "policy_analysis": self._analysis,
+            "lowerability": self._lowerability,
+            "translation_validation": (getattr(snap, "translation", None)
+                                       if snap is not None else None),
             "breaker": self.breaker.to_json(),
             "draining": self._draining,
             "device_timeout_s": self.device_timeout_s,

@@ -1154,15 +1154,26 @@ class NativeFrontend:
             # added protection.  This path fires only when the frontend is
             # strict but the engine is not.
             from ..analysis.tensor_lint import lint_snapshot
+            from ..analysis.translation_validate import (
+                certify_snapshot,
+                snapshot_policies,
+            )
 
             findings = lint_snapshot(snap)
+            if not findings:
+                # lint-clean: certify the compiled artifacts decide like
+                # the host oracle (same gate the engine's strict path
+                # runs; the fingerprint cache makes repeats free)
+                for pol in snapshot_policies(snap):
+                    _, fails, _ = certify_snapshot(pol)
+                    findings += fails
             if findings:
                 # no snap_id minted, no fe_swap: the previous C++ snapshot
                 # (and its credential variants) keeps serving untouched
                 metrics_mod.snapshot_rejected.labels("native_frontend").inc()
                 log.error(
-                    "native snapshot REJECTED by tensor lint (snapshot %d "
-                    "keeps serving): %s",
+                    "native snapshot REJECTED by tensor lint/translation "
+                    "validation (snapshot %d keeps serving): %s",
                     self._cur_rec.snap_id if self._cur_rec else 0,
                     "; ".join(str(f) for f in findings[:5]))
                 return

@@ -551,6 +551,35 @@ policy_analysis_findings = _counter(
     "never per request.",
     ("kind", "authconfig"),
 )
+policy_analysis_skipped = _counter(
+    "auth_server_policy_analysis_skipped_total",
+    "Evaluators the semantic analyzer SKIPPED because their operand "
+    "support exceeds the bounded-evaluation limit (MAX_ATOMS).  Skipped "
+    "rules are listed on /debug/vars under policy_analysis.summary.skipped "
+    "— they still serve, they are just unanalyzed.",
+    ("authconfig",),
+)
+translation_validate = _counter(
+    "auth_server_translation_validate_total",
+    "Per-config translation-validation outcomes at reconcile time "
+    "(analysis/translation_validate.py): validated = certified against "
+    "the host expression oracle this reconcile, cache_hit = unchanged "
+    "fingerprint served from the process-wide certificate cache, failed = "
+    "certification failure (under --strict-verify the snapshot is "
+    "rejected and the old one keeps serving).",
+    ("result",),
+)
+lowerability_configs = _counter(
+    "auth_server_lowerability_configs_total",
+    "Per-reconcile lowerability classification: lane = fast (verdict "
+    "rides the kernel) or slow (interpreter path), reason = the reason "
+    "code ('' for configs with no reason; catalogue in "
+    "docs/static_analysis.md).  Incremented once per (config, reason) "
+    "pair per reconcile — a config with N reason codes lands in N series, "
+    "so sum by lane over-counts multi-reason configs; /debug/vars "
+    "engine.lowerability carries the exact per-lane config counts.",
+    ("lane", "reason"),
+)
 
 # ---------------------------------------------------------------------------
 # Fault-injected graceful degradation (ISSUE 5): device circuit breaker,

@@ -192,12 +192,17 @@ def run_pipelined(model, docs, rows, B, seconds, workers):
 
 
 def maybe_verify_snapshot(args, engine=None, policy=None):
-    """--verify-snapshot: tensor-lint the benchmark's compiled snapshot
-    BEFORE trial 1 (analysis/tensor_lint.py) — a malformed corpus must
-    abort the run, not produce a fast wrong number."""
+    """--verify-snapshot: tensor-lint AND translation-certify the
+    benchmark's compiled snapshot BEFORE trial 1 (analysis/tensor_lint.py
+    + analysis/translation_validate.py) — a malformed or miscompiled
+    corpus must abort the run, not produce a fast wrong number."""
     if not getattr(args, "verify_snapshot", False):
         return
     from authorino_tpu.analysis.tensor_lint import lint_snapshot, tensor_lint
+    from authorino_tpu.analysis.translation_validate import (
+        certify_snapshot,
+        snapshot_policies,
+    )
 
     t0 = time.perf_counter()
     findings = (lint_snapshot(engine._snapshot) if engine is not None
@@ -208,7 +213,46 @@ def maybe_verify_snapshot(args, engine=None, policy=None):
         raise SystemExit(
             f"--verify-snapshot: {len(findings)} tensor-lint finding(s); "
             "refusing to run trials on a malformed snapshot")
-    log(f"verify-snapshot: OK ({time.perf_counter() - t0:.2f}s)")
+    policies = (snapshot_policies(engine._snapshot) if engine is not None
+                else [policy])
+    certified = 0
+    for pol in policies:
+        if pol is None:
+            continue
+        _, failures, st = certify_snapshot(pol)
+        if failures:
+            for f in failures:
+                log(f"verify-snapshot: {f}")
+            raise SystemExit(
+                f"--verify-snapshot: {len(failures)} translation-"
+                "certification failure(s); the compiled snapshot does not "
+                "decide like the host oracle")
+        certified += st["validated"] + st["cache_hits"]
+    log(f"verify-snapshot: OK ({certified} config(s) certified, "
+        f"{time.perf_counter() - t0:.2f}s)")
+
+
+def lowerability_block(engine=None, configs=None, policy=None):
+    """Artifact block: the per-config lowerability breakdown (fast-lane vs
+    slow-lane counts by reason code) so BENCH_r06+ rows show how much of
+    the benchmarked corpus actually rides the kernel."""
+    from types import SimpleNamespace
+
+    from authorino_tpu.analysis.translation_validate import (
+        lowerability_report,
+        snapshot_policies,
+    )
+
+    if engine is not None:
+        snap = engine._snapshot
+        entries = list(snap.by_id.values()) if snap is not None else []
+        policy = snapshot_policies(snap)
+    else:
+        entries = [SimpleNamespace(id=c.name, rules=c, runtime=None)
+                   for c in (configs or [])]
+    rep = lowerability_report(entries, policy, max_listed=0)
+    return {"fast": rep["fast"], "slow": rep["slow"],
+            "by_reason": rep["by_reason"]}
 
 
 def build_engine(configs, args):
@@ -829,6 +873,7 @@ def run_native_mode(args):
             len(trials_detail) // 2] if trials_detail else None,
         "trials": trials_detail,
         "key_repeat": args.key_repeat or None,
+        "lowerability": lowerability_block(engine=engine),
         "dedup_cache": {
             "readback_bytes_per_row": W_row,
             "verdict_cache": {
@@ -1629,6 +1674,7 @@ def main():
                 "max_inflight_batches": dv["max_inflight_batches"],
                 "dispatch_workers": dv["dispatch_workers"],
             }
+            detail["lowerability"] = lowerability_block(engine=engine)
             if chaos_before is not None:
                 from authorino_tpu.runtime import faults as faults_mod
 
@@ -1724,6 +1770,7 @@ def main():
                 "batch_p50_ms": round(p50, 3),
                 "batch_p99_ms": round(p99, 3),
                 "trials": trial_rps,
+                "lowerability": lowerability_block(configs=configs, policy=p),
             }
         )
     )

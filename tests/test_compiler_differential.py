@@ -229,3 +229,47 @@ def test_fast_resolver_negative_index_matches_selector():
     policy = compile_corpus(configs)
     own = kernel_decide(policy, [{"items": ["a", "b"]}], [0])
     assert not own[0]
+
+
+# ---------------------------------------------------------------------------
+# translation validation (ISSUE 6): the per-doc differential above samples
+# the input space; certification proves circuit ≡ oracle over ALL atom
+# assignments (and DFA tables against their regexes via witnesses), per
+# config, for the same generated corpora.
+# ---------------------------------------------------------------------------
+
+
+def _random_corpus(seed):
+    rng = random.Random(seed)
+    configs = []
+    for i in range(rng.randint(2, 12)):
+        evaluators = []
+        for _ in range(rng.randint(1, 4)):
+            cond = random_expr(rng) if rng.random() < 0.4 else None
+            evaluators.append((cond, random_expr(rng)))
+        configs.append(ConfigRules(name=f"cfg-{i}", evaluators=evaluators))
+    return configs
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_certify_generated_corpora(seed):
+    """Property pass: every generated config (invalid regexes, DFA + CPU
+    regex lanes, shared subtrees, conditions) earns a clean certificate."""
+    from authorino_tpu.analysis.translation_validate import certify_snapshot
+
+    policy = compile_corpus(_random_corpus(seed), members_k=8)
+    certs, failures, stats = certify_snapshot(policy, use_cache=False,
+                                              seed=seed)
+    assert failures == [], "\n".join(str(f) for f in failures)
+    assert stats["failed"] == 0
+    assert len(certs) == len(policy.config_ids)
+    assert all(c.ok and len(c.fingerprint) == 64 for c in certs)
+
+
+def test_certify_rejects_every_planted_mutant():
+    """...and the SAME validator rejects every planted miscompile class —
+    a certifier that passes everything would pass the property above too."""
+    from authorino_tpu.analysis.translation_validate import mutation_self_test
+
+    findings = mutation_self_test()
+    assert findings == [], "\n".join(str(f) for f in findings)

@@ -108,3 +108,33 @@ def test_regex_heavy_corpus_matches_oracle(seed):
     own, _ = eval_batch_jit(params, pack_batch(policy, enc))
     for r, (doc, row) in enumerate(zip(docs, rows)):
         assert bool(own[r]) == oracle_verdict(configs[row], doc), (seed, r, doc)
+
+
+def test_determinization_memo_keys_distinguish_anchoring():
+    """Audit of the process-wide determinization memo (compiler/redfa.py
+    _DFA_MEMO): the key is the FULL pattern string, and anchoring lives in
+    the pattern string itself (``^``/``$`` prefixes/suffixes), so variants
+    of one body can never share an entry.  There is no flags parameter in
+    the API at all — nothing else can alias.  Regression-pins both the
+    isolation (distinct languages per variant) and the memo behaviour
+    (same pattern → the SAME immutable DFA object, cross-snapshot)."""
+    variants = ["abc", "^abc", "abc$", "^abc$"]
+    dfas = {p: compile_regex_dfa(p) for p in variants}
+    assert all(d is not None for d in dfas.values())
+    # each anchoring variant decides a different language on these probes
+    probes = ["abc", "xabc", "abcx", "xabcx", ""]
+    behaviours = {p: tuple(dfa_match(d, s) for s in probes)
+                  for p, d in dfas.items()}
+    assert len(set(behaviours.values())) == len(variants), behaviours
+    assert behaviours["abc"] == (True, True, True, True, False)
+    assert behaviours["^abc"] == (True, False, True, False, False)
+    assert behaviours["abc$"] == (True, True, False, False, False)
+    assert behaviours["^abc$"] == (True, False, False, False, False)
+    # memo hit: byte-identical pattern returns the identical object (what
+    # lets the compiler's table dedup collapse repeats across snapshots)
+    for p in variants:
+        assert compile_regex_dfa(p) is dfas[p]
+    # ...and an escaped trailing dollar is NOT treated as an end anchor
+    esc = compile_regex_dfa(r"abc\$")
+    assert esc is not None and esc is not dfas["abc$"]
+    assert dfa_match(esc, "abc$x") and not dfa_match(esc, "abc")
