@@ -58,9 +58,13 @@ _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
 
 # functions on the graceful-degradation path: drain/stop/shutdown/breaker/
 # watchdog/probe code runs exactly when a peer may be wedged, so its waits
-# must be bounded (unbounded-wait kind)
+# must be bounded (unbounded-wait kind).  The overload-resilience layer
+# (ISSUE 7) extends the set: admission/brownout/overload/adaptive-controller
+# code runs exactly when the system is saturated — an unbounded wait there
+# turns backpressure into the collapse it guards against.
 _DRAIN_PATH = re.compile(
-    r"(drain|stop|shutdown|teardown|close|probe|watchdog|breaker)",
+    r"(drain|stop|shutdown|teardown|close|probe|watchdog|breaker"
+    r"|admi(t|ssion)|brownout|overload|adaptive)",
     re.IGNORECASE)
 _WAITISH_METHODS = {"wait", "join"}
 

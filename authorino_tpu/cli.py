@@ -54,8 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--batch-size", type=int, default=env_var("BATCH_SIZE", 256), help="Max micro-batch size for TPU dispatch")
     s.add_argument("--batch-window-us", type=int, default=env_var("BATCH_WINDOW_US", 500),
                    help="Micro-batch gather window in microseconds (native "
-                        "frontend's C++ batcher; the Python engine lane "
-                        "dispatches adaptively and does not wait on it)")
+                        "frontend's C++ batcher ONLY; the Python engine "
+                        "lane's old max_delay_s mirror of this flag is "
+                        "retired — it dispatches adaptively, see "
+                        "--no-adaptive-window)")
     s.add_argument("--max-inflight-batches", type=int,
                    default=env_var("MAX_INFLIGHT_BATCHES", 48),
                    help="Device dispatch window: micro-batches in flight "
@@ -92,6 +94,38 @@ def build_parser() -> argparse.ArgumentParser:
                    default=env_var("BREAKER_RESET_S", 5.0),
                    help="Seconds an OPEN circuit waits before admitting one "
                         "half-open probe batch to test device recovery")
+    s.add_argument("--admission-target-ms", type=float,
+                   default=env_var("ADMISSION_TARGET_MS", 50.0),
+                   help="CoDel-style admission wait target in ms: drives "
+                        "the OVERLOADED state machine, doomed-deadline "
+                        "rejection, and the dynamic queue bound "
+                        "(service_rate x target).  NOTE the bound floors "
+                        "at one full pipeline's worth of standing work "
+                        "(max-inflight-batches x batch-size) so bursts the "
+                        "window could absorb are never rejected — use "
+                        "--admission-queue-cap for a hard bound below "
+                        "that.  See docs/robustness.md 'Overload & "
+                        "brownout'")
+    s.add_argument("--admission-queue-cap", type=int,
+                   default=env_var("ADMISSION_QUEUE_CAP", 0),
+                   help="Hard cap on the engine submit queue in requests "
+                        "(0 = the wait-targeted dynamic cap only)")
+    s.add_argument("--no-adaptive-window", action="store_true",
+                   default=not env_var("ADAPTIVE_WINDOW", True),
+                   help="Disable the adaptive in-flight window/batch-cut "
+                        "controller (the lane then runs at the static "
+                        "--max-inflight-batches operating point, the old "
+                        "behavior)")
+    s.add_argument("--no-brownout", action="store_true",
+                   default=not env_var("BROWNOUT", True),
+                   help="Disable host-lane brownout (spilling small "
+                        "head-of-queue batches to the exact host oracle "
+                        "while the device window is saturated)")
+    s.add_argument("--brownout-max-batch", type=int,
+                   default=env_var("BROWNOUT_MAX_BATCH", 32),
+                   help="Rows per brownout spill batch (small by design: "
+                        "the host lane absorbs latency-critical work, not "
+                        "bulk throughput)")
     s.add_argument("--drain-timeout", type=float,
                    default=env_var("DRAIN_TIMEOUT_S", 10.0),
                    help="Graceful-shutdown bound in seconds: SIGTERM stops "
@@ -245,10 +279,17 @@ async def run_server(args) -> None:
                     "is a chaos/testing mode", fault_profile)
 
     device_timeout_ms = int(getattr(args, "device_timeout", 0) or 0)
+    # NOTE: --batch-window-us no longer reaches the engine (the old
+    # max_delay_s mirror was a documented no-op since the pipelined
+    # dispatcher landed); it still feeds the native C++ gather window below
     engine = PolicyEngine(
         max_batch=args.batch_size,
-        max_delay_s=args.batch_window_us / 1e6,
         timeout_s=(args.timeout / 1000.0) if args.timeout else None,
+        admission_target_s=float(getattr(args, "admission_target_ms", 50.0)) / 1e3,
+        admission_queue_cap=int(getattr(args, "admission_queue_cap", 0)),
+        adaptive_window=not getattr(args, "no_adaptive_window", False),
+        brownout=not getattr(args, "no_brownout", False),
+        brownout_max_batch=int(getattr(args, "brownout_max_batch", 32)),
         max_inflight_batches=args.max_inflight_batches,
         dispatch_workers=args.dispatch_workers,
         verdict_cache_size=args.verdict_cache_size,
@@ -350,6 +391,10 @@ async def run_server(args) -> None:
                 device_timeout_s=(device_timeout_ms / 1000.0) or None,
                 breaker_threshold=int(getattr(args, "breaker_threshold", 5)),
                 breaker_reset_s=float(getattr(args, "breaker_reset", 5.0)),
+                admission_target_s=float(getattr(
+                    args, "admission_target_ms", 50.0)) / 1e3,
+                brownout=not getattr(args, "no_brownout", False),
+                brownout_max_rows=int(getattr(args, "brownout_max_batch", 32)),
             )
             native_fe.start()
             native_holder["fe"] = native_fe  # /debug/vars picks it up

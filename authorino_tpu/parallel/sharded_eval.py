@@ -23,6 +23,7 @@ Layout:
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -47,6 +48,8 @@ from ..ops.pattern_eval import (
 )
 
 __all__ = ["ShardedPolicyModel", "build_mesh"]
+
+log = logging.getLogger("authorino_tpu.sharded_eval")
 
 
 # jitted sharded steps cached per (mesh, has_dfa, has_matmul, n_levels):
@@ -359,6 +362,22 @@ class ShardedPolicyModel:
 
         shard, row = self.locator[config_name]
         return host_results(self.shards[shard], doc, int(row))[1:]
+
+    def host_decide_many(self, config_names: Sequence[str],
+                         docs: Sequence[Any]) -> List[Optional[Tuple[np.ndarray, np.ndarray]]]:
+        """Batch form of host_decide for the engine's degraded and brownout
+        lanes: one (rule_results [E], skipped [E]) per request, or None for
+        a row whose oracle run itself failed (the caller resolves those
+        typed UNAVAILABLE, fail closed — one bad row never fails its
+        batchmates)."""
+        out: List[Optional[Tuple[np.ndarray, np.ndarray]]] = []
+        for name, doc in zip(config_names, docs):
+            try:
+                out.append(self.host_decide(name, doc))
+            except Exception:
+                log.exception("host oracle failed for config %r", name)
+                out.append(None)
+        return out
 
     def apply_fallback(self, host_fallback: np.ndarray, docs: Sequence[Any],
                        config_names: Sequence[str], own_rule: np.ndarray,

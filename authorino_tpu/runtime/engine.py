@@ -67,6 +67,7 @@ from ..utils import tracing as tracing_mod
 from ..utils.rpc import DEADLINE_EXCEEDED, NOT_FOUND, UNAVAILABLE, CheckAbort
 from ..utils.verdict_cache import VerdictCache
 from . import faults
+from .admission import AdaptiveWindow, AdmissionController
 from .breaker import CircuitBreaker
 
 __all__ = ["PolicyEngine", "EngineEntry", "SnapshotRejected"]
@@ -233,7 +234,7 @@ class PolicyEngine:
     def __init__(
         self,
         max_batch: int = 256,
-        max_delay_s: float = 0.0005,
+        max_delay_s: Optional[float] = None,
         timeout_s: Optional[float] = None,
         members_k: int = 16,
         mesh: Any = "auto",
@@ -247,6 +248,12 @@ class PolicyEngine:
         device_timeout_s: Optional[float] = None,
         breaker_threshold: int = 5,
         breaker_reset_s: float = 5.0,
+        admission_target_s: float = 0.05,
+        admission_queue_cap: int = 0,
+        admission_min_cap: Optional[int] = None,
+        adaptive_window: bool = True,
+        brownout: bool = True,
+        brownout_max_batch: int = 32,
     ):
         """``mesh="auto"`` shards the rule corpus over all visible devices
         when more than one is present (dp × mp ShardedPolicyModel);
@@ -260,10 +267,13 @@ class PolicyEngine:
         default, since the compiled-closure oracle costs ~2µs/request,
         cheaper than the reference's normal per-request path.
 
-        ``max_delay_s`` no longer gates engine-lane dispatch (flushing is
-        adaptive: open window → immediate, full window → completion-driven);
-        it is retained for construction compatibility and /debug/vars, and
-        still feeds the native frontend's C++ gather window via the CLI.
+        ``max_delay_s`` is RETIRED (deprecated no-op since PR 2, replaced
+        by the adaptive controller below): flushing is completion-driven
+        (open window → immediate, full window → completion-driven) and the
+        window/batch-cut are tuned by ``AdaptiveWindow``.  Passing a value
+        emits a DeprecationWarning and only echoes on /debug/vars; the
+        CLI's ``--batch-window-us`` still feeds the native frontend's C++
+        gather window, which is a real knob there.
 
         ``max_inflight_batches`` is the dispatch-window depth: launched
         batches awaiting readback.  Size it so window × max_batch ≥
@@ -295,10 +305,34 @@ class PolicyEngine:
         path (None/0 = off).  ``breaker_threshold`` consecutive batch
         failures trip the device circuit breaker OPEN (whole batches
         decided host-side); after ``breaker_reset_s`` one half-open probe
-        batch tests recovery.  See docs/robustness.md."""
+        batch tests recovery.  See docs/robustness.md.
+
+        Overload resilience (ISSUE 7, docs/robustness.md "Overload &
+        brownout"): ``admission_target_s``/``admission_queue_cap``/
+        ``admission_min_cap`` parameterize the CoDel-style admission gate —
+        a submit that would push the queue past the wait-targeted cap is
+        rejected typed RESOURCE_EXHAUSTED at admission (and one whose
+        deadline lands inside the predicted wait + device RTT is shed
+        DEADLINE_EXCEEDED there, before it ever queues).
+        ``adaptive_window`` enables the Little's-law controller that tunes
+        the live in-flight window and batch-cut inside
+        [1, max_inflight_batches] / [1, max_batch] from observed arrival
+        rate, queue wait and device RTT — ``max_inflight_batches`` is the
+        CAP, no longer the operating point.  ``brownout`` lets saturated
+        windows spill small head-of-queue batches to the exact host oracle
+        (``brownout_max_batch`` rows at a time): overload degrades
+        throughput, never correctness."""
         self.index: HostIndex[EngineEntry] = HostIndex()
         self.generation = 0  # bumped per apply_snapshot (gauge + /debug/vars)
         self.max_batch = max_batch
+        if max_delay_s is not None:
+            import warnings
+
+            warnings.warn(
+                "PolicyEngine(max_delay_s=...) is deprecated and ignored: "
+                "the engine lane dispatches adaptively (AdaptiveWindow); "
+                "--batch-window-us still tunes the native C++ gather window",
+                DeprecationWarning, stacklevel=2)
         self.max_delay_s = max_delay_s
         self.timeout_s = timeout_s
         self.members_k = members_k
@@ -341,6 +375,26 @@ class PolicyEngine:
         # headroom: a request whose deadline lands inside one expected
         # device round trip cannot be answered in time
         self._device_ewma = 0.0
+        # overload resilience (ISSUE 7): CoDel-style admission on the
+        # submit queue + the Little's-law window/batch-cut controller +
+        # host-lane brownout when the device pipeline saturates
+        if admission_min_cap is None:
+            # floor = one full pipeline's worth of standing work: the gate
+            # must never reject a burst the window itself could absorb
+            admission_min_cap = max(64, self.max_inflight_batches * max_batch)
+        self.admission = AdmissionController(
+            "engine", target_s=admission_target_s,
+            queue_cap=admission_queue_cap, min_cap=admission_min_cap)
+        self.controller = AdaptiveWindow(
+            "engine", cap=self.max_inflight_batches, batch_cap=max_batch,
+            enabled=adaptive_window)
+        self.brownout = bool(brownout)
+        self.brownout_max_batch = max(1, int(brownout_max_batch))
+        # concurrent brownout batches are bounded: the host lane absorbs
+        # overload, it must not become an unbounded CPU amplifier
+        self._brownout_limit = max(1, self.dispatch_workers // 2)
+        self._brownout_inflight = 0
+        self._brownout_total = 0
 
     # swap listeners: the native frontend rebuilds its C++ snapshot after
     # every corpus swap (runtime/native_frontend.py refresh)
@@ -510,6 +564,15 @@ class PolicyEngine:
             "draining": self._draining,
             "device_timeout_s": self.device_timeout_s,
             "device_rtt_ewma_s": self._device_ewma,
+            "admission": self.admission.to_json(),
+            "adaptive": self.controller.to_json(),
+            "brownout": {
+                "enabled": self.brownout,
+                "max_batch": self.brownout_max_batch,
+                "inflight": self._brownout_inflight,
+                "concurrency_limit": self._brownout_limit,
+                "decisions": self._brownout_total,
+            },
             "faults": (faults.FAULTS.describe() if faults.ACTIVE else
                        {"armed": False}),
             "snapshot": None,
@@ -549,6 +612,29 @@ class PolicyEngine:
                                 span=span, deadline=deadline)
         return await pipeline.evaluate()
 
+    def admission_precheck(self, deadline: Optional[float] = None):
+        """Front-door overload check for the gRPC/HTTP servers at the
+        ACTUAL queue depth: a request arriving into a full hard cap, or
+        doomed on arrival while the lane is OVERLOADED, is answered typed
+        before a span or pipeline is built.  Deterministic — the
+        submit-time gate stays the one true admission point (this never
+        consumes CoDel pacing state) and never rejects anything that gate
+        would accept.  Returns an AuthResult to serve, or None to
+        proceed."""
+        rej = self.admission.precheck(len(self._queue), deadline=deadline,
+                                      rtt_s=self._device_ewma)
+        if rej is None:
+            return None
+        code, reason = rej
+        self.admission.count_reject(reason)
+        if code == DEADLINE_EXCEEDED:
+            metrics_mod.deadline_shed.labels("engine").inc()
+            return AuthResult(code=code,
+                              message="rejected at admission: deadline "
+                                      "cannot be met")
+        return AuthResult(code=code,
+                          message=f"server overloaded ({reason})")
+
     # ---- micro-batching verdicts ----------------------------------------
 
     def provider_for(self, config_name: str):
@@ -584,12 +670,28 @@ class PolicyEngine:
             # graceful drain: stop admitting — already-queued work keeps
             # flowing, but nothing new may extend the drain
             raise CheckAbort(UNAVAILABLE, "server draining")
+        # admission control (ISSUE 7): doomed or beyond-the-wait-target
+        # work is rejected HERE, typed, before it queues — never after an
+        # encode, never as a raw exception.  A doomed-deadline rejection
+        # also counts as a deadline shed (it is one, just earlier).
+        rej = self.admission.admit(len(self._queue), deadline=deadline,
+                                   rtt_s=self._device_ewma)
+        if rej is not None:
+            code, reason = rej
+            self.admission.count_reject(reason)
+            if code == DEADLINE_EXCEEDED:
+                metrics_mod.deadline_shed.labels("engine").inc()
+                raise CheckAbort(code, "rejected at admission: deadline "
+                                       "cannot be met")
+            raise CheckAbort(code, f"server overloaded ({reason}): "
+                                   "admission rejected")
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         with self._queue_lock:
             self._queue.append(_Pending(doc, config_name, fut, loop,
                                         span=span, t_enq=time.monotonic(),
                                         deadline=deadline))
+            self.controller.observe_arrivals()
         loop.call_soon(self._maybe_dispatch)
         return await fut
 
@@ -600,23 +702,53 @@ class PolicyEngine:
         queue is non-empty.  Runs on event loops (post-submit) AND on the
         completion thread (post-readback) — redundant calls are cheap
         no-ops, so no timer is ever needed: a full window guarantees a
-        future completion, and that completion cuts the next batch."""
+        future completion, and that completion cuts the next batch.
+
+        The window bound is the ADAPTIVE controller's live window (≤ the
+        max_inflight_batches cap); the cut stays completion-driven (grows
+        with load).  With the window saturated and a standing queue forming
+        (head-of-queue age past half the admission wait target), small
+        head-of-queue batches spill to the exact host oracle instead —
+        brownout: docs/robustness.md "Overload & brownout"."""
         while True:
+            brown = False
             with self._queue_lock:
-                if not self._queue or self._inflight >= self.max_inflight_batches:
-                    depth = len(self._queue)
+                depth = len(self._queue)
+                if not self._queue:
                     break
-                n = min(len(self._queue), self.max_batch)
-                batch = [self._queue.popleft() for _ in range(n)]
-                self._inflight += 1
-                if self._inflight > self.inflight_peak:
-                    self.inflight_peak = self._inflight
-                inflight = self._inflight
-            self._g_inflight.set(inflight)
+                if self._inflight < self.controller.window:
+                    # the cut itself stays completion-driven (grow with
+                    # load, bounded by max_batch): clamping it to the
+                    # controller's advisory target would fragment standing
+                    # queues into cold pad shapes — see AdaptiveWindow
+                    n = min(depth, self.max_batch)
+                    batch = [self._queue.popleft() for _ in range(n)]
+                    self._inflight += 1
+                    if self._inflight > self.inflight_peak:
+                        self.inflight_peak = self._inflight
+                    inflight = self._inflight
+                elif (self.brownout
+                      and self._brownout_inflight < self._brownout_limit
+                      and (time.monotonic() - self._queue[0].t_enq)
+                      > self.admission.target_s / 2):
+                    # device pipeline saturated + a standing wait forming:
+                    # the OLDEST requests (most deadline-critical) spill to
+                    # the host lane — no window slot consumed
+                    n = min(depth, self.brownout_max_batch)
+                    batch = [self._queue.popleft() for _ in range(n)]
+                    self._brownout_inflight += 1
+                    brown = True
+                else:
+                    break
             snap = self._snapshot  # pinned per batch: double-buffer swap safety
-            _encode_pool(self.dispatch_workers).submit(
-                self._encode_launch_job, snap, batch)
-        self._g_depth.set(depth)
+            if brown:
+                _encode_pool(self.dispatch_workers).submit(
+                    self._brownout_job, snap, batch)
+            else:
+                self._g_inflight.set(inflight)
+                _encode_pool(self.dispatch_workers).submit(
+                    self._encode_launch_job, snap, batch)
+        self._g_depth.set(len(self._queue))
 
     def _encode_launch_job(self, snap: Optional[_Snapshot],
                            batch: List[_Pending], attempt: int = 0) -> None:
@@ -652,15 +784,20 @@ class PolicyEngine:
             return
         _completer_submit(item)
 
-    def _shed_expired(self, batch: List[_Pending]) -> List[_Pending]:
+    def _shed_expired(self, batch: List[_Pending],
+                      horizon_s: Optional[float] = None) -> List[_Pending]:
         """Deadline-aware admission: requests whose propagated Check()
-        deadline cannot be met — it lands inside one expected device round
-        trip (EWMA) — fail fast with a typed DEADLINE_EXCEEDED instead of
-        riding (and wasting) a kernel launch whose answer arrives dead."""
+        deadline cannot be met — it lands inside ``horizon_s`` (default:
+        one expected device round trip, EWMA) — fail fast with a typed
+        DEADLINE_EXCEEDED instead of riding (and wasting) a kernel launch
+        whose answer arrives dead.  The brownout lane passes 0: the host
+        oracle answers in microseconds, so only already-expired deadlines
+        shed there."""
         if all(p.deadline is None for p in batch):
             return batch
         now = time.monotonic()
-        horizon = now + self._device_ewma
+        horizon = now + (self._device_ewma if horizon_s is None
+                         else horizon_s)
         live = [p for p in batch if p.deadline is None or p.deadline > horizon]
         shed = [p for p in batch if p.deadline is not None
                 and p.deadline <= horizon]
@@ -689,39 +826,41 @@ class PolicyEngine:
         self._degrade_batch(snap, batch, exc=exc)
         self._launch_done()
 
-    def _degrade_batch(self, snap: _Snapshot, batch: List[_Pending],
-                       exc: Optional[Exception] = None,
-                       reason: str = "device-failure") -> None:
-        """Final fallback lane: every request re-decided row-by-row through
-        the host expression oracle (exactness preserved — host_results is
-        the kernel's differential-test reference, membership overflow
-        included).  Fail-closed typed UNAVAILABLE ONLY for rows where the
-        oracle itself fails."""
+    def _host_decide_batch(self, snap: _Snapshot, batch: List[_Pending]):
+        """Row-by-row exact host decisions for one batch (the oracle is the
+        kernel's differential-test reference, membership overflow
+        included).  Returns (resolutions-by-loop, failed-futures-by-loop,
+        n_ok); rows whose oracle run itself failed land in ``failed`` and
+        resolve typed UNAVAILABLE, fail closed."""
         from ..models.policy_model import host_results
 
         by_loop: Dict[Any, list] = {}
         failed: Dict[Any, list] = {}
         n_ok = 0
-        for p in batch:
-            try:
-                if snap.sharded is not None:
-                    rule, skipped = snap.sharded.host_decide(
-                        p.config_name, p.doc)
-                else:
+        if snap.sharded is not None:
+            results = snap.sharded.host_decide_many(
+                [p.config_name for p in batch], [p.doc for p in batch])
+        else:
+            results = []
+            for p in batch:
+                try:
                     row = snap.policy.config_ids[p.config_name]
                     _, rule, skipped = host_results(snap.policy, p.doc, row)
-            except Exception:
-                log.exception("host-oracle degrade failed for config %r "
-                              "(fail-closed UNAVAILABLE)", p.config_name)
+                    results.append((rule, skipped))
+                except Exception:
+                    log.exception("host oracle failed for config %r "
+                                  "(fail-closed UNAVAILABLE)", p.config_name)
+                    results.append(None)
+        for p, res in zip(batch, results):
+            if res is None:
                 failed.setdefault(p.loop, []).append(p.future)
-                continue
-            n_ok += 1
-            by_loop.setdefault(p.loop, []).append((p.future, rule, skipped))
-        if n_ok:
-            metrics_mod.degraded_decisions.labels("engine").inc(n_ok)
-            if exc is not None:
-                log.warning("micro-batch of %d re-decided host-side after "
-                            "device failure (%r)", len(batch), exc)
+            else:
+                n_ok += 1
+                by_loop.setdefault(p.loop, []).append((p.future,) + tuple(res))
+        return by_loop, failed, n_ok
+
+    @staticmethod
+    def _resolve_host_decisions(by_loop, failed) -> None:
         for loop, resolutions in by_loop.items():
             try:
                 loop.call_soon_threadsafe(_resolve_many, resolutions)
@@ -733,6 +872,58 @@ class PolicyEngine:
                     UNAVAILABLE, "policy evaluation unavailable"))
             except RuntimeError:
                 pass
+
+    def _degrade_batch(self, snap: _Snapshot, batch: List[_Pending],
+                       exc: Optional[Exception] = None,
+                       reason: str = "device-failure") -> None:
+        """Final fallback lane: every request re-decided row-by-row through
+        the host expression oracle.  Fail-closed typed UNAVAILABLE ONLY for
+        rows where the oracle itself fails."""
+        by_loop, failed, n_ok = self._host_decide_batch(snap, batch)
+        if n_ok:
+            metrics_mod.degraded_decisions.labels("engine").inc(n_ok)
+            self.admission.observe_service(n_ok)
+            if exc is not None:
+                log.warning("micro-batch of %d re-decided host-side after "
+                            "device failure (%r)", len(batch), exc)
+        self._resolve_host_decisions(by_loop, failed)
+
+    def _brownout_job(self, snap: Optional[_Snapshot],
+                      batch: List[_Pending]) -> None:
+        """Brownout lane (encode-pool thread): a small head-of-queue batch
+        decided through the exact host oracle while the device window is
+        saturated.  Identical verdicts to the device by construction (the
+        oracle is the kernel's reference); throughput degrades, correctness
+        never.  No window slot is held — brownout concurrency is bounded by
+        its own counter."""
+        try:
+            # horizon 0: the host oracle answers in microseconds — a
+            # deadline the DEVICE's inflated RTT could not meet is exactly
+            # what this lane exists to rescue
+            batch = self._shed_expired(batch, horizon_s=0.0)
+            if not batch:
+                return
+            if snap is None or (snap.policy is None and snap.sharded is None):
+                self._resolve_error(batch, CheckAbort(
+                    UNAVAILABLE, "no compiled policy snapshot"))
+                return
+            by_loop, failed, n_ok = self._host_decide_batch(snap, batch)
+            if n_ok:
+                metrics_mod.brownout_decisions.labels("engine").inc(n_ok)
+                metrics_mod.brownout_batches.labels("engine").inc()
+                self._brownout_total += n_ok
+                self.admission.observe_service(n_ok)
+            self._resolve_host_decisions(by_loop, failed)
+        except Exception:
+            # a brownout bug must fail its own batch typed, never leak or
+            # wedge the queue
+            log.exception("brownout batch failed")
+            self._resolve_error(batch, CheckAbort(
+                UNAVAILABLE, "policy evaluation unavailable"))
+        finally:
+            with self._queue_lock:
+                self._brownout_inflight -= 1
+            self._maybe_dispatch()
 
     def _watchdog_fire(self, item: "_Inflight") -> None:
         """Completer watchdog hand-off: an in-flight batch wedged past
@@ -771,14 +962,16 @@ class PolicyEngine:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             with self._queue_lock:
-                idle = not self._queue and self._inflight == 0
+                idle = (not self._queue and self._inflight == 0
+                        and self._brownout_inflight == 0)
             if idle:
                 return True
             time.sleep(0.01)
         with self._queue_lock:
             log.warning("engine drain timed out after %.1fs "
-                        "(queue=%d, inflight=%d)", timeout_s,
-                        len(self._queue), self._inflight)
+                        "(queue=%d, inflight=%d, brownout=%d)", timeout_s,
+                        len(self._queue), self._inflight,
+                        self._brownout_inflight)
         return False
 
     def _dedup_plan(self, keys, n, gen, eligible):
@@ -841,6 +1034,13 @@ class PolicyEngine:
         pad = _bucket(n)
         t0 = time.monotonic()
         waits = np.array([(t0 - p.t_enq) if p.t_enq else 0.0 for p in batch])
+        # the CoDel signal rides the batch cut: the cut's MINIMUM wait is
+        # the standing-queue indicator the admission state flips on.  A
+        # RETRIED batch re-feeds waits measured from the original enqueue,
+        # so the signal is total sojourn (queue + failed attempts) by
+        # design: a device so flaky that work is stuck re-dispatching is
+        # overload from the client's seat, whatever the queue depth says
+        self.admission.observe_waits(waits, now=t0)
         binfo = {"batch_size": n, "pad": pad, "eff": 0,
                  "start_ns": time.time_ns(), "duration_s": 0.0}
         docs = [p.doc for p in batch]
@@ -1034,6 +1234,13 @@ class PolicyEngine:
             dur = t_done - item.t_launch
             self._device_ewma = (dur if not self._device_ewma
                                  else 0.8 * self._device_ewma + 0.2 * dur)
+            # overload controllers: the batch's device round trip + size
+            # steps the adaptive window/cut; completed rows feed the
+            # admission gate's service-rate estimate
+            self.controller.observe_batch(dur, item.binfo["batch_size"],
+                                          len(self._queue), now=t_done)
+            self.admission.observe_service(item.binfo["batch_size"],
+                                           now=t_done)
             binfo = item.binfo
             binfo["duration_s"] = t_done - item.t_launch
             metrics_mod.observe_pipeline_stage("engine", "device",

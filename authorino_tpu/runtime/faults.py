@@ -27,13 +27,18 @@ Named profiles::
     device-down   kernel:raise               every dispatch fails
     flaky         kernel:raise:p=0.3         ~1 in 3 dispatches fails
     flap          kernel:raise:for=2         device down 2s, then recovers
-    slow-device   kernel:delay:delay=0.05    +50ms per dispatch
+    slow-device   kernel:delay:delay=0.05    +50ms readback latency/batch
     wedge         kernel:hang                readbacks never arrive
 
 ``hang`` is realized by wrapping the in-flight result handle: is_ready()
 stays False (until the rule's ``for=`` window closes), which is exactly
 what a wedged device looks like to the completer — the watchdog path, not
-the exception path, must catch it.
+the exception path, must catch it.  Device-stage ``delay`` rules ride the
+same wrapper with a per-batch release deadline: the readback arrives
+``delay_s`` late, so the measured device round trip (and everything keyed
+off it — deadline shedding headroom, the adaptive window controller)
+inflates exactly like a genuinely slow device; only encode-stage delays
+sleep on the worker thread.
 """
 
 from __future__ import annotations
@@ -235,6 +240,13 @@ class FaultPlane:
             for r in self._rules:
                 if r.stage != stage or r.mode == "hang":
                     continue  # hang rules fire at wrap_handle, not here
+                if r.mode == "delay" and r.stage != "encode":
+                    # device-stage delays model a SLOW DEVICE: they ride
+                    # wrap_handle as readback latency (is_ready stays False
+                    # for delay_s), never a sleep that stalls the encode
+                    # worker — the adaptive window controller must see the
+                    # RTT inflate, not the host thread stall
+                    continue
                 if r.lane not in ("*", lane):
                     continue
                 if not r.live(elapsed):
@@ -262,15 +274,17 @@ class FaultPlane:
             time.sleep(rule.delay_s)
 
     def wrap_handle(self, handle: Any, lane: str) -> Any:
-        """Launch-time hook: an armed ``hang`` rule (any device stage)
-        wraps the in-flight handle so its readback never arrives — until
-        the rule's active window closes, when the real handle shows
-        through (a recovering wedge)."""
+        """Launch-time hook for device-stage ``hang`` and ``delay`` rules:
+        the in-flight handle is wrapped so its readback never arrives
+        (hang — until the rule's active window closes, when the real
+        handle shows through: a recovering wedge) or arrives ``delay_s``
+        late (a slow device: is_ready turns True after the delay, and the
+        measured round trip inflates accordingly)."""
         with self._lock:
             elapsed = time.monotonic() - self._armed_at
             rule = None
             for r in self._rules:
-                if r.mode != "hang" or r.stage == "encode":
+                if r.mode not in ("hang", "delay") or r.stage == "encode":
                     continue
                 if r.lane not in ("*", lane):
                     continue
@@ -279,7 +293,7 @@ class FaultPlane:
                 if r.p < 1.0 and self._rng.random() >= r.p:
                     continue
                 r.fired += 1
-                key = f"{r.stage}:hang:{lane}"
+                key = f"{r.stage}:{r.mode}:{lane}"
                 self.fired[key] = self.fired.get(key, 0) + 1
                 rule = r
                 break
@@ -287,7 +301,10 @@ class FaultPlane:
             return handle
         from ..utils import metrics as metrics_mod
 
-        metrics_mod.injected_faults.labels(rule.stage, "hang", lane).inc()
+        metrics_mod.injected_faults.labels(rule.stage, rule.mode, lane).inc()
+        if rule.mode == "delay":
+            return HungHandle(handle,
+                              release_at=time.monotonic() + rule.delay_s)
         release = (None if rule.for_s is None
                    else self._armed_at + rule.after_s + rule.for_s)
         return HungHandle(handle, release_at=release)

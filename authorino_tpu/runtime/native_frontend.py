@@ -70,6 +70,7 @@ from ..utils.rpc import (
     UNAUTHENTICATED,
 )
 from . import faults
+from .admission import AdmissionController
 from .breaker import CircuitBreaker
 
 log = logging.getLogger("authorino_tpu.native_frontend")
@@ -617,7 +618,9 @@ class NativeFrontend:
                  verdict_cache_size: int = 32768, batch_dedup: bool = True,
                  strict_verify: bool = False,
                  device_timeout_s: Optional[float] = None,
-                 breaker_threshold: int = 5, breaker_reset_s: float = 5.0):
+                 breaker_threshold: int = 5, breaker_reset_s: float = 5.0,
+                 admission_target_s: float = 0.05,
+                 brownout: bool = True, brownout_max_rows: int = 64):
         self.engine = engine
         # fault tolerance (ISSUE 5, docs/robustness.md): a failed device
         # batch retries once, then degrades to the SAME kernel on the CPU
@@ -711,6 +714,33 @@ class NativeFrontend:
         self._fe_stopped = False  # set just before fe_stop(): readback must
         # never complete a batch into the torn-down C++ server
         self._g_native_inflight = metrics_mod.inflight_batches.labels("native")
+        # overload resilience (ISSUE 7): the C++ side already bounds its
+        # queues (slots for the device lane, slow_cap for the slow lane);
+        # the Python side adds (a) a CoDel admission state fed by the slow
+        # lane's estimated queue wait, paced-rejecting slow requests typed
+        # RESOURCE_EXHAUSTED while a standing queue persists, and (b)
+        # host-lane brownout: with nearly every device slot in flight, a
+        # small batch is answered by the SAME kernel on the CPU backend
+        # (exact; docs/robustness.md "Overload & brownout")
+        # the CoDel interval must exceed the wait-feed cadence (the drain
+        # loop, hist_drain_s): with a shorter interval the idle-reset
+        # would mistake the gap BETWEEN feeds for vanished load and flap
+        # the OVERLOADED state under genuinely sustained saturation
+        self.admission = AdmissionController(
+            "native", target_s=admission_target_s,
+            interval_s=max(1.0, 2 * self.hist_drain_s))
+        self.brownout = bool(brownout)
+        self.brownout_max_rows = max(1, int(brownout_max_rows))
+        self._brownout_threshold = max(1, self.slots - 2)
+        self._brownout_total = 0
+        self._brownout_batches = 0
+        # live brownout worker threads (under _rb_lock): stop()'s drain
+        # must wait these out like in-flight device batches — a spill
+        # mid-_host_eval completing into a torn-down C++ server would be
+        # a native use-after-stop
+        self._brownout_live = 0
+        # slow-lane service-rate estimator state (owned by the drain loop)
+        self._slow_last: Dict[str, float] = {"slow": 0.0, "t": 0.0}
 
     # ------------------------------------------------------------------
     def start(self) -> int:
@@ -763,10 +793,11 @@ class NativeFrontend:
                              and s.get("slow_queued", 0) == 0):
                     break
                 time.sleep(0.05)
-            # in-flight device batches must land (fe_complete_batch) while
-            # the C++ server is still alive
+            # in-flight device batches AND live brownout spills must land
+            # (fe_complete_batch) while the C++ server is still alive
             deadline = time.monotonic() + drain_s
-            while self._rb_inflight and time.monotonic() < deadline:
+            while ((self._rb_inflight or self._brownout_live)
+                   and time.monotonic() < deadline):
                 time.sleep(0.02)
         self._running = False
         self._rb_evt.set()
@@ -839,8 +870,33 @@ class NativeFrontend:
                 return
             try:
                 self.drain_native_stats()
+                self._feed_admission()
             except Exception:
                 log.exception("native stats drain failed")
+
+    def _feed_admission(self) -> None:
+        """Estimate the slow lane's standing queue wait from fe_stats()
+        (Little's law: queued / observed completion rate) and feed it to
+        the CoDel admission state.  The per-request waits live in C++; this
+        coarse estimate on the drain cadence is what the Python side can
+        see without putting itself back on the per-request path."""
+        s = self.stats()
+        if not s:
+            return
+        now = time.monotonic()
+        last_t = self._slow_last["t"]
+        done = float(s.get("slow", 0))
+        queued = float(s.get("slow_queued", 0)) + float(s.get("slow_pending", 0))
+        if last_t:
+            dt = now - last_t
+            delta = done - self._slow_last["slow"]
+            if dt > 0 and delta >= 0:
+                self.admission.observe_service(int(delta), now=now)
+                rate = delta / dt
+                est_wait = queued / max(rate, 1.0)
+                self.admission.observe_waits((est_wait,), now=now)
+        self._slow_last["slow"] = done
+        self._slow_last["t"] = now
 
     def debug_vars(self) -> Dict[str, Any]:
         """JSON-safe live state for /debug/vars: raw fe_stats counters and
@@ -863,6 +919,14 @@ class NativeFrontend:
                               if self._verdict_cache is not None else None),
             "breaker": self.breaker.to_json(),
             "device_timeout_s": self.device_timeout_s,
+            "admission": self.admission.to_json(),
+            "brownout": {
+                "enabled": self.brownout,
+                "max_rows": self.brownout_max_rows,
+                "slot_threshold": self._brownout_threshold,
+                "decisions": self._brownout_total,
+                "batches": self._brownout_batches,
+            },
             "snapshot": None,
         }
         if rec is not None:
@@ -1745,7 +1809,7 @@ class NativeFrontend:
         return keys, eligible, cached, miss_rows, unique_rows, inverse, elig_miss
 
     def _dispatch(self, snap_id: int, slot: int, count: int,
-                  attempt: int = 0) -> None:
+                  attempt: int = 0, spill: bool = True) -> None:
         """Launch stage: non-blocking kernel dispatch for one C++-encoded
         slot, then park the in-flight batch on the readback queue.  The
         dispatcher thread is immediately free to launch the next slot, so
@@ -1769,6 +1833,25 @@ class NativeFrontend:
         rec = self._snaps[snap_id]
         if not self.breaker.allow_device():
             self._degrade_slot(rec, snap_id, slot, count)
+            return
+        if (spill and self.brownout and count <= self.brownout_max_rows
+                and self._rb_inflight >= self._brownout_threshold
+                and rec.sharded is None and rec.policy is not None):
+            # device pipeline saturated (nearly every slot in flight) and
+            # this batch is small: answer it on the CPU-backend kernel
+            # instead of queueing it behind a full window — exact verdicts,
+            # bounded latency (brownout, docs/robustness.md).  On its OWN
+            # worker thread: the first CPU eval of a new (pad, eff) shape
+            # jit-compiles, and that must never stall a dispatcher thread
+            # mid-saturation (mirrors _fail_async — at most one live
+            # thread per C++ slot, since a slot cannot re-fire until
+            # fe_complete_batch refills it).  Counted in _brownout_live so
+            # stop()'s drain waits the spill out before fe_stop.
+            with self._rb_lock:
+                self._brownout_live += 1
+            threading.Thread(target=self._brownout_slot,
+                             args=(rec, snap_id, slot, count),
+                             name="atpu-fe-brownout", daemon=True).start()
             return
         a = rec.arrays[slot]
         # copy attribution rows BEFORE the slot can complete: once
@@ -1862,6 +1945,51 @@ class NativeFrontend:
         self._rb_q.append((rec, snap_id, slot, count, pad, eff, rows,
                            shards_arr, packed, t0, t0_ns, fan, attempt))
         self._rb_evt.set()
+
+    def _brownout_slot(self, rec: _SnapRec, snap_id: int, slot: int,
+                       count: int) -> None:
+        """Answer one small slot on the CPU-backend kernel while the device
+        window is saturated (worker thread — see _dispatch).  If the host
+        eval itself fails, the slot falls back to a normal device dispatch
+        (spill=False so it cannot loop back here).  Exactness: same kernel,
+        same encoded operands — only the execution backend differs."""
+        try:
+            t0 = time.monotonic()
+            t0_ns = time.time_ns()
+            rows = rec.arrays[slot]["config_id"][:count].copy()
+            try:
+                verdict = self._host_eval(rec, slot, count)
+            except Exception:
+                log.exception("native brownout eval failed; batch rides the "
+                              "device instead")
+                try:
+                    self._dispatch(snap_id, slot, count, spill=False)
+                except Exception as e:
+                    log.exception("post-brownout device dispatch failed")
+                    try:
+                        self._native_batch_failed(snap_id, slot, count, 0, e)
+                    except Exception:
+                        log.exception("native batch failure handling failed")
+                return
+            metrics_mod.brownout_decisions.labels("native").inc(count)
+            metrics_mod.brownout_batches.labels("native").inc()
+            self._brownout_total += count
+            self._brownout_batches += 1
+            if not self._fe_stopped:
+                self._mod.fe_complete_batch(snap_id, slot, verdict.ctypes.data)
+            try:
+                # pad/eff 0 + device_rows 0: per-authconfig counters stay
+                # exact, while the device-occupancy series never sees a
+                # batch that deliberately skipped the device
+                self._post_complete_telemetry(rec, count, 0, 0, rows, None,
+                                              verdict,
+                                              time.monotonic() - t0, t0_ns,
+                                              device_rows=0, device=False)
+            except Exception:
+                log.exception("brownout telemetry failed")
+        finally:
+            with self._rb_lock:
+                self._brownout_live -= 1
 
     def _readback_loop(self) -> None:
         """Completion stage: finalize in-flight batches as their readbacks
@@ -2105,13 +2233,18 @@ class NativeFrontend:
                                  shards_arr: Optional[np.ndarray],
                                  verdict: np.ndarray, dispatch_s: float,
                                  t0_ns: int,
-                                 device_rows: Optional[int] = None) -> None:
+                                 device_rows: Optional[int] = None,
+                                 device: bool = True) -> None:
         # per-batch telemetry AFTER completion: responses are already on
-        # their way to the wire (queue wait is C++-clocked — stage hists)
-        metrics_mod.observe_batch("native", count, pad, None, dispatch_s,
-                                  device_rows=device_rows)
-        metrics_mod.observe_pipeline_stage("native", "device", dispatch_s)
-        if tracing_mod.tracing_active():
+        # their way to the wire (queue wait is C++-clocked — stage hists).
+        # ``device=False`` (brownout spill) keeps the per-authconfig
+        # counters but stays out of the device-lane batch/RTT series — a
+        # sub-ms host eval must not read as a fast device round trip.
+        if device:
+            metrics_mod.observe_batch("native", count, pad, None, dispatch_s,
+                                      device_rows=device_rows)
+            metrics_mod.observe_pipeline_stage("native", "device", dispatch_s)
+        if device and tracing_mod.tracing_active():
             # fast-lane requests have no Python spans to link (only sampled
             # slow-lane ones do) — the DeviceBatch span still carries the
             # launch's batch_size/pad/eff for pad-waste attribution
@@ -2201,7 +2334,23 @@ class NativeFrontend:
             done_buf.append((req_id, payload, status))
             done_evt.set()
 
+        from ..utils.rpc import RESOURCE_EXHAUSTED
+
+        overload_bytes = check_response_from_result(AuthResult(
+            code=RESOURCE_EXHAUSTED,
+            message="server overloaded: slow lane shedding",
+        )).SerializeToString()
+
         async def handle(req_id: int, raw: bytes) -> None:
+            # CoDel admission (ISSUE 7): while the slow lane's estimated
+            # standing wait has stayed above target for a full interval,
+            # paced arrivals are answered typed RESOURCE_EXHAUSTED before
+            # any parse/pipeline work — the C++ slow_cap bounds the queue,
+            # this bounds the WAIT of what the queue holds
+            if self.admission.drop_now():
+                self.admission.count_reject("overload")
+                complete(req_id, overload_bytes, 0)
+                return
             try:
                 req = external_auth_pb2.CheckRequest.FromString(raw)
                 model = request_model_from_proto(req)

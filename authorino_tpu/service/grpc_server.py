@@ -178,6 +178,15 @@ def build_server(
                 deadline = time.monotonic() + remaining
         except Exception:
             pass
+        # front-door admission (ISSUE 7): doomed-on-arrival work under
+        # overload answers typed here, before a span/pipeline is built
+        # (the submit-time gate in the engine stays the true admission
+        # point — this subset is deterministic)
+        precheck = getattr(engine, "admission_precheck", None)
+        if precheck is not None:
+            rejected = precheck(deadline)
+            if rejected is not None:
+                return check_response_from_result(rejected)
         span = RequestSpan.from_headers(model.http.headers, model.http.id)
         try:
             result = await engine.check(model, span=span, deadline=deadline)

@@ -103,6 +103,20 @@ def make_check_handler(engine: PolicyEngine, max_body: int = DEFAULT_MAX_BODY):
                 deadline = time.monotonic() + max(float(timeout_ms), 0.0) / 1e3
             except ValueError:
                 pass
+        # front-door admission (ISSUE 7): a request that is doomed on
+        # arrival while the engine is overloaded is answered typed before
+        # a span or pipeline exists — the submit-time gate stays the one
+        # true admission point (this check is deterministic)
+        precheck = getattr(engine, "admission_precheck", None)
+        if precheck is not None:
+            rejected = precheck(deadline)
+            if rejected is not None:
+                status = http_status_for(rejected.code, rejected.status)
+                metrics_mod.response_status.labels(str(status)).inc()
+                return web.Response(
+                    status=status,
+                    headers={"X-Ext-Auth-Reason": rejected.message or ""},
+                    text="")
         span = RequestSpan.from_headers(
             check_request.http.headers, check_request.http.id
         )
@@ -173,6 +187,13 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
                 if breaker is not None and breaker.state != "closed":
                     degraded.append(
                         f"{lane} device circuit {breaker.state}")
+                # overload is surfaced but STAYS ready: admission is
+                # shedding typed rejections precisely so accepted work
+                # still meets its SLO — removing the endpoint would just
+                # move the queue to a peer
+                adm = getattr(owner, "admission", None) if owner else None
+                if adm is not None and adm.overloaded:
+                    degraded.append(f"{lane} admission overloaded")
             if degraded:
                 return web.Response(
                     text=f"ok (degraded: {'; '.join(degraded)})")

@@ -634,6 +634,66 @@ injected_faults = _counter(
     ("stage", "mode", "lane"),
 )
 
+# ---------------------------------------------------------------------------
+# Overload resilience (ISSUE 7): CoDel-style admission control, the adaptive
+# window controller, and host-lane brownout under sustained open-loop
+# traffic.  See runtime/admission.py + docs/robustness.md.
+# ---------------------------------------------------------------------------
+
+admission_state = _gauge(
+    "auth_server_admission_state",
+    "Admission-control state per lane: 0 = admitting, 1 = overloaded (the "
+    "minimum queue wait stayed above the CoDel target for a full interval "
+    "— a standing queue, not a transient burst; arrivals beyond the "
+    "wait-targeted cap are rejected typed RESOURCE_EXHAUSTED).",
+    _LANE_LABELS,
+)
+admission_rejected = _counter(
+    "auth_server_admission_rejected_total",
+    "Requests rejected at admission (before queueing, before encode): "
+    "queue-full = hard queue cap, overload = wait-targeted effective cap, "
+    "doomed-deadline = the propagated deadline lands inside the predicted "
+    "queue wait + device RTT (typed DEADLINE_EXCEEDED; the others are "
+    "typed RESOURCE_EXHAUSTED).",
+    _LANE_LABELS + ("reason",),
+)
+admission_queue_wait = _gauge(
+    "auth_server_admission_queue_wait_ewma_seconds",
+    "EWMA of the per-request submit-queue wait the admission controller "
+    "tracks (the CoDel signal's mean companion; the state flips on the "
+    "interval MINIMUM).",
+    _LANE_LABELS,
+)
+adaptive_window = _gauge(
+    "auth_server_adaptive_window",
+    "Live in-flight window chosen by the adaptive controller (Little's "
+    "law: arrival rate x device RTT / batch cut, clamped to [1, "
+    "max_inflight_batches]).  Replaces the static --max-inflight-batches "
+    "guess; the flag is now the cap.",
+    _LANE_LABELS,
+)
+adaptive_batch_cut = _gauge(
+    "auth_server_adaptive_batch_cut",
+    "Live batch-cut target chosen by the adaptive controller (pow2 bucket "
+    "of arrival rate x RTT / window, clamped to [1, max_batch]).",
+    _LANE_LABELS,
+)
+brownout_decisions = _counter(
+    "auth_server_brownout_decisions_total",
+    "Requests decided on the exact host lane because the device pipeline "
+    "was saturated (window full + standing queue): overload degrades "
+    "throughput, never correctness.  Engine lane: the host expression "
+    "oracle; native lane: the same kernel on the CPU backend.",
+    _LANE_LABELS,
+)
+brownout_batches = _counter(
+    "auth_server_brownout_batches_total",
+    "Micro-batches spilled to the host lane under device-pipeline "
+    "saturation (the per-batch companion of "
+    "auth_server_brownout_decisions_total).",
+    _LANE_LABELS,
+)
+
 host_fallback_total = _counter(
     "auth_server_host_fallback_total",
     "Requests re-decided by the host expression oracle because the compact "

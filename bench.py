@@ -263,9 +263,13 @@ def build_engine(configs, args):
         # chaos runs need the watchdog armed and a short breaker cooldown,
         # or a flap profile can't show a recovery inside one trial
         kw = dict(device_timeout_s=5.0, breaker_reset_s=1.0)
-    engine = PolicyEngine(
-        max_batch=args.batch, max_delay_s=args.window_us / 1e6, **kw
-    )
+    if getattr(args, "open_loop", ""):
+        # a window cap the overload pass can actually SATURATE (the
+        # closed-loop phase peaks well below it), so the adaptive window
+        # and the brownout spill show up in the artifact instead of
+        # hiding behind a 48-slot cap the offered load never fills
+        kw.update(max_inflight_batches=8)
+    engine = PolicyEngine(max_batch=args.batch, **kw)
     engine.apply_snapshot(
         [EngineEntry(id=c.name, hosts=[c.name], runtime=None, rules=c) for c in configs]
     )
@@ -378,7 +382,7 @@ def run_engine_mode(engine, docs, rows, args):
         await asyncio.gather(*[
             asyncio.ensure_future(engine.submit(docs[j % n_docs], f"cfg-{rows[j % n_docs]}"))
             for j in range(window)
-        ])
+        ], return_exceptions=True)
         lat.clear()
         total[0] = 0
         t0 = time.perf_counter()
@@ -389,6 +393,157 @@ def run_engine_mode(engine, docs, rows, args):
     if errors[0]:
         log(f"engine mode: {errors[0]} failed submits EXCLUDED from throughput")
     return total[0], measured[0], lat, None, None
+
+
+# ---------------------------------------------------------------------------
+# --open-loop: an honest OPEN-LOOP load generator (ISSUE 7).  The closed-loop
+# harnesses above structurally cannot create overload: every in-flight slot
+# waits for its completion before offering the next request, so offered load
+# self-throttles to capacity and queue growth is invisible (coordinated
+# omission).  Here arrivals are scheduled on a wall-clock timetable at a
+# fixed offered RPS (with burst/diurnal shapes and zipf key skew via
+# --key-repeat), latency is measured from each request's INTENDED arrival
+# time — the coordinated-omission correction — and typed rejections
+# (RESOURCE_EXHAUSTED / DEADLINE_EXCEEDED) are first-class outcomes, never
+# errors.  Goodput = completions inside --slo-ms.
+# ---------------------------------------------------------------------------
+
+
+def open_loop_offsets(rps, seconds, shape, burst_factor=2.0):
+    """Intended arrival offsets (seconds from start) for one open-loop
+    pass.  steady: constant rate; burst: alternating 1 s windows at base /
+    burst_factor x base (mean ≈ (1+f)/2 x base); diurnal: one sinusoidal
+    cycle between 0.5x and 1.5x across the pass."""
+    import math as _math
+
+    out = []
+    t = 0.0
+    while t < seconds:
+        if shape == "burst":
+            rate = rps * (burst_factor if int(t) % 2 else 1.0)
+        elif shape == "diurnal":
+            rate = rps * (1.0 + 0.5 * _math.sin(2 * _math.pi * t / seconds))
+        else:
+            rate = rps
+        out.append(t)
+        t += 1.0 / max(rate, 1e-9)
+    return out
+
+
+def run_engine_open_loop(engine, docs, rows, args, rps, seconds=None):
+    """Open-loop pass against PolicyEngine.submit at offered ``rps``.
+    Returns the overload artifact block: offered vs achieved RPS,
+    CO-corrected latency percentiles, typed-rejection counts (raw
+    exceptions counted separately and expected ZERO), in-SLO goodput, and
+    a sampled verdict-exactness check against the host expression rules."""
+    import asyncio
+
+    from authorino_tpu.utils.rpc import CheckAbort
+
+    seconds = seconds or args.seconds
+    slo_s = args.slo_ms / 1e3
+    deadline_s = (args.deadline_ms / 1e3) if args.deadline_ms else None
+    offsets = open_loop_offsets(rps, seconds, args.shape,
+                                args.burst_factor)
+    n_docs = len(docs)
+    # zipf key skew (--key-repeat): hot tenants/tokens repeat, exercising
+    # dedup/caching under overload exactly like the wire shaping does
+    if args.key_repeat:
+        import numpy as np
+
+        ranks = np.random.default_rng(11).zipf(args.key_repeat,
+                                               size=len(offsets))
+        order = [(int(r) - 1) % n_docs for r in ranks]
+    else:
+        order = None
+
+    lat_ok = []            # CO-corrected: completion - INTENDED arrival
+    gen_lag = []           # generator lateness: actual submit - intended
+    rejects = {}           # typed CheckAbort code -> count
+    raw_errors = [0]
+    exact = {"checked": 0, "mismatches": 0}
+    done_n = [0]
+
+    async def one(j, intended, seq):
+        try:
+            # deadline on the engine's clock (time.monotonic — perf_counter
+            # has an unrelated epoch on some platforms); latency math stays
+            # on perf_counter throughout
+            dl = (time.monotonic() + deadline_s) if deadline_s else None
+            rule, _ = await engine.submit(docs[j], f"cfg-{rows[j]}",
+                                          deadline=dl)
+        except CheckAbort as e:
+            rejects[e.code] = rejects.get(e.code, 0) + 1
+        except Exception:
+            raw_errors[0] += 1
+        else:
+            done_n[0] += 1
+            lat_ok.append(time.perf_counter() - intended)
+            if seq % 97 == 0:
+                # sampled exactness: the served verdict must equal the host
+                # expression rule — overload may shed, it must never
+                # approximate
+                exact["checked"] += 1
+                cond, expr = None, None
+                evs = args._configs[rows[j]].evaluators
+                cond, expr = evs[0]
+                want = bool(expr.matches(docs[j]))
+                if bool(rule[0]) != want:
+                    exact["mismatches"] += 1
+
+    async def run():
+        tasks = set()
+        t0 = time.perf_counter()
+        for seq, off in enumerate(offsets):
+            target = t0 + off
+            now = time.perf_counter()
+            if target > now:
+                await asyncio.sleep(target - now)
+            else:
+                gen_lag.append(now - target)
+            j = order[seq] if order is not None else seq % n_docs
+            t = asyncio.ensure_future(one(j, target, seq))
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        return time.perf_counter() - t0
+
+    elapsed = asyncio.run(run())
+    lat_ok.sort()
+    gen_lag.sort()
+
+    def pct(arr, q):
+        return round(arr[min(len(arr) - 1, int(len(arr) * q))] * 1e3, 3) \
+            if arr else None
+
+    in_slo = sum(1 for v in lat_ok if v <= slo_s)
+    offered = len(offsets) / seconds
+    code_names = {4: "DEADLINE_EXCEEDED", 8: "RESOURCE_EXHAUSTED",
+                  14: "UNAVAILABLE"}
+    block = {
+        "shape": args.shape,
+        "slo_ms": args.slo_ms,
+        "deadline_ms": args.deadline_ms or None,
+        "offered_rps": round(offered, 1),
+        "achieved_rps": round(done_n[0] / elapsed, 1),
+        "goodput_rps_in_slo": round(in_slo / elapsed, 1),
+        "co_corrected_p50_ms": pct(lat_ok, 0.5),
+        "co_corrected_p99_ms": pct(lat_ok, 0.99),
+        "rejected": {code_names.get(c, str(c)): n
+                     for c, n in sorted(rejects.items())},
+        "rejected_total": sum(rejects.values()),
+        "raw_exceptions": raw_errors[0],
+        "generator_lag_ms_p99": pct(gen_lag, 0.99) or 0.0,
+        "verdicts_exact_sampled": dict(exact),
+        "key_repeat": args.key_repeat or None,
+    }
+    log(f"open-loop [{args.shape}] offered={block['offered_rps']:,.0f} "
+        f"achieved={block['achieved_rps']:,.0f} "
+        f"goodput(SLO {args.slo_ms:.0f}ms)={block['goodput_rps_in_slo']:,.0f} "
+        f"rejected={block['rejected_total']} raw={raw_errors[0]} "
+        f"co-p99={block['co_corrected_p99_ms']}ms")
+    return block
 
 
 def build_wire_entries(args, provider_for):
@@ -452,7 +607,7 @@ def run_grpc_mode(args):
     external_auth_pb2 = protos.external_auth_pb2
     rng = random.Random(5)
 
-    engine = PolicyEngine(max_batch=args.batch, max_delay_s=args.window_us / 1e6)
+    engine = PolicyEngine(max_batch=args.batch)
     n_cfg = args.configs  # full north-star corpus on the wire path
     engine.apply_snapshot(build_wire_entries(args, engine.provider_for))
 
@@ -621,8 +776,7 @@ def run_native_mode(args):
     rng = random.Random(5)
     n_cfg = args.configs
 
-    engine = PolicyEngine(max_batch=args.batch, max_delay_s=args.window_us / 1e6,
-                          mesh=None)
+    engine = PolicyEngine(max_batch=args.batch, mesh=None)
     engine.apply_snapshot(build_wire_entries(args, engine.provider_for))
     maybe_verify_snapshot(args, engine=engine)
     B = min(args.batch, 4096)
@@ -872,6 +1026,13 @@ def run_native_mode(args):
         "rps_median": sorted(t["rps"] for t in trials_detail)[
             len(trials_detail) // 2] if trials_detail else None,
         "trials": trials_detail,
+        # the C++ loadgen is CLOSED-LOOP (fixed in-flight depth): offered
+        # load self-throttles to capacity, so these latencies are
+        # coordinated-omission-uncorrected and cannot stand in for
+        # open-loop numbers (bench --open-loop is the honest overload run)
+        "load_model": "closed-loop",
+        "coordinated_omission": "uncorrected (closed-loop: offered == "
+                                "achieved by construction)",
         "key_repeat": args.key_repeat or None,
         "lowerability": lowerability_block(engine=engine),
         "dedup_cache": {
@@ -1225,6 +1386,7 @@ def wire_trial(engine, payloads, args, label, wait_stat=None, sat=None):
         os.unlink(payload_path)
     return {
         "rps": round(ok_rps(best), 1),
+        "load_model": "closed-loop",
         "errors": int(best["errors"]),
         "sat_p50_ms": best["p50_ms"],
         "sat_p99_ms": best["p99_ms"],
@@ -1256,8 +1418,7 @@ def run_slowlane_mode(args):
     from authorino_tpu.runtime import EngineEntry, PolicyEngine
 
     rng = _random.Random(5)
-    engine = PolicyEngine(max_batch=args.batch,
-                          max_delay_s=args.window_us / 1e6, mesh=None)
+    engine = PolicyEngine(max_batch=args.batch, mesh=None)
     n = 100
     entries = []
     for i in range(n):
@@ -1324,8 +1485,7 @@ def run_mix_mode(args):
         return not selected or cls in selected
 
     def new_engine():
-        return PolicyEngine(max_batch=args.batch, max_delay_s=args.window_us / 1e6,
-                            mesh=None)
+        return PolicyEngine(max_batch=args.batch, mesh=None)
 
     def payload(host, headers=None, method="GET", path="/bench"):
         req = external_auth_pb2.CheckRequest()
@@ -1542,6 +1702,33 @@ def main():
     ap.add_argument("--classes", default="",
                     help="mix mode: comma-separated class filter (c1..c6); "
                          "empty = all")
+    ap.add_argument("--open-loop", default="",
+                    help="engine mode: run an OPEN-LOOP overload pass after "
+                         "the closed-loop trials — a number = offered RPS, "
+                         "'2x' = twice the measured sustainable (closed-"
+                         "loop median) rate.  Arrivals ride a wall-clock "
+                         "timetable; latency is coordinated-omission-"
+                         "corrected (measured from intended arrival); "
+                         "typed rejections are outcomes, not errors")
+    ap.add_argument("--shape", choices=["steady", "burst", "diurnal"],
+                    default="burst",
+                    help="open-loop traffic shape: steady rate; burst = "
+                         "alternating 1s windows of base and factor x base "
+                         "(the MEAN equals the requested rate); diurnal = "
+                         "one sinusoid cycle between 0.5x and 1.5x")
+    ap.add_argument("--burst-factor", type=float, default=2.0,
+                    help="burst shape: peak-to-base ratio of the "
+                         "alternating windows")
+    ap.add_argument("--slo-ms", type=float, default=250.0,
+                    help="open-loop goodput SLO: completions within this "
+                         "bound (CO-corrected) count as goodput")
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="open-loop: attach this per-request deadline so "
+                         "admission/shedding can reject doomed work typed "
+                         "DEADLINE_EXCEEDED (0 = no deadline)")
+    ap.add_argument("--admission-target-ms", type=float, default=50.0,
+                    help="open-loop engine: CoDel admission wait target "
+                         "fed to the engine under test")
     ap.add_argument("--key-repeat", type=float, default=0.0,
                     help="native mode: zipf exponent (> 1) shaping the wire "
                          "payload sequence so request keys REPEAT (hot "
@@ -1629,9 +1816,13 @@ def main():
             rng = random.Random(3)
             rows = [rng.randrange(args.configs) for _ in range(args.docs)]
             engine = build_engine(configs, args)
+            args._configs = configs  # open-loop exactness sampling
             maybe_verify_snapshot(args, engine=engine)
         chaos_before = None
-        if args.chaos and args.mode == "engine":
+        if args.chaos and args.mode == "engine" and not args.open_loop:
+            # with --open-loop the chaos window covers the OPEN-LOOP pass
+            # below instead: the closed-loop trials measure the clean
+            # sustainable rate the overload run is compared against
             from authorino_tpu.runtime import faults as faults_mod
 
             chaos_before = degradation_counters("engine")
@@ -1658,14 +1849,24 @@ def main():
             f"window={args.window_us}us rps={rps:,.0f} "
             f"request p50={p50:.2f}ms p99={p99:.2f}ms"
         )
+        rps_median = sorted(trial_rps)[len(trial_rps) // 2]
         detail = {
+            "platform": f"jax {jax.__version__} {jax.devices()}",
             "metric": f"check_rps_{args.mode}",
             "value": round(rps, 1),
             "unit": "req/s",
             "vs_baseline": round(rps / 100_000.0, 4),
             "request_p50_ms": round(p50, 3),
             "request_p99_ms": round(p99, 3),
+            "rps_median": rps_median,
             "trials": trial_rps,
+            # honest load-model labeling (ISSUE 7 satellite): closed-loop
+            # latencies are coordinated-omission-UNCORRECTED — offered load
+            # self-throttles to capacity, so these numbers cannot stand in
+            # for open-loop behavior (see the overload block / --open-loop)
+            "load_model": "closed-loop",
+            "coordinated_omission": "uncorrected (closed-loop: offered == "
+                                    "achieved by construction)",
         }
         if args.mode == "engine":
             dv = engine.debug_vars()
@@ -1673,6 +1874,7 @@ def main():
                 "inflight_peak": dv["inflight_peak"],
                 "max_inflight_batches": dv["max_inflight_batches"],
                 "dispatch_workers": dv["dispatch_workers"],
+                "adaptive": dv["adaptive"],
             }
             detail["lowerability"] = lowerability_block(engine=engine)
             if chaos_before is not None:
@@ -1684,6 +1886,60 @@ def main():
                     total=sum(int(r * args.seconds) for r in trial_rps) or None)
                 detail["degradation"]["p99_ms_under_faults"] = round(p99, 3)
                 log(f"degradation: {detail['degradation']}")
+            if args.open_loop:
+                # resolve the offered rate: a number, or '2x' the measured
+                # sustainable (closed-loop median) rate — burst shaping
+                # keeps the MEAN at the requested rate
+                if args.open_loop.lower().endswith("x"):
+                    base = rps_median * float(args.open_loop[:-1] or 2)
+                else:
+                    base = float(args.open_loop)
+                if args.shape == "burst":
+                    base = base / ((1.0 + args.burst_factor) / 2.0)
+                detail["sustainable_rps_closed_loop"] = rps_median
+                # tighten the admission gate for the overload pass: the
+                # closed-loop phase above needs its deliberately-deep
+                # in-flight window admitted (that IS its load model), the
+                # open-loop phase is where the wait-targeted cap must bind.
+                # The floor stays ≥ 2 batches: the engine cuts the WHOLE
+                # queue into one batch, so a queue cap below max_batch
+                # would silently bound batch occupancy (and throughput),
+                # not just wait
+                engine.admission.target_s = args.admission_target_ms / 1e3
+                engine.admission.min_cap = max(2 * args.batch, 64)
+                log(f"open-loop overload pass: base={base:,.0f} rps "
+                    f"({args.shape}) vs sustainable {rps_median:,.0f} "
+                    f"(admission target {args.admission_target_ms:.0f}ms)")
+                # unrecorded warm-up pass at the overload rate: the
+                # measured passes must not pay the cold pad-shape compiles
+                # the overload regime's batch cuts land on
+                log("open-loop warm-up pass (unrecorded)...")
+                run_engine_open_loop(engine, docs, rows, args, base,
+                                     seconds=min(4.0, args.seconds))
+                detail["overload"] = run_engine_open_loop(
+                    engine, docs, rows, args, base)
+                if args.chaos:
+                    from authorino_tpu.runtime import faults as faults_mod
+
+                    before = degradation_counters("engine")
+                    faults_mod.FAULTS.arm(args.chaos)
+                    log(f"chaos ARMED for the open-loop window: {args.chaos}")
+                    try:
+                        chaos_block = run_engine_open_loop(
+                            engine, docs, rows, args, base)
+                    finally:
+                        faults_mod.FAULTS.disarm()
+                    deg = degradation_block(args, "engine", before,
+                                            engine.breaker)
+                    chaos_block["degradation"] = deg
+                    goodput = chaos_block["goodput_rps_in_slo"]
+                    chaos_block["goodput_vs_sustainable"] = round(
+                        goodput / rps_median, 4) if rps_median else None
+                    detail["overload_chaos"] = chaos_block
+                dv = engine.debug_vars()
+                detail["admission"] = dv["admission"]
+                detail["adaptive"] = dv["adaptive"]
+                detail["brownout"] = dv["brownout"]
         print(json.dumps(detail))
         return
 

@@ -164,7 +164,7 @@ def resource(name="ac", namespace="tenant", spec=None, labels=None):
 class TestReconciler:
     def test_reconcile_status_and_serving(self):
         async def body():
-            engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+            engine = PolicyEngine(max_batch=4)
             cluster = make_cluster()
             rec = AuthConfigReconciler(engine, cluster=cluster)
             await rec.reconcile_all([resource()])
@@ -255,7 +255,7 @@ class TestReconciler:
 class TestSecretReconciler:
     def test_live_rotation_through_cluster_events(self):
         async def body():
-            engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+            engine = PolicyEngine(max_batch=4)
             cluster = make_cluster()
             rec = AuthConfigReconciler(engine, cluster=cluster)
             sec_rec = SecretReconciler(
@@ -317,7 +317,7 @@ class TestYamlSource:
             (tmp_path / "manifests.yaml").write_text(
                 yaml_mod.dump_all([resource(), secret], default_flow_style=False)
             )
-            engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+            engine = PolicyEngine(max_batch=4)
             cluster = InMemoryCluster()
             rec = AuthConfigReconciler(engine, cluster=cluster)
             sec_rec = SecretReconciler(
@@ -539,7 +539,7 @@ class TestWatchBookmarksAndStorms:
         from authorino_tpu.controllers.sources import K8sWatchSource
 
         async def body():
-            engine = PolicyEngine(max_batch=4, max_delay_s=0.0005)
+            engine = PolicyEngine(max_batch=4)
             swaps = [0]
             engine.add_swap_listener(lambda: swaps.__setitem__(0, swaps[0] + 1))
             rec = AuthConfigReconciler(engine)
@@ -629,7 +629,7 @@ class TestTopLevelWhenFolding:
         keeps the kernel fast lane (round 4)."""
         from authorino_tpu.runtime.native_frontend import fast_lane_eligible
 
-        engine = PolicyEngine(max_batch=8, max_delay_s=0.0005, mesh=None)
+        engine = PolicyEngine(max_batch=8, mesh=None)
         spec = {
             "hosts": ["gated.test"],
             "when": [{"selector": "request.method",
@@ -663,7 +663,7 @@ class TestTopLevelWhenFolding:
         """Folding is only sound for anonymous identities: a skipped
         pipeline must allow credential-less requests, which the credential
         fast lane could not honor — the gate stays on the pipeline."""
-        engine = PolicyEngine(max_batch=8, max_delay_s=0.0005)
+        engine = PolicyEngine(max_batch=8)
         cluster = InMemoryCluster()
         cluster.put_secret(Secret(name="k", namespace="t",
                                   labels={"g": "w"}, data={"api_key": b"s3"}))
@@ -701,7 +701,7 @@ class TestTopLevelWhenFolding:
         (missing selector → "") and runs the deny rules — after folding it
         would be unmatched and ALLOW, a fail-open divergence.  Any
         auth.*-rooted selector keeps the gate on the pipeline."""
-        engine = PolicyEngine(max_batch=8, max_delay_s=0.0005, mesh=None)
+        engine = PolicyEngine(max_batch=8, mesh=None)
         spec = {
             "hosts": ["gated-auth.test"],
             "when": [{"selector": "auth.identity.anonymous",
@@ -727,7 +727,7 @@ class TestTopLevelWhenFolding:
 
     def test_nested_auth_rooted_gate_does_not_fold(self):
         """auth.* detection must walk nested And/Or gate trees."""
-        engine = PolicyEngine(max_batch=8, max_delay_s=0.0005, mesh=None)
+        engine = PolicyEngine(max_batch=8, mesh=None)
         spec = {
             "hosts": ["gated-nest.test"],
             "patterns": {"who": [
@@ -748,7 +748,7 @@ class TestTopLevelWhenFolding:
         """A conditional anonymous identity could turn gate-unmatched
         requests from skip-OK into 401 under the fold — the gate must stay
         on the pipeline."""
-        engine = PolicyEngine(max_batch=8, max_delay_s=0.0005, mesh=None)
+        engine = PolicyEngine(max_batch=8, mesh=None)
         spec = {
             "hosts": ["gated-cond.test"],
             "when": [{"selector": "request.method",

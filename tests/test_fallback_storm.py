@@ -36,7 +36,7 @@ RULE = All(
 
 
 def build_engine(mesh, **kw) -> PolicyEngine:
-    engine = PolicyEngine(max_batch=64, max_delay_s=0.0005, members_k=4,
+    engine = PolicyEngine(max_batch=64, members_k=4,
                           mesh=mesh, **kw)
     engine.apply_snapshot([
         EngineEntry(id="c", hosts=["c"], runtime=None,

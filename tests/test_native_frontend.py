@@ -67,7 +67,7 @@ def make_pattern_entry(engine, cfg_id, hosts, rule, cond=None, deny_with=None):
 
 
 def build_engine() -> PolicyEngine:
-    engine = PolicyEngine(max_batch=64, max_delay_s=0.0005, mesh=None)
+    engine = PolicyEngine(max_batch=64, mesh=None)
 
     def pattern_entry(i, cfg_id, hosts, rule, cond=None, deny_with=None):
         return make_pattern_entry(engine, cfg_id, hosts, rule, cond, deny_with)
@@ -320,7 +320,7 @@ def test_sharded_engine_serves_fast_lane():
 
     if len(jax.devices()) < 2:
         pytest.skip("needs the virtual multi-device mesh")
-    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh="auto")
+    engine = PolicyEngine(max_batch=16, mesh="auto")
     entries = []
     # enough configs to land on several mp shards, incl. a device-DFA regex
     for i in range(10):
@@ -454,7 +454,7 @@ def run_fake_idp():
 def _oidc_engine(idp):
     from authorino_tpu.evaluators.identity import OIDC
 
-    engine = PolicyEngine(max_batch=32, max_delay_s=0.0005, mesh=None)
+    engine = PolicyEngine(max_batch=32, mesh=None)
     oidc = OIDC("kc", idp.issuer)
     rule = Pattern("auth.identity.realm_access.roles", Operator.INCL, "admin")
     pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/oidc"),
@@ -615,7 +615,7 @@ def test_multi_identity_or_fast_lane():
     try:
         from authorino_tpu.evaluators.identity import OIDC
 
-        engine = PolicyEngine(max_batch=32, max_delay_s=0.0005, mesh=None)
+        engine = PolicyEngine(max_batch=32, mesh=None)
         ak = APIKey("api-users", LabelSelector.from_spec(
             {"matchLabels": {"g": "multi"}}),
             credentials=AuthCredentials(key_selector="APIKEY"))
@@ -713,7 +713,7 @@ def test_response_templates_ride_fast_lane():
     try:
         from authorino_tpu.evaluators.identity import OIDC
 
-        engine = PolicyEngine(max_batch=32, max_delay_s=0.0005, mesh=None)
+        engine = PolicyEngine(max_batch=32, mesh=None)
         ak = APIKey("keys", LabelSelector.from_spec({"matchLabels": {"g": "rt"}}),
                     credentials=AuthCredentials(key_selector="APIKEY"))
         ak.add_k8s_secret_based_identity(Secret(
@@ -817,7 +817,7 @@ def test_identity_extensions_ride_fast_lane():
     from authorino_tpu.evaluators.base import IdentityExtension
     from authorino_tpu.evaluators.response import Plain
 
-    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    engine = PolicyEngine(max_batch=16, mesh=None)
     ak = APIKey("keys", LabelSelector.from_spec({"matchLabels": {"g": "ext"}}),
                 credentials=AuthCredentials(key_selector="APIKEY"))
     ak.add_k8s_secret_based_identity(Secret(
@@ -928,7 +928,7 @@ def test_per_request_features_stay_slow():
     from authorino_tpu.evaluators.base import IdentityExtension
     from authorino_tpu.evaluators.response import Plain
 
-    engine = PolicyEngine(max_batch=8, max_delay_s=0.0005, mesh=None)
+    engine = PolicyEngine(max_batch=8, mesh=None)
 
     def entry_with(response=None, exts=None):
         rule = Pattern("request.method", Operator.NEQ, "DELETE")
@@ -974,7 +974,7 @@ def test_oauth2_cache_opt_in_rides_fast_lane():
     holder, t = run_fake_idp()
     idp = holder["idp"]
     try:
-        engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+        engine = PolicyEngine(max_batch=16, mesh=None)
         url = f"{idp.issuer}/introspect"
         no_cache = OAuth2("oa", url, "cid", "csec")
         cached = OAuth2("oa", url, "cid", "csec")
@@ -1053,7 +1053,7 @@ def test_k8s_tokenreview_cache_opt_in_rides_fast_lane():
         "authenticated": True,
         "user": {"username": "system:serviceaccount:ns:app",
                  "groups": ["system:authenticated"]}}}
-    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    engine = PolicyEngine(max_batch=16, mesh=None)
     ka = KubernetesAuth("k8s", audiences=["talker-api"], cluster=cluster)
     rule = Pattern("auth.identity.username", Operator.EQ,
                    "system:serviceaccount:ns:app")
@@ -1114,7 +1114,7 @@ def test_identity_templated_deny_rides_fast_lane():
     still route slow."""
     from google.protobuf.json_format import MessageToDict
 
-    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    engine = PolicyEngine(max_batch=16, mesh=None)
     ak = APIKey("keys", LabelSelector.from_spec({"matchLabels": {"g": "dt"}}),
                 credentials=AuthCredentials(key_selector="APIKEY"))
     ak.add_k8s_secret_based_identity(Secret(
@@ -1183,7 +1183,7 @@ def test_hybrid_lane_procedural_rego():
     full phase (∧-verdict, so re-deciding covered patterns is correct).
     The reference evaluates OPA inline in the same server
     (ref pkg/evaluators/authorization/opa.go:86-117)."""
-    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    engine = PolicyEngine(max_batch=16, mesh=None)
     rule = Pattern("request.headers.x-tier", Operator.EQ, "gold")
     pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/hyb"),
                          evaluator_slot=0)
@@ -1258,7 +1258,7 @@ def test_hybrid_priority_order_guard():
     """Kernel pre-deny must not preempt an uncovered evaluator the pipeline
     would have failed in an EARLIER priority bucket (its denial could
     differ) — such configs stay fully slow."""
-    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    engine = PolicyEngine(max_batch=16, mesh=None)
     rule = Pattern("request.headers.x-tier", Operator.EQ, "gold")
     pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/hp"),
                          evaluator_slot=0)
@@ -1282,7 +1282,7 @@ def test_hybrid_allows_arbitrary_responses():
     from authorino_tpu.evaluators import ResponseConfig
     from authorino_tpu.evaluators.response import Plain
 
-    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    engine = PolicyEngine(max_batch=16, mesh=None)
     rule = Pattern("request.headers.x-tier", Operator.EQ, "gold")
     pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/hr"),
                          evaluator_slot=0)
@@ -1341,7 +1341,7 @@ def test_stop_drains_inflight_slow_requests():
             await asyncio.sleep(1.0)
             return {}
 
-    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    engine = PolicyEngine(max_batch=16, mesh=None)
     engine.apply_snapshot([EngineEntry(
         id="ns/sleepy2", hosts=["sleepy2.test"],
         runtime=RuntimeAuthConfig(
@@ -1390,7 +1390,7 @@ def test_mtls_fast_lane_cert_cache():
         "mtls", LabelSelector.parse("app=mtls"), cluster=cluster)
     asyncio.run(mtls.load_secrets())
 
-    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    engine = PolicyEngine(max_batch=16, mesh=None)
     rule = Pattern("auth.identity.Organization", Operator.EQ, "acme")
     pm = PatternMatching(rule, batched_provider=engine.provider_for("ns/mtls"),
                          evaluator_slot=0)
@@ -1477,7 +1477,7 @@ def test_slow_lane_no_head_of_line_blocking():
             await asyncio.sleep(2.5)
             return {}
 
-    engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+    engine = PolicyEngine(max_batch=16, mesh=None)
     entries = [
         EngineEntry(
             id="ns/sleepy", hosts=["sleepy.test"],

@@ -48,7 +48,7 @@ RULE = All(
 
 
 def build_engine(**kw) -> PolicyEngine:
-    engine = PolicyEngine(max_batch=32, max_delay_s=0.0005, members_k=4,
+    engine = PolicyEngine(max_batch=32, members_k=4,
                           mesh=None, **kw)
     engine.apply_snapshot([
         EngineEntry(id="c", hosts=["c"], runtime=None,

@@ -1,0 +1,686 @@
+"""Overload resilience (ISSUE 7): CoDel-style admission control, the
+adaptive window/batch-cut controller, host-lane brownout, and
+drain-under-overload — traffic failure must degrade throughput with typed
+rejections, never correctness and never a raw exception.
+
+Deliberately import-light: collects on images without `cryptography`
+(no evaluators.identity / native_frontend imports)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from authorino_tpu.compiler import ConfigRules
+from authorino_tpu.expressions import All, Operator, Pattern
+from authorino_tpu.runtime import EngineEntry, PolicyEngine
+from authorino_tpu.runtime import engine as engine_mod
+from authorino_tpu.runtime import faults
+from authorino_tpu.runtime.admission import (
+    ADMIT,
+    OVERLOADED,
+    AdaptiveWindow,
+    AdmissionController,
+    R_DOOMED,
+    R_OVERLOAD,
+    R_QUEUE_FULL,
+)
+from authorino_tpu.utils.rpc import (
+    DEADLINE_EXCEEDED,
+    RESOURCE_EXHAUSTED,
+    CheckAbort,
+    http_status_for,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.FAULTS.disarm()
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def sample(name, labels=None):
+    from prometheus_client import REGISTRY
+
+    v = REGISTRY.get_sample_value(name, labels or {})
+    return 0.0 if v is None else v
+
+
+RULE = All(
+    Pattern("auth.identity.roles", Operator.INCL, "admin"),
+    Pattern("auth.identity.groups", Operator.EXCL, "banned"),
+)
+
+
+def build_engine(**kw) -> PolicyEngine:
+    kw.setdefault("verdict_cache_size", 0)
+    kw.setdefault("max_batch", 8)
+    engine = PolicyEngine(members_k=4, mesh=None, **kw)
+    engine.apply_snapshot([
+        EngineEntry(id="c", hosts=["c"], runtime=None,
+                    rules=ConfigRules(name="c", evaluators=[(None, RULE)]))
+    ])
+    return engine
+
+
+def doc(i: int, allow: bool) -> dict:
+    return {"auth": {"identity": {
+        "roles": ["admin", f"r{i}"] if allow else [f"r{i}"],
+        "groups": []}}}
+
+
+async def submit_all(engine, docs, **kw):
+    outs = await asyncio.gather(
+        *(engine.submit(d, "c", **kw) for d in docs))
+    return [bool(rule[0]) for rule, _ in outs]
+
+
+class FakeHandle:
+    def __init__(self, ready_at):
+        self.ready_at = ready_at
+
+    def is_ready(self):
+        return time.monotonic() >= self.ready_at
+
+    def __array__(self, dtype=None):
+        return np.zeros((1, 1))
+
+
+class SlowStubDevice:
+    """Replaces _encode_and_launch: batches 'complete' after a fixed
+    latency, so the window can be held saturated deterministically."""
+
+    def __init__(self, engine, latency_s):
+        self.engine = engine
+        self.latency_s = latency_s
+        self.launched_batches = 0
+        self.launched_rows = 0
+        engine._encode_and_launch = self._launch
+
+    def _launch(self, snap, batch):
+        n = len(batch)
+        self.launched_batches += 1
+        self.launched_rows += n
+        binfo = {"batch_size": n, "pad": n, "eff": 0,
+                 "start_ns": time.time_ns(), "duration_s": 0.0}
+
+        def finalize(packed):
+            rule = np.ones((n, 1), dtype=bool)
+            return rule, np.zeros((n, 1), dtype=bool), None
+
+        return engine_mod._Inflight(
+            self.engine, batch,
+            FakeHandle(time.monotonic() + self.latency_s),
+            finalize, binfo, np.zeros(n))
+
+
+# ---------------------------------------------------------------------------
+# admission controller units
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionController:
+    def test_cold_start_floor_admits_bursts(self):
+        a = AdmissionController("t-cold", target_s=0.05, min_cap=128)
+        assert a.admit(0) is None
+        assert a.admit(127) is None
+        code, reason = a.admit(128)
+        assert code == RESOURCE_EXHAUSTED and reason == R_OVERLOAD
+        assert http_status_for(code) == 429
+
+    def test_wait_targeted_cap_follows_service_rate(self):
+        a = AdmissionController("t-rate", target_s=0.1, min_cap=10)
+        # 10k rows over 1s → rate ≈ 10k/s → cap ≈ 1000 (0.1s of work)
+        a.observe_service(0, now=100.0)
+        a.observe_service(10_000, now=101.0)
+        cap = a.effective_cap()
+        assert 500 <= cap <= 2000
+        assert a.admit(cap - 1) is None
+        code, reason = a.admit(cap)
+        assert code == RESOURCE_EXHAUSTED and reason == R_OVERLOAD
+
+    def test_hard_queue_cap_reason(self):
+        a = AdmissionController("t-hard", target_s=10.0, queue_cap=16,
+                                min_cap=4)
+        code, reason = a.admit(16)
+        assert code == RESOURCE_EXHAUSTED and reason == R_QUEUE_FULL
+
+    def test_doomed_deadline_rejected_at_admission(self):
+        a = AdmissionController("t-doom", target_s=0.05, min_cap=1000)
+        a.observe_service(0, now=10.0)
+        a.observe_service(1000, now=11.0)  # rate ≈ 1000/s
+        now = 50.0
+        # 500 queued at 1000/s → ~0.5s predicted wait; a 0.1s deadline
+        # budget is doomed, a 5s one is fine
+        code, reason = a.admit(500, now=now, deadline=now + 0.1)
+        assert code == DEADLINE_EXCEEDED and reason == R_DOOMED
+        assert a.admit(500, now=now, deadline=now + 5.0) is None
+
+    def test_codel_state_flips_on_standing_min_wait(self):
+        a = AdmissionController("t-codel", target_s=0.05, interval_s=0.5)
+        assert a.state == ADMIT
+        # min wait above target, sustained past one interval → OVERLOADED
+        a.observe_waits([0.2, 0.3], now=1.0)
+        assert a.state == ADMIT  # not sustained yet
+        a.observe_waits([0.2], now=1.3)
+        a.observe_waits([0.2], now=1.6)
+        assert a.state == OVERLOADED
+        # one batch whose MIN dips under target = the standing queue broke
+        a.observe_waits([0.01, 0.4], now=1.7)
+        assert a.state == ADMIT
+
+    def test_transient_spike_never_flips_state(self):
+        a = AdmissionController("t-spike", target_s=0.05, interval_s=0.5)
+        # a single high-wait batch inside the interval, then clean batches
+        a.observe_waits([0.3], now=1.0)
+        a.observe_waits([0.001], now=1.2)
+        a.observe_waits([0.001], now=1.9)
+        assert a.state == ADMIT
+
+    def test_drop_pacing_and_idle_decay(self):
+        a = AdmissionController("t-drop", target_s=0.05, interval_s=0.5)
+        for t in (1.0, 1.3, 1.6):
+            a.observe_waits([0.2], now=t)
+        assert a.state == OVERLOADED
+        assert a.drop_now(now=1.61) is True        # first paced drop
+        assert a.drop_now(now=1.62) is False       # inside the pacing gap
+        assert a.drop_now(now=1.61 + 0.51) is True  # next interval
+        # no wait observations for 2 intervals → the load vanished: the
+        # stale OVERLOADED flag must not drop the next quiet-period burst
+        assert a.drop_now(now=5.0) is False
+        assert a.state == ADMIT
+
+
+# ---------------------------------------------------------------------------
+# adaptive window controller units
+# ---------------------------------------------------------------------------
+
+
+class TestAdaptiveWindow:
+    def drive(self, c, rtt, rate, cut, rounds=40, depth=0):
+        t = 100.0
+        per = max(1, int(rate * 0.1))
+        for _ in range(rounds):
+            c.observe_arrivals(per)
+            t += 0.1
+            c.observe_batch(rtt, cut, depth, now=t)
+
+    def test_starts_at_cap_and_shrinks_when_idle(self):
+        c = AdaptiveWindow("t-start", cap=48, batch_cap=256)
+        assert c.window == 48  # cold burst is never window-starved
+        self.drive(c, rtt=0.001, rate=100, cut=8, rounds=60, depth=0)
+        assert 1 <= c.window < 48  # light load returned device memory
+
+    def test_converges_on_rtt_step_change(self):
+        c = AdaptiveWindow("t-step", cap=48, batch_cap=256)
+        # settle at a fast device first: queue clear, Little target ≈ 2
+        self.drive(c, rtt=0.005, rate=2000, cut=50, rounds=80, depth=0)
+        low = c.window
+        assert low <= 5
+        # device RTT steps 0.005 → 0.5 and a backlog forms: the controller
+        # must open the window back up (work-conserving), never sit at the
+        # light-load operating point while the queue stands
+        self.drive(c, rtt=0.5, rate=2000, cut=50, rounds=40, depth=4)
+        assert c.window > low
+        assert c.window == 48  # backlog standing → the full cap
+        assert c.batch_cut == 256  # full cuts amortize the deeper RTT
+        # and step back down once the RTT recovers and the queue clears:
+        # Little target = ceil(2000 × 0.005 / 50) + 1 = 2
+        self.drive(c, rtt=0.005, rate=2000, cut=50, rounds=120, depth=0)
+        assert c.window <= 6
+
+    def test_backlog_never_tracks_the_achieved_rate_fixed_point(self):
+        """The failure mode the law exists to avoid: under saturation the
+        measured arrival rate equals the achieved rate, so a Little's-law
+        tracker would pin a tiny window forever.  With a backlog standing
+        the window must GROW regardless of the (self-limited) rate."""
+        c = AdaptiveWindow("t-fixedpoint", cap=48, batch_cap=512)
+        # a self-consistent low point: rate 350, rtt 0.1, cut 20 → Little
+        # target 3 — but the queue never clears
+        self.drive(c, rtt=0.1, rate=350, cut=20, rounds=5, depth=1000)
+        assert c.window == 48
+        assert c.batch_cut == 512
+
+    def test_disabled_controller_pins_the_cap(self):
+        c = AdaptiveWindow("t-off", cap=7, batch_cap=32, enabled=False)
+        self.drive(c, rtt=0.001, rate=10, cut=4, rounds=30, depth=0)
+        assert c.window == 7 and c.batch_cut == 32
+
+    @pytest.mark.perf_guard
+    def test_window_and_cut_bounds_hold_under_adversarial_feeds(self):
+        """perf_guard invariant (ISSUE 7 satellite): whatever the
+        observations — junk RTTs, absurd rates, zero everything — the
+        controller NEVER leaves [1, cap] / [1, batch_cap]."""
+        import random as _random
+
+        rng = _random.Random(7)
+        c = AdaptiveWindow("t-bounds", cap=48, batch_cap=256)
+        t = 0.0
+        feeds = [0.0, -1.0, float("inf"), float("nan"), 1e9, 1e-9]
+        for i in range(500):
+            c.observe_arrivals(rng.randrange(0, 100_000))
+            t += rng.choice([0.0, 0.01, 0.5, 10.0])
+            c.observe_batch(rng.choice(feeds), rng.randrange(-5, 10_000),
+                            rng.randrange(0, 100), now=t)
+            assert 1 <= c.window <= 48
+            assert 1 <= c.batch_cut <= 256
+        assert 1 <= c.window <= 48
+
+
+# ---------------------------------------------------------------------------
+# engine lane: admission before encode
+# ---------------------------------------------------------------------------
+
+
+class TestEngineAdmission:
+    def test_saturated_window_rejects_typed_before_encode(self):
+        """Acceptance: with the window saturated and the queue at the
+        admission cap, further submits fail typed RESOURCE_EXHAUSTED at
+        admission — the stub device proves the rejected requests never
+        reached an encode."""
+        engine = build_engine(max_batch=4, max_inflight_batches=1,
+                              admission_queue_cap=8, brownout=False)
+        stub = SlowStubDevice(engine, latency_s=30.0)
+        rej0 = sample("auth_server_admission_rejected_total",
+                      {"lane": "engine", "reason": "queue-full"})
+
+        async def scenario():
+            tasks = [asyncio.ensure_future(engine.submit(doc(i, True), "c"))
+                     for i in range(4)]
+            await asyncio.sleep(0.05)  # one batch launches, window = 1/1
+            # fill the queue to the hard cap, then overflow it
+            extra = [asyncio.ensure_future(engine.submit(doc(10 + i, True), "c"))
+                     for i in range(8)]
+            await asyncio.sleep(0.02)
+            rejected = []
+            for i in range(5):
+                try:
+                    await engine.submit(doc(50 + i, True), "c")
+                except CheckAbort as e:
+                    rejected.append(e)
+            for t in tasks + extra:
+                t.cancel()
+            return rejected
+
+        rejected = run(scenario())
+        assert len(rejected) == 5
+        assert all(e.code == RESOURCE_EXHAUSTED for e in rejected)
+        assert all(http_status_for(e.code) == 429 for e in rejected)
+        assert sample("auth_server_admission_rejected_total",
+                      {"lane": "engine", "reason": "queue-full"}) == rej0 + 5
+        # only the one window batch ever encoded: rejected work cost nothing
+        assert stub.launched_batches == 1
+
+    def test_doomed_deadline_rejected_at_admission_before_encode(self):
+        engine = build_engine(max_batch=4, brownout=False)
+        stub = SlowStubDevice(engine, latency_s=30.0)
+        engine._device_ewma = 5.0  # one expected device round trip = 5s
+        shed0 = sample("auth_server_deadline_shed_total", {"lane": "engine"})
+        doom0 = sample("auth_server_admission_rejected_total",
+                       {"lane": "engine", "reason": "doomed-deadline"})
+
+        async def one():
+            with pytest.raises(CheckAbort) as ei:
+                await engine.submit(doc(0, True), "c",
+                                    deadline=time.monotonic() + 1.0)
+            return ei.value
+
+        e = run(one())
+        assert e.code == DEADLINE_EXCEEDED
+        assert http_status_for(e.code) == 504
+        # counted as BOTH an admission rejection and a deadline shed (it is
+        # one — just before the queue instead of at the batch cut)
+        assert sample("auth_server_admission_rejected_total",
+                      {"lane": "engine", "reason": "doomed-deadline"}) \
+            == doom0 + 1
+        assert sample("auth_server_deadline_shed_total",
+                      {"lane": "engine"}) == shed0 + 1
+        assert stub.launched_batches == 0  # never encoded, never launched
+
+    def test_admission_precheck_front_door(self):
+        engine = build_engine(brownout=False)
+        # force OVERLOADED with recent observations + a device RTT that
+        # dooms a tight deadline at the front door
+        now = time.monotonic()
+        for dt in (0.0, 0.4, 0.8):
+            engine.admission.observe_waits([0.5], now=now + dt)
+        assert engine.admission.overloaded
+        engine._device_ewma = 5.0
+        res = engine.admission_precheck(deadline=time.monotonic() + 0.01)
+        assert res is not None and res.code == DEADLINE_EXCEEDED
+        # a request with no deadline is never front-door rejected
+        assert engine.admission_precheck(deadline=None) is None
+
+    def test_precheck_hard_cap_and_consistency_with_admit(self):
+        a = AdmissionController("t-pre", target_s=0.05, queue_cap=8,
+                                min_cap=4)
+        code, reason = a.precheck(8)
+        assert code == RESOURCE_EXHAUSTED and reason == R_QUEUE_FULL
+        # below the hard cap and not overloaded: precheck never rejects
+        # (even where admit's dynamic cap would) — the submit gate stays
+        # the one true admission point
+        assert a.precheck(6) is None
+
+    def test_idle_engine_unlatches_overloaded_on_next_decision(self):
+        a = AdmissionController("t-idle", target_s=0.05, interval_s=0.5)
+        for t in (1.0, 1.4, 1.8):
+            a.observe_waits([0.5], now=t)
+        assert a.state == OVERLOADED
+        # the load vanished: the next admission decision (2x interval
+        # later) clears the stale flag instead of dooming the burst
+        # (deadline comfortably past the stale wait EWMA, which only
+        # decays with fresh observations)
+        assert a.admit(0, now=10.0, deadline=11.0, rtt_s=0.0) is None
+        assert a.state == ADMIT
+
+    def test_max_delay_s_is_a_deprecated_shim(self):
+        with pytest.warns(DeprecationWarning):
+            engine = build_engine(max_delay_s=0.123)
+        assert engine.max_delay_s == 0.123  # echoed for /debug/vars only
+        assert run(submit_all(engine, [doc(0, True)])) == [True]
+
+
+# ---------------------------------------------------------------------------
+# brownout: exact host-lane spill under saturation
+# ---------------------------------------------------------------------------
+
+
+class TestBrownout:
+    def test_brownout_verdicts_bit_identical_to_oracle(self):
+        """Acceptance: with the device window saturated, queued requests
+        spill to the host lane and their verdicts are EXACT — including the
+        membership-overflow rows the compact device payload is lossy for."""
+        engine = build_engine(max_batch=4, max_inflight_batches=1,
+                              admission_target_s=0.001,
+                              brownout_max_batch=16)
+        stub = SlowStubDevice(engine, latency_s=0.8)
+        b0 = sample("auth_server_brownout_decisions_total",
+                    {"lane": "engine"})
+        over = {"auth": {"identity": {
+            "roles": [f"r{k}" for k in range(10)] + ["admin"],
+            "groups": []}}}
+        docs = [doc(i, i % 3 != 0) for i in range(9)] + [over]
+        expected = [RULE.matches(d) for d in docs]
+
+        async def scenario():
+            first = asyncio.ensure_future(engine.submit(doc(100, True), "c"))
+            await asyncio.sleep(0.02)  # window (1) saturated by the stub
+            queued = [asyncio.ensure_future(engine.submit(d, "c"))
+                      for d in docs]
+            await asyncio.sleep(0.05)  # head-of-queue age passes target/2
+            trigger = asyncio.ensure_future(engine.submit(doc(101, True), "c"))
+            out = await asyncio.wait_for(asyncio.gather(*queued), timeout=5)
+            await asyncio.gather(first, trigger)
+            return [bool(r[0]) for r, _ in out]
+
+        assert run(scenario()) == expected
+        assert sample("auth_server_brownout_decisions_total",
+                      {"lane": "engine"}) >= b0 + len(docs)
+        assert engine._brownout_total >= len(docs)
+        # brownout is not a device failure: breaker untouched, nothing
+        # counted as degraded
+        assert engine.breaker.state == "closed"
+        # the saturating batch still rode the (stub) device
+        assert stub.launched_batches >= 1
+
+    def test_brownout_rescues_deadlines_the_device_could_not_meet(self):
+        """The brownout shed horizon is 0, not the device RTT: a deadline
+        the DEVICE's inflated round trip could not meet is exactly what the
+        microsecond host lane exists to rescue — it must be SERVED, not
+        shed DEADLINE_EXCEEDED."""
+        engine = build_engine(max_batch=4, max_inflight_batches=1,
+                              admission_target_s=0.001,
+                              brownout_max_batch=16)
+        SlowStubDevice(engine, latency_s=0.8)
+
+        async def scenario():
+            first = asyncio.ensure_future(engine.submit(doc(100, True), "c"))
+            await asyncio.sleep(0.02)  # window (1) saturated
+            queued = [asyncio.ensure_future(
+                engine.submit(doc(i, True), "c",
+                              deadline=time.monotonic() + 1.0))
+                for i in range(4)]
+            await asyncio.sleep(0.05)
+            # the device RTT estimate inflates AFTER they queued: their 1s
+            # deadlines are now inside one device round trip
+            engine._device_ewma = 5.0
+            trigger = asyncio.ensure_future(engine.submit(doc(101, True), "c"))
+            out = await asyncio.wait_for(asyncio.gather(*queued), timeout=5)
+            await asyncio.gather(first, trigger)
+            return [bool(r[0]) for r, _ in out]
+
+        assert run(scenario()) == [True] * 4
+        assert engine._brownout_total >= 4
+
+    def test_brownout_off_keeps_requests_queued(self):
+        engine = build_engine(max_batch=4, max_inflight_batches=1,
+                              admission_target_s=0.001, brownout=False)
+        SlowStubDevice(engine, latency_s=0.3)
+
+        async def scenario():
+            tasks = [asyncio.ensure_future(engine.submit(doc(i, True), "c"))
+                     for i in range(8)]
+            await asyncio.sleep(0.1)
+            # nothing spilled: exactly one batch in flight, rest queued
+            assert engine._brownout_total == 0
+            out = await asyncio.wait_for(asyncio.gather(*tasks), timeout=5)
+            return out
+
+        out = run(scenario())
+        assert len(out) == 8
+
+    def test_brownout_concurrency_is_bounded(self):
+        engine = build_engine(max_batch=2, max_inflight_batches=1,
+                              admission_target_s=0.001,
+                              brownout_max_batch=2)
+        SlowStubDevice(engine, latency_s=0.5)
+        assert engine._brownout_limit >= 1
+
+        async def scenario():
+            tasks = [asyncio.ensure_future(engine.submit(doc(i, True), "c"))
+                     for i in range(30)]
+            peak = 0
+            for _ in range(50):
+                await asyncio.sleep(0.01)
+                peak = max(peak, engine._brownout_inflight)
+            await asyncio.wait_for(
+                asyncio.gather(*tasks), timeout=10)
+            return peak
+
+        peak = run(scenario())
+        assert peak <= engine._brownout_limit
+        assert engine._brownout_inflight == 0
+
+
+# ---------------------------------------------------------------------------
+# adaptive controller end to end: slow-device step change
+# ---------------------------------------------------------------------------
+
+
+class TestAdaptiveIntegration:
+    def test_controller_rides_a_slow_device_rtt_step(self):
+        """faults.py slow-device inflates the measured round trip (the
+        delay rides the readback handle, not the encode worker) and the
+        controller grows the window to keep offered load in flight."""
+        engine = build_engine(max_batch=8, max_inflight_batches=16)
+        faults.FAULTS.arm("kernel:delay:delay=0.08")
+
+        async def sustained(seconds):
+            stop_at = time.monotonic() + seconds
+            sem = asyncio.Semaphore(64)
+            tasks = set()
+
+            async def one(i):
+                try:
+                    await engine.submit(doc(i % 50, True), "c")
+                finally:
+                    sem.release()
+
+            i = 0
+            while time.monotonic() < stop_at:
+                await sem.acquire()
+                t = asyncio.ensure_future(one(i))
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+                i += 1
+            if tasks:
+                await asyncio.gather(*tasks, return_exceptions=True)
+
+        run(sustained(1.5))
+        c = engine.controller
+        # the injected readback delay is VISIBLE as device RTT…
+        assert c.rtt_ewma >= 0.05
+        assert engine._device_ewma >= 0.05
+        # …and the window grew off its light-load floor to cover it, while
+        # never leaving the clamp (64 in flight / 8 per batch → target ~8)
+        assert 3 <= c.window <= 16
+
+    def test_window_gauge_matches_controller(self):
+        engine = build_engine(max_batch=8, max_inflight_batches=12)
+        run(submit_all(engine, [doc(i, True) for i in range(8)]))
+        assert sample("auth_server_adaptive_window",
+                      {"lane": "engine"}) == engine.controller.window
+
+
+# ---------------------------------------------------------------------------
+# drain under overload
+# ---------------------------------------------------------------------------
+
+
+class TestDrainUnderOverload:
+    def test_drain_resolves_backlog_including_brownout_jobs(self):
+        engine = build_engine(max_batch=4, max_inflight_batches=1,
+                              admission_target_s=0.001)
+        SlowStubDevice(engine, latency_s=0.15)
+
+        async def scenario():
+            tasks = [asyncio.ensure_future(engine.submit(doc(i, True), "c"))
+                     for i in range(24)]
+            await asyncio.sleep(0.05)
+            loop = asyncio.get_running_loop()
+            drained = await loop.run_in_executor(None, engine.drain, 5.0)
+            done = await asyncio.gather(*tasks, return_exceptions=True)
+            return drained, done
+
+        drained, done = run(scenario())
+        assert drained is True
+        assert engine._brownout_inflight == 0 and engine._inflight == 0
+        assert all(not isinstance(r, Exception) for r in done)
+
+    def test_drain_under_overload_stays_bounded_by_timeout(self):
+        """A wedged device under a standing backlog: drain() must give up
+        within its timeout — the recovery path never becomes the hang."""
+        engine = build_engine(max_batch=4, max_inflight_batches=1,
+                              brownout=False)
+        SlowStubDevice(engine, latency_s=60.0)
+
+        async def scenario():
+            tasks = [asyncio.ensure_future(engine.submit(doc(i, True), "c"))
+                     for i in range(12)]
+            await asyncio.sleep(0.03)
+            loop = asyncio.get_running_loop()
+            t0 = time.monotonic()
+            drained = await loop.run_in_executor(None, engine.drain, 0.3)
+            elapsed = time.monotonic() - t0
+            for t in tasks:
+                t.cancel()
+            return drained, elapsed
+
+        drained, elapsed = run(scenario())
+        assert drained is False
+        assert elapsed < 2.0
+
+
+# ---------------------------------------------------------------------------
+# surfacing: /readyz + /debug/vars
+# ---------------------------------------------------------------------------
+
+
+class TestOverloadSurfacing:
+    def test_readyz_surfaces_overload_but_stays_ready(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from authorino_tpu.service.http_server import build_app
+
+        engine = build_engine()
+        for t in (1.0, 1.4, 1.8):
+            engine.admission.observe_waits([0.5], now=t)
+        assert engine.admission.overloaded
+
+        async def scenario():
+            app = build_app(engine, readiness=lambda: True)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get("/readyz")
+                body, status = await r.text(), r.status
+                dv = await (await client.get("/debug/vars")).json()
+            finally:
+                await client.close()
+            return status, body, dv
+
+        status, body, dv = run(scenario())
+        # overload stays READY: admission is shedding typed rejections so
+        # accepted work meets its SLO — a 503 would just move the queue
+        assert status == 200 and "overloaded" in body
+        adm = dv["engine"]["admission"]
+        assert adm["state"] == "overloaded"
+        assert "queue_wait_ewma_s" in adm and "effective_cap" in adm
+        assert dv["engine"]["adaptive"]["window"] >= 1
+        assert dv["engine"]["brownout"]["enabled"] is True
+
+    def test_admission_state_gauge(self):
+        engine = build_engine()
+        for t in (1.0, 1.4, 1.8):
+            engine.admission.observe_waits([0.5], now=t)
+        assert sample("auth_server_admission_state",
+                      {"lane": "engine"}) == 1.0
+        engine.admission.observe_waits([0.0], now=2.0)
+        assert sample("auth_server_admission_state",
+                      {"lane": "engine"}) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# code lint: the overload layer rides the unbounded-wait gate
+# ---------------------------------------------------------------------------
+
+
+class TestOverloadLintGate:
+    def lint(self, src):
+        from authorino_tpu.analysis.code_lint import lint_source
+
+        return lint_source(src, "planted.py")
+
+    def test_admission_and_brownout_paths_are_drain_paths(self):
+        src = (
+            "def admit(self):\n"
+            "    self._evt.wait()\n"
+            "def brownout_spill(self):\n"
+            "    self._t.join()\n"
+            "def overload_probe(self):\n"
+            "    self._evt.wait()\n"
+            "def adaptive_step(self):\n"
+            "    self._evt.wait()\n"
+        )
+        found = self.lint(src)
+        assert [f.kind for f in found] == ["unbounded-wait"] * 4
+
+    def test_repo_overload_code_stays_clean(self):
+        import os
+
+        from authorino_tpu.analysis.code_lint import lint_paths
+
+        root = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "authorino_tpu")
+        assert [str(f) for f in lint_paths([root])] == []

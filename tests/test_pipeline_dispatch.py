@@ -43,7 +43,6 @@ RULE_EVIL = Pattern("auth.identity.org", Operator.EQ, "evil")
 
 def build_engine(rule=RULE_ACME, **kw) -> PolicyEngine:
     kw.setdefault("max_batch", 8)
-    kw.setdefault("max_delay_s", 0.0005)
     engine = PolicyEngine(members_k=4, mesh=None, **kw)
     engine.apply_snapshot([
         EngineEntry(id="c", hosts=["c"], runtime=None,

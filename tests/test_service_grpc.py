@@ -24,7 +24,7 @@ external_auth_pb2 = protos.external_auth_pb2
 
 
 def make_engine():
-    engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+    engine = PolicyEngine(max_batch=4)
     rules = All(Pattern("request.headers.x-org", Operator.EQ, "acme"))
     runtime = RuntimeAuthConfig(
         identity=[IdentityConfig("anon", Noop())],

@@ -25,7 +25,7 @@ from authorino_tpu.service.http_server import build_app
 
 
 def build_engine(batched: bool) -> PolicyEngine:
-    engine = PolicyEngine(max_batch=8, max_delay_s=0.002)
+    engine = PolicyEngine(max_batch=8)
     rules = All(
         Pattern("request.headers.x-api-tier", Operator.EQ, "gold"),
         Pattern("request.method", Operator.NEQ, "DELETE"),
@@ -102,7 +102,7 @@ def test_check_condition_matched_rules_enforced(batched):
     """Host-based config where conditions always match: rules are enforced."""
 
     async def run_all():
-        engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+        engine = PolicyEngine(max_batch=4)
         rules = All(Pattern("request.headers.x-api-tier", Operator.EQ, "gold"))
         pm = PatternMatching(
             rules,
@@ -196,7 +196,7 @@ def test_engine_snapshot_swap_under_load():
     """Reconcile-time swap must not break in-flight serving."""
 
     async def run_all():
-        engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+        engine = PolicyEngine(max_batch=4)
 
         def snapshot(tier):
             rules = All(Pattern("request.headers.x-api-tier", Operator.EQ, tier))

@@ -151,7 +151,7 @@ def test_engine_serves_from_sharded_snapshot():
     from authorino_tpu.expressions import All, Any_, Operator, Pattern
     from authorino_tpu.runtime import EngineEntry, PolicyEngine
 
-    engine = PolicyEngine(max_batch=4, max_delay_s=0.001, members_k=4)
+    engine = PolicyEngine(max_batch=4, members_k=4)
     entries = []
     exprs = {}
     for i in range(6):
@@ -255,7 +255,7 @@ class TestServingPathBitParity:
         corpus = self.corpus()
 
         def engine_for(mesh):
-            e = PolicyEngine(max_batch=16, max_delay_s=0.0005, members_k=self.K,
+            e = PolicyEngine(max_batch=16, members_k=self.K,
                              mesh=mesh)
             e.apply_snapshot([EngineEntry(id=n, hosts=[n], runtime=None, rules=c)
                               for n, c in corpus.items()])

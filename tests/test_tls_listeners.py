@@ -54,7 +54,7 @@ def self_signed():
 
 
 def make_engine():
-    engine = PolicyEngine(max_batch=4, max_delay_s=0.001)
+    engine = PolicyEngine(max_batch=4)
     rules = All(Pattern("request.method", Operator.NEQ, "DELETE"))
     runtime = RuntimeAuthConfig(
         identity=[IdentityConfig("anon", Noop())],

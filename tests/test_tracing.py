@@ -189,7 +189,7 @@ class TestNativeFrontendTracing:
                 tracing._native_exporter.flush_interval_s = 0.01
 
                 rule = Pattern("request.method", Operator.EQ, "GET")
-                engine = PolicyEngine(max_batch=16, max_delay_s=0.0005, mesh=None)
+                engine = PolicyEngine(max_batch=16, mesh=None)
                 cfg_id = "ns/traced"
                 pm = PatternMatching(rule, batched_provider=engine.provider_for(cfg_id),
                                      evaluator_slot=0)
