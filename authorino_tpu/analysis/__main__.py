@@ -100,7 +100,141 @@ def _run_verify_fixtures() -> List[Finding]:
     from .translation_validate import mutation_self_test
 
     errors += mutation_self_test(policy)
+
+    # snapshot serialization + diff self-test (ISSUE 8): the container
+    # must round-trip the fixture corpus bit-identically, and the diff
+    # engine must name EXACTLY the planted change — a blind diff engine
+    # (or a lossy serializer) fails this command
+    errors += _snapshot_selftest(policy)
     return errors
+
+
+def _snapshot_selftest(policy) -> List[Finding]:
+    import numpy as np
+
+    from ..compiler.compile import compile_corpus
+    from ..expressions.ast import Pattern
+    from ..snapshots.diff import snapshot_diff
+    from ..snapshots.fingerprint import rules_fingerprint
+    from ..snapshots.serialize import deserialize_policy, serialize_policy
+    from .fixtures import fixture_configs
+
+    errors: List[Finding] = []
+    configs = fixture_configs()
+    fps = {c.name: rules_fingerprint(c) for c in configs}
+    blob = serialize_policy(policy, meta={"fingerprints": fps,
+                                          "certified": True})
+    rt, meta = deserialize_policy(blob)
+    for name in ("leaf_op", "leaf_attr", "leaf_const", "eval_cond",
+                 "eval_rule", "eval_has_cond", "dfa_tables", "dfa_accept",
+                 "config_cacheable"):
+        if not np.array_equal(getattr(policy, name), getattr(rt, name)):
+            errors.append(Finding(
+                kind="serialize-roundtrip", layer="snapshots",
+                message=f"array {name!r} did not round-trip bit-identically",
+                location="fixtures"))
+    if rt.config_ids != policy.config_ids or \
+            rt.attr_selectors != policy.attr_selectors:
+        errors.append(Finding(
+            kind="serialize-roundtrip", layer="snapshots",
+            message="config/attr metadata did not round-trip",
+            location="fixtures"))
+
+    # plant exactly one SHAPE-PRESERVING change — 'api' blocks a different
+    # method constant (only 'api' lowers that leaf, and a const swap keeps
+    # every padded grid identical) — and demand the diff names it, and
+    # nothing else
+    changed = fixture_configs()
+
+    def _swap_method(expr):
+        from ..expressions.ast import And, Or
+
+        if isinstance(expr, Pattern):
+            if expr.selector == "request.method":
+                return Pattern(expr.selector, expr.operator, "PLANTED")
+            return expr
+        kids = tuple(_swap_method(c) for c in expr.children)
+        return And(kids) if isinstance(expr, And) else Or(kids)
+
+    changed[0] = type(changed[0])(name="api", evaluators=[
+        (cond if cond is None else _swap_method(cond), _swap_method(rule))
+        for cond, rule in changed[0].evaluators
+    ])
+    fps2 = {c.name: rules_fingerprint(c) for c in changed}
+    d = snapshot_diff(fps, fps2)
+    if d["changed"] != ["api"] or d["added"] or d["removed"]:
+        errors.append(Finding(
+            kind="diff-blind", layer="snapshots",
+            message=f"snapshot diff missed the planted change: {d}",
+            location="fixtures"))
+    # ... and that an UNCHANGED corpus diffs empty (fresh tree objects:
+    # fingerprints are structural, not identity-based)
+    d0 = snapshot_diff(fps, {c.name: rules_fingerprint(c)
+                             for c in fixture_configs()})
+    if d0["recompile"] or d0["removed"]:
+        errors.append(Finding(
+            kind="diff-blind", layer="snapshots",
+            message=f"identical corpora diffed non-empty: {d0}",
+            location="fixtures"))
+    # the mutated corpus must also produce a rows-level delta plan against
+    # the original (same padded shapes, a handful of touched rows)
+    from ..snapshots.diff import plan_delta
+
+    try:
+        from ..ops.pattern_eval import to_device
+
+        plan = plan_delta(to_device(policy, host=True),
+                          to_device(compile_corpus(
+                              changed, members_k=policy.members_k,
+                              interner=policy.interner.freeze_copy()),
+                              host=True))
+        if plan is None:
+            errors.append(Finding(
+                kind="diff-blind", layer="snapshots",
+                message="shape-preserving mutation produced no delta plan "
+                        "(full re-stage forced)", location="fixtures"))
+        elif plan.upload_bytes >= plan.full_bytes:
+            errors.append(Finding(
+                kind="diff-blind", layer="snapshots",
+                message="delta plan is not smaller than a full re-stage "
+                        f"({plan.upload_bytes} >= {plan.full_bytes})",
+                location="fixtures"))
+    except Exception as e:
+        errors.append(Finding(
+            kind="diff-blind", layer="snapshots",
+            message=f"delta planning failed: {e!r}", location="fixtures"))
+    return errors
+
+
+def _run_snapshot_diff(old_path: str, new_path: str) -> dict:
+    """Human-readable diff between two serialized snapshots (ISSUE 8):
+    the recompile set by config fingerprint, then the operand rows/bytes a
+    delta upload would ship.  Accepts blob files or publish directories
+    (snapshots/distribution.py MANIFEST layout)."""
+    import os
+
+    from ..ops.pattern_eval import to_device
+    from ..snapshots.diff import format_snapshot_diff, plan_delta, snapshot_diff
+    from ..snapshots.distribution import load_latest, load_snapshot_blob
+
+    def load(path):
+        if os.path.isdir(path) or path.startswith(("http://", "https://")):
+            return load_latest(path)
+        with open(path, "rb") as f:
+            return load_snapshot_blob(f.read())
+
+    old, new = load(old_path), load(new_path)
+    old_view = to_device(old.policy, host=True)
+    new_view = to_device(new.policy, host=True)
+    text = format_snapshot_diff(old.meta, new.meta, old_view, new_view)
+    plan = plan_delta(old_view, new_view)
+    return {
+        "text": text,
+        "configs": snapshot_diff(old.fingerprints, new.fingerprints),
+        "delta": plan.to_json() if plan is not None else {"mode": "full"},
+        "old_generation": old.generation,
+        "new_generation": new.generation,
+    }
 
 
 def _run_coverage_report() -> dict:
@@ -129,9 +263,24 @@ def main(argv=None) -> int:
     ap.add_argument("--coverage-report", action="store_true",
                     help="fast-lane vs slow-lane lowerability report with "
                          "reason codes over the fixture corpus")
+    ap.add_argument("--snapshot-diff", nargs=2, metavar=("OLD", "NEW"),
+                    help="human-readable diff between two serialized "
+                         "snapshots (blob files or publish directories): "
+                         "configs recompiled, operand rows touched, delta "
+                         "vs full upload bytes (docs/control_plane.md)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
+
+    if args.snapshot_diff:
+        report = _run_snapshot_diff(*args.snapshot_diff)
+        if args.as_json:
+            out = dict(report)
+            out.pop("text", None)
+            print(json.dumps(out, indent=2, sort_keys=True))
+        else:
+            print(report["text"])
+        return 0
 
     any_mode = args.self_lint or args.verify_fixtures or args.coverage_report
     run_lint = args.self_lint or not any_mode

@@ -143,6 +143,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "snapshot with structural findings is rejected and "
                         "the previous one keeps serving (counted in "
                         "auth_server_snapshot_rejected_total)")
+    s.add_argument("--snapshot-publish-dir", default=env_var("SNAPSHOT_PUBLISH_DIR", ""),
+                   help="Compile-leader mode: publish every vetted compiled "
+                        "snapshot into this directory (atomic blob + "
+                        "MANIFEST.json; serve it to replicas over a shared "
+                        "volume or any static HTTP server). "
+                        "docs/control_plane.md")
+    s.add_argument("--snapshot-source", default=env_var("SNAPSHOT_SOURCE", ""),
+                   help="Serving-replica mode: poll this directory or "
+                        "http(s) URL for leader-published snapshots and "
+                        "apply each new vetted one without compiling. "
+                        "Uncertified/corrupt snapshots are rejected and the "
+                        "previous one keeps serving")
+    s.add_argument("--snapshot-poll", type=float, default=env_var("SNAPSHOT_POLL_S", 5.0),
+                   help="Replica poll interval in seconds (default 5)")
     s.add_argument("--native-frontend", choices=["auto", "on", "off"],
                    default=env_var("NATIVE_FRONTEND", "auto"),
                    help="Serve the ext_authz gRPC port from the C++ device-owner "
@@ -278,6 +292,16 @@ async def run_server(args) -> None:
         log.warning("fault injection ARMED via --fault-profile (%s): this "
                     "is a chaos/testing mode", fault_profile)
 
+    if str(getattr(args, "snapshot_publish_dir", "") or "") \
+            and not args.strict_verify:
+        # a leader's published snapshots are only admissible at replicas
+        # when certified, and certification only happens under strict
+        # verify — publishing uncertified blobs would wedge every replica
+        # on its last vetted snapshot with nothing flagging it here
+        log.warning("--snapshot-publish-dir implies --strict-verify "
+                    "(replicas only admit certified snapshots): enabling it")
+        args.strict_verify = True
+
     device_timeout_ms = int(getattr(args, "device_timeout", 0) or 0)
     # NOTE: --batch-window-us no longer reaches the engine (the old
     # max_delay_s mirror was a documented no-op since the pipelined
@@ -299,6 +323,52 @@ async def run_server(args) -> None:
         breaker_threshold=int(getattr(args, "breaker_threshold", 5)),
         breaker_reset_s=float(getattr(args, "breaker_reset", 5.0)),
     )
+
+    # snapshot distribution (ISSUE 8, docs/control_plane.md): a compile
+    # LEADER publishes every vetted snapshot into --snapshot-publish-dir
+    # (serve it over HTTP or a shared volume); a serving REPLICA polls
+    # --snapshot-source and applies each new vetted snapshot WITHOUT
+    # compiling — compile once, serve many.  A replica keeps serving its
+    # last vetted snapshot when the leader goes away.
+    snapshot_replica = None
+    publish_dir = str(getattr(args, "snapshot_publish_dir", "") or "")
+    snapshot_source = str(getattr(args, "snapshot_source", "") or "")
+    if (publish_dir and snapshot_source
+            and not snapshot_source.startswith(("http://", "https://"))
+            and os.path.realpath(publish_dir)
+            == os.path.realpath(snapshot_source)):
+        # same directory as both feed and sink is always a misconfig (the
+        # publisher already refuses to republish LOADED snapshots, but
+        # locally-reconciled ones would still collide with the feed)
+        raise RuntimeError(
+            "--snapshot-publish-dir and --snapshot-source point at the "
+            "same directory: a node is either a compile leader or a "
+            "serving replica, not its own upstream")
+    if publish_dir:
+        from .snapshots.distribution import SnapshotPublisher
+
+        SnapshotPublisher(publish_dir).attach(engine)
+        log.info("snapshot leader: publishing vetted snapshots to %s",
+                 publish_dir)
+    if snapshot_source:
+        from .snapshots.distribution import SnapshotReplica
+
+        if args.watch_dir or args.in_cluster:
+            log.warning("--snapshot-source with a local control plane: the "
+                        "replica feed and local reconciles will race for "
+                        "the serving snapshot — pick one")
+        snapshot_replica = SnapshotReplica(
+            engine, snapshot_source,
+            poll_s=float(getattr(args, "snapshot_poll", 5.0)))
+        try:
+            snapshot_replica.poll_once()  # best-effort warm start
+        except Exception:
+            log.exception("snapshot warm start failed (replica keeps "
+                          "polling; serving an empty index until a vetted "
+                          "snapshot loads)")
+        snapshot_replica.start()
+        log.info("snapshot replica: polling %s every %.1fs",
+                 snapshot_source, float(getattr(args, "snapshot_poll", 5.0)))
 
     selector = LabelSelector.parse(args.auth_config_label_selector) if args.auth_config_label_selector else None
     secret_selector = LabelSelector.parse(args.secret_label_selector) if args.secret_label_selector else None
@@ -466,6 +536,9 @@ async def run_server(args) -> None:
 
         loop = asyncio.get_running_loop()
         # control plane first: no new snapshots compile mid-drain
+        if snapshot_replica is not None:
+            await best_effort(loop.run_in_executor(
+                None, lambda: snapshot_replica.stop(min(2.0, drain_left()))))
         if status_updater is not None:
             await best_effort(status_updater.stop())
         if source is not None:

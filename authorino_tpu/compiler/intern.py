@@ -15,6 +15,7 @@ Sentinels:
 
 from __future__ import annotations
 
+import itertools
 from typing import Dict, Iterable, List
 
 __all__ = ["StringInterner", "UNSEEN", "PAD", "EMPTY_ID"]
@@ -23,12 +24,22 @@ UNSEEN = -2
 PAD = -3
 EMPTY_ID = 0
 
+_SERIAL = itertools.count(1)
+
 
 class StringInterner:
-    __slots__ = ("_table",)
+    __slots__ = ("_table", "serial")
 
     def __init__(self):
         self._table: Dict[str, int] = {"": EMPTY_ID}
+        # process-unique, never-reused identity token.  Encoded operand ids
+        # only mean the same thing under the SAME interner object (a fresh
+        # interner may assign the same id to a different string), so the
+        # per-config verdict-cache key folds this serial into its encoding
+        # epoch (snapshots/fingerprint.py): a persistent interner keeps
+        # cached verdicts reachable across reconciles, a rebuilt one
+        # structurally invalidates them.
+        self.serial: int = next(_SERIAL)
 
     def intern(self, s: str) -> int:
         """Compile-time: insert and return the id."""

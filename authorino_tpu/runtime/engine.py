@@ -106,19 +106,19 @@ class _Snapshot:
     (ref: controllers/label_selector.go:14-45)."""
 
     def __init__(self, entries: Sequence[EngineEntry], members_k: int = 16,
-                 mesh=None, strict_verify: bool = False):
-        from ..ops.pattern_eval import to_device
-
+                 mesh=None, strict_verify: bool = False,
+                 compile_cache=None, prev: "Optional[_Snapshot]" = None):
         self.by_id: Dict[str, EngineEntry] = {e.id: e for e in entries}
         rules = [e.rules for e in entries if e.rules is not None]
         self.policy: Optional[CompiledPolicy] = None
         self.params = None
         self.sharded = None
-        # engine generation this snapshot serves under — the verdict-cache
-        # key prefix, set inside apply_snapshot's swap lock.  In-flight
-        # batches pin their snapshot, so they insert AND serve under the
-        # generation they were encoded against: a swap can never let a
-        # stale verdict leak into the new generation's lookups.
+        # engine generation this snapshot serves under, set inside
+        # apply_snapshot's swap lock.  In-flight batches pin their
+        # snapshot, so they insert AND serve under the cache tokens (or,
+        # on the mesh path, the generation) they were encoded against: a
+        # swap can never let a stale verdict leak into the new snapshot's
+        # lookups.
         self.generation = 0
         # set by a passing _verify(): downstream strict-verify consumers
         # (the native frontend's refresh) skip re-linting an already-vetted
@@ -130,11 +130,25 @@ class _Snapshot:
         # the /debug/vars evidence that the fingerprint cache is
         # actually incremental across reconciles
         self.translation: Optional[Dict[str, int]] = None
+        # incremental control plane (ISSUE 8, authorino_tpu/snapshots/):
+        # per-config source fingerprints, the (epoch, fingerprint) verdict-
+        # cache tokens per eval row, what the incremental compile actually
+        # did, the upload plan, per-phase timings, and the host operand
+        # view the NEXT reconcile diffs against
+        self.fingerprints: Dict[str, str] = {}
+        self.cache_tokens = None         # per-row tokens (single corpus only)
+        self.compile_report = None
+        self.upload: Optional[Dict[str, Any]] = None
+        self.phase_s: Dict[str, float] = {}
+        self.host_view = None
+        self.published_origin: Optional[str] = None  # set by from_published
         if rules:
             if mesh is not None:
                 from ..parallel import ShardedPolicyModel
 
+                t0 = time.monotonic()
                 self.sharded = ShardedPolicyModel(rules, mesh, members_k=members_k)
+                self.phase_s["compile"] = time.monotonic() - t0
                 if strict_verify:
                     # sharded caveat: ShardedPolicyModel compiles AND stages
                     # per-shard operands internally, so this lint runs after
@@ -142,15 +156,158 @@ class _Snapshot:
                     # below) — rejection still precedes the swap, so a
                     # corrupt corpus never SERVES, but the upload itself is
                     # not gated on this path
+                    t0 = time.monotonic()
                     self._verify()
+                    self.phase_s["validate"] = time.monotonic() - t0
             else:
-                self.policy = compile_corpus(rules, members_k=members_k)
-                if strict_verify:
-                    # lint BEFORE the device upload: a corrupt corpus is
-                    # rejected host-side, never staged on the device (and
-                    # never crashes mid-operand-build with a raw IndexError)
-                    self._verify()
-                self.params = to_device(self.policy)
+                self._compile_single(rules, members_k, strict_verify,
+                                     compile_cache, prev)
+
+    def _compile_single(self, rules, members_k: int, strict_verify: bool,
+                        compile_cache, prev: "Optional[_Snapshot]") -> None:
+        """Single-corpus compile → verify → diff → upload, each phase
+        timed.  With a compile cache and an unchanged corpus the previous
+        snapshot's CompiledPolicy AND device params are reused outright:
+        zero configs compiled, zero bytes uploaded, verification skipped
+        (the artifacts are byte-identical to ones already vetted)."""
+        from ..snapshots.fingerprint import cache_tokens, rules_fingerprint
+
+        t0 = time.monotonic()
+        prev_ok = (prev is not None and prev.policy is not None
+                   and prev.sharded is None)
+        if compile_cache is not None:
+            policy, report = compile_cache.compile(
+                rules, members_k=members_k,
+                prev_fps=(prev.fingerprints if prev_ok else None),
+                prev_policy=(prev.policy if prev_ok else None))
+            self.compile_report = report
+            self.fingerprints = dict(report.fingerprints)
+        else:
+            policy = compile_corpus(rules, members_k=members_k)
+            memo: Dict[int, str] = {}
+            self.fingerprints = {c.name: rules_fingerprint(c, memo)
+                                 for c in rules}
+        self.policy = policy
+        self.phase_s["compile"] = time.monotonic() - t0
+        reused = (self.compile_report is not None
+                  and self.compile_report.reused_policy)
+        if reused and (prev.lint_ok or not strict_verify):
+            # fingerprint-identical corpus: previous params serve as-is
+            self.lint_ok = prev.lint_ok
+            # the strict-verify evidence for /debug/vars: every config's
+            # certificate is (trivially) served from cache — nothing was
+            # re-validated, the strongest form of PR 6's zero-revalidation
+            # property (the certify pass didn't even need to run)
+            self.translation = (
+                {"validated": 0, "cache_hits": len(prev.policy.config_ids),
+                 "failed": 0, "sampled": 0, "dfa_witnesses": 0}
+                if strict_verify and prev.lint_ok else prev.translation)
+            self.params = prev.params
+            self.host_view = prev.host_view
+            self.cache_tokens = prev.cache_tokens
+            self.upload = {"mode": "reuse", "upload_bytes": 0,
+                           "full_bytes": 0, "arrays_reused": None,
+                           "arrays_touched": []}
+            return
+        if strict_verify:
+            # lint BEFORE the device upload: a corrupt corpus is rejected
+            # host-side, never staged on the device (and never crashes
+            # mid-operand-build with a raw IndexError)
+            t0 = time.monotonic()
+            self._verify()
+            self.phase_s["validate"] = time.monotonic() - t0
+        self.cache_tokens = cache_tokens(policy, self.fingerprints)
+        self._upload(prev if prev_ok else None)
+
+    def _upload(self, prev: "Optional[_Snapshot]") -> None:
+        """Diff + upload phases: plan a delta against the previous host
+        operand view, ship only changed rows when a structure-preserving
+        delta exists, fall back to a full re-stage otherwise."""
+        from ..ops.pattern_eval import to_device
+        from ..snapshots.delta import apply_delta, full_upload
+        from ..snapshots.diff import plan_delta
+
+        t0 = time.monotonic()
+        host_view = to_device(self.policy, host=True)
+        self.host_view = host_view
+        plan = None
+        if (prev is not None and prev.params is not None
+                and prev.host_view is not None):
+            plan = plan_delta(prev.host_view, host_view)
+        self.phase_s["diff"] = time.monotonic() - t0
+        t0 = time.monotonic()
+        if plan is not None:
+            self.params, uploaded = apply_delta(prev.params, host_view, plan)
+            self.upload = dict(plan.to_json(), upload_bytes=uploaded)
+        else:
+            self.params, uploaded = full_upload(host_view)
+            self.upload = {"mode": "full", "upload_bytes": uploaded,
+                           "full_bytes": uploaded, "arrays_reused": 0,
+                           "arrays_touched": []}
+        self.phase_s["upload"] = time.monotonic() - t0
+
+    @classmethod
+    def from_published(cls, loaded, members_k: int = 16,
+                       strict_verify: bool = False,
+                       prev: "Optional[_Snapshot]" = None) -> "_Snapshot":
+        """Serving-replica constructor: wrap a leader-serialized corpus
+        (snapshots/distribution.py LoadedSnapshot) WITHOUT compiling
+        anything.  The admission gate: an uncertified snapshot is rejected
+        outright; with ``strict_verify`` the replica additionally re-runs
+        the full local verification (tensor lint + translation
+        certification — cheap on repeats thanks to the fingerprint-keyed
+        certificate cache).  Entries carry hosts only (runtime=None): a
+        replica serves the compiled verdict lane, not the identity/
+        metadata pipeline (docs/control_plane.md)."""
+        from ..snapshots.fingerprint import cache_tokens
+
+        if not loaded.certified:
+            raise SnapshotRejected([
+                "snapshot is not certified: the leader never marked it "
+                "strict-verified (lint + translation certification)"])
+        entries = [EngineEntry(id=cid, hosts=hosts, runtime=None, rules=None)
+                   for cid, hosts in loaded.entries]
+        snap = cls.__new__(cls)
+        snap.by_id = {e.id: e for e in entries}
+        snap.policy = loaded.policy
+        snap.sharded = None
+        snap.params = None
+        snap.generation = 0
+        snap.lint_ok = False
+        snap.translation = (loaded.meta or {}).get("translation")
+        snap.fingerprints = loaded.fingerprints
+        snap.cache_tokens = None
+        snap.compile_report = None
+        snap.upload = None
+        snap.phase_s = {}
+        snap.host_view = None
+        # provenance: this snapshot was LOADED, not compiled here — the
+        # publisher skips it (a replica must never republish what it
+        # consumed, or a node whose source and publish dir meet — even
+        # through an HTTP relay — would republish/re-apply forever)
+        snap.published_origin = loaded.digest or "<loaded>"
+        if strict_verify:
+            t0 = time.monotonic()
+            snap._verify()
+            snap.phase_s["validate"] = time.monotonic() - t0
+        else:
+            snap.lint_ok = True  # vouched for by the leader's certificate
+        prev_ok = (prev is not None and prev.policy is not None
+                   and prev.sharded is None)
+        if prev_ok:
+            # interner continuity: every deserialize builds a FRESH
+            # StringInterner (new identity serial), which would change the
+            # encoding epoch and kill every cached verdict on each applied
+            # generation — the exact churn cliff this subsystem removes.
+            # The leader's interner is insert-only, so when the loaded
+            # table prefix-extends the previous snapshot's, the ids ARE
+            # the previous interner's ids: extend it in place and adopt it
+            # (same object ⇒ same serial ⇒ untouched configs' entries
+            # survive on replicas too).
+            _adopt_interner(prev.policy.interner, loaded.policy)
+        snap.cache_tokens = cache_tokens(loaded.policy, snap.fingerprints)
+        snap._upload(prev if prev_ok else None)
+        return snap
 
     def _verify(self) -> None:
         from ..analysis.tensor_lint import lint_snapshot
@@ -180,6 +337,28 @@ class _Snapshot:
         if failures:
             raise SnapshotRejected(failures)
         self.lint_ok = True
+
+
+def _adopt_interner(prev_interner, new_policy) -> None:
+    """Replica-side interner continuity (see from_published): when the
+    freshly-deserialized policy's id table prefix-extends the previous
+    snapshot's, graft the new entries onto the previous interner and point
+    the policy at it.  Ids are positional in the insertion-ordered table,
+    so a true prefix match proves every shared id means the same string;
+    any mismatch (leader restarted with a fresh interner) keeps the new
+    interner — a structural epoch change, exactly as safe as before."""
+    old_t = prev_interner._table
+    new_t = new_policy.interner._table
+    if len(new_t) < len(old_t):
+        return
+    it = iter(new_t.items())
+    for want in old_t.items():
+        if next(it) != want:
+            return
+    for s, i in new_t.items():
+        if s not in old_t:
+            old_t[s] = i
+    new_policy.interner = prev_interner
 
 
 @dataclass
@@ -342,6 +521,14 @@ class PolicyEngine:
         self.batch_dedup = bool(batch_dedup)
         self.strict_verify = bool(strict_verify)
         self.analyze_policies = bool(analyze_policies)
+        # incremental control plane (ISSUE 8): the persistent per-config
+        # compile cache (fingerprint → artifact + the cross-reconcile
+        # interner/DFA memos) and the latest reconcile's phase/delta
+        # evidence for /debug/vars
+        from ..snapshots.compile_cache import CompileCache
+
+        self.compile_cache = CompileCache()
+        self._control_plane: Optional[Dict[str, Any]] = None
         # latest reconcile's policy-analysis report (JSON-safe; /debug/vars)
         self._analysis: Optional[Dict[str, Any]] = None
         # latest reconcile's lowerability report (ISSUE 6: fast/slow lane
@@ -433,11 +620,19 @@ class PolicyEngine:
         With ``strict_verify`` the compiled snapshot is tensor-linted HERE,
         before the generation bump: a corrupt snapshot raises
         SnapshotRejected and the old snapshot/index keep serving (the
-        reconciler maps the raise to CachingError + retry)."""
+        reconciler maps the raise to CachingError + retry).
+
+        Incremental (ISSUE 8): compilation runs through the engine's
+        persistent per-config compile cache and the device upload is a
+        DELTA against the previous snapshot — an unchanged corpus compiles
+        zero configs and ships zero bytes; verdict-cache entries of
+        untouched configs survive the swap (per-config cache tokens)."""
         try:
             snap = _Snapshot(entries, members_k=self.members_k,
                              mesh=self._resolve_mesh(),
-                             strict_verify=self.strict_verify)
+                             strict_verify=self.strict_verify,
+                             compile_cache=self.compile_cache,
+                             prev=self._snapshot)
         except SnapshotRejected as e:
             metrics_mod.snapshot_rejected.labels("engine").inc()
             log.error(
@@ -445,26 +640,91 @@ class PolicyEngine:
                 "keeps serving): %s", self.generation,
                 "; ".join(str(f) for f in e.findings[:5]))
             raise
+        self._install_snapshot(snap, entries, override=override)
+        if self.analyze_policies:
+            self._run_policy_analysis(entries, snap)
+            self._run_lowerability(entries, snap)
+
+    def apply_published(self, loaded) -> None:
+        """Serving-replica swap path: install a leader-serialized vetted
+        snapshot (snapshots/distribution.py LoadedSnapshot) without
+        compiling anything.  The admission gate lives in
+        _Snapshot.from_published — an uncertified or locally-failing
+        snapshot raises SnapshotRejected and the previous snapshot keeps
+        serving, exactly like a strict-verify reconcile rejection."""
+        try:
+            snap = _Snapshot.from_published(
+                loaded, members_k=self.members_k,
+                strict_verify=self.strict_verify, prev=self._snapshot)
+        except SnapshotRejected as e:
+            metrics_mod.snapshot_rejected.labels("engine").inc()
+            log.error(
+                "published snapshot REJECTED at admission (previous "
+                "generation %d keeps serving): %s", self.generation,
+                "; ".join(str(f) for f in e.findings[:5]))
+            raise
+        entries = list(snap.by_id.values())
+        self._install_snapshot(snap, entries, override=True)
+
+    def _install_snapshot(self, snap: "_Snapshot",
+                          entries: Sequence[EngineEntry],
+                          override: bool = True) -> None:
+        """Shared swap tail: index build, atomic swap, telemetry, swap
+        listeners."""
         new_index: HostIndex[EngineEntry] = HostIndex()
         for e in entries:
             for host in e.hosts:
                 new_index.set(e.id, host, e, override=override)
         with self._swap_lock:
             self.generation += 1
-            # the verdict cache keys on snap.generation: in-flight batches
-            # of the OLD snapshot keep inserting/serving under the old
-            # generation, so the swap structurally invalidates without TTLs
+            # the mesh lane's verdict cache keys on snap.generation (the
+            # single-corpus lane keys on per-config cache tokens instead):
+            # in-flight batches of the OLD snapshot keep inserting/serving
+            # under the tokens/generation they were encoded against, so
+            # the swap structurally invalidates without TTLs
             snap.generation = self.generation
             self._snapshot = snap
             self.index = new_index
             metrics_mod.snapshot_generation.labels("engine").set(self.generation)
+        self._record_control_plane(snap)
         # listeners (the native frontend rebuilding its C++ snapshot) fire
         # BEFORE the advisory analysis: a revoking reconcile must propagate
         # at swap speed, not wait out a bounded-evaluation pass
         self.notify_swap_listeners()
-        if self.analyze_policies:
-            self._run_policy_analysis(entries, snap)
-            self._run_lowerability(entries, snap)
+
+    def _record_control_plane(self, snap: "_Snapshot") -> None:
+        """Reconcile telemetry (ISSUE 8 satellite): phase histograms,
+        compile-cache hit/miss counters, delta-upload byte counters, and
+        the /debug/vars control_plane block.  Advisory — never fails a
+        swap."""
+        try:
+            for phase, dt in snap.phase_s.items():
+                metrics_mod.reconcile_phase.labels(phase).observe(dt)
+            rep = snap.compile_report
+            if rep is not None:
+                if rep.cached:
+                    metrics_mod.compile_cache_events.labels("hit").inc(
+                        rep.cached)
+                if rep.compiled:
+                    metrics_mod.compile_cache_events.labels("miss").inc(
+                        rep.compiled)
+            if snap.upload is not None:
+                metrics_mod.delta_upload_bytes.labels("engine").inc(
+                    int(snap.upload.get("upload_bytes", 0)))
+                metrics_mod.full_upload_bytes.labels("engine").inc(
+                    int(snap.upload.get("full_bytes", 0)))
+            self._control_plane = {
+                "generation": snap.generation,
+                "phases_ms": {k: round(v * 1e3, 3)
+                              for k, v in snap.phase_s.items()},
+                "compile": rep.to_json() if rep is not None else None,
+                "upload": snap.upload,
+                "compile_cache": (self.compile_cache.stats()
+                                  if self.compile_cache is not None else None),
+                "per_config_cache_keying": snap.cache_tokens is not None,
+            }
+        except Exception:
+            log.exception("control-plane telemetry failed (swap unaffected)")
 
     def _run_policy_analysis(self, entries: Sequence[EngineEntry],
                              snap: "_Snapshot") -> None:
@@ -556,6 +816,7 @@ class PolicyEngine:
             "verdict_cache": (self._verdict_cache.counts()
                               if self._verdict_cache is not None else None),
             "strict_verify": self.strict_verify,
+            "control_plane": self._control_plane,
             "policy_analysis": self._analysis,
             "lowerability": self._lowerability,
             "translation_validation": (getattr(snap, "translation", None)
@@ -974,23 +1235,39 @@ class PolicyEngine:
                         self._brownout_inflight)
         return False
 
-    def _dedup_plan(self, keys, n, gen, eligible):
+    def _cache_keys(self, keys, n, snap, rows=None):
+        """Full verdict-cache keys for one batch.  Single-corpus snapshots
+        key per config: (encoding epoch, config source fingerprint, row
+        bytes) — entries for configs a swap did NOT touch stay reachable
+        across the swap (ISSUE 8: the verdict cache survives churn).  Mesh
+        snapshots fall back to PR 3's generation keying (one shard compile
+        is monolithic there)."""
+        if keys is None or self._verdict_cache is None:
+            return None
+        tokens = snap.cache_tokens
+        if tokens is not None and rows is not None:
+            return [(tokens[rows[r]], keys[r]) for r in range(n)]
+        gen = snap.generation
+        return [(gen, keys[r]) for r in range(n)]
+
+    def _dedup_plan(self, keys, ckeys, n, eligible):
         """Shared cache-lookup + within-batch-collapse plan for one
         micro-batch.  ``eligible(r)`` gates verdict-cache participation
         (cacheable config AND not a lossy host-fallback row — the
-        fallback flag itself already rides the row keys).  Returns
-        (cached {row: value}, miss_rows, unique_rows, inverse,
-        eligible_misses)."""
+        fallback flag itself already rides the row keys).  ``ckeys`` are
+        the full cache keys (per-config tokens folded in; None = cache
+        off).  Returns (cached {row: value}, miss_rows, unique_rows,
+        inverse, eligible_misses)."""
         from ..compiler.pack import dedup_rows
 
         cache = self._verdict_cache
         cached: Dict[int, Any] = {}
         eligible_misses = 0
-        if cache is not None and keys is not None:
+        if cache is not None and ckeys is not None:
             miss_rows: List[int] = []
             for r in range(n):
                 if eligible(r):
-                    v = cache.get((gen, keys[r]))
+                    v = cache.get(ckeys[r])
                     if v is not None:
                         cached[r] = v
                         continue
@@ -1004,17 +1281,19 @@ class PolicyEngine:
             unique_rows, inverse = miss_rows, np.arange(len(miss_rows))
         return cached, miss_rows, unique_rows, inverse, eligible_misses
 
-    def _cache_insert(self, keys, gen, unique_rows, eligible,
+    def _cache_insert(self, ckeys, unique_rows, eligible,
                       own_rule, own_skipped) -> int:
-        """Insert freshly-evaluated unique rows; returns the eviction delta
-        for this batch's metrics fold."""
+        """Insert freshly-evaluated unique rows under their full cache
+        keys (captured from the batch's PINNED snapshot at encode time —
+        a swap admitted mid-dispatch can never relabel in-flight work);
+        returns the eviction delta for this batch's metrics fold."""
         cache = self._verdict_cache
-        if cache is None or keys is None:
+        if cache is None or ckeys is None:
             return 0
         evict0 = cache.evictions
         for r in unique_rows:
             if eligible(r):
-                cache.put((gen, keys[r]),
+                cache.put(ckeys[r],
                           (own_rule[r].copy(), own_skipped[r].copy()))
         return cache.evictions - evict0
 
@@ -1056,17 +1335,17 @@ class PolicyEngine:
         enc = encode_batch(policy, docs, rows, batch_pad=pad)
         db = pack_batch(policy, enc)
         has_dfa = snap.params["dfa_tables"] is not None
-        gen = snap.generation
         cacheable = policy.config_cacheable
         keys = (batch_row_keys(db, n)
                 if n and (self.batch_dedup or self._verdict_cache is not None)
                 else None)
+        ckeys = self._cache_keys(keys, n, snap, rows=rows)
 
         def eligible(r: int) -> bool:
             return bool(cacheable[rows[r]]) and not bool(db.host_fallback[r])
 
         cached, miss_rows, unique_rows, inverse, elig_miss = self._dedup_plan(
-            keys, n, gen, eligible)
+            keys, ckeys, n, eligible)
         u = len(unique_rows)
         if u == n:
             db_u, pad_u = db, pad  # nothing collapsed: ship the batch as-is
@@ -1124,7 +1403,7 @@ class PolicyEngine:
                     np.nonzero(db.host_fallback[:n])[0],
                     own_rule, own_skipped, max_fallback,
                 )
-            evict_d = self._cache_insert(keys, gen, unique_rows, eligible,
+            evict_d = self._cache_insert(ckeys, unique_rows, eligible,
                                          own_rule, own_skipped)
             metrics_mod.observe_dedup("engine", n, u, len(cached),
                                       elig_miss, evict_d)
@@ -1141,10 +1420,12 @@ class PolicyEngine:
 
         sharded = snap.sharded
         enc = sharded.encode(docs, names, batch_pad=pad)
-        gen = snap.generation
         keys = (sharded.row_keys(enc, n)
                 if n and (self.batch_dedup or self._verdict_cache is not None)
                 else None)
+        # mesh lane: per-config tokens are single-corpus only — generation
+        # keying (PR 3 semantics) still applies here
+        ckeys = self._cache_keys(keys, n, snap)
 
         def eligible(r: int) -> bool:
             return (bool(sharded.config_cacheable[enc.shard_of[r],
@@ -1152,7 +1433,7 @@ class PolicyEngine:
                     and not bool(enc.host_fallback[r]))
 
         cached, miss_rows, unique_rows, inverse, elig_miss = self._dedup_plan(
-            keys, n, gen, eligible)
+            keys, ckeys, n, eligible)
         u = len(unique_rows)
         binfo["device_rows"] = u
         if u == n:
@@ -1195,7 +1476,7 @@ class PolicyEngine:
                 own_skipped[r] = c_skip
             sharded.apply_fallback(enc.host_fallback, docs, names,
                                    own_rule, own_skipped, max_fallback)
-            evict_d = self._cache_insert(keys, gen, unique_rows, eligible,
+            evict_d = self._cache_insert(ckeys, unique_rows, eligible,
                                          own_rule, own_skipped)
             metrics_mod.observe_dedup("engine", n, u, len(cached),
                                       elig_miss, evict_d)

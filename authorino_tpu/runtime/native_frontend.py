@@ -603,6 +603,13 @@ class _SnapRec:
     # verdict-cache eligibility per kernel row: [G] bool (single corpus) or
     # [S, G] (mesh) — compiler/compile.py config_cacheable
     cacheable: Optional[np.ndarray] = None
+    # per-config verdict-cache key tokens (ISSUE 8): (encoding epoch,
+    # config source fingerprint) per kernel row, inherited from the engine
+    # snapshot.  Entries of configs a reconcile did NOT touch stay
+    # reachable across fe snapshots — the cache survives churn.  None
+    # (mesh corpora, or pre-fingerprint snapshots) falls back to PR 3's
+    # snap_id keying.
+    cache_tokens: Optional[list] = None
     # lazily-built host (numpy) operand pytree for the degraded lane: the
     # same kernel on the CPU backend when the device path fails/trips
     host_params: Any = None
@@ -1306,6 +1313,7 @@ class NativeFrontend:
                 spec["attr_member_slot_addr"] = ams.ctypes.data
                 spec["attr_byte_slot_addr"] = abs_v.ctypes.data
                 rec.cacheable = policy.config_cacheable
+                rec.cache_tokens = getattr(snap, "cache_tokens", None)
                 if policy.n_byte_attrs > 0 and policy.dfa_tables.size:
                     # C++ indexes transition tables BY ROW: expand the
                     # compiler's deduped [T, S, 256] store through
@@ -1768,9 +1776,13 @@ class NativeFrontend:
         """Cache-lookup + within-batch row collapse for one C++-encoded
         slot.  Keys are the raw encoded operand bytes of each row (exact:
         the kernel is a pure per-row function; the native path has no
-        lossy host-fallback rows).  Returns (keys, eligible [count] bool,
-        cached {row: verdict}, miss_rows, unique_rows, inverse,
-        eligible_misses) — or None when both features are off."""
+        lossy host-fallback rows).  Single-corpus snapshots key the cache
+        per config — (encoding epoch, config fingerprint, row bytes), so
+        entries for configs a reconcile did not touch SURVIVE the swap
+        (ISSUE 8); mesh corpora fall back to snap_id keying.  Returns
+        (cache_keys, eligible [count] bool, cached {row: verdict},
+        miss_rows, unique_rows, inverse, eligible_misses) — or None when
+        both features are off."""
         cache = self._verdict_cache
         if not self.batch_dedup and cache is None:
             return None
@@ -1781,6 +1793,12 @@ class NativeFrontend:
         if shards_arr is not None:
             arrays.insert(0, a["shard_of"])
         keys = row_key_bytes(arrays, count)
+        tok = rec.cache_tokens if shards_arr is None else None
+        if tok is not None:
+            ckeys = [(tok[rows[r]], keys[r]) for r in range(count)]
+        else:
+            snap_id = rec.snap_id
+            ckeys = [(snap_id, keys[r]) for r in range(count)]
         if rec.cacheable is None:
             eligible = np.zeros((count,), dtype=bool)
         elif shards_arr is not None:
@@ -1791,10 +1809,9 @@ class NativeFrontend:
         elig_miss = 0
         if cache is not None:
             miss_rows: List[int] = []
-            snap_id = rec.snap_id
             for r in range(count):
                 if eligible[r]:
-                    v = cache.get((snap_id, keys[r]))
+                    v = cache.get(ckeys[r])
                     if v is not None:
                         cached[r] = v
                         continue
@@ -1806,7 +1823,7 @@ class NativeFrontend:
             unique_rows, inverse = dedup_rows(keys, miss_rows)
         else:
             unique_rows, inverse = miss_rows, np.arange(len(miss_rows))
-        return keys, eligible, cached, miss_rows, unique_rows, inverse, elig_miss
+        return ckeys, eligible, cached, miss_rows, unique_rows, inverse, elig_miss
 
     def _dispatch(self, snap_id: int, slot: int, count: int,
                   attempt: int = 0, spill: bool = True) -> None:
@@ -2119,7 +2136,10 @@ class NativeFrontend:
                 evict0 = cache.evictions
                 for r in fan[4]:  # unique rows: freshly evaluated
                     if fan[1][r]:
-                        cache.put((snap_id, fan[0][r]), int(verdict[r]))
+                        # fan[0] carries the FULL cache key (per-config
+                        # token or snap_id already folded in — captured
+                        # from the batch's pinned snapshot at dispatch)
+                        cache.put(fan[0][r], int(verdict[r]))
                 evict_d = cache.evictions - evict0
             metrics_mod.observe_dedup("native", count, u, cached_n,
                                       elig_miss_n, evict_d)

@@ -1,0 +1,46 @@
+"""Incremental compile & delta snapshot distribution (ISSUE 8 — ROADMAP
+open item 1: the control plane at 100k AuthConfigs).
+
+Every reconcile used to recompile the entire corpus into one monolithic
+snapshot, re-upload every device tensor, and bump a global generation that
+invalidated the whole verdict cache.  This package makes the control plane
+incremental end to end:
+
+  fingerprint.py   — canonical per-config source fingerprints (the compile-
+                     cache key) and the encoding *epoch* (everything that
+                     defines the meaning of an encoded operand row), the
+                     two halves of the per-config verdict-cache key
+  compile_cache.py — bounded persistent compile cache: fingerprint →
+                     per-config artifact; re-reconciling an unchanged
+                     corpus compiles ZERO configs, mutating one compiles
+                     exactly that one
+  diff.py          — snapshot diff plans: which configs changed, which
+                     operand rows they touch, and how many bytes a delta
+                     upload ships vs a full re-stage (pure numpy —
+                     import-light, reused by the analysis CLI)
+  delta.py         — applies a diff plan as delta H2D transfers
+                     (device-side row scatter; only changed rows cross
+                     the link)
+  serialize.py     — pickle-free snapshot container (JSON header + raw
+                     array payload + sha256 trailer)
+  distribution.py  — compile-leader publish / serving-replica load over a
+                     directory or HTTP, with the strict-verify certificate
+                     as the admission gate
+
+See docs/control_plane.md for the full design."""
+
+from .compile_cache import CompileCache, CompileReport, ConfigArtifact
+from .diff import format_snapshot_diff, plan_delta, snapshot_diff
+from .fingerprint import cache_tokens, encoding_epoch, rules_fingerprint
+from .serialize import (
+    SnapshotFormatError,
+    deserialize_policy,
+    serialize_policy,
+)
+
+__all__ = [
+    "CompileCache", "CompileReport", "ConfigArtifact",
+    "rules_fingerprint", "encoding_epoch", "cache_tokens",
+    "snapshot_diff", "plan_delta", "format_snapshot_diff",
+    "serialize_policy", "deserialize_policy", "SnapshotFormatError",
+]

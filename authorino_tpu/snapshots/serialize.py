@@ -1,0 +1,259 @@
+"""Pickle-free snapshot container: a leader-serialized compiled corpus a
+serving replica can load without recompiling anything.
+
+Wire layout (all little-endian):
+
+    MAGIC  "ATPUSNAP1\\0"
+    u64    header length H
+    H      JSON header — format version, meta (generation, per-config
+           fingerprints, certified flag, translation-validation stats),
+           the string-interner table, every JSON-safe policy field, and
+           an array directory {name: {dtype, shape, offset, nbytes}}
+    ...    raw C-contiguous array payload (offsets relative to its start)
+    32     sha256 over EVERYTHING above — the load-time integrity gate
+
+No pickle anywhere: the JSON header carries expression trees as plain
+``{"p": [selector, op, value]}`` / ``{"all": [...]}`` / ``{"any": [...]}``
+nodes and the loader reconstructs real Pattern/And/Or objects (re-running
+their constructor validation), so a snapshot file can never smuggle code.
+Integrity ≠ authorization: the sha256 detects corruption and truncation;
+the ``certified`` flag (set only after the leader's strict-verify lint +
+translation certification passed) is what the replica's admission gate
+requires — see snapshots/distribution.py and docs/control_plane.md."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..compiler.compile import CompiledPolicy
+from ..compiler.intern import StringInterner
+from ..expressions.ast import And, Expression, Operator, Or, Pattern
+
+__all__ = ["serialize_policy", "deserialize_policy", "SnapshotFormatError",
+           "expr_to_json", "expr_from_json"]
+
+MAGIC = b"ATPUSNAP1\x00"
+FORMAT_VERSION = 1
+_DIGEST_LEN = 32
+
+
+class SnapshotFormatError(ValueError):
+    """The blob is not a valid snapshot container (bad magic, truncated,
+    checksum mismatch, or unsupported version).  Load-time only — the
+    serving snapshot is never touched."""
+
+
+# ---------------------------------------------------------------------------
+# expression trees <-> JSON
+# ---------------------------------------------------------------------------
+
+
+def expr_to_json(expr: Expression) -> Any:
+    if isinstance(expr, Pattern):
+        return {"p": [expr.selector, expr.operator.value, expr.value]}
+    tag = "all" if isinstance(expr, And) else "any"
+    return {tag: [expr_to_json(c) for c in expr.children]}
+
+
+def expr_from_json(d: Any) -> Expression:
+    if not isinstance(d, dict) or len(d) != 1:
+        raise SnapshotFormatError(f"malformed expression node: {d!r}")
+    if "p" in d:
+        sel, op, value = d["p"]
+        return Pattern(str(sel), Operator.from_string(str(op)), str(value))
+    if "all" in d:
+        return And(tuple(expr_from_json(c) for c in d["all"]))
+    if "any" in d:
+        return Or(tuple(expr_from_json(c) for c in d["any"]))
+    raise SnapshotFormatError(f"unknown expression node: {list(d)!r}")
+
+
+# ---------------------------------------------------------------------------
+# serialize
+# ---------------------------------------------------------------------------
+
+_ARRAY_FIELDS = (
+    "leaf_op", "leaf_attr", "leaf_const", "eval_cond", "eval_rule",
+    "eval_has_cond", "dfa_tables", "dfa_accept", "dfa_table_of_row",
+    "dfa_leaf_attr", "leaf_dfa_row", "attr_byte_slot", "leaf_is_membership",
+    "member_attr_slot", "member_attrs", "cpu_leaf_list", "config_cacheable",
+)
+
+
+def serialize_policy(policy: CompiledPolicy,
+                     meta: Optional[Dict[str, Any]] = None) -> bytes:
+    """One compiled corpus → one self-verifying blob.  ``meta`` lands in
+    the header verbatim (generation, fingerprints, certified, entries)."""
+    arrays: Dict[str, np.ndarray] = {
+        name: getattr(policy, name) for name in _ARRAY_FIELDS}
+    for i, (children, is_and) in enumerate(policy.levels):
+        arrays[f"levels.{i}.children"] = children
+        arrays[f"levels.{i}.is_and"] = is_and
+
+    directory: Dict[str, Dict[str, Any]] = {}
+    payload = bytearray()
+    for name, a in arrays.items():
+        c = np.ascontiguousarray(a)
+        directory[name] = {
+            "dtype": c.dtype.str, "shape": list(c.shape),
+            "offset": len(payload), "nbytes": int(c.nbytes),
+        }
+        payload += c.tobytes()
+
+    # interner table: index IS the id (insertion-ordered dict, sequential
+    # ids by construction — compiler/intern.py)
+    interner_table = [None] * len(policy.interner)
+    for s, i in policy.interner._table.items():
+        interner_table[i] = s
+
+    header = {
+        "version": FORMAT_VERSION,
+        "meta": meta or {},
+        "n_levels": len(policy.levels),
+        "n_byte_attrs": int(policy.n_byte_attrs),
+        "members_k": int(policy.members_k),
+        "n_member_attrs": int(policy.n_member_attrs),
+        "n_cpu_leaves": int(policy.n_cpu_leaves),
+        "interner": interner_table,
+        "attr_selectors": list(policy.attr_selectors),
+        "config_ids": dict(policy.config_ids),
+        "config_attrs": [list(map(int, a)) for a in policy.config_attrs],
+        "config_cpu_leaves": [list(map(int, a))
+                              for a in policy.config_cpu_leaves],
+        "leaf_regex": [rx.pattern if rx is not None else None
+                       for rx in policy.leaf_regex],
+        "leaf_tree": [expr_to_json(t) if t is not None else None
+                      for t in policy.leaf_tree],
+        "config_exprs": [
+            [[expr_to_json(cond) if cond is not None else None,
+              expr_to_json(rule)] for cond, rule in evs]
+            for evs in policy.config_exprs
+        ],
+        "arrays": directory,
+    }
+    header_bytes = json.dumps(header, sort_keys=True,
+                              separators=(",", ":")).encode("utf-8")
+    body = MAGIC + struct.pack("<Q", len(header_bytes)) + header_bytes + payload
+    return body + hashlib.sha256(body).digest()
+
+
+# ---------------------------------------------------------------------------
+# deserialize
+# ---------------------------------------------------------------------------
+
+
+def _read_header(blob: bytes) -> Tuple[Dict[str, Any], int]:
+    if len(blob) < len(MAGIC) + 8 + _DIGEST_LEN:
+        raise SnapshotFormatError("snapshot blob truncated")
+    if blob[:len(MAGIC)] != MAGIC:
+        raise SnapshotFormatError("bad snapshot magic")
+    body, digest = blob[:-_DIGEST_LEN], blob[-_DIGEST_LEN:]
+    if hashlib.sha256(body).digest() != digest:
+        raise SnapshotFormatError("snapshot checksum mismatch (corrupt or "
+                                  "tampered blob)")
+    (hlen,) = struct.unpack_from("<Q", blob, len(MAGIC))
+    start = len(MAGIC) + 8
+    if start + hlen > len(body):
+        raise SnapshotFormatError("snapshot header overruns the blob")
+    try:
+        header = json.loads(body[start:start + hlen].decode("utf-8"))
+    except Exception as e:
+        raise SnapshotFormatError(f"unparseable snapshot header: {e}")
+    if header.get("version") != FORMAT_VERSION:
+        raise SnapshotFormatError(
+            f"unsupported snapshot format version {header.get('version')!r}")
+    return header, start + hlen
+
+
+def deserialize_policy(blob: bytes) -> Tuple[CompiledPolicy, Dict[str, Any]]:
+    """Blob → (CompiledPolicy, header meta).  Pure deserialization: nothing
+    is recompiled, no device is touched — the replica's whole point."""
+    header, payload_off = _read_header(blob)
+    payload = blob[payload_off:-_DIGEST_LEN]
+
+    def arr(name: str) -> np.ndarray:
+        spec = header["arrays"].get(name)
+        if spec is None:
+            raise SnapshotFormatError(f"snapshot missing array {name!r}")
+        try:
+            end = spec["offset"] + spec["nbytes"]
+            if end > len(payload):
+                raise SnapshotFormatError(
+                    f"array {name!r} overruns the payload")
+            a = np.frombuffer(payload[spec["offset"]:end],
+                              dtype=np.dtype(spec["dtype"]))
+            a = a.reshape(spec["shape"])
+        except SnapshotFormatError:
+            raise
+        except Exception as e:
+            # bad dtype strings, nbytes not a multiple of the itemsize,
+            # shape mismatches — a checksum only proves the WRITER's bytes,
+            # not that a (version-skewed or adversarial) writer wrote a
+            # well-formed directory
+            raise SnapshotFormatError(f"array {name!r} malformed: {e}")
+        return np.array(a)  # explicit writable copy (frombuffer is RO)
+
+    levels = tuple(
+        (arr(f"levels.{i}.children"), arr(f"levels.{i}.is_and"))
+        for i in range(int(header["n_levels"])))
+
+    interner = StringInterner()
+    table: Dict[str, int] = {}
+    for i, s in enumerate(header["interner"]):
+        table[str(s)] = i
+    if table.get("") != 0:
+        raise SnapshotFormatError("interner table must map \"\" to id 0")
+    interner._table = table
+
+    leaf_regex: List[Optional[re.Pattern]] = [
+        re.compile(p) if p is not None else None
+        for p in header["leaf_regex"]]
+    leaf_tree: List[Optional[Expression]] = [
+        expr_from_json(t) if t is not None else None
+        for t in header["leaf_tree"]]
+    config_exprs = [
+        [(expr_from_json(c) if c is not None else None, expr_from_json(r))
+         for c, r in evs]
+        for evs in header["config_exprs"]]
+
+    policy = CompiledPolicy(
+        leaf_op=arr("leaf_op"),
+        leaf_attr=arr("leaf_attr"),
+        leaf_const=arr("leaf_const"),
+        levels=levels,
+        eval_cond=arr("eval_cond"),
+        eval_rule=arr("eval_rule"),
+        eval_has_cond=arr("eval_has_cond"),
+        dfa_tables=arr("dfa_tables"),
+        dfa_accept=arr("dfa_accept"),
+        dfa_table_of_row=arr("dfa_table_of_row"),
+        dfa_leaf_attr=arr("dfa_leaf_attr"),
+        leaf_dfa_row=arr("leaf_dfa_row"),
+        attr_byte_slot=arr("attr_byte_slot"),
+        n_byte_attrs=int(header["n_byte_attrs"]),
+        interner=interner,
+        attr_selectors=[str(s) for s in header["attr_selectors"]],
+        config_ids={str(k): int(v)
+                    for k, v in header["config_ids"].items()},
+        config_attrs=[list(map(int, a)) for a in header["config_attrs"]],
+        config_cpu_leaves=[list(map(int, a))
+                           for a in header["config_cpu_leaves"]],
+        leaf_regex=leaf_regex,
+        leaf_tree=leaf_tree,
+        leaf_is_membership=arr("leaf_is_membership"),
+        members_k=int(header["members_k"]),
+        member_attr_slot=arr("member_attr_slot"),
+        member_attrs=arr("member_attrs"),
+        n_member_attrs=int(header["n_member_attrs"]),
+        cpu_leaf_list=arr("cpu_leaf_list"),
+        n_cpu_leaves=int(header["n_cpu_leaves"]),
+        config_exprs=config_exprs,
+        config_cacheable=arr("config_cacheable"),
+    )
+    return policy, dict(header.get("meta") or {})

@@ -706,3 +706,52 @@ host_fallback_shed_total = _counter(
     "fallback cap was exceeded.",
     (),
 )
+
+# ---------------------------------------------------------------------------
+# Incremental control plane (ISSUE 8, authorino_tpu/snapshots/): per-phase
+# reconcile timing, the compile cache's hit evidence, delta-upload traffic,
+# and leader/replica snapshot distribution outcomes.
+# ---------------------------------------------------------------------------
+
+reconcile_phase = _histogram(
+    "auth_server_reconcile_phase_seconds",
+    "Per-phase reconcile timing on the engine lane: compile (incremental "
+    "corpus compile through the per-config artifact cache), validate "
+    "(--strict-verify tensor lint + translation certification), diff "
+    "(delta plan between the old and new host operand views), upload "
+    "(H2D staging — delta rows or full re-stage).  The sum is what a "
+    "reconcile costs the control plane; docs/control_plane.md.",
+    ("phase",),
+    buckets=(.0005, .002, .01, .05, .1, .25, .5, 1.0, 2.5, 5.0, 10.0),
+)
+compile_cache_events = _counter(
+    "auth_server_compile_cache_events_total",
+    "Per-config compile-cache outcomes per reconcile: hit = the config's "
+    "source fingerprint matched a cached artifact (no re-lowering, no "
+    "re-determinization), miss = the config was actually compiled.  An "
+    "unchanged corpus is all hits; mutating one config is exactly one "
+    "miss (ISSUE 8 churn property).",
+    ("outcome",),
+)
+delta_upload_bytes = _counter(
+    "auth_server_delta_upload_bytes_total",
+    "Operand bytes actually shipped to the device per reconcile upload "
+    "(changed rows + scatter indices on the delta path; whole tensors on "
+    "a full re-stage).  Compare against "
+    "auth_server_full_upload_bytes_total for the avoided traffic.",
+    ("lane",),
+)
+full_upload_bytes = _counter(
+    "auth_server_full_upload_bytes_total",
+    "Operand bytes a FULL re-stage of each reconciled snapshot would have "
+    "shipped (the delta baseline; the monolithic pre-ISSUE-8 behavior).",
+    ("lane",),
+)
+snapshot_distribution = _counter(
+    "auth_server_snapshot_distribution_total",
+    "Leader/replica snapshot distribution outcomes: role = leader | "
+    "replica; result = published | applied | rejected (admission gate: "
+    "uncertified or locally-failing snapshot, old snapshot keeps serving) "
+    "| error (unreadable/corrupt source).",
+    ("role", "result"),
+)

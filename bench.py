@@ -322,6 +322,140 @@ def degradation_block(args, lane, before, breaker, total=None):
     return out
 
 
+# ---------------------------------------------------------------------------
+# --churn N (ISSUE 8): apply N single-config mutations WHILE the closed-loop
+# pump serves, and record what the incremental control plane did — reconcile
+# latency, recompiled-config count (must be 1 per mutation), delta-upload
+# bytes, verdict-cache survival across the swaps, and the serving p99 under
+# churn vs the churn-free baseline.
+# ---------------------------------------------------------------------------
+
+
+def _mutate_config(cfg, tag):
+    """Clone one bench ConfigRules with its org-equality constant changed —
+    a shape-preserving single-config mutation (same leaves, same padded
+    grids, so the upload is a rows-level delta)."""
+    from authorino_tpu.compiler import ConfigRules
+    from authorino_tpu.expressions import And, Operator, Or, Pattern
+
+    def walk(expr):
+        if isinstance(expr, Pattern):
+            if expr.selector == "auth.identity.org" and expr.operator is Operator.EQ:
+                return Pattern(expr.selector, expr.operator,
+                               f"{expr.value}-churn-{tag}")
+            return expr
+        kids = tuple(walk(c) for c in expr.children)
+        return And(kids) if isinstance(expr, And) else Or(kids)
+
+    return ConfigRules(name=cfg.name, evaluators=[
+        (cond if cond is None else walk(cond), walk(rule))
+        for cond, rule in cfg.evaluators])
+
+
+def run_churn_pass(engine, configs, docs, rows, args, baseline_p99_ms=None):
+    import asyncio
+    import threading
+
+    from authorino_tpu.runtime import EngineEntry
+
+    n_mut = args.churn
+    vc = engine._verdict_cache  # None with --verdict-cache-size 0
+
+    # probe set: one distinct (doc, config) pair per config (bounded) —
+    # warmed into the verdict cache, re-probed after the churn window to
+    # measure how many entries SURVIVED the swaps
+    probe_n = min(len(configs), 512) if vc is not None else 0
+    probe = [(docs[j % len(docs)], f"cfg-{j}") for j in range(probe_n)]
+
+    async def probe_pass():
+        await asyncio.gather(*[engine.submit(d, c) for d, c in probe],
+                             return_exceptions=True)
+
+    if probe:
+        asyncio.run(probe_pass())
+
+    reconciles = []
+    live = list(configs)
+    stop_evt = threading.Event()
+
+    def mutator():
+        # space the mutations over the measured window (skip the first
+        # second — run_engine_mode's warmup pass)
+        spacing = max(0.2, (args.seconds - 1.0) / max(1, n_mut))
+        if stop_evt.wait(1.0):
+            return
+        for k in range(n_mut):
+            i = k % len(live)
+            live[i] = _mutate_config(live[i], k)
+            entries = [EngineEntry(id=c.name, hosts=[c.name], runtime=None,
+                                   rules=c) for c in live]
+            t0 = time.perf_counter()
+            try:
+                engine.apply_snapshot(entries)
+            except Exception as e:
+                log(f"churn reconcile {k} FAILED: {e!r}")
+                continue
+            dt = time.perf_counter() - t0
+            cp = (engine.debug_vars().get("control_plane") or {})
+            comp = cp.get("compile") or {}
+            up = cp.get("upload") or {}
+            reconciles.append({
+                "reconcile_ms": round(dt * 1e3, 3),
+                "recompiled": comp.get("compiled"),
+                "cached": comp.get("cached"),
+                "upload_mode": up.get("mode"),
+                "delta_upload_bytes": up.get("upload_bytes"),
+                "full_upload_bytes": up.get("full_bytes"),
+                "phases_ms": cp.get("phases_ms"),
+            })
+            if stop_evt.wait(spacing):
+                return
+
+    th = threading.Thread(target=mutator, name="bench-churn", daemon=True)
+    th.start()
+    total, elapsed, lat, _, _ = run_engine_mode(engine, docs, rows, args)
+    stop_evt.set()
+    th.join(timeout=30)
+
+    # survival: re-probe the warmed rows against the post-churn snapshot
+    survived = 0
+    if probe:
+        hits0 = vc.hits
+        asyncio.run(probe_pass())
+        survived = vc.hits - hits0
+
+    lat.sort()
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3 if lat else None
+    rec_ms = sorted(r["reconcile_ms"] for r in reconciles) or [0.0]
+    out = {
+        "mutations": n_mut,
+        "reconciles": reconciles,
+        "reconcile_ms_p50": rec_ms[len(rec_ms) // 2],
+        "reconcile_ms_max": rec_ms[-1],
+        "recompiled_total": sum(r["recompiled"] or 0 for r in reconciles),
+        "delta_upload_bytes_total": sum(r["delta_upload_bytes"] or 0
+                                        for r in reconciles),
+        "full_upload_bytes_total": sum(r["full_upload_bytes"] or 0
+                                       for r in reconciles),
+        "verdict_cache_survival": {
+            "probes": probe_n,
+            "survived": int(survived),
+            "rate": (round(survived / probe_n, 4) if probe_n else None),
+        },
+        "serving_rps_under_churn": round(total / elapsed, 1),
+        "serving_p99_ms_under_churn": round(p99, 3) if p99 else None,
+        "serving_p99_ms_baseline": baseline_p99_ms,
+        "compile_cache": engine.compile_cache.stats(),
+    }
+    log(f"churn: {len(reconciles)} reconciles, recompiled "
+        f"{out['recompiled_total']} config(s) total, "
+        f"{out['delta_upload_bytes_total']} delta bytes "
+        f"(vs {out['full_upload_bytes_total']} full), survival "
+        f"{out['verdict_cache_survival']['rate']}, p99 "
+        f"{out['serving_p99_ms_under_churn']}ms vs {baseline_p99_ms}ms")
+    return out
+
+
 def run_engine_mode(engine, docs, rows, args):
     """Service-path variant: requests flow through PolicyEngine.submit —
     the same micro-batching queue + double-buffered snapshot the gRPC/HTTP
@@ -1734,6 +1868,14 @@ def main():
                          "payload sequence so request keys REPEAT (hot "
                          "tenants/tokens) — exercises batch row dedup and "
                          "the verdict cache; 0 = uniform (off)")
+    ap.add_argument("--churn", type=int, default=0,
+                    help="engine mode: apply N single-config mutations "
+                         "during a measured serving window and emit a "
+                         "churn artifact block — reconcile latency, "
+                         "recompiled-config count (1 per mutation with the "
+                         "incremental compile cache), delta-upload bytes, "
+                         "verdict-cache survival rate, p99 impact "
+                         "(docs/control_plane.md)")
     ap.add_argument("--chaos", default="",
                     help="arm a fault-injection profile (runtime/faults.py: "
                          "device-down, flaky, flap, slow-device, wedge, or a "
@@ -1886,6 +2028,18 @@ def main():
                     total=sum(int(r * args.seconds) for r in trial_rps) or None)
                 detail["degradation"]["p99_ms_under_faults"] = round(p99, 3)
                 log(f"degradation: {detail['degradation']}")
+            if args.churn:
+                # ISSUE 8: N single-config mutations during a measured
+                # serving window — reconcile latency, recompiled-config
+                # count, delta-upload bytes, verdict-cache survival, p99
+                # impact (docs/control_plane.md)
+                log(f"churn pass: {args.churn} single-config mutations "
+                    f"over {args.seconds:.0f}s of serving...")
+                detail["churn"] = run_churn_pass(
+                    engine, configs, docs, rows, args,
+                    baseline_p99_ms=round(p99, 3))
+                detail["control_plane"] = (engine.debug_vars()
+                                           .get("control_plane"))
             if args.open_loop:
                 # resolve the offered rate: a number, or '2x' the measured
                 # sustainable (closed-loop median) rate — burst shaping

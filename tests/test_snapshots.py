@@ -1,0 +1,639 @@
+"""Incremental compile & delta snapshot distribution (ISSUE 8).
+
+Churn properties end to end: re-reconciling an unchanged corpus compiles
+ZERO configs and uploads ZERO bytes; mutating one config recompiles exactly
+that one, ships a rows-level delta, keeps ≥95% of verdict-cache entries
+alive across the swap, and serves verdicts bit-identical to a cold full
+compile.  Plus the serialization container (round-trip, corruption), the
+leader/replica distribution protocol (vetted load, admission rejection with
+the old snapshot still serving), the snapshot-diff engine, and the
+mid-dispatch swap pinning regression.
+
+Deliberately import-light: collects on images without `cryptography`
+(no evaluators.identity / native_frontend imports); JAX_PLATFORMS=cpu."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from authorino_tpu.compiler import ConfigRules, compile_corpus
+from authorino_tpu.expressions import All, Any_, Operator, Pattern
+from authorino_tpu.runtime import EngineEntry, PolicyEngine
+from authorino_tpu.runtime.engine import SnapshotRejected
+from authorino_tpu.snapshots import (
+    CompileCache,
+    cache_tokens,
+    encoding_epoch,
+    rules_fingerprint,
+    serialize_policy,
+    snapshot_diff,
+)
+from authorino_tpu.snapshots.delta import apply_delta
+from authorino_tpu.snapshots.diff import plan_delta
+from authorino_tpu.snapshots.distribution import (
+    SnapshotLoadError,
+    SnapshotPublisher,
+    SnapshotReplica,
+    load_latest,
+    load_snapshot_blob,
+)
+from authorino_tpu.snapshots.serialize import deserialize_policy
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def make_corpus(n=20, mutated=(), tag="MUT", seed=5):
+    """Deterministic corpus; rebuilding with the same args yields fresh
+    tree OBJECTS with identical structure (the fingerprint must see
+    through object identity)."""
+    rng = random.Random(seed)
+    cfgs = []
+    for i in range(n):
+        const = f"org-{i}" + (f"-{tag}" if i in mutated else "")
+        rule = All(
+            Pattern("request.method", Operator.EQ,
+                    ["GET", "POST"][i % 2]),
+            Any_(
+                Pattern("auth.identity.org", Operator.EQ, const),
+                Pattern("auth.identity.roles", Operator.INCL, f"role-{i}"),
+                Pattern("request.url_path", Operator.MATCHES,
+                        rf"^/svc-{i % 3}/"),
+            ),
+        )
+        cfgs.append(ConfigRules(name=f"cfg-{i}", evaluators=[(None, rule)]))
+    rng.random()  # keep the signature honest about determinism
+    return cfgs
+
+
+def entries_of(cfgs):
+    return [EngineEntry(id=c.name, hosts=[c.name], runtime=None, rules=c)
+            for c in cfgs]
+
+
+def build_engine(cfgs=None, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("verdict_cache_size", 4096)
+    engine = PolicyEngine(members_k=4, mesh=None, **kw)
+    if cfgs is not None:
+        engine.apply_snapshot(entries_of(cfgs))
+    return engine
+
+
+def doc(i, method="GET"):
+    return {"request": {"method": ["GET", "POST"][i % 2],
+                        "url_path": f"/svc-{i % 3}/x"},
+            "auth": {"identity": {"org": f"org-{i}", "roles": []}}}
+
+
+async def submit_all(engine, n):
+    return await asyncio.gather(*[engine.submit(doc(i), f"cfg-{i}")
+                                  for i in range(n)])
+
+
+# ---------------------------------------------------------------------------
+# fingerprints + epoch
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_stable_across_rebuilds_and_sensitive_to_change():
+    a = make_corpus()[3]
+    b = make_corpus()[3]          # fresh objects, same structure
+    c = make_corpus(mutated={3})[3]
+    assert a.evaluators[0][1] is not b.evaluators[0][1]
+    assert rules_fingerprint(a) == rules_fingerprint(b)
+    assert rules_fingerprint(a) != rules_fingerprint(c)
+    # name-free: identical rules under different names share a fingerprint
+    renamed = ConfigRules(name="other", evaluators=list(a.evaluators))
+    assert rules_fingerprint(renamed) == rules_fingerprint(a)
+
+
+def test_encoding_epoch_folds_in_interner_identity():
+    cfgs = make_corpus(4)
+    p1 = compile_corpus(cfgs, members_k=4)
+    p2 = compile_corpus(make_corpus(4), members_k=4)  # fresh interner
+    assert encoding_epoch(p1) != encoding_epoch(p2)
+    # same interner, same layout → same epoch
+    p3 = compile_corpus(make_corpus(4), members_k=4, interner=p1.interner)
+    assert encoding_epoch(p3) == encoding_epoch(p1)
+
+
+def test_cache_tokens_cover_padded_rows():
+    cfgs = make_corpus(3)
+    p = compile_corpus(cfgs, members_k=4)
+    fps = {c.name: rules_fingerprint(c) for c in cfgs}
+    toks = cache_tokens(p, fps)
+    assert len(toks) == p.eval_rule.shape[0]
+    for name, row in p.config_ids.items():
+        assert toks[row] == (encoding_epoch(p), fps[name])
+
+
+# ---------------------------------------------------------------------------
+# compile cache: zero-recompile / exactly-one properties
+# ---------------------------------------------------------------------------
+
+
+def test_unchanged_corpus_compiles_zero_and_uploads_zero():
+    engine = build_engine(make_corpus())
+    snap1 = engine._snapshot
+    engine.apply_snapshot(entries_of(make_corpus()))  # fresh trees
+    snap2 = engine._snapshot
+    rep = snap2.compile_report
+    assert rep.compiled == 0 and rep.cached == 20 and rep.reused_policy
+    assert snap2.policy is snap1.policy
+    assert snap2.params is snap1.params
+    assert snap2.upload["mode"] == "reuse"
+    assert snap2.upload["upload_bytes"] == 0
+    # the swap itself still happened (generation advances, index rebuilt)
+    assert snap2.generation == snap1.generation + 1
+
+
+def test_mutating_one_config_recompiles_exactly_one():
+    engine = build_engine(make_corpus())
+    engine.apply_snapshot(entries_of(make_corpus(mutated={7})))
+    rep = engine._snapshot.compile_report
+    assert rep.compiled == 1
+    assert rep.compiled_names == ["cfg-7"]
+    assert rep.cached == 19
+    up = engine._snapshot.upload
+    assert up["mode"] == "delta"
+    assert 0 < up["upload_bytes"] < up["full_bytes"] / 2
+
+
+def test_compile_cache_shares_artifacts_across_identical_configs():
+    cache = CompileCache()
+    rule = All(Pattern("auth.identity.org", Operator.EQ, "acme"))
+    a1, hit1 = cache.artifact_for(ConfigRules(name="a", evaluators=[(None, rule)]))
+    a2, hit2 = cache.artifact_for(ConfigRules(name="b", evaluators=[(None, rule)]))
+    assert not hit1 and hit2 and a1 is a2
+    assert cache.stats()["entries"] == 1
+
+
+def test_compile_cache_lru_bound():
+    cache = CompileCache(max_entries=2)
+    for i in range(4):
+        cache.artifact_for(ConfigRules(name=f"c{i}", evaluators=[
+            (None, Pattern("auth.identity.org", Operator.EQ, f"o{i}"))]))
+    assert len(cache) == 2
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_churn_verdicts_bit_identical_to_cold_compile(seed):
+    """Property: after mutating one config, EVERY served verdict equals a
+    cold full compile of the same corpus — the incremental path changes
+    how tensors reach the device, never what they decide."""
+    n = 12
+    mut = seed % n
+    engine = build_engine(make_corpus(n, seed=seed))
+    run(submit_all(engine, n))  # warm (and pollute the caches)
+    engine.apply_snapshot(entries_of(make_corpus(n, mutated={mut}, seed=seed)))
+    got = run(submit_all(engine, n))
+
+    cold = build_engine(make_corpus(n, mutated={mut}, seed=seed),
+                        verdict_cache_size=0, batch_dedup=False)
+    want = run(submit_all(cold, n))
+    for (r1, s1), (r2, s2) in zip(got, want):
+        np.testing.assert_array_equal(r1, r2)
+        np.testing.assert_array_equal(s1, s2)
+
+
+def test_verdict_cache_survival_at_least_95pct():
+    """ISSUE 8 acceptance: mutate 1 of 40 configs → ≥95% of warmed
+    verdict-cache entries still serve after the swap (per-config tokens;
+    the global-generation keying this PR replaces survived 0%)."""
+    n = 40
+    engine = build_engine(make_corpus(n))
+    run(submit_all(engine, n))
+    vc = engine._verdict_cache
+    assert vc.adds >= n
+    engine.apply_snapshot(entries_of(make_corpus(n, mutated={11})))
+    hits0 = vc.hits
+    run(submit_all(engine, n))
+    survived = vc.hits - hits0
+    assert survived >= int(n * 0.95)
+    # and the mutated config did NOT serve stale: its verdict flipped
+    out = run(engine.submit(doc(11), "cfg-11"))
+    cold = build_engine(make_corpus(n, mutated={11}),
+                        verdict_cache_size=0, batch_dedup=False)
+    want = run(cold.submit(doc(11), "cfg-11"))
+    np.testing.assert_array_equal(out[0], want[0])
+
+
+def test_changed_config_never_serves_stale_verdict():
+    """The per-config keying is structural: a changed fingerprint makes
+    every old entry unreachable, no flush, no TTL."""
+    rule_acme = Pattern("auth.identity.org", Operator.EQ, "acme")
+    rule_evil = Pattern("auth.identity.org", Operator.EQ, "evil")
+    engine = build_engine([ConfigRules(name="c", evaluators=[(None, rule_acme)])])
+    d = {"auth": {"identity": {"org": "acme"}}}
+    out = run(engine.submit(d, "c"))
+    assert bool(out[0][0])
+    engine.apply_snapshot(entries_of(
+        [ConfigRules(name="c", evaluators=[(None, rule_evil)])]))
+    out = run(engine.submit(d, "c"))
+    assert not bool(out[0][0])
+
+
+def test_inflight_swap_inserts_under_pinned_tokens():
+    """Mid-dispatch swap pinning (ISSUE 8 bugfix satellite): a batch in
+    flight across a swap resolves AND inserts under its pinned snapshot's
+    tokens — for an UNCHANGED config those tokens equal the new
+    snapshot's, so the late insert is servable (not stale: identical
+    semantics); for a CHANGED config they differ and the insert is
+    unreachable from the new snapshot."""
+    n = 4
+    engine = build_engine(make_corpus(n))
+    run(submit_all(engine, n))  # warm jit
+
+    gate = threading.Event()
+    real = PolicyEngine._encode_and_launch
+    gated_launches = []
+
+    class GatedHandle:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def is_ready(self):
+            return gate.is_set() and (
+                not hasattr(self.inner, "is_ready") or self.inner.is_ready())
+
+        def __array__(self, dtype=None):
+            return np.asarray(self.inner)
+
+    def gated(snap, batch):
+        item = real(engine, snap, batch)
+        item.handle = GatedHandle(item.handle)
+        gated_launches.append(item)
+        return item
+
+    engine._encode_and_launch = gated
+    pinned_snap = engine._snapshot
+
+    async def body():
+        # cfg-1 (stays unchanged) and cfg-2 (will mutate) ride one gated
+        # in-flight batch; different docs so nothing is cached yet
+        d1 = {"request": {"method": "POST", "url_path": "/svc-1/z"},
+              "auth": {"identity": {"org": "zzz", "roles": ["role-1"]}}}
+        # url deliberately OUTSIDE cfg-2's ^/svc-2/ regex alternative, so
+        # the verdict hinges on the org constant the mutation changes
+        d2 = {"request": {"method": "GET", "url_path": "/nope/z"},
+              "auth": {"identity": {"org": "org-2", "roles": []}}}
+        pre = [asyncio.ensure_future(engine.submit(d1, "cfg-1")),
+               asyncio.ensure_future(engine.submit(d2, "cfg-2"))]
+        deadline = time.monotonic() + 5
+        while not gated_launches and time.monotonic() < deadline:
+            await asyncio.sleep(0.005)
+        assert gated_launches
+        engine._encode_and_launch = real.__get__(engine, PolicyEngine)
+        engine.apply_snapshot(entries_of(make_corpus(n, mutated={2})))
+        new_snap = engine._snapshot
+        # unchanged config: tokens identical across the swap; changed: not
+        assert new_snap.cache_tokens[pinned_snap.policy.config_ids["cfg-1"]] \
+            == pinned_snap.cache_tokens[pinned_snap.policy.config_ids["cfg-1"]]
+        assert new_snap.cache_tokens[pinned_snap.policy.config_ids["cfg-2"]] \
+            != pinned_snap.cache_tokens[pinned_snap.policy.config_ids["cfg-2"]]
+        adds0 = engine._verdict_cache.adds
+        gate.set()
+        outs = await asyncio.wait_for(asyncio.gather(*pre), timeout=10)
+        assert engine._verdict_cache.adds > adds0  # late inserts landed
+        # the in-flight batch resolved with its PINNED snapshot's
+        # semantics (cfg-2 pre-mutation: org-2 allowed)
+        assert bool(outs[1][0][0])
+        # a fresh post-swap submit of the same cfg-2 row must NOT see the
+        # pinned-token insert: mutated fingerprint → fresh evaluation
+        # under the new rules (org-2 no longer matches org-2-MUT)
+        hits0 = engine._verdict_cache.hits
+        out2 = await engine.submit(d2, "cfg-2")
+        assert not bool(out2[0][0])
+        # ...while the unchanged config's late insert IS reachable
+        hits1 = engine._verdict_cache.hits
+        out1 = await engine.submit(d1, "cfg-1")
+        assert engine._verdict_cache.hits > hits1
+        np.testing.assert_array_equal(out1[0], outs[0][0])
+
+    run(body())
+
+
+def test_strict_verify_unchanged_corpus_skips_revalidation():
+    from authorino_tpu.analysis.translation_validate import (
+        clear_certificate_cache,
+    )
+
+    clear_certificate_cache()
+    engine = build_engine(make_corpus(6), strict_verify=True)
+    assert engine._snapshot.translation["validated"] == 6
+    engine.apply_snapshot(entries_of(make_corpus(6)))
+    tv = engine._snapshot.translation
+    assert tv["validated"] == 0 and tv["cache_hits"] == 6
+    # mutate one: exactly one re-validation (PR 6 certificate cache keyed
+    # by the same fingerprints)
+    engine.apply_snapshot(entries_of(make_corpus(6, mutated={2})))
+    tv = engine._snapshot.translation
+    assert tv["validated"] == 1 and tv["cache_hits"] == 5
+    clear_certificate_cache()
+
+
+# ---------------------------------------------------------------------------
+# delta plan units
+# ---------------------------------------------------------------------------
+
+
+def test_plan_delta_modes():
+    old = {"a": np.arange(64, dtype=np.int32).reshape(8, 8),
+           "b": np.ones((4, 4), dtype=np.int32),
+           "c": np.zeros((3,), dtype=np.int32),
+           "levels": ((np.zeros((2, 2), dtype=np.int32),
+                       np.ones((2,), dtype=bool)),),
+           "matmul": None, "dfa": None}
+    new = {k: (v if not isinstance(v, np.ndarray) else v.copy())
+           for k, v in old.items()}
+    new["levels"] = ((old["levels"][0][0].copy(), old["levels"][0][1].copy()),)
+    new["a"][3] += 100                      # one row differs → rows mode
+    new["c"] = np.zeros((5,), dtype=np.int32)  # shape change → full
+    plan = plan_delta(old, new)
+    modes = {e.name: e.mode for e in plan.entries}
+    assert modes["a"] == "rows" and modes["b"] == "reuse"
+    assert modes["c"] == "full"
+    assert modes["levels.0.0"] == "reuse"  # generic tuple flattening
+    a_entry = next(e for e in plan.entries if e.name == "a")
+    assert list(a_entry.rows) == [3]
+    assert plan.upload_bytes < plan.full_bytes
+    # structure break: a lane appearing forces a full restage
+    new2 = dict(new, dfa=np.ones((2, 2)))
+    assert plan_delta(old, new2) is None
+
+
+def test_apply_delta_reconstructs_exact_arrays():
+    import jax
+
+    old = {"a": np.arange(64, dtype=np.int32).reshape(8, 8),
+           "b": np.ones((4, 4), dtype=np.int32), "matmul": None}
+    new = {"a": old["a"].copy(), "b": old["b"].copy(), "matmul": None}
+    new["a"][5] = -7
+    prev_params = jax.tree.map(jax.device_put, old)
+    plan = plan_delta(old, new)
+    params, uploaded = apply_delta(prev_params, new, plan)
+    np.testing.assert_array_equal(np.asarray(params["a"]), new["a"])
+    assert params["b"] is prev_params["b"]          # reused buffer
+    # the previous device buffer is untouched (double-buffer safety)
+    np.testing.assert_array_equal(np.asarray(prev_params["a"]), old["a"])
+    assert 0 < uploaded < new["a"].nbytes
+
+
+# ---------------------------------------------------------------------------
+# serialization + distribution
+# ---------------------------------------------------------------------------
+
+
+def _serialize_corpus(cfgs, certified=True, generation=1):
+    policy = compile_corpus(cfgs, members_k=4)
+    fps = {c.name: rules_fingerprint(c) for c in cfgs}
+    meta = {"generation": generation, "certified": certified,
+            "fingerprints": fps,
+            "entries": [{"id": c.name, "hosts": [c.name]} for c in cfgs]}
+    return serialize_policy(policy, meta=meta), policy
+
+
+def test_serialize_roundtrip_bit_identical():
+    cfgs = make_corpus(8)
+    blob, policy = _serialize_corpus(cfgs)
+    rt, meta = deserialize_policy(blob)
+    for name in ("leaf_op", "leaf_attr", "leaf_const", "eval_cond",
+                 "eval_rule", "eval_has_cond", "dfa_tables", "dfa_accept",
+                 "dfa_table_of_row", "leaf_dfa_row", "attr_byte_slot",
+                 "leaf_is_membership", "member_attr_slot", "member_attrs",
+                 "cpu_leaf_list", "config_cacheable"):
+        np.testing.assert_array_equal(getattr(policy, name),
+                                      getattr(rt, name), err_msg=name)
+    for (c1, i1), (c2, i2) in zip(policy.levels, rt.levels):
+        np.testing.assert_array_equal(c1, c2)
+        np.testing.assert_array_equal(i1, i2)
+    assert rt.config_ids == policy.config_ids
+    assert rt.attr_selectors == policy.attr_selectors
+    assert rt.interner._table == policy.interner._table
+    assert meta["certified"] is True
+    # host oracle works on the reconstructed expression trees
+    from authorino_tpu.models.policy_model import host_results
+
+    for i in range(8):
+        _, r1, s1 = host_results(policy, doc(i), i)
+        _, r2, s2 = host_results(rt, doc(i), i)
+        np.testing.assert_array_equal(r1, r2)
+        np.testing.assert_array_equal(s1, s2)
+
+
+def test_serialize_rejects_corruption_and_truncation():
+    blob, _ = _serialize_corpus(make_corpus(4))
+    flipped = bytearray(blob)
+    flipped[len(flipped) // 2] ^= 0xFF
+    with pytest.raises(SnapshotLoadError):
+        load_snapshot_blob(bytes(flipped))
+    with pytest.raises(SnapshotLoadError):
+        load_snapshot_blob(blob[:200])
+    with pytest.raises(SnapshotLoadError):
+        load_snapshot_blob(b"not a snapshot at all")
+
+
+def test_leader_replica_end_to_end(tmp_path):
+    """Acceptance: a replica loads a leader-serialized vetted snapshot and
+    serves bit-identical verdicts to an in-process compile of the same
+    corpus; corrupt and uncertified snapshots are rejected at admission
+    with the old snapshot still serving."""
+    d = str(tmp_path / "pub")
+    cfgs = make_corpus(10)
+
+    leader = build_engine(strict_verify=True)
+    pub = SnapshotPublisher(d)
+    pub.attach(leader)
+    leader.apply_snapshot(entries_of(cfgs))  # vetted + published (async)
+    assert pub.flush()
+
+    replica = build_engine()
+    loaded = load_latest(d)
+    assert loaded.certified and loaded.generation == leader.generation
+    replica.apply_published(loaded)
+    assert replica._snapshot.policy.config_ids == \
+        leader._snapshot.policy.config_ids
+    # host index routes (replica serves the compiled verdict lane)
+    assert replica.lookup("cfg-3") is not None
+
+    got = run(submit_all(replica, 10))
+    want = run(submit_all(leader, 10))
+    for (r1, s1), (r2, s2) in zip(got, want):
+        np.testing.assert_array_equal(r1, r2)
+        np.testing.assert_array_equal(s1, s2)
+
+    good_snap = replica._snapshot
+    good_gen = replica.generation
+
+    # corrupt blob: flip a payload byte AND keep the manifest digest in
+    # sync — the container's own sha256 trailer must still catch it
+    man = json.loads(open(os.path.join(d, "MANIFEST.json")).read())
+    p = os.path.join(d, man["current"])
+    raw = bytearray(open(p, "rb").read())
+    raw[len(raw) // 3] ^= 0xFF
+    open(p, "wb").write(bytes(raw))
+    import hashlib
+
+    man["sha256"] = hashlib.sha256(bytes(raw)).hexdigest()
+    open(os.path.join(d, "MANIFEST.json"), "w").write(json.dumps(man))
+    with pytest.raises(SnapshotLoadError):
+        load_latest(d)
+    assert replica._snapshot is good_snap  # old snapshot still serving
+
+    # uncertified snapshot: loads fine, rejected at ADMISSION
+    blob, _ = _serialize_corpus(make_corpus(10, mutated={1}),
+                                certified=False, generation=99)
+    pub.publish_blob(blob, 99)
+    with pytest.raises(SnapshotRejected):
+        replica.apply_published(load_latest(d))
+    assert replica._snapshot is good_snap
+    assert replica.generation == good_gen
+    out = run(replica.submit(doc(3), "cfg-3"))
+    assert bool(out[0][0])  # still serving the last vetted snapshot
+
+
+def test_replica_poll_loop_applies_and_survives_rejection(tmp_path):
+    d = str(tmp_path / "pub")
+    leader = build_engine(strict_verify=True)
+    pub = SnapshotPublisher(d)
+    pub.attach(leader)
+    leader.apply_snapshot(entries_of(make_corpus(6)))
+    assert pub.flush()
+
+    replica = build_engine()
+    rep = SnapshotReplica(replica, d, poll_s=0.1)
+    assert rep.poll_once() is True
+    assert rep.applied == 1
+    assert rep.poll_once() is False  # unchanged digest: no re-apply
+    # a new vetted publish is picked up
+    leader.apply_snapshot(entries_of(make_corpus(6, mutated={0})))
+    assert pub.flush()
+    assert rep.poll_once() is True and rep.applied == 2
+    # an uncertified publish is rejected exactly once (digest remembered)
+    blob, _ = _serialize_corpus(make_corpus(6, mutated={0, 1}),
+                                certified=False, generation=50)
+    pub.publish_blob(blob, 50)
+    assert rep.poll_once() is False and rep.rejected == 1
+    assert rep.poll_once() is False and rep.rejected == 1
+    rep.stop()
+
+
+def test_replica_delta_uploads_and_cache_survival_across_generations(tmp_path):
+    """Churn reaches replicas too: the second published generation lands
+    as a rows-level delta against the replica's previous device params,
+    and — via interner adoption (every deserialize builds a fresh interner
+    whose serial would otherwise change the epoch) — the replica's
+    verdict-cache entries for untouched configs SURVIVE the swap."""
+    n = 12
+    d = str(tmp_path / "pub")
+    leader = build_engine(strict_verify=True)
+    pub = SnapshotPublisher(d)
+    pub.attach(leader)
+    leader.apply_snapshot(entries_of(make_corpus(n)))
+    assert pub.flush()
+    replica = build_engine()
+    replica.apply_published(load_latest(d))
+    run(submit_all(replica, n))  # warm the replica's verdict cache
+    vc = replica._verdict_cache
+    assert vc.adds >= n
+    leader.apply_snapshot(entries_of(make_corpus(n, mutated={4})))
+    assert pub.flush()
+    replica.apply_published(load_latest(d))
+    up = replica._snapshot.upload
+    assert up["mode"] == "delta"
+    assert up["upload_bytes"] < up["full_bytes"] / 2
+    hits0 = vc.hits
+    run(submit_all(replica, n))
+    assert vc.hits - hits0 >= n - 1  # only the mutated config misses
+    # and the mutated config's new rules actually serve
+    out = run(replica.submit(doc(4), "cfg-4"))
+    cold = build_engine(make_corpus(n, mutated={4}),
+                        verdict_cache_size=0, batch_dedup=False)
+    want = run(cold.submit(doc(4), "cfg-4"))
+    np.testing.assert_array_equal(out[0], want[0])
+
+
+def test_replica_never_republishes_loaded_snapshots(tmp_path):
+    """Loop breaker: a node that both loads and publishes (a relay, or a
+    misconfigured replica) must not republish what it consumed — that
+    would re-apply/republish forever through any shared path."""
+    d1, d2 = str(tmp_path / "up"), str(tmp_path / "down")
+    leader = build_engine(strict_verify=True)
+    pub = SnapshotPublisher(d1)
+    pub.attach(leader)
+    leader.apply_snapshot(entries_of(make_corpus(4)))
+    assert pub.flush()
+
+    relay = build_engine()
+    relay_pub = SnapshotPublisher(d2)
+    relay_pub.attach(relay)
+    relay.apply_published(load_latest(d1))
+    assert relay_pub.flush()
+    assert relay._snapshot.published_origin
+    assert not [f for f in os.listdir(d2) if f.endswith(".atpusnap")]
+
+
+# ---------------------------------------------------------------------------
+# diff engine + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_diff_names_exactly_the_changes():
+    old = {c.name: rules_fingerprint(c) for c in make_corpus(6)}
+    new_cfgs = make_corpus(6, mutated={2})[:5]  # drop cfg-5, mutate cfg-2
+    new = {c.name: rules_fingerprint(c) for c in new_cfgs}
+    new["cfg-9"] = "f" * 64                      # and add one
+    d = snapshot_diff(old, new)
+    assert d["changed"] == ["cfg-2"]
+    assert d["removed"] == ["cfg-5"]
+    assert d["added"] == ["cfg-9"]
+    assert d["unchanged"] == 4
+    assert d["recompile"] == ["cfg-2", "cfg-9"]
+
+
+def test_snapshot_diff_cli(tmp_path):
+    blob1, _ = _serialize_corpus(make_corpus(6), generation=1)
+    # same interner continuity is NOT required for the CLI diff — it
+    # compares fingerprints and host views structurally
+    blob2, _ = _serialize_corpus(make_corpus(6, mutated={3}), generation=2)
+    p1, p2 = str(tmp_path / "old.snap"), str(tmp_path / "new.snap")
+    open(p1, "wb").write(blob1)
+    open(p2, "wb").write(blob2)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-m", "authorino_tpu.analysis",
+         "--snapshot-diff", p1, p2, "--json"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout)
+    assert out["configs"]["changed"] == ["cfg-3"]
+    assert out["new_generation"] == 2
+
+
+def test_debug_vars_control_plane_block():
+    engine = build_engine(make_corpus(5))
+    engine.apply_snapshot(entries_of(make_corpus(5)))
+    cp = engine.debug_vars()["control_plane"]
+    assert cp["compile"]["compiled"] == 0
+    assert cp["upload"]["mode"] == "reuse"
+    assert cp["per_config_cache_keying"] is True
+    assert "compile" in cp["phases_ms"]
+    assert cp["compile_cache"]["hit_ratio"] is not None

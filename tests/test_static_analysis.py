@@ -401,6 +401,10 @@ def test_strict_verify_rejects_corrupt_swap(monkeypatch):
     from authorino_tpu.utils import metrics as metrics_mod
 
     eng = PolicyEngine(mesh=None, strict_verify=True, analyze_policies=False)
+    # this test simulates a COMPILER bug by monkeypatching compile_corpus:
+    # the incremental compile cache (ISSUE 8) would honestly skip the
+    # recompile of an identical corpus, so force the monolithic path
+    eng.compile_cache = None
     eng.apply_snapshot(_entries(fixture_configs()))
     g1 = eng.generation
     snap1 = eng._snapshot

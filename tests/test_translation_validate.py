@@ -304,6 +304,10 @@ def test_strict_verify_rejects_miscompiled_swap(monkeypatch):
 
     clear_certificate_cache()
     eng = PolicyEngine(mesh=None, strict_verify=True, analyze_policies=False)
+    # this test simulates a COMPILER bug by monkeypatching compile_corpus:
+    # the incremental compile cache (ISSUE 8) would honestly skip the
+    # recompile of an identical corpus, so force the monolithic path
+    eng.compile_cache = None
     eng.apply_snapshot(_entries(fixture_configs()))
     g1, snap1 = eng.generation, eng._snapshot
     assert snap1.translation["validated"] == 3
