@@ -237,6 +237,97 @@ def _run_snapshot_diff(old_path: str, new_path: str) -> dict:
     }
 
 
+def _run_metrics_catalog() -> dict:
+    """Metrics-catalogue drift gate (ISSUE 9 satellite): every family
+    registered in utils/metrics.py must appear in docs/observability.md
+    and vice versa.  Non-empty drift fails the command (and tier-1)."""
+    from .metrics_catalog import DOC_PATH, catalog_drift
+
+    missing, stale = catalog_drift()
+    return {"doc": DOC_PATH, "missing_in_docs": missing,
+            "stale_in_docs": stale, "ok": not missing and not stale}
+
+
+def _load_json_source(src: str) -> dict:
+    """JSON from a local file or an http(s) URL (e.g. a live server's
+    /debug/decisions, or a flight-recorder bundle on disk)."""
+    if src.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(src, timeout=10) as resp:  # nosec - operator-given URL
+            return json.loads(resp.read().decode("utf-8"))
+    with open(src, "r") as f:
+        return json.load(f)
+
+
+def _fmt_ts(t) -> str:
+    import datetime
+
+    try:
+        return datetime.datetime.fromtimestamp(float(t)).strftime(
+            "%H:%M:%S.%f")[:-3]
+    except Exception:
+        return str(t)
+
+
+def _print_decisions(report: dict) -> None:
+    """Pretty-print a decision-log JSON (/debug/decisions shape)."""
+    records = report.get("records", [])
+    print(f"decision log: {len(records)} record(s) shown, "
+          f"{report.get('records_total', len(records))} sampled total "
+          f"(1-in-{report.get('sample_n', '?')}, "
+          f"ring capacity {report.get('capacity', '?')})")
+    if not records:
+        return
+    print(f"{'time':<12} {'lane':<8} {'verdict':<7} {'gen':<5} "
+          f"{'ms':>8}  {'host':<20} {'authconfig':<24} rule")
+    for r in records:
+        print(f"{_fmt_ts(r.get('t')):<12} {str(r.get('lane', '')):<8} "
+              f"{str(r.get('verdict', '')):<7} "
+              f"{str(r.get('generation', '')):<5} "
+              f"{r.get('latency_ms', 0):>8.2f}  "
+              f"{str(r.get('host', ''))[:20]:<20} "
+              f"{str(r.get('authconfig', ''))[:24]:<24} "
+              f"{r.get('rule') or '-'}")
+
+
+def _print_flight_bundle(bundle: dict) -> None:
+    """Pretty-print one flight-recorder diagnostic bundle."""
+    from ..runtime.flight_recorder import ANOMALY_KINDS, BUNDLE_SCHEMA
+
+    if bundle.get("kind") != "authorino-tpu-flight-bundle":
+        print("not a flight-recorder bundle (missing kind marker)")
+        return
+    if bundle.get("schema") != BUNDLE_SCHEMA:
+        print(f"WARNING: bundle schema {bundle.get('schema')} != "
+              f"reader schema {BUNDLE_SCHEMA} — fields may be missing")
+    events = bundle.get("events", [])
+    anomalies = [e for e in events if e.get("kind") in ANOMALY_KINDS]
+    print(f"flight bundle: trigger={bundle.get('trigger')} "
+          f"at {_fmt_ts(bundle.get('t'))} pid={bundle.get('pid')}")
+    print(f"  {len(events)} event(s) in the ring, "
+          f"{len(anomalies)} anomalies")
+    for comp, dv in (bundle.get("vars") or {}).items():
+        if not isinstance(dv, dict):
+            continue
+        breaker = (dv.get("breaker") or {}).get("state")
+        adm = (dv.get("admission") or {}).get("state")
+        gen = dv.get("generation", dv.get("snapshot"))
+        print(f"  {comp}: breaker={breaker} admission={adm} "
+              f"generation={gen}")
+    print("event trail (oldest first):")
+    for e in events:
+        mark = "!" if e.get("kind") in ANOMALY_KINDS else " "
+        detail = e.get("detail")
+        detail_s = json.dumps(detail, default=str) if detail else ""
+        print(f" {mark} {_fmt_ts(e.get('t'))} "
+              f"{str(e.get('lane', '')):<8} {e.get('kind'):<22} "
+              f"{detail_s[:100]}")
+    if bundle.get("metrics"):
+        print(f"  (+ {len(bundle['metrics'])} bytes of /metrics exposition "
+              f"in the bundle)")
+
+
 def _run_coverage_report() -> dict:
     """Lowerability report over the fixture corpus (ISSUE 6 layer 3)."""
     from ..compiler.compile import compile_corpus
@@ -268,6 +359,20 @@ def main(argv=None) -> int:
                          "snapshots (blob files or publish directories): "
                          "configs recompiled, operand rows touched, delta "
                          "vs full upload bytes (docs/control_plane.md)")
+    ap.add_argument("--metrics-catalog", action="store_true",
+                    help="drift gate: every metric family registered in "
+                         "utils/metrics.py must appear in "
+                         "docs/observability.md and vice versa (exit 1 on "
+                         "drift)")
+    ap.add_argument("--decisions", metavar="SRC",
+                    help="pretty-print a decision log: SRC is a live "
+                         "server's /debug/decisions URL or a saved JSON "
+                         "file (docs/observability.md 'Decision "
+                         "provenance')")
+    ap.add_argument("--flight-dump", metavar="FILE",
+                    help="pretty-print a flight-recorder diagnostic bundle "
+                         "(the JSON auto-dumped on anomaly triggers; "
+                         "docs/observability.md 'Flight recorder')")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -280,6 +385,38 @@ def main(argv=None) -> int:
             print(json.dumps(out, indent=2, sort_keys=True))
         else:
             print(report["text"])
+        return 0
+
+    if args.metrics_catalog:
+        report = _run_metrics_catalog()
+        if args.as_json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            for name in report["missing_in_docs"]:
+                print(f"UNDOCUMENTED: {name} registered in utils/metrics.py "
+                      f"but absent from docs/observability.md")
+            for name in report["stale_in_docs"]:
+                print(f"STALE: {name} documented in docs/observability.md "
+                      f"but not registered in utils/metrics.py")
+            print(f"{'OK' if report['ok'] else 'DRIFT'}: "
+                  f"{len(report['missing_in_docs'])} undocumented, "
+                  f"{len(report['stale_in_docs'])} stale")
+        return 0 if report["ok"] else 1
+
+    if args.decisions:
+        report = _load_json_source(args.decisions)
+        if args.as_json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            _print_decisions(report)
+        return 0
+
+    if args.flight_dump:
+        bundle = _load_json_source(args.flight_dump)
+        if args.as_json:
+            print(json.dumps(bundle, indent=2, sort_keys=True, default=str))
+        else:
+            _print_flight_bundle(bundle)
         return 0
 
     any_mode = args.self_lint or args.verify_fixtures or args.coverage_report

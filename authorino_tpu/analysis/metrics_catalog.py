@@ -1,0 +1,104 @@
+"""Metrics-catalogue drift gate (ISSUE 9 satellite).
+
+Every Prometheus family registered in ``utils/metrics.py`` must be
+documented in ``docs/observability.md``, and every ``auth_server_*`` family
+the doc names must actually exist in code — otherwise dashboards chase
+ghosts and new series ship undocumented.  Wired as
+``python -m authorino_tpu.analysis --metrics-catalog`` and a tier-1 test
+(tests/test_provenance.py), so the two can never drift silently.
+
+Doc parsing understands the catalogue's two brace conventions:
+
+- expansion braces mid-name: ``auth_server_evaluator_{total,denied}`` →
+  both families;
+- label braces after a complete name: ``auth_server_rule_fired_total
+  {authconfig,rule}`` → labels are dropped, the family is the prefix.
+
+The distinction is structural: an expansion group is preceded by ``_``, a
+label group by a completed family name."""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Set, Tuple
+
+__all__ = ["registered_families", "documented_families", "catalog_drift",
+           "DOC_PATH"]
+
+DOC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "docs", "observability.md")
+
+_TOKEN_RE = re.compile(r"auth_server_[a-z0-9_]+(?:\{[^}]*\}[a-z0-9_]*)*")
+
+# sample-suffix forms the doc may use when naming histogram/counter series
+# explicitly (e.g. `auth_server_batch_size_bucket`); strip back to family
+_SAMPLE_SUFFIXES = ("_bucket", "_count", "_sum")
+
+
+def registered_families() -> Set[str]:
+    """Every auth_server_* family utils/metrics.py registered in this
+    process (prometheus_client stores counters without the _total suffix;
+    re-append it so names compare in exposition form)."""
+    from ..utils import metrics as metrics_mod
+
+    fams: Set[str] = set()
+    for value in vars(metrics_mod).values():
+        name = getattr(value, "_name", None)
+        mtype = getattr(value, "_type", None)
+        if not isinstance(name, str) or not name.startswith("auth_server_"):
+            continue
+        fams.add(name + "_total" if mtype == "counter" else name)
+    return fams
+
+
+def _expand(token: str) -> List[str]:
+    m = re.search(r"\{([^{}]*)\}", token)
+    if m is None:
+        return [token]
+    pre, inner, post = token[:m.start()], m.group(1), token[m.end():]
+    if pre.endswith("_"):
+        out: List[str] = []
+        for part in inner.split(","):
+            out.extend(_expand(pre + part.strip() + post))
+        return out
+    # label braces: the family is the completed name before the brace
+    return [pre]
+
+
+def documented_families(path: str = DOC_PATH) -> Set[str]:
+    with open(path, "r") as f:
+        text = f.read()
+    fams: Set[str] = set()
+    for token in _TOKEN_RE.findall(text):
+        for name in _expand(token):
+            for suffix in _SAMPLE_SUFFIXES:
+                if name.endswith(suffix) and name[:-len(suffix)]:
+                    name = name[:-len(suffix)]
+                    break
+            if name:
+                fams.add(name)
+    return fams
+
+
+def catalog_drift(path: str = DOC_PATH) -> Tuple[List[str], List[str]]:
+    """(registered-but-undocumented, documented-but-unregistered).
+
+    The documented set may legitimately contain sample-suffix-stripped
+    stems that are PREFIXES of real families (`auth_server_evaluator`
+    from `auth_server_evaluator_duration_seconds` prose); a documented
+    name counts as unregistered only when no registered family starts
+    with it."""
+    code = registered_families()
+    docs = documented_families(path)
+    # counters may be documented under their reference-parity name without
+    # the exposition _total suffix (auth_server_response_status et al.)
+    missing_in_docs = sorted(
+        c for c in code
+        if c not in docs
+        and not (c.endswith("_total") and c[:-len("_total")] in docs))
+    stale_in_docs = sorted(
+        d for d in docs
+        if d not in code and not any(c.startswith(d) for c in code))
+    return missing_in_docs, stale_in_docs

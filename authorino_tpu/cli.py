@@ -126,6 +126,38 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Rows per brownout spill batch (small by design: "
                         "the host lane absorbs latency-critical work, not "
                         "bulk throughput)")
+    s.add_argument("--expose-deny-reason", action="store_true",
+                   default=env_var("EXPOSE_DENY_REASON", False),
+                   help="PRIVACY KNOB (decision provenance): name the "
+                        "attributed firing rule in the client-visible "
+                        "X-Ext-Auth-Reason header on denials.  Off by "
+                        "default — clients see the generic 'Unauthorized' "
+                        "while Envoy dynamic_metadata and the operator "
+                        "surfaces (/metrics rule heat map, /debug/"
+                        "decisions) always carry the attribution")
+    s.add_argument("--slo-ms", type=float, default=env_var("SLO_MS", 0.0),
+                   help="Per-request latency SLO in ms (0 = SLO tracking "
+                        "off): arms the multi-window burn-rate tracker "
+                        "(auth_server_slo_burn_rate{lane,window} gauges + "
+                        "the /debug/vars slo block) on both lanes")
+    s.add_argument("--decision-log-size", type=int,
+                   default=env_var("DECISION_LOG_SIZE", 1024),
+                   help="Bounded decision-log ring capacity "
+                        "(/debug/decisions; head-sampled records)")
+    s.add_argument("--decision-log-sample", type=int,
+                   default=env_var("DECISION_LOG_SAMPLE", 64),
+                   help="Head-sample 1-in-N decisions into the decision "
+                        "log (at most one record per micro-batch — zero "
+                        "per-request work on the native lane)")
+    s.add_argument("--flight-dir", default=env_var("AUTHORINO_TPU_FLIGHT_DIR", ""),
+                   help="Directory for flight-recorder diagnostic bundles "
+                        "(default: <tmp>/authorino-tpu-flight).  Bundles "
+                        "auto-dump on anomalies: breaker OPEN, watchdog "
+                        "fire, snapshot rejection, admission OVERLOADED")
+    s.add_argument("--no-flight-recorder", action="store_true",
+                   default=not env_var("AUTHORINO_TPU_FLIGHT_RECORDER", True),
+                   help="Disable the lifecycle flight recorder (the "
+                        "bounded event ring + anomaly bundle dumps)")
     s.add_argument("--drain-timeout", type=float,
                    default=env_var("DRAIN_TIMEOUT_S", 10.0),
                    help="Graceful-shutdown bound in seconds: SIGTERM stops "
@@ -284,6 +316,20 @@ async def run_server(args) -> None:
 
         setup_tracing(args.tracing_service_endpoint, insecure=args.tracing_service_insecure)
 
+    # decision observability (ISSUE 9, docs/observability.md): the deny-
+    # reason privacy knob, the decision-log ring, and the flight recorder
+    from .runtime import provenance as prov_mod
+    from .runtime.flight_recorder import RECORDER
+
+    prov_mod.EXPOSE_DENY_REASON = bool(
+        getattr(args, "expose_deny_reason", False))
+    prov_mod.DECISIONS.configure(
+        capacity=int(getattr(args, "decision_log_size", 1024)),
+        sample_n=int(getattr(args, "decision_log_sample", 64)))
+    RECORDER.configure(
+        dump_dir=(str(getattr(args, "flight_dir", "") or "") or None),
+        enabled=not getattr(args, "no_flight_recorder", False))
+
     fault_profile = str(getattr(args, "fault_profile", "") or "")
     if fault_profile:
         from .runtime import faults
@@ -322,6 +368,7 @@ async def run_server(args) -> None:
         device_timeout_s=(device_timeout_ms / 1000.0) or None,
         breaker_threshold=int(getattr(args, "breaker_threshold", 5)),
         breaker_reset_s=float(getattr(args, "breaker_reset", 5.0)),
+        slo_ms=float(getattr(args, "slo_ms", 0.0)),
     )
 
     # snapshot distribution (ISSUE 8, docs/control_plane.md): a compile
@@ -465,6 +512,7 @@ async def run_server(args) -> None:
                     args, "admission_target_ms", 50.0)) / 1e3,
                 brownout=not getattr(args, "no_brownout", False),
                 brownout_max_rows=int(getattr(args, "brownout_max_batch", 32)),
+                slo_ms=float(getattr(args, "slo_ms", 0.0)),
             )
             native_fe.start()
             native_holder["fe"] = native_fe  # /debug/vars picks it up

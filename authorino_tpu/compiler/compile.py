@@ -217,6 +217,28 @@ class CompiledPolicy:
     # never depends on it — cache keys are full encoded-row digests.
     config_cacheable: np.ndarray = None  # [G] bool
 
+    def rule_sources(self) -> List[List[str]]:
+        """Decision provenance (ISSUE 9): per config row, the source string
+        of each evaluator's rule expression — the rule-index → (authconfig,
+        rule-source) map the observability layer attributes denials with.
+        Derived from ``config_exprs`` (which the snapshot serializer
+        round-trips, so replicas attribute identically to the compiling
+        leader); memoized on first use — one walk per compiled corpus,
+        never per request."""
+        memo = getattr(self, "_rule_sources", None)
+        if memo is None:
+            memo = [[str(rule) for _cond, rule in evs]
+                    for evs in self.config_exprs]
+            object.__setattr__(self, "_rule_sources", memo)
+        return memo
+
+    def provenance_map(self) -> Dict[str, Dict[str, Any]]:
+        """JSON-safe {config name: {"row", "rules": [source, ...]}} view of
+        rule_sources (the /debug/vars + analysis-CLI shape)."""
+        srcs = self.rule_sources()
+        return {name: {"row": row, "rules": list(srcs[row])}
+                for name, row in self.config_ids.items()}
+
     @property
     def dfa_tables_by_row(self) -> np.ndarray:
         """Transition tables expanded back to the per-row axis [R, S, 256]

@@ -360,6 +360,11 @@ async def translate_auth_config(
                 rules,
                 batched_provider=engine.provider_for(cfg_id) if engine is not None else None,
                 evaluator_slot=slot,
+                # deny attribution (ISSUE 9): which rule fired rides the
+                # denial into dynamic_metadata / X-Ext-Auth-Reason
+                attributor=(engine.attribution_for(cfg_id)
+                            if engine is not None
+                            and hasattr(engine, "attribution_for") else None),
             )
             if engine is not None:
                 # conditions are compiled into the kernel; avoid double gating

@@ -11,6 +11,13 @@ ref: pkg/evaluators/authorization/json.go:11-27):
   evaluates the whole corpus on TPU (runtime/engine.py); the pipeline seam
   is identical, so mixed CPU/TPU AuthConfigs compose (BASELINE.json north
   star).
+
+Decision provenance (ISSUE 9): a denial raises an EvaluationError carrying
+a ``provenance`` attribute — which rule fired — that the pipeline forwards
+into Envoy ``dynamic_metadata``; the reason STRING only names the rule
+behind the ``--expose-deny-reason`` privacy knob
+(runtime/provenance.py EXPOSE_DENY_REASON), staying the reference's generic
+"Unauthorized" otherwise.
 """
 
 from __future__ import annotations
@@ -24,6 +31,12 @@ from ..base import EvaluationError, SkippedError
 # (allowed, skipped); skipped means the compiled conditions gated it off
 BatchedVerdictProvider = Callable[[Any, int], "Awaitable[tuple[bool, bool]]"]
 
+# an Attributor resolves an evaluator slot → provenance dict (authconfig,
+# rule_index, rule source) for a denial, or None (engine.attribution_for).
+# It may accept an optional second arg: the pinned snapshot that evaluated
+# the request (pipeline.eval_snapshot, set by the engine's provider)
+Attributor = Callable[..., Optional[dict]]
+
 
 class PatternMatching:
     def __init__(
@@ -31,10 +44,38 @@ class PatternMatching:
         rules: Expression,
         batched_provider: Optional[BatchedVerdictProvider] = None,
         evaluator_slot: int = 0,
+        attributor: Optional[Attributor] = None,
     ):
         self.rules = rules
         self.batched_provider = batched_provider
         self.evaluator_slot = evaluator_slot
+        self.attributor = attributor
+
+    def _deny(self, pipeline=None) -> EvaluationError:
+        from ...runtime import provenance as prov_mod
+
+        prov = None
+        if self.attributor is not None:
+            # the provider pinned the snapshot that evaluated this request
+            # on the pipeline: attribution must read THAT corpus, not one
+            # a reconcile swapped in since the verdict
+            snap = getattr(pipeline, "eval_snapshot", None)
+            try:
+                prov = self.attributor(self.evaluator_slot, snap)
+            except TypeError:
+                # attributor with the plain (slot) signature
+                prov = self.attributor(self.evaluator_slot)
+            except Exception:
+                prov = None
+        if prov is None:
+            # inline mode (or no compiled snapshot): the evaluator still
+            # knows its own rule source — attribution never goes dark just
+            # because the verdict rode the interpreter
+            prov = prov_mod.deny_provenance(
+                "", self.evaluator_slot, str(self.rules), lane="pipeline")
+        err = EvaluationError(prov_mod.deny_reason(prov))
+        err.provenance = prov
+        return err
 
     async def call(self, pipeline) -> Any:
         if self.batched_provider is not None:
@@ -47,5 +88,5 @@ class PatternMatching:
             except PatternError as e:
                 raise EvaluationError(str(e))
         if not allowed:
-            raise EvaluationError("Unauthorized")
+            raise self._deny(pipeline)
         return True

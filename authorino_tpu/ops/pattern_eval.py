@@ -74,7 +74,7 @@ from ..compiler.compile import (
 __all__ = ["DevicePolicy", "to_device", "eval_verdicts", "eval_batch_jit",
            "fuse_batch", "eval_fused_jit", "dispatch_fused",
            "fused_h2d_supported", "eval_bitpacked_jit", "unpack_verdicts",
-           "packed_width"]
+           "packed_width", "firing_columns", "unpack_attribution"]
 
 # exact integer range of f32 accumulation — larger interners must use the
 # gather lane
@@ -553,6 +553,37 @@ def unpack_verdicts(arr, n_cols: int) -> np.ndarray:
     [B, n_cols] bool matrix eval_packed_jit would have returned."""
     a = np.asarray(arr)
     return np.unpackbits(a, axis=1, bitorder="little")[:, :n_cols].astype(bool)
+
+
+def firing_columns(own_rule: np.ndarray, own_skipped: np.ndarray) -> np.ndarray:
+    """Which-rule-fired attribution (ISSUE 9): the FIRST evaluator column
+    that evaluated false and was not condition-skipped, per row — the same
+    short-circuit order the reference pipeline denies in — or -1 for
+    allowed rows (verdict ≡ all(skipped | rule), so a row is denied iff a
+    firing column exists).  Pure vectorized numpy: one call per BATCH, the
+    zero-per-request-Python contract of the native fast lane.
+
+    Padded evaluator columns read TRUE_SLOT (rule=True) and can never
+    fire.  Host-fallback rows past the fallback cap are denied fail-closed
+    with rule[:]=False — they attribute to column 0, a synthetic denial
+    documented in docs/observability.md."""
+    fired = ~np.asarray(own_skipped, dtype=bool) & ~np.asarray(
+        own_rule, dtype=bool)                                  # [B, E]
+    first = fired.argmax(axis=1).astype(np.int32)              # [B]
+    first[~fired.any(axis=1)] = -1
+    return first
+
+
+def unpack_attribution(packed, n_evaluators: int):
+    """Per-batch decode of a bitpacked [B, W] uint8 readback into
+    (verdict [B] uint8, firing [B] int32) — the native lane's one-shot
+    column fold (bit 0 = own verdict, bits 1..E = rule results,
+    E+1..2E = skipped)."""
+    E = n_evaluators
+    cols = unpack_verdicts(packed, 1 + 2 * E)
+    verdict = cols[:, 0].astype(np.uint8)
+    firing = firing_columns(cols[:, 1:1 + E], cols[:, 1 + E:1 + 2 * E])
+    return verdict, firing
 
 
 @partial(jax.jit, static_argnames=())

@@ -397,7 +397,8 @@ class ShardedPolicyModel:
             return host_results(self.shards[shard], docs[r], int(row))[1:]
 
         fallback_rows = np.nonzero(host_fallback[: len(docs)])[0]
-        metrics_mod.batch_host_fallback.observe(len(fallback_rows))
+        metrics_mod.batch_host_fallback.labels("engine").observe(
+            len(fallback_rows))
         apply_host_fallback(
             decide, fallback_rows,
             own_rule, own_skipped, max_fallback,

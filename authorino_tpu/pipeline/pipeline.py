@@ -84,6 +84,15 @@ class AuthPipeline:
         # pipeline below --timeout AND rides into the batch dispatcher,
         # where deadline-aware shedding fails doomed requests before encode
         self.deadline = deadline
+        # deny provenance (ISSUE 9): which rule fired, captured from the
+        # authorization failure and forwarded into AuthResult.metadata
+        # (Envoy dynamic_metadata) — the reason string stays generic unless
+        # --expose-deny-reason
+        self.deny_provenance: Optional[Dict[str, Any]] = None
+        # the engine snapshot that evaluated this request's batched
+        # verdict (set by the engine's provider): deny attribution reads
+        # this corpus, immune to a mid-request reconcile swap
+        self.eval_snapshot: Any = None
         self.identity_results: Dict[Any, Any] = {}
         self.metadata_results: Dict[Any, Any] = {}
         self.authorization_results: Dict[Any, Any] = {}
@@ -296,6 +305,7 @@ class AuthPipeline:
                     raise
                 except Exception as e:
                     self._sync_auth()
+                    self.deny_provenance = getattr(e, "provenance", None)
                     return str(e)
                 self.authorization_results[c] = obj
                 self._sync_auth()
@@ -320,6 +330,8 @@ class AuthPipeline:
                             raise
                         except Exception as e:
                             failure = str(e)
+                            self.deny_provenance = getattr(
+                                e, "provenance", None)
                             break
                         self.authorization_results[conf] = obj
                 self._sync_auth()
@@ -463,6 +475,12 @@ class AuthPipeline:
             if authz_err is not None:
                 result.code = PERMISSION_DENIED
                 result.message = authz_err
+                if self.deny_provenance is not None:
+                    # Envoy dynamic_metadata: the attributed rule always
+                    # reaches the mesh (operator surface); the client-
+                    # visible reason header is gated separately
+                    result.metadata = {
+                        "ext_authz_provenance": dict(self.deny_provenance)}
                 result = self._customize_deny_with(result, self.config.deny_with.unauthorized)
             else:
                 ph = self._phase_span("response", self.config.response)

@@ -274,6 +274,13 @@ class AdmissionController:
         if state != self._state:
             self._state = state
             self._g_state.set(_STATE_VALUE[state])
+            # flight recorder (ISSUE 9): admission flips ride the lifecycle
+            # ring; entering OVERLOADED is an anomaly trigger (auto-dump)
+            from .flight_recorder import RECORDER
+
+            RECORDER.record(
+                "admission-overloaded" if state is OVERLOADED
+                else "admission", lane=self.lane, detail={"state": state})
 
     @property
     def state(self) -> str:

@@ -60,6 +60,14 @@ class CircuitBreaker:
         self.transitions.append(
             {"t": time.time(), "state": state, "reason": reason})
         del self.transitions[:-64]
+        # flight recorder (ISSUE 9): every transition rides the lifecycle
+        # ring; entering OPEN is an anomaly trigger (auto-dump).  record()
+        # is a deque append — safe under this lock, fail-safe inside
+        from .flight_recorder import RECORDER
+
+        RECORDER.record("breaker-open" if state == OPEN else "breaker",
+                        lane=self.lane,
+                        detail={"state": state, "reason": reason})
         log.warning("circuit breaker (%s lane) -> %s (%s)",
                     self.lane, state.upper(), reason)
 

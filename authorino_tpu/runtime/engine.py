@@ -67,8 +67,10 @@ from ..utils import tracing as tracing_mod
 from ..utils.rpc import DEADLINE_EXCEEDED, NOT_FOUND, UNAVAILABLE, CheckAbort
 from ..utils.verdict_cache import VerdictCache
 from . import faults
+from . import provenance as prov_mod
 from .admission import AdaptiveWindow, AdmissionController
 from .breaker import CircuitBreaker
+from .flight_recorder import RECORDER
 
 __all__ = ["PolicyEngine", "EngineEntry", "SnapshotRejected"]
 
@@ -142,6 +144,9 @@ class _Snapshot:
         self.phase_s: Dict[str, float] = {}
         self.host_view = None
         self.published_origin: Optional[str] = None  # set by from_published
+        # rule heat map (ISSUE 9): built at install time by
+        # _install_snapshot (kernel rows → authconfig/rule-source labels)
+        self.heat = None
         if rules:
             if mesh is not None:
                 from ..parallel import ShardedPolicyModel
@@ -281,6 +286,7 @@ class _Snapshot:
         snap.upload = None
         snap.phase_s = {}
         snap.host_view = None
+        snap.heat = None
         # provenance: this snapshot was LOADED, not compiled here — the
         # publisher skips it (a replica must never republish what it
         # consumed, or a node whose source and publish dir meet — even
@@ -433,6 +439,7 @@ class PolicyEngine:
         adaptive_window: bool = True,
         brownout: bool = True,
         brownout_max_batch: int = 32,
+        slo_ms: float = 0.0,
     ):
         """``mesh="auto"`` shards the rule corpus over all visible devices
         when more than one is present (dp × mp ShardedPolicyModel);
@@ -582,6 +589,16 @@ class PolicyEngine:
         self._brownout_limit = max(1, self.dispatch_workers // 2)
         self._brownout_inflight = 0
         self._brownout_total = 0
+        # decision observability (ISSUE 9, docs/observability.md): the SLO
+        # burn-rate tracker (--slo-ms; 0 = off) and the flight-recorder
+        # debug-vars provider.  The rule heat map lives on each snapshot
+        # (attribution must match the corpus that evaluated the batch).
+        self.slo = None
+        if slo_ms:
+            from ..utils.slo import SloTracker
+
+            self.slo = SloTracker("engine", slo_ms)
+        RECORDER.register_provider("engine", self, "debug_vars")
 
     # swap listeners: the native frontend rebuilds its C++ snapshot after
     # every corpus swap (runtime/native_frontend.py refresh)
@@ -635,6 +652,9 @@ class PolicyEngine:
                              prev=self._snapshot)
         except SnapshotRejected as e:
             metrics_mod.snapshot_rejected.labels("engine").inc()
+            RECORDER.record("snapshot-rejected", lane="engine", detail={
+                "generation": self.generation,
+                "findings": [str(f) for f in e.findings[:5]]})
             log.error(
                 "snapshot REJECTED by tensor lint (previous generation %d "
                 "keeps serving): %s", self.generation,
@@ -658,6 +678,9 @@ class PolicyEngine:
                 strict_verify=self.strict_verify, prev=self._snapshot)
         except SnapshotRejected as e:
             metrics_mod.snapshot_rejected.labels("engine").inc()
+            RECORDER.record("snapshot-rejected", lane="engine", detail={
+                "generation": self.generation, "published": True,
+                "findings": [str(f) for f in e.findings[:5]]})
             log.error(
                 "published snapshot REJECTED at admission (previous "
                 "generation %d keeps serving): %s", self.generation,
@@ -675,6 +698,15 @@ class PolicyEngine:
         for e in entries:
             for host in e.hosts:
                 new_index.set(e.id, host, e, override=override)
+        # decision provenance (ISSUE 9): the rule heat map binds kernel rows
+        # to (authconfig, rule source) for THIS snapshot — attribution and
+        # the dead-rule report always read the corpus that evaluated
+        try:
+            snap.heat = prov_mod.HeatMap.for_snapshot(snap.policy,
+                                                      snap.sharded)
+        except Exception:
+            log.exception("rule heat map build failed (swap unaffected)")
+            snap.heat = None
         with self._swap_lock:
             self.generation += 1
             # the mesh lane's verdict cache keys on snap.generation (the
@@ -686,6 +718,8 @@ class PolicyEngine:
             self._snapshot = snap
             self.index = new_index
             metrics_mod.snapshot_generation.labels("engine").set(self.generation)
+        RECORDER.record("snapshot-swap", lane="engine", detail={
+            "generation": snap.generation, "configs": len(snap.by_id)})
         self._record_control_plane(snap)
         # listeners (the native frontend rebuilding its C++ snapshot) fire
         # BEFORE the advisory analysis: a revoking reconcile must propagate
@@ -713,6 +747,10 @@ class PolicyEngine:
                     int(snap.upload.get("upload_bytes", 0)))
                 metrics_mod.full_upload_bytes.labels("engine").inc(
                     int(snap.upload.get("full_bytes", 0)))
+            RECORDER.record("reconcile", lane="engine", detail={
+                "generation": snap.generation,
+                "phases_ms": {k: round(v * 1e3, 3)
+                              for k, v in snap.phase_s.items()}})
             self._control_plane = {
                 "generation": snap.generation,
                 "phases_ms": {k: round(v * 1e3, 3)
@@ -836,6 +874,26 @@ class PolicyEngine:
             },
             "faults": (faults.FAULTS.describe() if faults.ACTIVE else
                        {"armed": False}),
+            # decision observability (ISSUE 9, docs/observability.md):
+            # heat-map shape + fold evidence, the dead-rule cross-reference
+            # against the static findings, decision-log state, the SLO
+            # burn-rate windows, and the flight recorder's tail
+            "provenance": {
+                "expose_deny_reason": prov_mod.EXPOSE_DENY_REASON,
+                "heat": (snap.heat.to_json()
+                         if snap is not None and snap.heat is not None
+                         else None),
+                "dead_rules": prov_mod.dead_rule_report(
+                    getattr(snap, "heat", None) if snap else None,
+                    self._analysis),
+                "decisions": {
+                    "capacity": prov_mod.DECISIONS.capacity,
+                    "sample_n": prov_mod.DECISIONS.sample_n,
+                    "records_total": prov_mod.DECISIONS.records_total,
+                },
+            },
+            "slo": self.slo.to_json() if self.slo is not None else None,
+            "flight_recorder": RECORDER.to_json(),
             "snapshot": None,
         }
         if snap is not None:
@@ -903,16 +961,53 @@ class PolicyEngine:
         PatternMatching evaluators at translate time."""
 
         async def provider(pipeline, evaluator_slot: int) -> Tuple[bool, bool]:
-            rule, skipped = await self.submit(
+            rule, skipped, snap = await self.submit(
                 pipeline.authorization_json(), config_name, span=pipeline.span,
-                deadline=getattr(pipeline, "deadline", None))
+                deadline=getattr(pipeline, "deadline", None),
+                return_snapshot=True)
+            # pin the evaluating snapshot on the pipeline: a deny built
+            # moments later attributes against THIS corpus, not whatever
+            # a concurrent reconcile swapped in since
+            pipeline.eval_snapshot = snap
             e = evaluator_slot
             return bool(rule[e]), bool(skipped[e])
 
         return provider
 
+    def attribution_for(self, config_name: str):
+        """Deny-attribution resolver bound to one config (ISSUE 9): handed
+        to PatternMatching evaluators at translate time alongside
+        provider_for.  Called ONLY on the deny path (slow lane — fast-lane
+        denials are attributed per batch instead); returns the provenance
+        dict for Envoy dynamic_metadata / X-Ext-Auth-Reason, or None when
+        no compiled snapshot covers the config."""
+
+        def attributor(evaluator_slot: int, snap=None):
+            # prefer the snapshot that evaluated the request (pinned on
+            # the pipeline by provider_for); fall back to the serving one
+            # for inline/interpreter callers with no pinned snapshot
+            if snap is None:
+                snap = self._snapshot
+            heat = getattr(snap, "heat", None) if snap is not None else None
+            if heat is None:
+                return None
+            try:
+                if snap.sharded is not None:
+                    shard, row = snap.sharded.locator[config_name]
+                    src = heat.source(row, evaluator_slot, shard=shard)
+                else:
+                    row = snap.policy.config_ids[config_name]
+                    src = heat.source(row, evaluator_slot)
+            except (KeyError, AttributeError):
+                return None
+            return prov_mod.deny_provenance(config_name, evaluator_slot,
+                                            src, lane="engine")
+
+        return attributor
+
     async def submit(self, doc: Any, config_name: str, span: Any = None,
                      deadline: Optional[float] = None,
+                     return_snapshot: bool = False,
                      ) -> Tuple[np.ndarray, np.ndarray]:
         """Queue one request for the next micro-batch; resolves to that
         request's per-evaluator (rule_results [E], skipped [E]).  ``span``
@@ -954,7 +1049,13 @@ class PolicyEngine:
                                         deadline=deadline))
             self.controller.observe_arrivals()
         loop.call_soon(self._maybe_dispatch)
-        return await fut
+        rule, skipped, snap = await fut
+        if return_snapshot:
+            # deny attribution (ISSUE 9): the caller gets the snapshot
+            # that EVALUATED this request, so a reconcile landing between
+            # verdict and deny-response build cannot relabel the rule
+            return rule, skipped, snap
+        return rule, skipped
 
     # ---- pipelined dispatch ----------------------------------------------
 
@@ -1092,7 +1193,12 @@ class PolicyEngine:
         kernel's differential-test reference, membership overflow
         included).  Returns (resolutions-by-loop, failed-futures-by-loop,
         n_ok); rows whose oracle run itself failed land in ``failed`` and
-        resolve typed UNAVAILABLE, fail closed."""
+        resolve typed UNAVAILABLE, fail closed.
+
+        Attribution (ISSUE 9): the oracle's (rule, skipped) columns fold
+        into the SAME heat map / decision log as the device lane — a
+        degraded or brownout decision attributes identically to the kernel
+        decision it replaced (the oracle is the kernel's reference)."""
         from ..models.policy_model import host_results
 
         by_loop: Dict[Any, list] = {}
@@ -1117,8 +1223,69 @@ class PolicyEngine:
                 failed.setdefault(p.loop, []).append(p.future)
             else:
                 n_ok += 1
-                by_loop.setdefault(p.loop, []).append((p.future,) + tuple(res))
+                by_loop.setdefault(p.loop, []).append(
+                    (p.future,) + tuple(res) + (snap,))
+        self._fold_host_provenance(snap, batch, results)
         return by_loop, failed, n_ok
+
+    def _fold_host_provenance(self, snap: _Snapshot, batch: List[_Pending],
+                              results) -> None:
+        """Heat-map/decision-log fold for the host-oracle lanes (degrade +
+        brownout): stack the per-row (rule, skipped) columns and run the
+        same per-batch fold the device completion uses."""
+        try:
+            heat = getattr(snap, "heat", None)
+            if heat is None:
+                return
+            pendings, rows, shards, rules, skips = [], [], [], [], []
+            for p, res in zip(batch, results):
+                if res is None:
+                    continue
+                if snap.sharded is not None:
+                    s, r = snap.sharded.locator[p.config_name]
+                    shards.append(s)
+                    rows.append(r)
+                else:
+                    rows.append(snap.policy.config_ids[p.config_name])
+                pendings.append(p)
+                rules.append(np.asarray(res[0], dtype=bool))
+                skips.append(np.asarray(res[1], dtype=bool))
+            if not rows:
+                return
+            self._observe_provenance(
+                snap, pendings, np.asarray(rows), np.stack(rules),
+                np.stack(skips),
+                shards=(np.asarray(shards) if snap.sharded is not None
+                        else None))
+        except Exception:
+            log.exception("host-lane provenance fold failed "
+                          "(decision unaffected)")
+
+    def _observe_provenance(self, snap: _Snapshot, pendings: List[_Pending],
+                            rows, own_rule, own_skipped, shards=None,
+                            lane: str = "engine"):
+        """Per-batch decision-observability fold: which-rule-fired columns →
+        the snapshot's heat map (vectorized composite-key bincount), plus at
+        most ONE head-sampled decision record.  Never raises — a telemetry
+        bug must not re-dispatch a decided batch."""
+        try:
+            heat = getattr(snap, "heat", None)
+            if heat is None:
+                return None
+            from ..ops.pattern_eval import firing_columns
+
+            firing = firing_columns(own_rule, own_skipped)
+            p = pendings[0] if pendings else None
+            prov_mod.fold_and_sample(
+                heat, rows, firing, len(pendings), lane=lane, shards=shards,
+                host=_doc_host(p.doc) if p is not None else "",
+                latency_ms=((time.monotonic() - p.t_enq) * 1e3
+                            if p is not None and p.t_enq else 0.0),
+                generation=snap.generation)
+            return firing
+        except Exception:
+            log.exception("provenance fold failed (decision unaffected)")
+            return None
 
     @staticmethod
     def _resolve_host_decisions(by_loop, failed) -> None:
@@ -1144,6 +1311,11 @@ class PolicyEngine:
         if n_ok:
             metrics_mod.degraded_decisions.labels("engine").inc(n_ok)
             self.admission.observe_service(n_ok)
+            if self.slo is not None:
+                now = time.monotonic()
+                n_bad = sum(1 for p in batch if p.t_enq
+                            and now - p.t_enq > self.slo.slo_s)
+                self.slo.observe(n_ok, min(n_bad, n_ok))
             if exc is not None:
                 log.warning("micro-batch of %d re-decided host-side after "
                             "device failure (%r)", len(batch), exc)
@@ -1174,6 +1346,11 @@ class PolicyEngine:
                 metrics_mod.brownout_batches.labels("engine").inc()
                 self._brownout_total += n_ok
                 self.admission.observe_service(n_ok)
+                if self.slo is not None:
+                    now = time.monotonic()
+                    n_bad = sum(1 for p in batch if p.t_enq
+                                and now - p.t_enq > self.slo.slo_s)
+                    self.slo.observe(n_ok, min(n_bad, n_ok))
             self._resolve_host_decisions(by_loop, failed)
         except Exception:
             # a brownout bug must fail its own batch typed, never leak or
@@ -1192,6 +1369,9 @@ class PolicyEngine:
         handle is simply dropped) and fed the retry/degrade path as a
         breaker-counted failure."""
         metrics_mod.watchdog_timeouts.labels("engine").inc()
+        RECORDER.record("watchdog-timeout", lane="engine", detail={
+            "requests": len(item.batch), "attempt": item.attempt,
+            "device_timeout_s": self.device_timeout_s})
         log.warning("device batch (%d requests, attempt %d) wedged past "
                     "--device-timeout %.3fs: abandoning the handle",
                     len(item.batch), item.attempt, self.device_timeout_s)
@@ -1210,6 +1390,8 @@ class PolicyEngine:
         Queued and in-flight work keeps flowing to completion."""
         if not self._draining:
             self._draining = True
+            RECORDER.record("drain", lane="engine", detail={
+                "queue": len(self._queue), "inflight": self._inflight})
             log.info("engine draining: admission stopped "
                      "(queue=%d, inflight=%d)", len(self._queue),
                      self._inflight)
@@ -1407,6 +1589,11 @@ class PolicyEngine:
                                          own_rule, own_skipped)
             metrics_mod.observe_dedup("engine", n, u, len(cached),
                                       elig_miss, evict_d)
+            # attribution (ISSUE 9): one per-batch fold over the FINAL
+            # columns — cache hits, dedup fan-out and fallback rows are
+            # already folded back in, so every path attributes identically
+            self._observe_provenance(snap, batch, rows, own_rule,
+                                     own_skipped)
             return own_rule, own_skipped, n_fallback
 
         return _Inflight(self, batch, handle, finalize, binfo, waits)
@@ -1480,6 +1667,8 @@ class PolicyEngine:
                                          own_rule, own_skipped)
             metrics_mod.observe_dedup("engine", n, u, len(cached),
                                       elig_miss, evict_d)
+            self._observe_provenance(snap, batch, enc.row_of[:n], own_rule,
+                                     own_skipped, shards=enc.shard_of[:n])
             return own_rule, own_skipped, None
 
         return _Inflight(self, batch, handle, finalize, binfo, waits)
@@ -1503,6 +1692,7 @@ class PolicyEngine:
             # device/readback failure: retry once, then host-oracle degrade
             self._batch_failed(item.snap, item.batch, item.attempt, e)
             return
+        slo_counted = False
         try:
             # the device answered: clear the breaker's consecutive-failure
             # count (and close a half-open probe) BEFORE resolution work.
@@ -1522,6 +1712,13 @@ class PolicyEngine:
                                           len(self._queue), now=t_done)
             self.admission.observe_service(item.binfo["batch_size"],
                                            now=t_done)
+            if self.slo is not None:
+                # per-request latency ≈ queue wait + this batch's device
+                # stage — one vectorized compare per batch (ISSUE 9)
+                lat = np.asarray(item.waits) + dur
+                self.slo.observe(len(item.batch),
+                                 int(np.count_nonzero(lat > self.slo.slo_s)))
+                slo_counted = True
             binfo = item.binfo
             binfo["duration_s"] = t_done - item.t_launch
             metrics_mod.observe_pipeline_stage("engine", "device",
@@ -1544,7 +1741,7 @@ class PolicyEngine:
             by_loop: Dict[Any, list] = {}
             for i, p in enumerate(item.batch):
                 by_loop.setdefault(p.loop, []).append(
-                    (p.future, own_rule[i], own_skipped[i]))
+                    (p.future, own_rule[i], own_skipped[i], item.snap))
             for loop, resolutions in by_loop.items():
                 try:
                     loop.call_soon_threadsafe(_resolve_many, resolutions)
@@ -1560,11 +1757,12 @@ class PolicyEngine:
             # device and could walk the breaker open off exporter noise.
             log.exception("post-completion work failed (batch verdicts "
                           "already computed)")
-            self._resolve_error(item.batch, e)
+            self._resolve_error(item.batch, e, slo_counted=slo_counted)
         finally:
             self._launch_done()
 
-    def _resolve_error(self, batch: List[_Pending], exc: Exception) -> None:
+    def _resolve_error(self, batch: List[_Pending], exc: Exception,
+                       slo_counted: bool = False) -> None:
         """Fail unresolved requests with a TYPED CheckAbort — never the raw
         exception, whose repr would otherwise serve as a deny reason
         string through the gRPC/HTTP layer (ISSUE 5 satellite).  Raw causes
@@ -1573,6 +1771,13 @@ class PolicyEngine:
             log.error("batch of %d failed without a degrade path: %r",
                       len(batch), exc)
             exc = CheckAbort(UNAVAILABLE, "policy evaluation unavailable")
+        if self.slo is not None and not slo_counted and \
+                exc.code != DEADLINE_EXCEEDED:
+            # serving errors burn the SLO budget; deadline sheds are the
+            # protection mechanism working and stay out of it.  slo_counted:
+            # a post-completion telemetry failure arrives here AFTER the
+            # success path already observed the batch — don't double-burn
+            self.slo.observe_errors(len(batch))
         by_loop: Dict[Any, list] = {}
         for p in batch:
             by_loop.setdefault(p.loop, []).append(p.future)
@@ -1590,10 +1795,18 @@ class PolicyEngine:
         self._maybe_dispatch()
 
 
+def _doc_host(doc) -> str:
+    """Best-effort host of one authorization JSON (decision-log records)."""
+    try:
+        return str((doc.get("request") or {}).get("host", ""))
+    except Exception:
+        return ""
+
+
 def _resolve_many(resolutions) -> None:
-    for fut, rule, skipped in resolutions:
+    for fut, rule, skipped, snap in resolutions:
         if not fut.done():
-            fut.set_result((rule, skipped))
+            fut.set_result((rule, skipped, snap))
 
 
 def _fail_many(futs, exc) -> None:

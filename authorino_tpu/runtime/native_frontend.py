@@ -70,8 +70,10 @@ from ..utils.rpc import (
     UNAUTHENTICATED,
 )
 from . import faults
+from . import provenance as prov_mod
 from .admission import AdmissionController
 from .breaker import CircuitBreaker
+from .flight_recorder import RECORDER
 
 log = logging.getLogger("authorino_tpu.native_frontend")
 
@@ -613,6 +615,11 @@ class _SnapRec:
     # lazily-built host (numpy) operand pytree for the degraded lane: the
     # same kernel on the CPU backend when the device path fails/trips
     host_params: Any = None
+    # decision provenance (ISSUE 9): the rule heat map binding this
+    # snapshot's kernel rows to (authconfig, rule source) — shared with the
+    # engine snapshot's instance when one exists, so both lanes fold into
+    # one label-children cache
+    heat: Any = None
 
 
 class NativeFrontend:
@@ -627,7 +634,8 @@ class NativeFrontend:
                  device_timeout_s: Optional[float] = None,
                  breaker_threshold: int = 5, breaker_reset_s: float = 5.0,
                  admission_target_s: float = 0.05,
-                 brownout: bool = True, brownout_max_rows: int = 64):
+                 brownout: bool = True, brownout_max_rows: int = 64,
+                 slo_ms: float = 0.0):
         self.engine = engine
         # fault tolerance (ISSUE 5, docs/robustness.md): a failed device
         # batch retries once, then degrades to the SAME kernel on the CPU
@@ -748,6 +756,15 @@ class NativeFrontend:
         self._brownout_live = 0
         # slow-lane service-rate estimator state (owned by the drain loop)
         self._slow_last: Dict[str, float] = {"slow": 0.0, "t": 0.0}
+        # decision observability (ISSUE 9): per-lane SLO burn-rate tracker
+        # (--slo-ms; 0 = off — the native SLI is the batch's device round
+        # trip, folded per batch) and the flight-recorder provider
+        self.slo = None
+        if slo_ms:
+            from ..utils.slo import SloTracker
+
+            self.slo = SloTracker("native", slo_ms)
+        RECORDER.register_provider("native_frontend", self, "debug_vars")
 
     # ------------------------------------------------------------------
     def start(self) -> int:
@@ -934,6 +951,12 @@ class NativeFrontend:
                 "decisions": self._brownout_total,
                 "batches": self._brownout_batches,
             },
+            "provenance": {
+                "heat": (rec.heat.to_json()
+                         if rec is not None and rec.heat is not None
+                         else None),
+            },
+            "slo": self.slo.to_json() if self.slo is not None else None,
             "snapshot": None,
         }
         if rec is not None:
@@ -1267,6 +1290,16 @@ class NativeFrontend:
             "health": self._health_bytes(),
         }
         rec = _SnapRec(snap_id=snap_id, policy=policy, params=None, encoder=None)
+        # attribution (ISSUE 9): reuse the engine snapshot's heat map when
+        # it exists (same policy object → same rows), else build one
+        try:
+            rec.heat = getattr(snap, "heat", None) if snap is not None \
+                else None
+            if rec.heat is None:
+                rec.heat = prov_mod.HeatMap.for_snapshot(policy, sharded)
+        except Exception:
+            log.exception("native heat-map build failed (refresh unaffected)")
+            rec.heat = None
 
         entries = list(snap.by_id.values()) if snap is not None else []
         fcs: List[dict] = []
@@ -1975,7 +2008,7 @@ class NativeFrontend:
             t0_ns = time.time_ns()
             rows = rec.arrays[slot]["config_id"][:count].copy()
             try:
-                verdict = self._host_eval(rec, slot, count)
+                verdict, firing = self._host_eval(rec, slot, count)
             except Exception:
                 log.exception("native brownout eval failed; batch rides the "
                               "device instead")
@@ -2001,7 +2034,8 @@ class NativeFrontend:
                 self._post_complete_telemetry(rec, count, 0, 0, rows, None,
                                               verdict,
                                               time.monotonic() - t0, t0_ns,
-                                              device_rows=0, device=False)
+                                              device_rows=0, device=False,
+                                              firing=firing)
             except Exception:
                 log.exception("brownout telemetry failed")
         finally:
@@ -2041,6 +2075,10 @@ class NativeFrontend:
                         pending.remove(item)
                         progressed = True
                         metrics_mod.watchdog_timeouts.labels("native").inc()
+                        RECORDER.record("watchdog-timeout", lane="native",
+                                        detail={"slot": item[2],
+                                                "requests": item[3],
+                                                "attempt": item[12]})
                         log.warning(
                             "native batch (slot %d, %d requests, attempt %d)"
                             " wedged past --device-timeout %.3fs",
@@ -2105,11 +2143,24 @@ class NativeFrontend:
             # release a half-open probe slot it may have claimed
             self.breaker.release_probe()
         dispatch_s = time.monotonic() - t0
+        # attribution (ISSUE 9): the packed readback already carries the
+        # per-rule result/skip columns — ONE vectorized unpack per batch
+        # recovers the firing column next to the verdict bit (zero
+        # per-request Python, pinned by tests/test_provenance.py)
+        from ..ops.pattern_eval import unpack_attribution
+
+        heat = rec.heat
+        E = heat.E if heat is not None else 0
         if fan is None:
             # dedup/cache off: packed is the bit-masked result of the full
             # slot; own verdict = bit 0 of byte 0
-            verdict = np.ascontiguousarray(
-                packed[:count, 0] & 1).astype(np.uint8)
+            if E:
+                verdict, firing = unpack_attribution(packed[:count], E)
+                verdict = np.ascontiguousarray(verdict)
+            else:
+                verdict = np.ascontiguousarray(
+                    packed[:count, 0] & 1).astype(np.uint8)
+                firing = None
             u = count
             cached_n = elig_miss_n = evict_d = 0
         else:
@@ -2117,11 +2168,23 @@ class NativeFrontend:
                 elig_miss_n = fan
             u = len(unique_rows)
             verdict = np.zeros((count,), dtype=np.uint8)
+            firing = np.full((count,), -1, dtype=np.int32) if E else None
             if u:
-                uniq_v = (packed[:, 0] & 1).astype(np.uint8)
-                verdict[np.asarray(miss_rows)] = uniq_v[inverse]
+                if E:
+                    uniq_v, uniq_f = unpack_attribution(packed[:u], E)
+                else:
+                    uniq_v = (packed[:, 0] & 1).astype(np.uint8)
+                    uniq_f = None
+                mr = np.asarray(miss_rows)
+                verdict[mr] = uniq_v[inverse]
+                if firing is not None and uniq_f is not None:
+                    firing[mr] = uniq_f[inverse]
             for r, v in cached.items():
-                verdict[r] = v
+                # cached value = (verdict, firing): a cache hit attributes
+                # identically to the device evaluation it memoized
+                verdict[r] = v[0]
+                if firing is not None:
+                    firing[r] = v[1]
             verdict = np.ascontiguousarray(verdict)
             cached_n = len(cached)
             evict_d = 0
@@ -2139,13 +2202,16 @@ class NativeFrontend:
                         # fan[0] carries the FULL cache key (per-config
                         # token or snap_id already folded in — captured
                         # from the batch's pinned snapshot at dispatch)
-                        cache.put(fan[0][r], int(verdict[r]))
+                        cache.put(fan[0][r], (
+                            int(verdict[r]),
+                            int(firing[r]) if firing is not None else -1))
                 evict_d = cache.evictions - evict0
             metrics_mod.observe_dedup("native", count, u, cached_n,
                                       elig_miss_n, evict_d)
             self._post_complete_telemetry(rec, count, pad, eff, rows,
                                           shards_arr, verdict, dispatch_s,
-                                          t0_ns, device_rows=u)
+                                          t0_ns, device_rows=u,
+                                          firing=firing)
         except Exception:
             log.exception("post-completion telemetry failed")
 
@@ -2199,9 +2265,14 @@ class NativeFrontend:
                         count)
             return
         verdict: Optional[np.ndarray] = None
+        firing: Optional[np.ndarray] = None
+        rows: Optional[np.ndarray] = None
         if rec.sharded is None and rec.policy is not None:
             try:
-                verdict = self._host_eval(rec, slot, count)
+                # attribution rows copied BEFORE completion: the C++
+                # encoder may refill the slot once fe_complete_batch runs
+                rows = rec.arrays[slot]["config_id"][:count].copy()
+                verdict, firing = self._host_eval(rec, slot, count)
             except Exception:
                 log.exception("native host degrade failed (fail-closed deny)")
         if verdict is not None:
@@ -2214,16 +2285,34 @@ class NativeFrontend:
             verdict = np.zeros(count, dtype=np.uint8)
         if not self._fe_stopped:
             self._mod.fe_complete_batch(snap_id, slot, verdict.ctypes.data)
+        if firing is not None and rows is not None and rec.heat is not None:
+            try:
+                # degraded decisions attribute like the device decisions
+                # they replaced (same kernel, CPU backend) — heat fold +
+                # head sample only; the per-authconfig counters keep their
+                # established healthy-path-only semantics
+                prov_mod.fold_and_sample(rec.heat, rows, firing, count,
+                                         lane="native",
+                                         generation=rec.snap_id)
+            except Exception:
+                log.exception("degrade provenance fold failed")
 
-    def _host_eval(self, rec: _SnapRec, slot: int, count: int) -> np.ndarray:
-        """CPU-backend kernel evaluation of one C++-encoded slot → own
-        verdicts [count] uint8.  The host operand pytree is built lazily
-        once per snapshot; each (pad, eff) shape compiles on first use —
-        a degraded-mode cost, never on the healthy path."""
+    def _host_eval(self, rec: _SnapRec, slot: int,
+                   count: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """CPU-backend kernel evaluation of one C++-encoded slot → (own
+        verdicts [count] uint8, firing columns [count] int32 or None) —
+        the SAME packed columns the device returns, so degraded/brownout
+        decisions attribute identically.  The host operand pytree is built
+        lazily once per snapshot; each (pad, eff) shape compiles on first
+        use — a degraded-mode cost, never on the healthy path."""
         import jax
         import jax.numpy as jnp
 
-        from ..ops.pattern_eval import eval_bitpacked_jit, to_device
+        from ..ops.pattern_eval import (
+            eval_bitpacked_jit,
+            to_device,
+            unpack_attribution,
+        )
 
         a = rec.arrays[slot]
         if rec.host_params is None:
@@ -2246,7 +2335,12 @@ class NativeFrontend:
                 if has_dfa else None,
             )
             out = np.asarray(packed)
-        return np.ascontiguousarray(out[:count, 0] & 1).astype(np.uint8)
+        E = rec.heat.E if rec.heat is not None else 0
+        if E:
+            verdict, firing = unpack_attribution(out[:count], E)
+            return np.ascontiguousarray(verdict), firing
+        return (np.ascontiguousarray(out[:count, 0] & 1).astype(np.uint8),
+                None)
 
     def _post_complete_telemetry(self, rec: _SnapRec, count: int, pad: int,
                                  eff: int, rows: np.ndarray,
@@ -2254,12 +2348,27 @@ class NativeFrontend:
                                  verdict: np.ndarray, dispatch_s: float,
                                  t0_ns: int,
                                  device_rows: Optional[int] = None,
-                                 device: bool = True) -> None:
+                                 device: bool = True,
+                                 firing: Optional[np.ndarray] = None) -> None:
         # per-batch telemetry AFTER completion: responses are already on
         # their way to the wire (queue wait is C++-clocked — stage hists).
         # ``device=False`` (brownout spill) keeps the per-authconfig
         # counters but stays out of the device-lane batch/RTT series — a
         # sub-ms host eval must not read as a fast device round trip.
+        # which-rule-fired attribution (ISSUE 9): one composite-key
+        # bincount per batch into the rule heat map + at most one
+        # head-sampled decision record — never per-request Python
+        heat = rec.heat
+        if heat is not None and firing is not None and count:
+            prov_mod.fold_and_sample(heat, rows, firing, count,
+                                     lane="native", shards=shards_arr,
+                                     latency_ms=dispatch_s * 1e3,
+                                     generation=rec.snap_id)
+        if self.slo is not None and count:
+            # the native SLI is the batch's on-box round trip (per-request
+            # waits are C++-clocked): every member shares the batch verdict
+            self.slo.observe(count,
+                             count if dispatch_s > self.slo.slo_s else 0)
         if device:
             metrics_mod.observe_batch("native", count, pad, None, dispatch_s,
                                       device_rows=device_rows)

@@ -119,30 +119,39 @@ def _headers_to_options(headers):
     return out
 
 
+def _attach_dynamic_metadata(resp, result: AuthResult) -> None:
+    """AuthResult.metadata → CheckResponse.dynamic_metadata, on BOTH the
+    allow and deny paths.  On denials this carries the attributed firing
+    rule (pipeline.deny_provenance → ext_authz_provenance) into Envoy's
+    metadata exchange even when the client-visible reason stays the
+    generic one — "why was this denied" is a mesh-side answer first.
+    Unencodable metadata is dropped, never fails the response."""
+    if not result.metadata:
+        return
+    try:
+        md = struct_pb2.Struct()
+        md.update(result.metadata)
+        resp.dynamic_metadata.CopyFrom(md)
+    except Exception:
+        pass
+
+
 def check_response_from_result(result: AuthResult):
     """AuthResult → CheckResponse (ref auth.go:315-357)."""
     if result.success():
-        dynamic_metadata = None
-        if result.metadata:
-            dynamic_metadata = struct_pb2.Struct()
-            try:
-                dynamic_metadata.update(result.metadata)
-            except Exception:
-                dynamic_metadata = None
         resp = external_auth_pb2.CheckResponse(
             status=protos.status_pb2.Status(code=OK),
             ok_response=external_auth_pb2.OkHttpResponse(
                 headers=_headers_to_options(result.headers)
             ),
         )
-        if dynamic_metadata is not None:
-            resp.dynamic_metadata.CopyFrom(dynamic_metadata)
+        _attach_dynamic_metadata(resp, result)
         return resp
 
     headers = list(result.headers)
     if result.message:
         headers = headers + [{"X-Ext-Auth-Reason": result.message}]
-    return external_auth_pb2.CheckResponse(
+    resp = external_auth_pb2.CheckResponse(
         status=protos.status_pb2.Status(code=result.code),
         denied_response=external_auth_pb2.DeniedHttpResponse(
             status=protos.http_status_pb2.HttpStatus(
@@ -152,6 +161,8 @@ def check_response_from_result(result: AuthResult):
             body=result.body,
         ),
     )
+    _attach_dynamic_metadata(resp, result)
+    return resp
 
 
 def build_server(

@@ -204,6 +204,12 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
         try:
             from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
 
+            from ..runtime import provenance as prov_mod
+
+            # rule heat maps accumulate in-process and flush on a cadence;
+            # flushing here makes the rule-fired series current on THIS
+            # scrape (collector ordering alone lags it by one)
+            prov_mod.flush_heatmaps()
             return web.Response(body=generate_latest(), content_type="text/plain")
         except Exception:
             return web.Response(status=501, text="prometheus_client unavailable")
@@ -231,6 +237,23 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
                 pass
             data["native_frontend"] = fe.debug_vars()
         return web.json_response(data)
+
+    async def debug_decisions(request: web.Request):
+        """Head-sampled decision log (ISSUE 9, docs/observability.md
+        "Decision provenance"): the bounded ring of structured decision
+        records — host, authconfig, verdict, firing rule, lane, latency,
+        snapshot generation.  ``?n=K`` returns the newest K records.
+        Query it live, or feed the JSON to
+        ``python -m authorino_tpu.analysis --decisions``."""
+        from ..runtime import provenance as prov_mod
+
+        n = None
+        if "n" in request.query:
+            try:
+                n = int(request.query["n"])
+            except ValueError:
+                return web.Response(status=400, text="bad n")
+        return web.json_response(prov_mod.DECISIONS.to_json(n=n))
 
     profile_state = {"busy": False}
 
@@ -279,6 +302,7 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
     app.router.add_get("/metrics", server_metrics)
     app.router.add_get("/server-metrics", server_metrics)
     app.router.add_get("/debug/vars", debug_vars)
+    app.router.add_get("/debug/decisions", debug_decisions)
     app.router.add_get("/debug/profile", debug_profile)
     # catch-all LAST: Envoy's HTTP ext_authz filter forwards the ORIGINAL
     # request path (path_prefix + :path), so /check is just the conventional

@@ -293,7 +293,7 @@ FALLBACK_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
 batch_host_fallback = _histogram(
     "auth_server_batch_host_fallback",
     "Host-oracle fallback requests (membership overflow) per micro-batch.",
-    (),
+    _LANE_LABELS,
     buckets=FALLBACK_BUCKETS,
 )
 jit_warm_cache = _counter(
@@ -434,6 +434,7 @@ def _ensure_batch_children(lane):
             batch_pad_occupancy.labels(lane),
             batch_queue_wait.labels(lane),
             device_dispatch_duration.labels(lane),
+            batch_host_fallback.labels(lane),
         )
     return ch
 
@@ -460,7 +461,7 @@ def observe_batch(lane, n, pad, queue_wait_s, dispatch_s,
             ch[2].observe(queue_wait_s)
     ch[3].observe(dispatch_s)
     if fallback_n is not None:
-        batch_host_fallback.observe(fallback_n)
+        ch[4].observe(fallback_n)
 
 
 # ---------------------------------------------------------------------------
@@ -754,4 +755,70 @@ snapshot_distribution = _counter(
     "uncertified or locally-failing snapshot, old snapshot keeps serving) "
     "| error (unreadable/corrupt source).",
     ("role", "result"),
+)
+
+# ---------------------------------------------------------------------------
+# Decision provenance + SLO + flight recorder (ISSUE 9,
+# docs/observability.md "Decision provenance"): which-rule-fired attribution
+# decoded per BATCH from the bitpacked readback's rule columns, the runtime
+# rule heat map, the multi-window SLO burn-rate tracker, and the black-box
+# lifecycle flight recorder.  Nothing here is per-request Python on the
+# native fast lane: attribution is a per-batch column fold, decision records
+# are head-sampled.
+# ---------------------------------------------------------------------------
+
+rule_fired = _counter(
+    "auth_server_rule_fired_total",
+    "Denials attributed to one compiled authorization rule (the FIRST "
+    "evaluator column that evaluated false and was not condition-skipped — "
+    "the same short-circuit order the reference's pipeline denies in).  "
+    "rule = '<evaluator idx>:<rule source>' (truncated); folded once per "
+    "micro-batch from the readback's rule columns on every lane — device, "
+    "cached, deduped, degraded, brownout.  The runtime rule heat map: "
+    "never-incremented rules cross-reference the static constant/shadowed "
+    "findings in the /debug/vars dead-rule report.",
+    ("authconfig", "rule"),
+)
+decision_records = _counter(
+    "auth_server_decision_records_total",
+    "Head-sampled structured decision records appended to the bounded "
+    "decision log (served on /debug/decisions; one record at most per "
+    "micro-batch, sampled 1-in-N decisions).",
+    _LANE_LABELS,
+)
+slo_burn_rate = _gauge(
+    "auth_server_slo_burn_rate",
+    "Multi-window SLO burn rate per lane: (bad fraction in the window) / "
+    "(error budget fraction), where bad = latency over --slo-ms or a "
+    "non-deadline serving error.  1.0 = burning exactly the budget; "
+    "sustained values over ~14 on the short window are page-worthy "
+    "(multi-window multi-burn alerting).",
+    _LANE_LABELS + ("window",),
+)
+slo_bad_total = _counter(
+    "auth_server_slo_bad_total",
+    "Requests counted against the SLO error budget (latency over --slo-ms "
+    "or a serving error), per lane.  The companion total rides "
+    "auth_server_slo_observed_total.",
+    _LANE_LABELS,
+)
+slo_observed_total = _counter(
+    "auth_server_slo_observed_total",
+    "Requests observed by the SLO burn-rate tracker, per lane (the "
+    "denominator for auth_server_slo_bad_total).",
+    _LANE_LABELS,
+)
+flight_events = _counter(
+    "auth_server_flight_recorder_events_total",
+    "Lifecycle events appended to the flight-recorder ring (breaker "
+    "transitions, watchdog fires, snapshot swaps/rejections, admission "
+    "flips, reconcile phases, drain).",
+    ("kind",),
+)
+flight_dumps = _counter(
+    "auth_server_flight_recorder_dumps_total",
+    "Diagnostic bundles auto-dumped by the flight recorder on anomaly "
+    "triggers (breaker OPEN, watchdog fire, snapshot rejection, admission "
+    "OVERLOADED), by the anomaly kind that triggered the dump.",
+    ("trigger",),
 )

@@ -255,6 +255,106 @@ def lowerability_block(engine=None, configs=None, policy=None):
             "by_reason": rep["by_reason"]}
 
 
+def provenance_block(engine=None, fe=None, configs=None, docs=None,
+                     rows=None, elapsed=None, sample_n=64):
+    """Artifact block (ISSUE 9, docs/observability.md "Decision
+    provenance"): the rule-fire histogram (top heat-map counters), the
+    per-batch attribution-fold overhead as a fraction of the measured
+    window (the decision-log overhead delta — asserted ≈0 on the native
+    lane: attribution must never put Python back on the per-request
+    path), and — engine mode — a sampled attribution-exactness check
+    against the host expression oracle."""
+    import asyncio
+
+    from prometheus_client import REGISTRY
+
+    block = {"rule_fired_top": [], "fold": None, "exactness": None}
+    heat = None
+    if engine is not None and engine._snapshot is not None:
+        heat = engine._snapshot.heat
+    elif fe is not None and fe._cur_rec is not None:
+        heat = fe._cur_rec.heat
+    if heat is not None:
+        heat.flush()  # counters flush on a cadence; the scrape wants NOW
+    fired = []
+    for metric in REGISTRY.collect():
+        if metric.name == "auth_server_rule_fired":
+            for s in metric.samples:
+                if s.name.endswith("_total") and s.value:
+                    fired.append((s.value, s.labels.get("authconfig", ""),
+                                  s.labels.get("rule", "")))
+    fired.sort(reverse=True)
+    block["rule_fired_top"] = [
+        {"authconfig": a, "rule": r, "fired": int(v)}
+        for v, a, r in fired[:20]]
+    block["rules_fired_distinct"] = len(fired)
+
+    if heat is not None:
+        frac = (heat.fold_seconds / elapsed) if elapsed else None
+        block["fold"] = {
+            "calls": heat.fold_calls,
+            "seconds": round(heat.fold_seconds, 6),
+            "fraction_of_window": (round(frac, 6)
+                                   if frac is not None else None),
+        }
+        if fe is not None and frac is not None:
+            # the acceptance bar: the per-batch column fold must be noise
+            # against the measured window on the native lane
+            assert frac < 0.01, (
+                f"native attribution fold cost {frac:.4f} of the window "
+                f"(must be ~0: no per-request Python on the fast lane)")
+
+    if engine is not None and docs and rows is not None and configs:
+        from authorino_tpu.ops.pattern_eval import firing_columns
+
+        checked = mismatches = 0
+
+        async def sample_pass():
+            nonlocal checked, mismatches
+            for j in range(0, len(docs), max(1, len(docs) // sample_n)):
+                cfg = configs[rows[j]]
+                rule_res, skipped = await engine.submit(docs[j],
+                                                        f"cfg-{rows[j]}")
+                got = int(firing_columns(rule_res[None, :],
+                                         skipped[None, :])[0])
+                # host oracle: recompute (rule, skipped) from the source
+                # expression trees and attribute identically
+                want_rule, want_skip = [], []
+                doc = docs[j]
+                for cond, expr in cfg.evaluators:
+                    skip = False
+                    if cond is not None:
+                        try:
+                            skip = not bool(cond.matches(doc))
+                        except Exception:
+                            skip = True
+                    want_skip.append(skip)
+                    if skip:
+                        want_rule.append(True)
+                        continue
+                    try:
+                        want_rule.append(bool(expr.matches(doc)))
+                    except Exception:
+                        want_rule.append(False)
+                import numpy as _np
+
+                E = len(rule_res)
+                wr = _np.ones(E, dtype=bool)
+                ws = _np.zeros(E, dtype=bool)
+                wr[:len(want_rule)] = want_rule
+                ws[:len(want_skip)] = want_skip
+                want = int(firing_columns(wr[None, :], ws[None, :])[0])
+                checked += 1
+                if got != want:
+                    mismatches += 1
+
+        asyncio.run(sample_pass())
+        block["exactness"] = {"checked": checked, "mismatches": mismatches}
+        assert mismatches == 0, (
+            f"attribution mismatch vs host oracle: {mismatches}/{checked}")
+    return block
+
+
 def build_engine(configs, args):
     from authorino_tpu.runtime import EngineEntry, PolicyEngine
 
@@ -1169,6 +1269,9 @@ def run_native_mode(args):
                                 "achieved by construction)",
         "key_repeat": args.key_repeat or None,
         "lowerability": lowerability_block(engine=engine),
+        "provenance": provenance_block(
+            fe=fe, elapsed=sum(t.get("seconds", args.seconds)
+                               for t in trials_detail) or args.seconds),
         "dedup_cache": {
             "readback_bytes_per_row": W_row,
             "verdict_cache": {
@@ -2019,6 +2122,11 @@ def main():
                 "adaptive": dv["adaptive"],
             }
             detail["lowerability"] = lowerability_block(engine=engine)
+            detail["provenance"] = provenance_block(
+                engine=engine, configs=configs, docs=docs, rows=rows,
+                elapsed=args.seconds * args.trials)
+            log(f"provenance: {detail['provenance']['exactness']} "
+                f"fold={detail['provenance']['fold']}")
             if chaos_before is not None:
                 from authorino_tpu.runtime import faults as faults_mod
 

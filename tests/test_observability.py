@@ -138,8 +138,8 @@ class TestEngineTelemetry:
             "occ": sample("auth_server_batch_pad_occupancy_count", {"lane": "engine"}),
             "wait": sample("auth_server_batch_queue_wait_seconds_count", {"lane": "engine"}),
             "disp": sample("auth_server_device_dispatch_seconds_count", {"lane": "engine"}),
-            "fb": sample("auth_server_batch_host_fallback_count"),
-            "fb_sum": sample("auth_server_batch_host_fallback_sum"),
+            "fb": sample("auth_server_batch_host_fallback_count", {"lane": "engine"}),
+            "fb_sum": sample("auth_server_batch_host_fallback_sum", {"lane": "engine"}),
         }
 
         async def body():
@@ -176,9 +176,9 @@ class TestEngineTelemetry:
         assert sample("auth_server_batch_pad_occupancy_count", {"lane": "engine"}) > before["occ"]
         assert sample("auth_server_batch_queue_wait_seconds_count", {"lane": "engine"}) > before["wait"]
         assert sample("auth_server_device_dispatch_seconds_count", {"lane": "engine"}) > before["disp"]
-        assert sample("auth_server_batch_host_fallback_count") > before["fb"]
+        assert sample("auth_server_batch_host_fallback_count", {"lane": "engine"}) > before["fb"]
         # no fallback rows in this corpus: the per-batch counts are all 0
-        assert sample("auth_server_batch_host_fallback_sum") == before["fb_sum"]
+        assert sample("auth_server_batch_host_fallback_sum", {"lane": "engine"}) == before["fb_sum"]
         # occupancy is a ratio ≤ 1.0
         occ_sum = sample("auth_server_batch_pad_occupancy_sum", {"lane": "engine"})
         occ_n = sample("auth_server_batch_pad_occupancy_count", {"lane": "engine"})

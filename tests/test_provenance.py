@@ -1,0 +1,689 @@
+"""Decision provenance suite (ISSUE 9): which-rule-fired attribution
+exactness across lanes (kernel / engine / verdict-cache hit / dedup
+fan-out / host-oracle degrade, property-tested against the host
+expression trees), the decision-record schema pin, the flight-recorder
+dump under a chaos profile, the SLO burn-rate tracker, the metrics-
+catalogue drift gate, and the zero-per-request-Python perf guard.
+
+Deliberately import-light: collects and runs without `cryptography`
+(JAX_PLATFORMS=cpu), like tests/test_observability.py."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+
+import numpy as np
+import pytest
+
+from authorino_tpu.compiler import ConfigRules
+from authorino_tpu.compiler.compile import compile_corpus
+from authorino_tpu.expressions import All, Any_, Operator, Pattern
+from authorino_tpu.models.policy_model import PolicyModel, host_results
+from authorino_tpu.ops.pattern_eval import (
+    firing_columns,
+    unpack_attribution,
+)
+from authorino_tpu.runtime import EngineEntry, PolicyEngine
+from authorino_tpu.runtime import provenance as prov_mod
+from authorino_tpu.runtime.flight_recorder import FlightRecorder, RECORDER
+from authorino_tpu.utils.slo import SloTracker
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+SELECTORS = [
+    "request.method", "request.url_path", "request.headers.x-org",
+    "request.headers.x-tier", "auth.identity.username",
+    "auth.identity.roles", "auth.identity.groups",
+]
+VALUES = ["acme", "evil", "GET", "POST", "/a", "/b/c", "gold", "admin",
+          "dev", "john", "jane"]
+
+
+def random_pattern(rng):
+    op = rng.choice([Operator.EQ, Operator.NEQ, Operator.INCL,
+                     Operator.EXCL, Operator.MATCHES])
+    sel = rng.choice(SELECTORS)
+    if op is Operator.MATCHES:
+        val = rng.choice([r"^/a", r"\d+", r"^(GET|POST)$", r"adm.n", r"^$"])
+    else:
+        val = rng.choice(VALUES)
+    return Pattern(sel, op, val)
+
+
+def random_expr(rng, depth=0):
+    if depth >= 2 or rng.random() < 0.5:
+        return random_pattern(rng)
+    comb = All if rng.random() < 0.5 else Any_
+    return comb(*[random_expr(rng, depth + 1)
+                  for _ in range(rng.randint(1, 3))])
+
+
+def random_doc(rng):
+    doc = {
+        "request": {
+            "method": rng.choice(["GET", "POST", "DELETE"]),
+            "url_path": rng.choice(["/a", "/b/c", "/x", ""]),
+            "headers": {},
+            "host": rng.choice(["a.test", "b.test"]),
+        },
+        "auth": {"identity": {}},
+    }
+    if rng.random() < 0.8:
+        doc["request"]["headers"]["x-org"] = rng.choice(VALUES)
+    if rng.random() < 0.5:
+        doc["request"]["headers"]["x-tier"] = rng.choice(["gold", "silver"])
+    ident = doc["auth"]["identity"]
+    if rng.random() < 0.9:
+        ident["username"] = rng.choice(["john", "jane", "nobody"])
+    if rng.random() < 0.8:
+        ident["roles"] = rng.sample(["admin", "dev", "ops"],
+                                    k=rng.randint(0, 3))
+    if rng.random() < 0.6:
+        ident["groups"] = [rng.choice(VALUES)
+                           for _ in range(rng.randint(0, 20))]
+    return doc
+
+
+def oracle_firing(policy, doc, row) -> int:
+    """Host-expression-tree attribution: the first not-skipped false rule
+    column — the property every lane must reproduce."""
+    _, rule, skipped = host_results(policy, doc, row)
+    return int(firing_columns(rule[None, :], skipped[None, :])[0])
+
+
+def build_engine(configs, **kw) -> PolicyEngine:
+    engine = PolicyEngine(max_batch=32, members_k=4, mesh=None, **kw)
+    engine.apply_snapshot([
+        EngineEntry(id=c.name, hosts=[c.name], runtime=None, rules=c)
+        for c in configs
+    ])
+    return engine
+
+
+RULE = All(
+    Pattern("request.method", Operator.EQ, "GET"),
+    Pattern("auth.identity.org", Operator.EQ, "acme"),
+)
+DENY_RULE2 = Pattern("request.headers.x-tier", Operator.EQ, "gold")
+
+
+def doc(method="GET", org="acme", tier="gold"):
+    return {"request": {"method": method, "host": "c", "headers":
+                        {"x-tier": tier}},
+            "auth": {"identity": {"org": org}}}
+
+
+# ---------------------------------------------------------------------------
+# attribution exactness: property test across lanes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_attribution_matches_host_oracle_property(seed):
+    """Kernel-lane attribution (bitpacked readback → unpack_attribution)
+    equals host-expression-tree attribution for random corpora/docs —
+    membership-overflow (host_fallback) rows excluded (the engine path
+    re-decides those through the oracle itself, covered below)."""
+    from authorino_tpu.ops.pattern_eval import eval_bitpacked_jit, to_device
+
+    rng = random.Random(seed)
+    configs = []
+    for i in range(rng.randint(2, 6)):
+        evaluators = [(random_expr(rng) if rng.random() < 0.4 else None,
+                       random_expr(rng))
+                      for _ in range(rng.randint(1, 3))]
+        configs.append(ConfigRules(name=f"cfg-{i}", evaluators=evaluators))
+    policy = compile_corpus(configs, members_k=4)
+    model = PolicyModel(policy)
+    docs = [random_doc(rng) for _ in range(48)]
+    rows = [rng.randrange(len(configs)) for _ in docs]
+    db = model.encode(docs, rows)
+    params = to_device(policy)
+    import jax.numpy as jnp
+
+    has_dfa = params["dfa_tables"] is not None
+    packed = np.asarray(eval_bitpacked_jit(
+        params, jnp.asarray(db.attrs_val), jnp.asarray(db.members_c),
+        jnp.asarray(db.cpu_dense), jnp.asarray(db.config_id),
+        jnp.asarray(db.attr_bytes) if has_dfa else None,
+        jnp.asarray(db.byte_ovf) if has_dfa else None))
+    E = int(policy.eval_rule.shape[1])
+    verdict, firing = unpack_attribution(packed, E)
+    for r in range(len(docs)):
+        if db.host_fallback[r]:
+            continue  # lossy compact row: the serving paths re-decide it
+        want = oracle_firing(policy, docs[r], rows[r])
+        assert int(firing[r]) == want, (
+            f"seed={seed} row={r}: kernel attributed {int(firing[r])}, "
+            f"oracle {want}")
+        assert bool(verdict[r]) == (want < 0)
+
+
+def test_attribution_parity_engine_cache_dedup_and_degrade():
+    """The same request attributes to the same rule through: a fresh
+    engine dispatch, a duplicate row in one batch (dedup fan-out), a
+    verdict-cache hit on a later batch, and the breaker-open host-oracle
+    degrade path."""
+    configs = [ConfigRules(name="c", evaluators=[(None, RULE),
+                                                 (None, DENY_RULE2)])]
+    engine = build_engine(configs)
+    policy = engine._snapshot.policy
+    row = policy.config_ids["c"]
+    deny_doc = doc(org="evil")          # rule 0 fires
+    deny_doc2 = doc(tier="silver")      # rule 1 fires
+    want0 = oracle_firing(policy, deny_doc, row)
+    want1 = oracle_firing(policy, deny_doc2, row)
+    assert want0 == 0 and want1 == 1
+
+    def firing_of(res):
+        rule, skipped = res
+        return int(firing_columns(np.asarray(rule)[None, :],
+                                  np.asarray(skipped)[None, :])[0])
+
+    async def pass1():
+        # duplicates of both docs in one gather: dedup fan-out must give
+        # every duplicate the same attribution
+        outs = await asyncio.gather(*(
+            [engine.submit(dict(deny_doc), "c") for _ in range(6)]
+            + [engine.submit(dict(deny_doc2), "c") for _ in range(6)]))
+        return [firing_of(o) for o in outs]
+
+    got = run(pass1())
+    assert got[:6] == [want0] * 6 and got[6:] == [want1] * 6
+
+    # verdict-cache hit: a later batch serves the same rows from cache
+    cache = engine._verdict_cache
+    hits_before = cache.hits
+    got2 = run(asyncio.wait_for(_submit_one(engine, deny_doc), 30))
+    assert firing_of(got2) == want0
+    assert cache.hits > hits_before
+
+    # breaker-open degrade: whole batches re-decide through the oracle
+    engine.breaker.record_failure()
+    for _ in range(10):
+        engine.breaker.record_failure()
+    assert engine.breaker.state == "open"
+    got3 = run(asyncio.wait_for(_submit_one(engine, deny_doc2), 30))
+    assert firing_of(got3) == want1
+
+
+async def _submit_one(engine, d):
+    return await engine.submit(dict(d), "c")
+
+
+def test_membership_overflow_fallback_attributes_exactly():
+    """host_fallback rows (membership overflow past K) re-decide through
+    the oracle inside finalize — attribution must match the oracle's."""
+    rule = Pattern("auth.identity.groups", Operator.INCL, "magic")
+    configs = [ConfigRules(name="c", evaluators=[(None, rule)])]
+    engine = build_engine(configs)
+    policy = engine._snapshot.policy
+    row = policy.config_ids["c"]
+    overflow_doc = {"request": {"method": "GET", "host": "c",
+                                "headers": {}},
+                    "auth": {"identity": {
+                        "groups": [f"g{i}" for i in range(40)]}}}
+    want = oracle_firing(policy, overflow_doc, row)
+    assert want == 0  # denied: 'magic' not among the groups
+    rule_res, skipped = run(_submit_one(engine, overflow_doc))
+    got = int(firing_columns(np.asarray(rule_res)[None, :],
+                             np.asarray(skipped)[None, :])[0])
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# heat map + dead-rule report
+# ---------------------------------------------------------------------------
+
+
+def test_heat_map_folds_and_dead_rule_report():
+    prov_mod._reset_fired_for_tests()
+    configs = [ConfigRules(name="c", evaluators=[(None, RULE),
+                                                 (None, DENY_RULE2)])]
+    engine = build_engine(configs)
+    heat = engine._snapshot.heat
+    assert heat is not None
+    folds_before = heat.fold_calls
+    run(_submit_one(engine, doc(org="evil")))       # rule 0 fires
+    assert heat.fold_calls > folds_before
+    heat.flush()  # counters flush on cadence/scrape; force it for the reads
+    fired = prov_mod.fired_pairs()
+    assert ("c", 0) in fired and ("c", 1) not in fired
+    report = prov_mod.dead_rule_report(heat, engine._analysis)
+    assert report["rules_total"] == 2
+    assert report["rules_fired"] == 1
+    never = {d["rule"] for d in report["never_fired"]}
+    assert len(never) == 1 and next(iter(never)).startswith("1:")
+    # /metrics carries the attributed series
+    from prometheus_client import REGISTRY
+
+    label = prov_mod.rule_label(0, str(RULE))
+    v = REGISTRY.get_sample_value("auth_server_rule_fired_total",
+                                  {"authconfig": "c", "rule": label})
+    assert v and v >= 1.0
+
+
+def test_constant_allow_rule_is_statically_explained_dead():
+    """A constant-allow rule can never fire; the dead-rule report must
+    cross-reference the static finding (PR 4) for it."""
+    prov_mod._reset_fired_for_tests()
+    const_rule = Pattern("request.method", Operator.NEQ,
+                         "\x00never-a-method")  # constant-true in practice
+    configs = [
+        ConfigRules(name="live", evaluators=[(None, RULE)]),
+        ConfigRules(name="const", evaluators=[(None, All())]),
+    ]
+    engine = build_engine(configs)
+    del const_rule
+    report = prov_mod.dead_rule_report(engine._snapshot.heat,
+                                       engine._analysis)
+    by_cfg = {d["authconfig"]: d for d in report["never_fired"]}
+    assert "const" in by_cfg
+    assert "constant-allow" in by_cfg["const"]["static_findings"]
+
+
+# ---------------------------------------------------------------------------
+# decision log: schema pin + head sampling
+# ---------------------------------------------------------------------------
+
+
+def test_decision_record_schema_pinned():
+    log = prov_mod.DecisionLog(capacity=8, sample_n=1)
+    log.record(lane="engine", host="a.test", authconfig="c", verdict=False,
+               rule="0:x eq y", rule_index=0, latency_ms=1.25,
+               generation=3)
+    rec = log.to_json()["records"][-1]
+    assert tuple(sorted(rec)) == tuple(sorted(prov_mod.DECISION_FIELDS))
+    assert rec["verdict"] == "deny" and rec["rule_index"] == 0
+    assert log.to_json()["schema"] == prov_mod.DECISION_SCHEMA
+
+
+def test_decision_log_head_sampling_bounds():
+    log = prov_mod.DecisionLog(capacity=16, sample_n=100)
+    fires = sum(1 for _ in range(50) if log.should_sample(10))
+    # 500 decisions at 1-in-100: ~5 fires, never one per batch
+    assert 1 <= fires <= 10
+
+
+def test_engine_samples_decision_records():
+    prov_mod.DECISIONS.configure(sample_n=1)
+    try:
+        configs = [ConfigRules(name="c", evaluators=[(None, RULE)])]
+        engine = build_engine(configs)
+        before = prov_mod.DECISIONS.records_total
+        run(_submit_one(engine, doc(org="evil")))
+        assert prov_mod.DECISIONS.records_total > before
+        rec = prov_mod.DECISIONS.to_json(n=1)["records"][-1]
+        assert rec["authconfig"] == "c"
+        assert rec["verdict"] == "deny"
+        assert rec["rule"] and rec["rule"].startswith("0:")
+        assert rec["host"] == "c"
+        assert rec["generation"] == engine.generation
+    finally:
+        prov_mod.DECISIONS.configure(sample_n=64)
+
+
+def test_debug_decisions_endpoint():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from authorino_tpu.service.http_server import build_app
+
+    prov_mod.DECISIONS.configure(sample_n=1)
+    try:
+        configs = [ConfigRules(name="c", evaluators=[(None, RULE)])]
+        engine = build_engine(configs)
+
+        async def body():
+            await engine.submit(doc(org="evil"), "c")
+            client = TestClient(TestServer(build_app(engine)))
+            await client.start_server()
+            try:
+                resp = await client.get("/debug/decisions?n=5")
+                assert resp.status == 200
+                payload = await resp.json()
+            finally:
+                await client.close()
+            return payload
+
+        payload = run(body())
+        assert payload["schema"] == prov_mod.DECISION_SCHEMA
+        assert payload["records"]
+        assert len(payload["records"]) <= 5
+    finally:
+        prov_mod.DECISIONS.configure(sample_n=64)
+
+
+# ---------------------------------------------------------------------------
+# deny-reason knob + dynamic_metadata provenance
+# ---------------------------------------------------------------------------
+
+
+def test_deny_reason_knob_and_pipeline_metadata():
+    from authorino_tpu.evaluators.authorization.pattern_matching import (
+        PatternMatching,
+    )
+    from authorino_tpu.evaluators.base import EvaluationError
+
+    configs = [ConfigRules(name="c", evaluators=[(None, RULE)])]
+    engine = build_engine(configs)
+    pm = PatternMatching(RULE, batched_provider=engine.provider_for("c"),
+                         evaluator_slot=0,
+                         attributor=engine.attribution_for("c"))
+
+    async def call_once():
+        # drive via the engine loop: provider awaits engine.submit
+        try:
+            await pm.call(_PipelineStub(engine))
+        except EvaluationError as e:
+            return e
+        raise AssertionError("deny expected")
+
+    prov_mod.EXPOSE_DENY_REASON = False
+    try:
+        e = run(call_once())
+        assert str(e) == "Unauthorized"
+        assert e.provenance["authconfig"] == "c"
+        assert e.provenance["rule_index"] == 0
+        assert "acme" in e.provenance["rule"]
+        prov_mod.EXPOSE_DENY_REASON = True
+        e2 = run(call_once())
+        assert "denied by c rule[0]" in str(e2)
+        assert "acme" in str(e2)
+    finally:
+        prov_mod.EXPOSE_DENY_REASON = False
+
+
+class _PipelineStub:
+    def __init__(self, engine):
+        self.engine = engine
+        self.span = None
+        self.deadline = None
+
+    def authorization_json(self):
+        return doc(org="evil")
+
+
+def test_denied_check_response_carries_dynamic_metadata():
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from authorino_tpu.pipeline.pipeline import AuthResult
+    from authorino_tpu.service.grpc_server import check_response_from_result
+    from authorino_tpu.utils.rpc import PERMISSION_DENIED
+
+    result = AuthResult(code=PERMISSION_DENIED, message="Unauthorized",
+                        metadata={"ext_authz_provenance": {
+                            "authconfig": "c", "rule_index": 0,
+                            "rule": "x eq y", "lane": "engine"}})
+    resp = check_response_from_result(result)
+    md = resp.dynamic_metadata
+    prov = md.fields["ext_authz_provenance"].struct_value
+    assert prov.fields["authconfig"].string_value == "c"
+    assert prov.fields["rule"].string_value == "x eq y"
+    # the deny response itself still carries the generic reason header
+    headers = {h.header.key: h.header.value
+               for h in resp.denied_response.headers}
+    assert headers.get("X-Ext-Auth-Reason") == "Unauthorized"
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rate
+# ---------------------------------------------------------------------------
+
+
+def test_slo_tracker_burn_rates():
+    t0 = 1_000_000.0
+    slo = SloTracker("testlane-a", slo_ms=50.0, objective=0.999)
+    # 1000 requests, 10 bad → bad fraction 1% → burn 10x on every window
+    for i in range(10):
+        slo.observe(100, 1, now=t0 + i)
+    assert abs(slo.burn_rate(60, now=t0 + 10) - 10.0) < 0.2
+    assert abs(slo.burn_rate(3600, now=t0 + 10) - 10.0) < 0.2
+    js = slo.to_json(now=t0 + 10)
+    assert js["windows"]["1m"]["total"] == 1000
+    assert js["windows"]["1m"]["bad"] == 10
+    # outside the 1m window the short burn decays to 0
+    assert slo.burn_rate(60, now=t0 + 3000) == 0.0
+    assert slo.burn_rate(3600, now=t0 + 3000) > 0.0
+
+
+def test_engine_feeds_slo_tracker():
+    configs = [ConfigRules(name="c", evaluators=[(None, RULE)])]
+    engine = build_engine(configs, slo_ms=10_000.0)
+    run(_submit_one(engine, doc()))
+    js = engine.slo.to_json()
+    assert js["observed_total"] >= 1
+    assert js["bad_total"] == 0  # 10s target: nothing is bad
+    dv = engine.debug_vars()
+    assert dv["slo"]["slo_ms"] == 10_000.0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_bundle(tmp_path):
+    rec = FlightRecorder(capacity=32, dump_dir=str(tmp_path),
+                         min_dump_interval_s=0.0)
+    rec.record("breaker", lane="x", detail={"state": "half-open"})
+    rec.record("reconcile", detail={"generation": 1})
+    path = rec.dump("manual")
+    bundle = json.loads(open(path).read())
+    assert bundle["kind"] == "authorino-tpu-flight-bundle"
+    assert bundle["schema"] == 1
+    kinds = [e["kind"] for e in bundle["events"]]
+    assert kinds == ["breaker", "reconcile"]
+    assert "metrics" in bundle and "vars" in bundle
+
+
+def test_flight_recorder_dump_under_chaos_profile(tmp_path):
+    """Acceptance: a live chaos drive (device-down profile) produces a
+    flight-recorder bundle containing the breaker trail and the
+    triggering anomaly, readable by the analysis CLI."""
+    from authorino_tpu.analysis.__main__ import main as analysis_main
+    from authorino_tpu.runtime import faults
+
+    old = (RECORDER.dump_dir, RECORDER.min_dump_interval_s,
+           RECORDER.enabled)
+    RECORDER.configure(dump_dir=str(tmp_path), min_dump_interval_s=0.0,
+                       enabled=True)
+    dumps_before = list(RECORDER.dumps)
+    configs = [ConfigRules(name="c", evaluators=[(None, RULE)])]
+    engine = build_engine(configs, breaker_threshold=2, breaker_reset_s=60.0)
+    faults.FAULTS.arm("device-down")
+    try:
+        # every dispatch fails → retry → degrade; two failures trip the
+        # breaker OPEN → anomaly → auto-dump.  Verdicts stay exact.
+        for _ in range(3):
+            rule_res, skipped = run(_submit_one(engine, doc(org="evil")))
+            assert not bool(rule_res[0])
+        assert engine.breaker.state == "open"
+    finally:
+        faults.FAULTS.disarm()
+    # the dump runs on its own thread: wait it out
+    deadline = time.monotonic() + 10.0
+    new_dumps = []
+    while time.monotonic() < deadline:
+        new_dumps = [d for d in RECORDER.dumps if d not in dumps_before]
+        if new_dumps:
+            break
+        time.sleep(0.05)
+    RECORDER.configure(dump_dir=old[0], min_dump_interval_s=old[1],
+                       enabled=old[2])
+    assert new_dumps, "breaker OPEN did not produce a flight bundle"
+    bundle = json.loads(open(new_dumps[0]).read())
+    assert bundle["trigger"] == "breaker-open"
+    kinds = [e["kind"] for e in bundle["events"]]
+    assert "breaker-open" in kinds
+    # the breaker trail rides the registered engine's debug-vars snapshot
+    eng_vars = bundle["vars"].get("engine")
+    assert eng_vars is not None
+    assert eng_vars["breaker"]["state"] == "open"
+    assert eng_vars["breaker"]["transitions"]
+    # ...and the analysis CLI reads it
+    assert analysis_main(["--flight-dump", new_dumps[0]]) == 0
+
+
+def test_breaker_and_admission_flips_recorded():
+    from authorino_tpu.runtime.admission import AdmissionController
+    from authorino_tpu.runtime.breaker import CircuitBreaker
+
+    events_before = RECORDER.events_total
+    br = CircuitBreaker("testlane-b", threshold=1, reset_s=60.0)
+    br.record_failure()
+    assert RECORDER.events_total > events_before
+    tail = [e for e in RECORDER.to_json()["tail"]
+            if e["lane"] == "testlane-b"]
+    assert tail and tail[-1]["kind"] == "breaker-open"
+
+    adm = AdmissionController("testlane-c", target_s=0.001, interval_s=0.01)
+    t = time.monotonic()
+    for i in range(40):
+        adm.observe_waits((0.5,), now=t + i * 0.01)
+    assert adm.overloaded
+    tail = [e for e in RECORDER.to_json()["tail"]
+            if e["lane"] == "testlane-c"]
+    assert tail and tail[-1]["kind"] == "admission-overloaded"
+
+
+# ---------------------------------------------------------------------------
+# metrics-catalogue drift gate (satellite, wired as tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_catalog_gate():
+    from authorino_tpu.analysis.metrics_catalog import catalog_drift
+
+    missing, stale = catalog_drift()
+    assert not missing, (
+        f"families registered in utils/metrics.py but missing from "
+        f"docs/observability.md: {missing}")
+    assert not stale, (
+        f"families documented in docs/observability.md but not registered "
+        f"in utils/metrics.py: {stale}")
+
+
+def test_metrics_catalog_detects_planted_drift(tmp_path):
+    """A blind gate is worse than none: a doc missing one registered
+    family, or naming a ghost one, must trip it."""
+    from authorino_tpu.analysis.metrics_catalog import (
+        DOC_PATH,
+        catalog_drift,
+    )
+
+    text = open(DOC_PATH).read()
+    pruned = text.replace("auth_server_rule_fired_total", "auth_server_rule_")
+    p1 = tmp_path / "pruned.md"
+    p1.write_text(pruned)
+    missing, _ = catalog_drift(str(p1))
+    assert "auth_server_rule_fired_total" in missing
+    p2 = tmp_path / "ghost.md"
+    p2.write_text(text + "\n| `auth_server_ghost_series_total` | counter |")
+    _, stale = catalog_drift(str(p2))
+    assert "auth_server_ghost_series_total" in stale
+
+
+# ---------------------------------------------------------------------------
+# perf guard: zero per-request Python on the fold path
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.perf_guard
+def test_fold_is_per_batch_not_per_request():
+    """Structural pin: pushing N concurrent requests through the engine
+    folds attribution once per BATCH (fold_calls ≪ N) and samples at most
+    one decision record per batch."""
+    prov_mod.DECISIONS.configure(sample_n=1)
+    try:
+        configs = [ConfigRules(name="c", evaluators=[(None, RULE)])]
+        engine = build_engine(configs)
+        heat = engine._snapshot.heat
+        records_before = prov_mod.DECISIONS.records_total
+
+        async def burst():
+            await asyncio.gather(*(engine.submit(doc(), "c")
+                                   for _ in range(64)))
+
+        run(burst())
+        assert heat.fold_calls <= 16, (
+            f"{heat.fold_calls} folds for 64 requests: fold is not "
+            f"per-batch")
+        assert (prov_mod.DECISIONS.records_total - records_before
+                <= heat.fold_calls)
+    finally:
+        prov_mod.DECISIONS.configure(sample_n=64)
+
+
+@pytest.mark.perf_guard
+def test_attribution_decode_is_vectorized():
+    """The per-batch decode + fold must be numpy-vectorized: decoding a
+    16k-row batch has to beat an equivalent per-row Python loop by >5x
+    (the native lane's zero-per-request-Python contract)."""
+    rng = np.random.default_rng(5)
+    B, E = 16384, 8
+    own_rule = rng.random((B, E)) > 0.3
+    own_skipped = rng.random((B, E)) > 0.7
+    rows = rng.integers(0, 32, size=B)
+    heat = prov_mod.HeatMap([f"cfg-{i}" for i in range(32)],
+                            [[f"r{j}" for j in range(E)]
+                             for _ in range(32)], E)
+    firing_columns(own_rule[:8], own_skipped[:8])  # warm
+    t0 = time.perf_counter()
+    firing = firing_columns(own_rule, own_skipped)
+    heat.fold(rows, firing)
+    vectorized = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    slow = np.empty(B, dtype=np.int64)
+    counts = {}
+    for r in range(B):
+        first = -1
+        for e in range(E):
+            if not own_skipped[r, e] and not own_rule[r, e]:
+                first = e
+                break
+        slow[r] = first
+        if first >= 0:
+            counts[(int(rows[r]), first)] = counts.get(
+                (int(rows[r]), first), 0) + 1
+    per_row = time.perf_counter() - t0
+    assert np.array_equal(firing, slow)
+    assert vectorized * 5 < per_row, (
+        f"vectorized fold {vectorized * 1e3:.2f}ms vs per-row "
+        f"{per_row * 1e3:.2f}ms: not vectorized enough")
+
+
+# ---------------------------------------------------------------------------
+# compiler provenance map + rule labels
+# ---------------------------------------------------------------------------
+
+
+def test_compiler_emits_provenance_map():
+    configs = [ConfigRules(name="a", evaluators=[(None, RULE),
+                                                 (None, DENY_RULE2)]),
+               ConfigRules(name="b", evaluators=[(All(), RULE)])]
+    policy = compile_corpus(configs, members_k=4)
+    pm = policy.provenance_map()
+    assert set(pm) == {"a", "b"}
+    assert pm["a"]["rules"] == [str(RULE), str(DENY_RULE2)]
+    assert pm["a"]["row"] == policy.config_ids["a"]
+    # memoized: one walk per corpus
+    assert policy.rule_sources() is policy.rule_sources()
+
+
+def test_rule_label_truncates_but_never_merges():
+    long_a = "x eq " + "a" * 300
+    long_b = "x eq " + "b" * 300
+    la, lb = prov_mod.rule_label(0, long_a), prov_mod.rule_label(0, long_b)
+    assert len(la) <= prov_mod.RULE_LABEL_MAX + 4
+    assert la != lb or long_a == long_b
+    assert prov_mod.rule_label(1, "short") == "1:short"
