@@ -106,6 +106,18 @@ def _run_verify_fixtures() -> List[Finding]:
     # engine must name EXACTLY the planted change — a blind diff engine
     # (or a lossy serializer) fails this command
     errors += _snapshot_selftest(policy)
+
+    # change-safety self-test (ISSUE 10): a planted constant-deny poison
+    # MUST breach the canary guard (with the poison config named as the
+    # suspect) and an identical-rate clean churn MUST stay clean (and so
+    # promote) — a blind or trigger-happy guard fails this command, and
+    # with it tier-1 (matching the PR 4/6/8 self-test pattern)
+    from ..runtime.change_safety import guard_self_test
+
+    for msg in guard_self_test():
+        errors.append(Finding(
+            kind="guard-blind", layer="change_safety", message=msg,
+            location="fixtures"))
     return errors
 
 
@@ -328,6 +340,18 @@ def _print_flight_bundle(bundle: dict) -> None:
               f"in the bundle)")
 
 
+def _run_change_safety_override(server: str, action: str) -> dict:
+    """POST the manual change-safety override to a live server's
+    /debug/canary endpoint (ISSUE 10, docs/robustness.md "Change safety")
+    and return its JSON response."""
+    from urllib.request import Request, urlopen
+
+    url = server.rstrip("/") + "/debug/canary?action=" + action
+    req = Request(url, method="POST")
+    with urlopen(req, timeout=10) as resp:  # nosec - operator-given URL
+        return json.loads(resp.read().decode("utf-8"))
+
+
 def _run_coverage_report() -> dict:
     """Lowerability report over the fixture corpus (ISSUE 6 layer 3)."""
     from ..compiler.compile import compile_corpus
@@ -373,9 +397,40 @@ def main(argv=None) -> int:
                     help="pretty-print a flight-recorder diagnostic bundle "
                          "(the JSON auto-dumped on anomaly triggers; "
                          "docs/observability.md 'Flight recorder')")
+    ap.add_argument("--rollback", metavar="SERVER",
+                    help="OPERATOR OVERRIDE (change safety, docs/"
+                         "robustness.md): roll back the server's "
+                         "in-progress canary — or, with none active, its "
+                         "last retained snapshot generation.  SERVER is "
+                         "the HTTP base URL (e.g. http://host:5001)")
+    ap.add_argument("--promote", metavar="SERVER",
+                    help="OPERATOR OVERRIDE: promote the server's "
+                         "in-progress canary to 100%% immediately, guard "
+                         "unconsulted")
+    ap.add_argument("--clear-quarantine", metavar="SERVER",
+                    help="OPERATOR OVERRIDE: release the server's active "
+                         "poison-config quarantine (the next reconcile "
+                         "serves the specs as written)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
+
+    override = next(
+        ((act, url) for act, url in (
+            ("rollback", args.rollback), ("promote", args.promote),
+            ("clear-quarantine", args.clear_quarantine)) if url), None)
+    if override:
+        action, server = override
+        report = _run_change_safety_override(server, action)
+        if args.as_json:
+            print(json.dumps(report, indent=2, sort_keys=True, default=str))
+        else:
+            cs = report.get("change_safety") or {}
+            print(f"{action}: {'applied' if report.get('applied') else 'NOT applied (nothing to do)'}")
+            print(f"  canary: {cs.get('canary')}")
+            print(f"  quarantine: {cs.get('quarantine')}")
+            print(f"  last_rollback: {cs.get('last_rollback')}")
+        return 0 if report.get("applied") else 1
 
     if args.snapshot_diff:
         report = _run_snapshot_diff(*args.snapshot_diff)

@@ -149,6 +149,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Head-sample 1-in-N decisions into the decision "
                         "log (at most one record per micro-batch — zero "
                         "per-request work on the native lane)")
+    s.add_argument("--canary-fraction", type=float,
+                   default=env_var("CANARY_FRACTION", 0.0),
+                   help="CHANGE SAFETY (docs/robustness.md): fraction of "
+                        "requests (deterministic hash of host|path|method) "
+                        "routed to a newly reconciled snapshot generation "
+                        "while the rest keeps serving the previous one "
+                        "(0 = swaps serve 100%% immediately, the pre-ISSUE-10 "
+                        "behavior).  Guards compare the cohorts; a breach "
+                        "inside the window auto-rolls-back and quarantines "
+                        "the poison configs, a clean window promotes")
+    s.add_argument("--canary-window", type=float,
+                   default=env_var("CANARY_WINDOW_S", 30.0),
+                   help="Canary observation window in seconds before a "
+                        "clean new generation promotes to 100%%")
+    s.add_argument("--snapshot-history", type=int,
+                   default=env_var("SNAPSHOT_HISTORY", 4),
+                   help="Previous snapshot generations retained for "
+                        "rollback (pointer swap — old device buffers are "
+                        "double-buffer safe; bounds device/host memory of "
+                        "retired corpora)")
+    s.add_argument("--flight-keep", type=int,
+                   default=env_var("AUTHORINO_TPU_FLIGHT_KEEP", 16),
+                   help="Flight-recorder on-disk bundle retention: only "
+                        "the newest N diagnostic bundles survive in "
+                        "--flight-dir (anomaly storms must not fill the "
+                        "disk)")
     s.add_argument("--flight-dir", default=env_var("AUTHORINO_TPU_FLIGHT_DIR", ""),
                    help="Directory for flight-recorder diagnostic bundles "
                         "(default: <tmp>/authorino-tpu-flight).  Bundles "
@@ -328,7 +354,8 @@ async def run_server(args) -> None:
         sample_n=int(getattr(args, "decision_log_sample", 64)))
     RECORDER.configure(
         dump_dir=(str(getattr(args, "flight_dir", "") or "") or None),
-        enabled=not getattr(args, "no_flight_recorder", False))
+        enabled=not getattr(args, "no_flight_recorder", False),
+        keep=int(getattr(args, "flight_keep", 16)))
 
     fault_profile = str(getattr(args, "fault_profile", "") or "")
     if fault_profile:
@@ -369,6 +396,9 @@ async def run_server(args) -> None:
         breaker_threshold=int(getattr(args, "breaker_threshold", 5)),
         breaker_reset_s=float(getattr(args, "breaker_reset", 5.0)),
         slo_ms=float(getattr(args, "slo_ms", 0.0)),
+        canary_fraction=float(getattr(args, "canary_fraction", 0.0)),
+        canary_window_s=float(getattr(args, "canary_window", 30.0)),
+        snapshot_history=int(getattr(args, "snapshot_history", 4)),
     )
 
     # snapshot distribution (ISSUE 8, docs/control_plane.md): a compile

@@ -276,6 +276,23 @@ class AuthConfigReconciler:
                 self.status.set(entry.id, STATUS_CACHING_ERROR,
                                 f"corpus swap failed: {e}")
             raise
+        # change safety (ISSUE 10): a config the engine quarantined after
+        # a canary guard breach SERVES (its prior vetted artifact), so it
+        # stays Ready — but the status message must tell the operator the
+        # new spec was rolled back and is being held out
+        cs_vars = getattr(self.engine, "change_safety_vars", None)
+        q = (cs_vars() or {}).get("quarantine") if cs_vars else None
+        if q:
+            for cid in q.get("configs", []):
+                report = self.status.get(cid)
+                if report is not None:
+                    self.status.set(
+                        cid, STATUS_RECONCILED,
+                        message="quarantined after canary guard breach: "
+                                "serving the previous vetted rules; ship a "
+                                "fixed spec (or clear-quarantine) to "
+                                "release",
+                        hosts_ready=report.hosts_ready)
         if old_entries:
             await self._clean_entries(old_entries)
 

@@ -68,6 +68,7 @@ from ..utils.rpc import DEADLINE_EXCEEDED, NOT_FOUND, UNAVAILABLE, CheckAbort
 from ..utils.verdict_cache import VerdictCache
 from . import faults
 from . import provenance as prov_mod
+from . import change_safety as safety_mod
 from .admission import AdaptiveWindow, AdmissionController
 from .breaker import CircuitBreaker
 from .flight_recorder import RECORDER
@@ -144,6 +145,10 @@ class _Snapshot:
         self.phase_s: Dict[str, float] = {}
         self.host_view = None
         self.published_origin: Optional[str] = None  # set by from_published
+        # change-safety provenance (ISSUE 10): set on rollback clones and
+        # quarantine re-applies so the publisher manifest can carry the
+        # rollback/quarantine record to replicas
+        self.change_safety: Optional[Dict[str, Any]] = None
         # rule heat map (ISSUE 9): built at install time by
         # _install_snapshot (kernel rows → authconfig/rule-source labels)
         self.heat = None
@@ -286,6 +291,7 @@ class _Snapshot:
         snap.upload = None
         snap.phase_s = {}
         snap.host_view = None
+        snap.change_safety = (loaded.meta or {}).get("change_safety")
         snap.heat = None
         # provenance: this snapshot was LOADED, not compiled here — the
         # publisher skips it (a replica must never republish what it
@@ -314,6 +320,17 @@ class _Snapshot:
         snap.cache_tokens = cache_tokens(loaded.policy, snap.fingerprints)
         snap._upload(prev if prev_ok else None)
         return snap
+
+    def clone(self) -> "_Snapshot":
+        """Shallow re-serve copy (rollback is a pointer swap, ISSUE 10):
+        shares the compiled policy, device params, heat map and cache
+        tokens — only the generation and change-safety record are fresh,
+        so in-flight batches pinned to the ORIGINAL object keep resolving
+        and inserting verdicts under their own generation/tokens."""
+        c = _Snapshot.__new__(_Snapshot)
+        c.__dict__.update(self.__dict__)
+        c.change_safety = None
+        return c
 
     def _verify(self) -> None:
         from ..analysis.tensor_lint import lint_snapshot
@@ -376,6 +393,10 @@ class _Pending:
     span: Any = None              # RequestSpan (DeviceBatch span links)
     t_enq: float = 0.0            # monotonic enqueue time (queue-wait hist)
     deadline: Optional[float] = None  # monotonic Check() deadline (shedding)
+    # canary cohort flag (ISSUE 10): stamped at submit while a canary is in
+    # progress — batch cuts partition by it so every launched batch rides
+    # exactly ONE snapshot generation (no torn batches)
+    canary: bool = False
 
 
 class _Inflight:
@@ -440,6 +461,10 @@ class PolicyEngine:
         brownout: bool = True,
         brownout_max_batch: int = 32,
         slo_ms: float = 0.0,
+        canary_fraction: float = 0.0,
+        canary_window_s: float = 30.0,
+        canary_thresholds=None,
+        snapshot_history: int = 4,
     ):
         """``mesh="auto"`` shards the rule corpus over all visible devices
         when more than one is present (dp × mp ShardedPolicyModel);
@@ -507,7 +532,20 @@ class PolicyEngine:
         CAP, no longer the operating point.  ``brownout`` lets saturated
         windows spill small head-of-queue batches to the exact host oracle
         (``brownout_max_batch`` rows at a time): overload degrades
-        throughput, never correctness."""
+        throughput, never correctness.
+
+        Change safety (ISSUE 10, docs/robustness.md "Change safety"):
+        with ``canary_fraction`` > 0, a reconcile that actually changes
+        the compiled corpus does NOT swap at 100% — a deterministic
+        hash-fraction of requests routes to the new generation for
+        ``canary_window_s`` while the rest keeps serving the previous one.
+        Guards (``canary_thresholds``: runtime/change_safety.py
+        GuardThresholds) compare the cohorts' deny/error/SLO rates; a
+        breach auto-rolls-back (pointer swap — the previous snapshot and
+        its device buffers are retained) and quarantines the poison
+        configs, a clean window promotes.  ``snapshot_history`` bounds how
+        many previous (snapshot, index) generations are retained for
+        manual rollback."""
         self.index: HostIndex[EngineEntry] = HostIndex()
         self.generation = 0  # bumped per apply_snapshot (gauge + /debug/vars)
         self.max_batch = max_batch
@@ -598,6 +636,20 @@ class PolicyEngine:
             from ..utils.slo import SloTracker
 
             self.slo = SloTracker("engine", slo_ms)
+        # change safety (ISSUE 10): the canary state machine, the
+        # quarantine record (poison config id → fingerprints + the prior
+        # entry each resync substitutes back in), the bounded generation
+        # history for manual rollback, and the last-rollback evidence
+        self.canary_fraction = min(max(float(canary_fraction), 0.0), 1.0)
+        self.canary_window_s = float(canary_window_s)
+        self.canary_thresholds = canary_thresholds
+        self._canary: Optional[safety_mod.CanaryPhase] = None
+        self._quarantine: Optional[Dict[str, Any]] = None
+        self._quarantine_prior: Dict[str, EngineEntry] = {}
+        self._history: deque = deque(maxlen=max(1, int(snapshot_history)))
+        self._last_rollback: Optional[Dict[str, Any]] = None
+        self._g_canary = metrics_mod.canary_state.labels("engine")
+        self._g_quarantine = metrics_mod.quarantined_configs.labels("engine")
         RECORDER.register_provider("engine", self, "debug_vars")
 
     # swap listeners: the native frontend rebuilds its C++ snapshot after
@@ -643,7 +695,25 @@ class PolicyEngine:
         persistent per-config compile cache and the device upload is a
         DELTA against the previous snapshot — an unchanged corpus compiles
         zero configs and ships zero bytes; verdict-cache entries of
-        untouched configs survive the swap (per-config cache tokens)."""
+        untouched configs survive the swap (per-config cache tokens).
+
+        Change safety (ISSUE 10): still-poisoned quarantined configs are
+        substituted with their prior artifacts before compile, and a
+        corpus-changing swap enters the canary phase instead of serving
+        100% immediately (``canary_fraction`` > 0)."""
+        self._apply_entries(entries, override=override, allow_canary=True)
+
+    def _apply_entries(self, entries: Sequence[EngineEntry],
+                       override: bool = True,
+                       allow_canary: bool = True) -> None:
+        phase = self._canary
+        if phase is not None:
+            # a newer reconcile supersedes an undecided canary: fall back
+            # to the baseline first — the new corpus gets its own canary
+            # (never stack two candidate generations)
+            self._canary_rollback(phase, reason="superseded",
+                                  quarantine=False)
+        entries = self._substitute_quarantined(entries)
         try:
             snap = _Snapshot(entries, members_k=self.members_k,
                              mesh=self._resolve_mesh(),
@@ -660,7 +730,20 @@ class PolicyEngine:
                 "keeps serving): %s", self.generation,
                 "; ".join(str(f) for f in e.findings[:5]))
             raise
-        self._install_snapshot(snap, entries, override=override)
+        q = self._quarantine
+        if q is not None:
+            # stamp the ACTIVE quarantine onto the outgoing snapshot BEFORE
+            # install fires the swap listeners: the publisher serializes
+            # this record into the blob meta + manifest, so replicas
+            # converge on the quarantined state — assigning it after the
+            # listeners ran would race the publish thread's read
+            snap.change_safety = {"quarantine": {
+                "configs": sorted(q["configs"]),
+                "from_generation": q["from_generation"]}}
+        if allow_canary and self._should_canary(snap):
+            self._enter_canary(snap, entries, override=override)
+        else:
+            self._install_snapshot(snap, entries, override=override)
         if self.analyze_policies:
             self._run_policy_analysis(entries, snap)
             self._run_lowerability(entries, snap)
@@ -701,12 +784,7 @@ class PolicyEngine:
         # decision provenance (ISSUE 9): the rule heat map binds kernel rows
         # to (authconfig, rule source) for THIS snapshot — attribution and
         # the dead-rule report always read the corpus that evaluated
-        try:
-            snap.heat = prov_mod.HeatMap.for_snapshot(snap.policy,
-                                                      snap.sharded)
-        except Exception:
-            log.exception("rule heat map build failed (swap unaffected)")
-            snap.heat = None
+        self._build_heat(snap)
         with self._swap_lock:
             self.generation += 1
             # the mesh lane's verdict cache keys on snap.generation (the
@@ -715,9 +793,17 @@ class PolicyEngine:
             # under the tokens/generation they were encoded against, so
             # the swap structurally invalidates without TTLs
             snap.generation = self.generation
+            prev_snap, prev_index = self._snapshot, self.index
             self._snapshot = snap
             self.index = new_index
             metrics_mod.snapshot_generation.labels("engine").set(self.generation)
+            # bounded generation history (ISSUE 10): rollback is a pointer
+            # swap to a retained (snapshot, index) pair — the old device
+            # buffers are double-buffer safe and the compile cache keeps
+            # re-applies nearly free
+            if prev_snap is not None and (prev_snap.policy is not None
+                                          or prev_snap.sharded is not None):
+                self._history.append((prev_snap, prev_index))
         RECORDER.record("snapshot-swap", lane="engine", detail={
             "generation": snap.generation, "configs": len(snap.by_id)})
         self._record_control_plane(snap)
@@ -763,6 +849,412 @@ class PolicyEngine:
             }
         except Exception:
             log.exception("control-plane telemetry failed (swap unaffected)")
+
+    def _build_heat(self, snap: "_Snapshot") -> None:
+        if snap.heat is not None:
+            return
+        try:
+            snap.heat = prov_mod.HeatMap.for_snapshot(snap.policy,
+                                                      snap.sharded)
+        except Exception:
+            log.exception("rule heat map build failed (swap unaffected)")
+            snap.heat = None
+
+    # ---- change safety (ISSUE 10): canary, rollback, quarantine ----------
+
+    def _should_canary(self, snap: "_Snapshot") -> bool:
+        """A swap canaries when it can (both generations single-corpus —
+        the mesh lane has no per-request split) and should (the compiled
+        corpus actually changed; an identical-fingerprint resync swaps
+        straight through, it has nothing to prove)."""
+        if not (self.canary_fraction > 0.0 and self.canary_window_s > 0.0):
+            return False
+        if self._draining:
+            return False
+        prev = self._snapshot
+        if prev is None or prev.policy is None or prev.sharded is not None:
+            return False
+        if snap.policy is None or snap.sharded is not None:
+            return False
+        return snap.fingerprints != prev.fingerprints
+
+    def _enter_canary(self, snap: "_Snapshot",
+                      entries: Sequence[EngineEntry],
+                      override: bool = True) -> None:
+        """Start the canary phase: the reconcile's host index (pipeline
+        semantics) lands immediately, but the compiled VERDICT lane splits
+        — the hash-fraction cohort rides the new generation, everyone else
+        keeps the baseline.  Swap listeners (native frontend rebuild,
+        snapshot publisher) deliberately do NOT fire here: the native fast
+        lane and the replica fleet hold the baseline until promotion, so a
+        breach never has to claw anything back from them."""
+        new_index: HostIndex[EngineEntry] = HostIndex()
+        for e in entries:
+            for host in e.hosts:
+                new_index.set(e.id, host, e, override=override)
+        self._build_heat(snap)
+        baseline = self._snapshot
+        # the per-config guards watch only what this reconcile CHANGED
+        # (the PR 8 fingerprint diff): unchanged configs share the
+        # baseline's artifacts and can only differ by cohort selection
+        # bias — see change_safety.CanaryGuard
+        from ..snapshots.diff import snapshot_diff
+
+        changed = set(snapshot_diff(baseline.fingerprints or {},
+                                    snap.fingerprints or {})["recompile"])
+        phase = safety_mod.CanaryPhase(
+            snap=snap, baseline=baseline, entries=entries,
+            index=new_index, baseline_index=self.index,
+            fraction=self.canary_fraction, window_s=self.canary_window_s,
+            guard=safety_mod.CanaryGuard(self.canary_thresholds,
+                                         changed=changed))
+        with self._swap_lock:
+            self.generation += 1
+            snap.generation = self.generation
+            self._canary = phase
+            self.index = new_index
+        self._g_canary.set(1)
+        RECORDER.record("canary-start", lane="engine", detail={
+            "generation": snap.generation,
+            "baseline_generation": baseline.generation,
+            "fraction": self.canary_fraction,
+            "window_s": self.canary_window_s,
+            "configs": len(snap.by_id)})
+        self._record_control_plane(snap)
+        log.info("canary started: generation %d serving %.1f%% of traffic "
+                 "for %.1fs (baseline %d serves the rest)",
+                 snap.generation, self.canary_fraction * 100,
+                 self.canary_window_s, baseline.generation)
+        phase.start_timer(lambda: self._canary_conclude(phase))
+
+    def _canary_conclude(self, phase) -> None:
+        """Window-expiry decision (the phase timer's callback): one final
+        guard evaluation (forced past the rate limit — a per-batch check
+        moments earlier must not turn this into a blind promote), then
+        promote or roll back."""
+        if self._draining:
+            return
+        try:
+            b = phase.guard.breach(force=True)
+            if b is not None:
+                self._canary_rollback(phase, reason="guard-breach",
+                                      detail=b)
+            else:
+                self._canary_promote(phase)
+        except Exception:
+            log.exception("canary conclude failed")
+
+    def _canary_guard_check(self, phase) -> None:
+        """Per-feed breach/expiry check (worker threads only — promotion
+        and rollback fan out to swap listeners, which must never run on a
+        serving event loop)."""
+        if self._canary is not phase or self._draining:
+            return
+        b = phase.guard.breach()
+        if b is not None:
+            self._canary_rollback(phase, reason="guard-breach", detail=b)
+        elif phase.expired():
+            self._canary_conclude(phase)
+
+    def _canary_promote(self, phase, manual: bool = False) -> bool:
+        """Clean window (or operator override): the canary generation goes
+        to 100% — a pointer swap; the baseline joins the rollback history
+        and the swap listeners (native rebuild, publisher) finally fire."""
+        with self._swap_lock:
+            if self._canary is not phase:
+                return False
+            self._canary = None
+            self._snapshot = phase.snap
+            if phase.baseline is not None and \
+                    phase.baseline.policy is not None:
+                self._history.append((phase.baseline, phase.baseline_index))
+            metrics_mod.snapshot_generation.labels("engine").set(
+                phase.snap.generation)
+        phase.cancel_timer()
+        phase.guard.close()
+        self._g_canary.set(0)
+        RECORDER.record("canary-promote", lane="engine", detail={
+            "generation": phase.snap.generation, "manual": manual,
+            "age_s": round(time.monotonic() - phase.t_start, 3)})
+        log.info("canary promoted to 100%%: generation %d now serves all "
+                 "traffic%s", phase.snap.generation,
+                 " (manual override)" if manual else "")
+        self.notify_swap_listeners()
+        return True
+
+    def _canary_rollback(self, phase, reason: str,
+                         detail: Optional[Dict[str, Any]] = None,
+                         quarantine: bool = True,
+                         manual: bool = False) -> bool:
+        """Guard breach (or supersede/manual): the baseline re-serves 100%
+        immediately — a pointer swap to a CLONE of the retained baseline
+        (fresh generation: in-flight batches pinned to the original keep
+        resolving/inserting under their own tokens), then the poison
+        configs are quarantined and the rest of the reconcile re-applied."""
+        t_detect = time.monotonic()
+        clone = phase.baseline.clone()
+        clone.change_safety = {"rollback": {
+            "from_generation": phase.snap.generation, "reason": reason}}
+        with self._swap_lock:
+            if self._canary is not phase:
+                return False
+            self._canary = None
+            self.generation += 1
+            clone.generation = self.generation
+            self._snapshot = clone
+            self.index = phase.baseline_index
+            metrics_mod.snapshot_generation.labels("engine").set(
+                clone.generation)
+        phase.cancel_timer()
+        phase.guard.close()
+        self._g_canary.set(0)
+        metrics_mod.snapshot_rollbacks.labels(reason).inc()
+        self._last_rollback = {
+            "t": time.time(), "reason": reason, "manual": manual,
+            "from_generation": phase.snap.generation,
+            "to_generation": clone.generation,
+            "detect_ms": round((t_detect - phase.t_start) * 1e3, 3),
+            "detail": detail, "quarantined": [],
+        }
+        RECORDER.record("snapshot-rollback", lane="engine", detail={
+            "reason": reason,
+            "from_generation": phase.snap.generation,
+            "to_generation": clone.generation,
+            "guard": detail})
+        log.error("canary ROLLED BACK (%s): generation %d abandoned, "
+                  "baseline re-serving as generation %d%s", reason,
+                  phase.snap.generation, clone.generation,
+                  f" — guard: {detail}" if detail else "")
+        self.notify_swap_listeners()
+        if quarantine and reason == "guard-breach":
+            try:
+                self._quarantine_poison(phase, detail, t_detect)
+            except Exception:
+                log.exception("quarantine re-apply failed (rolled-back "
+                              "baseline keeps serving)")
+        return True
+
+    def _quarantine_poison(self, phase, detail: Optional[Dict[str, Any]],
+                           t_detect: float) -> None:
+        """Post-rollback quarantine: the PR 8 fingerprint diff names what
+        the reconcile changed, the guard's per-config deny deltas pin the
+        spike — their intersection is the poison set (every changed config
+        when the breach had no per-config attribution).  The reconcile is
+        then re-applied with ONLY the poison configs reverted to their
+        prior compiled artifacts; the compile cache makes that nearly
+        free.  Quarantine persists across resyncs (apply_snapshot keeps
+        substituting) until the operator ships a FIXED config."""
+        from ..snapshots.diff import snapshot_diff
+
+        d = snapshot_diff(phase.baseline.fingerprints or {},
+                          phase.snap.fingerprints or {})
+        changed = set(d["recompile"])
+        suspects = [s for s in (detail or {}).get("suspects", [])
+                    if s in changed]
+        poison = suspects or sorted(changed)
+        if not poison:
+            return
+        base_by_id = phase.baseline.by_id
+        configs: Dict[str, Dict[str, Any]] = {}
+        prior: Dict[str, EngineEntry] = {}
+        for e in phase.entries:
+            if e.id not in poison:
+                continue
+            configs[e.id] = {
+                "poison": (phase.snap.fingerprints or {}).get(e.id),
+                "prior": (phase.baseline.fingerprints or {}).get(e.id),
+            }
+            pe = base_by_id.get(e.id)
+            if pe is not None:
+                prior[e.id] = pe
+            # pe is None → the poison config is NEW this reconcile: it has
+            # no prior artifact and quarantines out entirely (the
+            # substitution below drops it while keeping it quarantined)
+        if not configs:
+            return
+        self._quarantine = {
+            "since": time.time(), "reason": "guard-breach",
+            "from_generation": phase.snap.generation,
+            "configs": configs,
+        }
+        self._quarantine_prior = prior
+        self._g_quarantine.set(len(configs))
+        RECORDER.record("quarantine", lane="engine", detail={
+            "configs": sorted(configs),
+            "from_generation": phase.snap.generation})
+        log.warning("quarantined %d poison config(s) %s: re-applying the "
+                    "reconcile with their prior artifacts", len(configs),
+                    sorted(configs))
+        # re-apply the ORIGINAL entries: the quarantine is armed above, so
+        # _substitute_quarantined swaps each poison entry for its prior
+        # artifact (or drops a no-prior one) exactly like a control-plane
+        # resync would — one substitution path, and the quarantine record
+        # stays intact for configs that have no prior to serve
+        self._apply_entries(phase.entries, override=True,
+                            allow_canary=False)
+        if self._last_rollback is not None:
+            self._last_rollback["quarantined"] = sorted(configs)
+            self._last_rollback["recover_ms"] = round(
+                (time.monotonic() - t_detect) * 1e3, 3)
+
+    def _substitute_quarantined(
+            self, entries: Sequence[EngineEntry]) -> Sequence[EngineEntry]:
+        """Resync guard: while a quarantine is active, incoming entries
+        that still carry the POISON fingerprint are substituted with their
+        prior artifacts (the control plane keeps resyncing the same bad
+        spec — it must not re-serve it); an entry whose fingerprint
+        changed (neither poison nor prior) was fixed by the operator and
+        is released back to the normal (canaried) path."""
+        q = self._quarantine
+        if not q:
+            return entries
+        from ..snapshots.fingerprint import rules_fingerprint
+
+        qc: Dict[str, Dict[str, Any]] = q["configs"]
+        out: List[EngineEntry] = []
+        still: Dict[str, Dict[str, Any]] = {}
+        for e in entries:
+            rec = qc.get(e.id)
+            if rec is None:
+                out.append(e)
+                continue
+            fp = rules_fingerprint(e.rules) if e.rules is not None else None
+            if fp == rec["poison"]:
+                still[e.id] = rec
+                pe = self._quarantine_prior.get(e.id)
+                if pe is not None:
+                    out.append(EngineEntry(id=e.id, hosts=list(e.hosts),
+                                           runtime=pe.runtime,
+                                           rules=pe.rules))
+                # no prior artifact: stays quarantined out
+            elif fp == rec["prior"]:
+                # already the prior artifact (our own quarantine re-apply,
+                # or the operator reverting by hand): serve it, keep the
+                # quarantine armed against the poison spec resyncing back
+                still[e.id] = rec
+                out.append(e)
+            else:
+                log.info("quarantine released for %s: fingerprint changed "
+                         "(operator fix) — the new spec takes the normal "
+                         "path", e.id)
+                out.append(e)
+        if still != qc:
+            if still:
+                self._quarantine = dict(q, configs=still)
+            else:
+                self.clear_quarantine(note="all poison configs changed")
+            self._g_quarantine.set(len(still))
+        return out
+
+    def clear_quarantine(self, note: str = "") -> bool:
+        q = self._quarantine
+        if q is None:
+            return False
+        RECORDER.record("quarantine-clear", lane="engine", detail={
+            "note": note, "configs": sorted(q["configs"])})
+        log.info("quarantine cleared (%s): %s", note or "operator",
+                 sorted(q["configs"]))
+        self._quarantine = None
+        self._quarantine_prior = {}
+        self._g_quarantine.set(0)
+        return True
+
+    @property
+    def quarantine_active(self) -> bool:
+        return self._quarantine is not None
+
+    def canary_promote(self) -> bool:
+        """Operator override (analysis CLI --promote / /debug/canary):
+        promote the in-progress canary immediately, guard unconsulted."""
+        phase = self._canary
+        return self._canary_promote(phase, manual=True) \
+            if phase is not None else False
+
+    def canary_rollback(self, reason: str = "manual") -> bool:
+        """Operator override: roll back the in-progress canary (no
+        quarantine — the operator is driving), or, with no canary active,
+        pointer-swap back to the newest retained history generation."""
+        phase = self._canary
+        if phase is not None:
+            return self._canary_rollback(phase, reason=reason,
+                                         quarantine=False, manual=True)
+        return self.rollback_last(reason=reason)
+
+    def rollback_last(self, reason: str = "manual") -> bool:
+        """Manual rollback outside a canary: re-serve the newest retained
+        (snapshot, index) pair from the bounded generation history."""
+        with self._swap_lock:
+            if not self._history:
+                return False
+            prev_snap, prev_index = self._history.pop()
+            clone = prev_snap.clone()
+            from_gen = (self._snapshot.generation
+                        if self._snapshot is not None else 0)
+            self.generation += 1
+            clone.generation = self.generation
+            clone.change_safety = {"rollback": {
+                "from_generation": from_gen, "reason": reason}}
+            self._snapshot = clone
+            self.index = prev_index
+            metrics_mod.snapshot_generation.labels("engine").set(
+                clone.generation)
+        metrics_mod.snapshot_rollbacks.labels(reason).inc()
+        self._last_rollback = {
+            "t": time.time(), "reason": reason, "manual": True,
+            "from_generation": from_gen,
+            "to_generation": clone.generation,
+            "detect_ms": None, "detail": None, "quarantined": [],
+        }
+        RECORDER.record("snapshot-rollback", lane="engine", detail={
+            "reason": reason, "from_generation": from_gen,
+            "to_generation": clone.generation})
+        log.warning("manual rollback: generation %d re-serving as %d",
+                    from_gen, clone.generation)
+        self.notify_swap_listeners()
+        return True
+
+    def canary_observe_external(self, rows, firing, heat,
+                                shards=None) -> None:
+        """Baseline-cohort guard evidence from OUTSIDE the engine's own
+        dispatch — the native fast lane serves the baseline during a
+        canary (its C++ snapshot only rebuilds on promotion), so its
+        per-batch attribution strengthens the comparison.  Breach handling
+        hops to the encode pool: the caller may be a readback thread that
+        must never run swap listeners."""
+        phase = self._canary
+        if phase is None or heat is None or firing is None:
+            return
+        try:
+            phase.guard.observe_batch(False, rows, firing, heat,
+                                      shards=shards)
+            if phase.guard.breach() is not None or phase.expired():
+                _encode_pool(self.dispatch_workers).submit(
+                    self._canary_guard_check, phase)
+        except Exception:
+            log.exception("external canary guard feed failed")
+
+    def change_safety_vars(self) -> Dict[str, Any]:
+        """JSON-safe change-safety state (pure read — /debug/canary,
+        /debug/vars, the native frontend's mirror, bench artifacts)."""
+        phase = self._canary
+        q = self._quarantine
+        with self._swap_lock:
+            # a reconcile thread appends to the bounded deque under this
+            # lock; iterating it unguarded can raise mid-reconcile —
+            # exactly when the operator is reading the debug surface
+            history = [s.generation for s, _ in self._history]
+        return {
+            "canary_fraction": self.canary_fraction,
+            "canary_window_s": self.canary_window_s,
+            "canary": phase.to_json() if phase is not None else None,
+            "quarantine": ({
+                "since": q["since"], "reason": q["reason"],
+                "from_generation": q["from_generation"],
+                "configs": sorted(q["configs"]),
+            } if q is not None else None),
+            "history_generations": history,
+            "last_rollback": self._last_rollback,
+        }
 
     def _run_policy_analysis(self, entries: Sequence[EngineEntry],
                              snap: "_Snapshot") -> None:
@@ -894,6 +1386,7 @@ class PolicyEngine:
             },
             "slo": self.slo.to_json() if self.slo is not None else None,
             "flight_recorder": RECORDER.to_json(),
+            "change_safety": self.change_safety_vars(),
             "snapshot": None,
         }
         if snap is not None:
@@ -1043,10 +1536,21 @@ class PolicyEngine:
                                    "admission rejected")
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
+        # canary cohort (ISSUE 10): stamped at submit — deterministic over
+        # the request's identity, so retries/redispatches keep the cohort
+        phase = self._canary
+        # a config ADDED by the canaried reconcile has no baseline artifact:
+        # its traffic must ride the candidate regardless of cohort (the
+        # baseline snapshot cannot decide it — encoding against it would
+        # hard-fail and walk the breaker open on healthy hardware)
+        in_canary = phase is not None and (
+            phase.in_cohort(doc)
+            or config_name not in phase.baseline.by_id)
         with self._queue_lock:
             self._queue.append(_Pending(doc, config_name, fut, loop,
                                         span=span, t_enq=time.monotonic(),
-                                        deadline=deadline))
+                                        deadline=deadline,
+                                        canary=in_canary))
             self.controller.observe_arrivals()
         loop.call_soon(self._maybe_dispatch)
         rule, skipped, snap = await fut
@@ -1078,6 +1582,10 @@ class PolicyEngine:
                 depth = len(self._queue)
                 if not self._queue:
                     break
+                # canary phase (ISSUE 10): the cut partitions by cohort —
+                # every launched batch rides exactly ONE snapshot
+                # generation, so no request can ever observe a torn swap
+                phase = self._canary
                 if self._inflight < self.controller.window:
                     # the cut itself stays completion-driven (grow with
                     # load, bounded by max_batch): clamping it to the
@@ -1085,7 +1593,8 @@ class PolicyEngine:
                     # queues into cold pad shapes — see AdaptiveWindow
                     n = min(depth, self.max_batch)
                     batch = [self._queue.popleft() for _ in range(n)]
-                    self._inflight += 1
+                    parts = _split_cohorts(batch, phase)
+                    self._inflight += len(parts)
                     if self._inflight > self.inflight_peak:
                         self.inflight_peak = self._inflight
                     inflight = self._inflight
@@ -1098,19 +1607,30 @@ class PolicyEngine:
                     # the host lane — no window slot consumed
                     n = min(depth, self.brownout_max_batch)
                     batch = [self._queue.popleft() for _ in range(n)]
-                    self._brownout_inflight += 1
+                    parts = _split_cohorts(batch, phase)
+                    self._brownout_inflight += len(parts)
                     brown = True
                 else:
                     break
-            snap = self._snapshot  # pinned per batch: double-buffer swap safety
-            if brown:
-                _encode_pool(self.dispatch_workers).submit(
-                    self._brownout_job, snap, batch)
-            else:
-                self._g_inflight.set(inflight)
-                _encode_pool(self.dispatch_workers).submit(
-                    self._encode_launch_job, snap, batch)
+            for is_canary, part in parts:
+                # pinned per batch: double-buffer swap safety.  During a
+                # canary the cohort picks its generation; a phase that
+                # concluded since the stamp collapses to the (promoted or
+                # rolled-back) serving snapshot — still one generation.
+                snap = self._snap_for(phase, is_canary)
+                if brown:
+                    _encode_pool(self.dispatch_workers).submit(
+                        self._brownout_job, snap, part)
+                else:
+                    self._g_inflight.set(inflight)
+                    _encode_pool(self.dispatch_workers).submit(
+                        self._encode_launch_job, snap, part)
         self._g_depth.set(len(self._queue))
+
+    def _snap_for(self, phase, is_canary: bool) -> "Optional[_Snapshot]":
+        if phase is None:
+            return self._snapshot
+        return phase.snap if is_canary else phase.baseline
 
     def _encode_launch_job(self, snap: Optional[_Snapshot],
                            batch: List[_Pending], attempt: int = 0) -> None:
@@ -1268,6 +1788,7 @@ class PolicyEngine:
         the snapshot's heat map (vectorized composite-key bincount), plus at
         most ONE head-sampled decision record.  Never raises — a telemetry
         bug must not re-dispatch a decided batch."""
+        phase = self._canary
         try:
             heat = getattr(snap, "heat", None)
             if heat is None:
@@ -1282,10 +1803,22 @@ class PolicyEngine:
                 latency_ms=((time.monotonic() - p.t_enq) * 1e3
                             if p is not None and p.t_enq else 0.0),
                 generation=snap.generation)
-            return firing
+            # canary guards (ISSUE 10): the SAME attribution columns feed
+            # the per-cohort deny-rate comparison — batches are cohort-
+            # homogeneous, so the evaluating snapshot names the cohort
+            if phase is not None and \
+                    (snap is phase.snap or snap is phase.baseline):
+                phase.guard.observe_batch(snap is phase.snap, rows, firing,
+                                          heat, shards=shards)
         except Exception:
             log.exception("provenance fold failed (decision unaffected)")
             return None
+        if phase is not None:
+            try:
+                self._canary_guard_check(phase)
+            except Exception:
+                log.exception("canary guard check failed")
+        return firing
 
     @staticmethod
     def _resolve_host_decisions(by_loop, failed) -> None:
@@ -1319,6 +1852,17 @@ class PolicyEngine:
             if exc is not None:
                 log.warning("micro-batch of %d re-decided host-side after "
                             "device failure (%r)", len(batch), exc)
+        n_failed = sum(len(futs) for futs in failed.values())
+        phase = self._canary
+        if n_failed and phase is not None and batch:
+            # typed-error guard feed (ISSUE 10): rows the degrade oracle
+            # itself fails are serving errors too — a canary artifact
+            # broken on BOTH lanes must still accumulate breach evidence
+            try:
+                phase.guard.observe_errors(bool(batch[0].canary), n_failed)
+                self._canary_guard_check(phase)
+            except Exception:
+                log.exception("canary error feed failed")
         self._resolve_host_decisions(by_loop, failed)
 
     def _brownout_job(self, snap: Optional[_Snapshot],
@@ -1390,6 +1934,13 @@ class PolicyEngine:
         Queued and in-flight work keeps flowing to completion."""
         if not self._draining:
             self._draining = True
+            phase = self._canary
+            if phase is not None:
+                # a mid-drain window expiry must not promote/rollback into
+                # a tearing-down process (swap listeners would rebuild a
+                # stopped native frontend); the canary stays undecided and
+                # cohort routing keeps serving until exit
+                phase.cancel_timer()
             RECORDER.record("drain", lane="engine", detail={
                 "queue": len(self._queue), "inflight": self._inflight})
             log.info("engine draining: admission stopped "
@@ -1716,9 +2267,16 @@ class PolicyEngine:
                 # per-request latency ≈ queue wait + this batch's device
                 # stage — one vectorized compare per batch (ISSUE 9)
                 lat = np.asarray(item.waits) + dur
-                self.slo.observe(len(item.batch),
-                                 int(np.count_nonzero(lat > self.slo.slo_s)))
+                n_bad = int(np.count_nonzero(lat > self.slo.slo_s))
+                self.slo.observe(len(item.batch), n_bad)
                 slo_counted = True
+                # SLO-delta canary guard feed (ISSUE 10): per-cohort bad
+                # fractions ride the same per-batch counts
+                phase = self._canary
+                if phase is not None and \
+                        item.snap in (phase.snap, phase.baseline):
+                    phase.guard.observe_slo(item.snap is phase.snap,
+                                            len(item.batch), n_bad)
             binfo = item.binfo
             binfo["duration_s"] = t_done - item.t_launch
             metrics_mod.observe_pipeline_stage("engine", "device",
@@ -1778,6 +2336,18 @@ class PolicyEngine:
             # a post-completion telemetry failure arrives here AFTER the
             # success path already observed the batch — don't double-burn
             self.slo.observe_errors(len(batch))
+        phase = self._canary
+        if phase is not None and batch and exc.code != DEADLINE_EXCEEDED:
+            # typed-error guard (ISSUE 10): a canary generation whose
+            # batches keep failing (encode raises on a bad artifact, say)
+            # must breach even when it never produces a deny column.
+            # Batches are cohort-homogeneous post-partition.
+            try:
+                phase.guard.observe_errors(bool(batch[0].canary),
+                                           len(batch))
+                self._canary_guard_check(phase)
+            except Exception:
+                log.exception("canary error feed failed")
         by_loop: Dict[Any, list] = {}
         for p in batch:
             by_loop.setdefault(p.loop, []).append(p.future)
@@ -1801,6 +2371,21 @@ def _doc_host(doc) -> str:
         return str((doc.get("request") or {}).get("host", ""))
     except Exception:
         return ""
+
+
+def _split_cohorts(batch, phase):
+    """Partition one cut by canary cohort: [(is_canary, items), ...] with
+    empties dropped.  With no canary in progress the cut ships whole."""
+    if phase is None:
+        return [(False, batch)]
+    base = [p for p in batch if not p.canary]
+    can = [p for p in batch if p.canary]
+    parts = []
+    if base:
+        parts.append((False, base))
+    if can:
+        parts.append((True, can))
+    return parts or [(False, batch)]
 
 
 def _resolve_many(resolutions) -> None:

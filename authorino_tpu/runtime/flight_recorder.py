@@ -44,7 +44,7 @@ BUNDLE_SCHEMA = 1
 # only rides the ring as context
 ANOMALY_KINDS = frozenset({
     "breaker-open", "watchdog-timeout", "snapshot-rejected",
-    "admission-overloaded",
+    "admission-overloaded", "snapshot-rollback",
 })
 
 
@@ -58,8 +58,14 @@ class FlightRecorder:
     disk-filling amplifier."""
 
     def __init__(self, capacity: int = 512, dump_dir: Optional[str] = None,
-                 min_dump_interval_s: float = 30.0, enabled: bool = True):
+                 min_dump_interval_s: float = 30.0, enabled: bool = True,
+                 keep: int = 16):
         self.capacity = max(16, int(capacity))
+        # on-disk bundle retention (ISSUE 10 satellite): --flight-dir used
+        # to grow without limit across anomalies — a flapping lane on a
+        # long-lived pod would slowly fill the disk with bundles nobody
+        # read.  Only the newest ``keep`` bundles survive each dump.
+        self.keep = max(1, int(keep))
         self._ring: deque = deque(maxlen=self.capacity)
         # guards ring append vs snapshot: record() fires from any thread
         # (breaker/admission hooks) while the dump thread lists the ring —
@@ -87,7 +93,8 @@ class FlightRecorder:
     def configure(self, dump_dir: Optional[str] = None,
                   capacity: Optional[int] = None,
                   min_dump_interval_s: Optional[float] = None,
-                  enabled: Optional[bool] = None) -> None:
+                  enabled: Optional[bool] = None,
+                  keep: Optional[int] = None) -> None:
         if dump_dir is not None:
             self.dump_dir = dump_dir
         if capacity is not None and int(capacity) != self.capacity:
@@ -98,6 +105,8 @@ class FlightRecorder:
             self.min_dump_interval_s = float(min_dump_interval_s)
         if enabled is not None:
             self.enabled = bool(enabled)
+        if keep is not None:
+            self.keep = max(1, int(keep))
 
     def register_provider(self, name: str, owner: Any,
                           method: str = "debug_vars") -> None:
@@ -203,9 +212,29 @@ class FlightRecorder:
         metrics_mod.flight_dumps.labels(trigger).inc()
         self.dumps.append(path)
         del self.dumps[:-32]
+        self._prune_disk()
         log.warning("flight recorder dumped diagnostic bundle (%s): %s",
                     trigger, path)
         return path
+
+    def _prune_disk(self) -> None:
+        """Bounded on-disk retention: keep only the newest ``keep``
+        bundles in dump_dir (by mtime).  Best-effort — a prune failure
+        must never lose the bundle that was just written."""
+        try:
+            names = [n for n in os.listdir(self.dump_dir)
+                     if n.startswith("flight-") and n.endswith(".json")]
+            if len(names) <= self.keep:
+                return
+            names.sort(key=lambda n: os.path.getmtime(
+                os.path.join(self.dump_dir, n)))
+            for n in names[:-self.keep]:
+                try:
+                    os.unlink(os.path.join(self.dump_dir, n))
+                except OSError:
+                    pass
+        except OSError:
+            pass
 
     # -- introspection -----------------------------------------------------
 
@@ -218,6 +247,7 @@ class FlightRecorder:
             "events_recorded": self.events_total,
             "ring_depth": depth,
             "dump_dir": self.dump_dir,
+            "keep": self.keep,
             "min_dump_interval_s": self.min_dump_interval_s,
             "dumps": list(self.dumps),
             "tail": tail,
@@ -226,6 +256,14 @@ class FlightRecorder:
 
 # the process-wide recorder every hook reports into (one black box per
 # process, like one breaker trail per lane)
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 RECORDER = FlightRecorder(
     enabled=os.environ.get("AUTHORINO_TPU_FLIGHT_RECORDER", "1").lower()
-    not in ("0", "false", "no"))
+    not in ("0", "false", "no"),
+    keep=_env_int("AUTHORINO_TPU_FLIGHT_KEEP", 16))

@@ -957,6 +957,13 @@ class NativeFrontend:
                          else None),
             },
             "slo": self.slo.to_json() if self.slo is not None else None,
+            # change-safety mirror (ISSUE 10): the native lane holds the
+            # baseline through a canary window (refresh fires on
+            # promotion/rollback only) — operators reading this lane's
+            # vars see the same canary/quarantine state the engine owns
+            "change_safety": (self.engine.change_safety_vars()
+                              if hasattr(self.engine, "change_safety_vars")
+                              else None),
             "snapshot": None,
         }
         if rec is not None:
@@ -1221,7 +1228,16 @@ class NativeFrontend:
         Serialized end-to-end under _lock: concurrent reconciles must not
         mint duplicate ids OR install their C++ snapshots out of order
         (fe_swap sets the serving snapshot unconditionally — a late older
-        swap would leave a stale corpus serving)."""
+        swap would leave a stale corpus serving).
+
+        Change safety (ISSUE 10): during an engine canary window the swap
+        listeners do not fire, and ``engine._snapshot`` IS the baseline —
+        so this lane holds the previous generation until promotion (the
+        C++ batcher gathers per-snapshot and cannot split one gathered
+        batch across two compiled corpora; its canary evidence instead
+        feeds the guard's baseline cohort via canary_observe_external).
+        Promotion and rollback both fire the listeners, converging this
+        lane in one atomic fe_swap."""
         with self._lock:
             self._refresh_locked()
 
@@ -2364,6 +2380,13 @@ class NativeFrontend:
                                      lane="native", shards=shards_arr,
                                      latency_ms=dispatch_s * 1e3,
                                      generation=rec.snap_id)
+            # change safety (ISSUE 10): during an engine canary the native
+            # fast lane serves the BASELINE (its C++ snapshot only
+            # rebuilds on promotion — swap listeners are deferred), so its
+            # attribution strengthens the guard's baseline cohort
+            if getattr(self.engine, "_canary", None) is not None:
+                self.engine.canary_observe_external(rows, firing, heat,
+                                                    shards=shards_arr)
         if self.slo is not None and count:
             # the native SLI is the batch's on-box round trip (per-request
             # waits are C++-clocked): every member shares the batch verdict

@@ -194,6 +194,12 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
                 adm = getattr(owner, "admission", None) if owner else None
                 if adm is not None and adm.overloaded:
                     degraded.append(f"{lane} admission overloaded")
+            # change safety (ISSUE 10): an active quarantine is surfaced
+            # but STAYS ready — the quarantined configs serve their prior
+            # (exact, vetted) artifacts; 503ing would take down every
+            # healthy config with them
+            if getattr(engine, "quarantine_active", False):
+                degraded.append("quarantine active")
             if degraded:
                 return web.Response(
                     text=f"ok (degraded: {'; '.join(degraded)})")
@@ -255,6 +261,45 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
                 return web.Response(status=400, text="bad n")
         return web.json_response(prov_mod.DECISIONS.to_json(n=n))
 
+    async def debug_canary(request: web.Request):
+        """Change-safety state + manual override (ISSUE 10,
+        docs/robustness.md "Change safety"): GET returns the canary/
+        quarantine/rollback-history state; ``?action=promote`` promotes an
+        in-progress canary immediately, ``?action=rollback`` rolls it back
+        (or, with none active, pointer-swaps to the previous retained
+        generation), ``?action=clear-quarantine`` releases the quarantine.
+        Driven by ``python -m authorino_tpu.analysis --promote/--rollback``."""
+        import asyncio as _asyncio
+
+        action = request.query.get("action", "")
+        if not action:
+            return web.json_response(engine.change_safety_vars())
+        if request.method != "POST":
+            # promote/rollback/clear-quarantine change the serving
+            # snapshot — never off an idempotent-by-contract GET (link
+            # prefetchers, dashboard refreshes)
+            return web.json_response(
+                {"error": "state-changing actions require POST"},
+                status=405)
+        ops = {
+            "promote": engine.canary_promote,
+            "rollback": engine.canary_rollback,
+            "clear-quarantine": engine.clear_quarantine,
+        }
+        op = ops.get(action)
+        if op is None:
+            return web.Response(
+                status=400,
+                text=f"unknown action {action!r} "
+                     f"(want promote|rollback|clear-quarantine)")
+        # promote/rollback fan out to swap listeners (native C++ snapshot
+        # rebuild) — never on the serving event loop
+        applied = await _asyncio.get_running_loop().run_in_executor(None, op)
+        return web.json_response({
+            "action": action, "applied": bool(applied),
+            "change_safety": engine.change_safety_vars(),
+        })
+
     profile_state = {"busy": False}
 
     async def debug_profile(request: web.Request):
@@ -303,6 +348,8 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
     app.router.add_get("/server-metrics", server_metrics)
     app.router.add_get("/debug/vars", debug_vars)
     app.router.add_get("/debug/decisions", debug_decisions)
+    app.router.add_get("/debug/canary", debug_canary)
+    app.router.add_post("/debug/canary", debug_canary)
     app.router.add_get("/debug/profile", debug_profile)
     # catch-all LAST: Envoy's HTTP ext_authz filter forwards the ORIGINAL
     # request path (path_prefix + :path), so /check is just the conventional

@@ -99,7 +99,13 @@ class SnapshotPublisher:
         self._engine = None
         self._last_published_snap: Any = None
 
-    def publish_blob(self, blob: bytes, generation: int) -> str:
+    def publish_blob(self, blob: bytes, generation: int,
+                     extra: Optional[Dict[str, Any]] = None) -> str:
+        """``extra`` merges additional manifest fields — the change-safety
+        record (ISSUE 10): ``active_generation`` (the leader's serving
+        decision, which replicas converge on) and, after a guard-breach,
+        the ``rollback``/``quarantine`` provenance, so a fleet operator
+        can see WHY the manifest moved backwards semantically."""
         name = f"snapshot-{generation:012d}.atpusnap"
         path = os.path.join(self.directory, name)
         tmp = path + ".tmp"
@@ -111,10 +117,13 @@ class SnapshotPublisher:
         manifest = {
             "current": name,
             "generation": int(generation),
+            "active_generation": int(generation),
             "sha256": _sha256_hex(blob),
             "size": len(blob),
             "published_unix": time.time(),
         }
+        if extra:
+            manifest.update(extra)
         mtmp = os.path.join(self.directory, MANIFEST + ".tmp")
         with open(mtmp, "w") as f:
             json.dump(manifest, f)
@@ -146,6 +155,7 @@ class SnapshotPublisher:
             # this snapshot was itself loaded from a publisher: replicas
             # never republish (loop breaker — see engine.from_published)
             return None
+        change_safety = getattr(snap, "change_safety", None)
         meta = {
             "generation": int(snap.generation),
             "certified": bool(getattr(snap, "lint_ok", False)),
@@ -154,10 +164,16 @@ class SnapshotPublisher:
             "entries": [{"id": e.id, "hosts": list(e.hosts)}
                         for e in snap.by_id.values()],
         }
+        if change_safety:
+            meta["change_safety"] = change_safety
         blob = serialize_policy(snap.policy, meta=meta)
-        path = self.publish_blob(blob, snap.generation)
-        log.info("published snapshot generation %d (%d bytes, certified=%s) "
-                 "-> %s", snap.generation, len(blob), meta["certified"], path)
+        path = self.publish_blob(blob, snap.generation,
+                                 extra=(dict(change_safety)
+                                        if change_safety else None))
+        log.info("published snapshot generation %d (%d bytes, certified=%s"
+                 "%s) -> %s", snap.generation, len(blob), meta["certified"],
+                 f", change_safety={sorted(change_safety)}"
+                 if change_safety else "", path)
         return path
 
     def attach(self, engine) -> None:

@@ -819,6 +819,50 @@ flight_dumps = _counter(
     "auth_server_flight_recorder_dumps_total",
     "Diagnostic bundles auto-dumped by the flight recorder on anomaly "
     "triggers (breaker OPEN, watchdog fire, snapshot rejection, admission "
-    "OVERLOADED), by the anomaly kind that triggered the dump.",
+    "OVERLOADED, snapshot rollback), by the anomaly kind that triggered "
+    "the dump.",
     ("trigger",),
+)
+
+# ---------------------------------------------------------------------------
+# Change safety (ISSUE 10, docs/robustness.md "Change safety"): canary
+# snapshot swaps, guard-breach auto-rollback, and poison-config quarantine.
+# ---------------------------------------------------------------------------
+
+canary_state = _gauge(
+    "auth_server_canary_state",
+    "Canary swap state per lane: 0 = no canary in progress, 1 = a newly "
+    "reconciled snapshot is serving only its deterministic hash-fraction "
+    "cohort (--canary-fraction) while the previous generation serves the "
+    "rest; a clean --canary-window promotes to 100%, a guard breach "
+    "auto-rolls-back.",
+    _LANE_LABELS,
+)
+snapshot_rollbacks = _counter(
+    "auth_server_snapshot_rollbacks_total",
+    "Snapshot generations rolled back, by reason: guard-breach (a canary "
+    "guard tripped inside the window — deny-rate/error-rate/SLO delta "
+    "canary vs baseline), superseded (a newer reconcile landed before the "
+    "canary concluded), manual (operator override via the analysis CLI / "
+    "debug endpoint).  Rollback is a pointer swap to the retained "
+    "previous generation — old device buffers are double-buffer safe.",
+    ("reason",),
+)
+quarantined_configs = _gauge(
+    "auth_server_quarantined_configs",
+    "AuthConfigs currently quarantined per lane: after a guard-breach "
+    "rollback, the reconcile is re-applied with these configs reverted to "
+    "their prior compiled artifacts (the rest of the change still lands). "
+    "Quarantine clears when the operator ships a FIXED config (changed "
+    "fingerprint) or overrides via clear-quarantine.",
+    _LANE_LABELS,
+)
+canary_guard_delta = _gauge(
+    "auth_server_canary_guard_delta",
+    "Live canary-vs-baseline guard deltas during a canary window: "
+    "deny-rate (overall), config-deny-rate (worst per-authconfig delta), "
+    "error-rate (typed serving errors), slo-bad-rate (SLO bad fraction). "
+    "A delta past its threshold (docs/robustness.md) breaches the guard "
+    "and triggers automatic rollback.",
+    ("guard",),
 )

@@ -87,15 +87,22 @@ def build_corpus(n_configs: int, rules_per_config: int, seed: int = 42):
     return configs
 
 
-def build_docs(n_docs: int, seed: int = 7):
+def build_docs(n_docs: int, seed: int = 7, cohort_entropy: bool = False):
     rng = random.Random(seed)
     docs = []
     for _ in range(n_docs):
+        # cohort_entropy (--poison runs only, so every other mode's doc
+        # bytes stay comparable across bench rounds): a fragment suffix
+        # spreads the canary cohort hash (host|path|method) over ~4096
+        # keys instead of 9 — the measured canary fraction then tracks
+        # --canary-fraction instead of the luck of 9 crc values.  Regex
+        # truth is unchanged: the path patterns are prefix-anchored only.
+        frag = f"#c{rng.randrange(4096)}" if cohort_entropy else ""
         docs.append(
             {
                 "request": {
                     "method": rng.choice(["GET", "POST", "DELETE"]),
-                    "url_path": rng.choice(["/api/v1/r0", "/api/v2/r1", "/x"]),
+                    "url_path": rng.choice(["/api/v1/r0", "/api/v2/r1", "/x"]) + frag,
                     "headers": {f"x-attr-{k}": f"v{rng.randrange(9)}" for k in range(4)},
                 },
                 "auth": {
@@ -363,6 +370,15 @@ def build_engine(configs, args):
         # chaos runs need the watchdog armed and a short breaker cooldown,
         # or a flap profile can't show a recovery inside one trial
         kw = dict(device_timeout_s=5.0, breaker_reset_s=1.0)
+    if getattr(args, "poison", False):
+        # change-safety runs (--churn --poison): the canary WINDOW is
+        # armed here, the FRACTION only right before the poison lands
+        # (run_churn_pass's mutator) — benign churn reconciles spaced
+        # tighter than the window would otherwise supersede each other's
+        # canaries and pollute the detection evidence this artifact
+        # exists to record
+        kw.update(canary_window_s=float(getattr(args, "canary_window",
+                                                4.0)))
     if getattr(args, "open_loop", ""):
         # a window cap the overload pass can actually SATURATE (the
         # closed-loop phase peaks well below it), so the adaptive window
@@ -452,6 +468,21 @@ def _mutate_config(cfg, tag):
         for cond, rule in cfg.evaluators])
 
 
+def _poison_config(cfg):
+    """The --poison mutation (ISSUE 10): a constant-deny typo on a hot
+    config — every rule collapses to an org equality no request carries,
+    the classic 'semantically valid yet wrong' operator mistake that
+    passes strict-verify AND translation validation (the compiled tensors
+    faithfully implement the wrong policy)."""
+    from authorino_tpu.compiler import ConfigRules
+    from authorino_tpu.expressions import All, Operator, Pattern
+
+    deny = All(Pattern("auth.identity.org", Operator.EQ,
+                       "__poison-never-matches__"))
+    return ConfigRules(name=cfg.name,
+                       evaluators=[(None, deny) for _ in cfg.evaluators])
+
+
 def run_churn_pass(engine, configs, docs, rows, args, baseline_p99_ms=None):
     import asyncio
     import threading
@@ -477,6 +508,32 @@ def run_churn_pass(engine, configs, docs, rows, args, baseline_p99_ms=None):
     reconciles = []
     live = list(configs)
     stop_evt = threading.Event()
+    # --poison (ISSUE 10): one mutation mid-window is a planted constant-
+    # deny on the HOT config (the one the request mix hits most).  The
+    # canary guard must detect it and auto-roll-back; benign mutations
+    # stop there (a later reconcile would supersede the canary and erase
+    # the detection evidence this artifact exists to record).
+    poison = {"armed": bool(getattr(args, "poison", False)),
+              "at": n_mut // 2, "t_apply": None, "config": None}
+    if poison["armed"]:
+        import numpy as _np
+
+        hot = int(_np.bincount(rows).argmax())
+        poison["config"] = f"cfg-{hot}"
+        # the poison story is 'a typo constant-denies a HOT host': the hot
+        # config's traffic must actually ALLOW at baseline, or flipping it
+        # to constant-deny is observationally invisible (random bench docs
+        # deny almost every specific config).  Shape the hot config's docs
+        # into requests its rule admits: matching method + org.
+        rule = configs[hot].evaluators[0][1]
+        method = rule.children[0].value  # All(method EQ m, Any_(...))
+        for j in range(len(docs)):
+            if rows[j] == hot:
+                d = dict(docs[j])
+                d["request"] = dict(d["request"], method=method)
+                d["auth"] = {"identity": dict(
+                    d["auth"]["identity"], org=f"org-{hot}")}
+                docs[j] = d
 
     def mutator():
         # space the mutations over the measured window (skip the first
@@ -485,8 +542,18 @@ def run_churn_pass(engine, configs, docs, rows, args, baseline_p99_ms=None):
         if stop_evt.wait(1.0):
             return
         for k in range(n_mut):
-            i = k % len(live)
-            live[i] = _mutate_config(live[i], k)
+            if poison["armed"] and k == poison["at"]:
+                hot = int(poison["config"].split("-", 1)[1])
+                live[hot] = _poison_config(configs[hot])
+                engine.canary_fraction = float(
+                    getattr(args, "canary_fraction", 0.25))
+                log(f"POISON injected on hot config {poison['config']} "
+                    f"(constant-deny; canary fraction "
+                    f"{engine.canary_fraction})")
+                poison["t_apply"] = time.time()
+            else:
+                i = k % len(live)
+                live[i] = _mutate_config(live[i], k)
             entries = [EngineEntry(id=c.name, hosts=[c.name], runtime=None,
                                    rules=c) for c in live]
             t0 = time.perf_counter()
@@ -495,6 +562,9 @@ def run_churn_pass(engine, configs, docs, rows, args, baseline_p99_ms=None):
             except Exception as e:
                 log(f"churn reconcile {k} FAILED: {e!r}")
                 continue
+            if poison["armed"] and k >= poison["at"]:
+                # the poison's canary must conclude undisturbed
+                return
             dt = time.perf_counter() - t0
             cp = (engine.debug_vars().get("control_plane") or {})
             comp = cp.get("compile") or {}
@@ -516,6 +586,10 @@ def run_churn_pass(engine, configs, docs, rows, args, baseline_p99_ms=None):
     total, elapsed, lat, _, _ = run_engine_mode(engine, docs, rows, args)
     stop_evt.set()
     th.join(timeout=30)
+    change_safety = None
+    if poison["armed"]:
+        change_safety = _change_safety_block(engine, configs, docs, rows,
+                                             poison, args)
 
     # survival: re-probe the warmed rows against the post-churn snapshot
     survived = 0
@@ -547,6 +621,8 @@ def run_churn_pass(engine, configs, docs, rows, args, baseline_p99_ms=None):
         "serving_p99_ms_baseline": baseline_p99_ms,
         "compile_cache": engine.compile_cache.stats(),
     }
+    if change_safety is not None:
+        out["change_safety"] = change_safety
     log(f"churn: {len(reconciles)} reconciles, recompiled "
         f"{out['recompiled_total']} config(s) total, "
         f"{out['delta_upload_bytes_total']} delta bytes "
@@ -554,6 +630,116 @@ def run_churn_pass(engine, configs, docs, rows, args, baseline_p99_ms=None):
         f"{out['verdict_cache_survival']['rate']}, p99 "
         f"{out['serving_p99_ms_under_churn']}ms vs {baseline_p99_ms}ms")
     return out
+
+
+def _change_safety_block(engine, configs, docs, rows, poison, args):
+    """The --churn --poison artifact block (ISSUE 10): wait out the canary
+    conclusion, then record detection latency (poison apply → guard
+    breach), rollback MTTR (poison apply → the quarantined snapshot
+    serving), the quarantine set, and sampled verdict exactness of the
+    NON-poison traffic against the host expression oracle."""
+    import asyncio
+
+    def poison_rollback(cs):
+        rb = cs["last_rollback"]
+        if rb is None or poison["t_apply"] is None:
+            return None
+        if rb["reason"] == "guard-breach" and rb["t"] >= poison["t_apply"]:
+            return rb
+        return None
+
+    # keep serving until the canary concludes: the guard compares LIVE
+    # cohorts — with the measured pump already over, the breach (or a
+    # clean promote) needs traffic to decide on
+    deadline = time.time() + float(getattr(args, "canary_window",
+                                           4.0)) + 15.0
+
+    async def decide_pump():
+        j = 0
+        while time.time() < deadline and engine._canary is not None:
+            await asyncio.gather(
+                *[engine.submit(docs[(j + i) % len(docs)],
+                                f"cfg-{rows[(j + i) % len(docs)]}")
+                  for i in range(256)],
+                return_exceptions=True)
+            j += 256
+
+    asyncio.run(decide_pump())
+    while time.time() < deadline:
+        cs = engine.change_safety_vars()
+        if cs["canary"] is None:
+            break
+        time.sleep(0.1)
+    # the rollback clears the canary pointer FIRST; the quarantine
+    # re-apply (diff + recompile + the recover_ms stamp) lands moments
+    # later on the guard-check worker — wait that out too, or the block
+    # records quarantine=null nondeterministically
+    while time.time() < deadline:
+        cs = engine.change_safety_vars()
+        rb = poison_rollback(cs)
+        if rb is None or (cs["quarantine"] is not None
+                          and rb.get("recover_ms") is not None):
+            break
+        time.sleep(0.1)
+    cs = engine.change_safety_vars()
+    rb = poison_rollback(cs)
+    block = {
+        "poison_config": poison["config"],
+        "canary_fraction": engine.canary_fraction,
+        "canary_window_s": engine.canary_window_s,
+        "poison_applied_unix": poison["t_apply"],
+        "rollback": rb,
+        "quarantine": cs["quarantine"],
+    }
+    if rb is not None and poison["t_apply"]:
+        # detection: poison serving → guard breach (canary start ≈ the
+        # apply, detect_ms is breach-relative-to-canary-start); MTTR:
+        # poison serving → baseline re-serving 100% (the rollback stamp)
+        block["detection_latency_ms"] = rb.get("detect_ms")
+        block["rollback_mttr_ms"] = round(
+            (rb["t"] - poison["t_apply"]) * 1e3, 3)
+        block["quarantine_recover_ms"] = rb.get("recover_ms")
+    # sampled exactness: the serving (quarantined) snapshot must decide
+    # exactly like the host oracle over the expression trees it serves —
+    # non-poison traffic was never wrong, and the poison config now serves
+    # its prior rules
+    from authorino_tpu.models.policy_model import host_results
+
+    snap = engine._snapshot
+    mismatches = checked = 0
+
+    async def sample_pass():
+        nonlocal mismatches, checked
+        import numpy as _np
+
+        for j in range(0, len(docs), max(1, len(docs) // 64)):
+            name = f"cfg-{rows[j]}"
+            try:
+                got_rule, got_skip = await engine.submit(docs[j], name)
+            except Exception:
+                mismatches += 1
+                continue
+            row = snap.policy.config_ids[name]
+            _, want_rule, want_skip = host_results(snap.policy, docs[j], row)
+            checked += 1
+            if not (_np.array_equal(got_rule[:len(want_rule)], want_rule)
+                    and _np.array_equal(got_skip[:len(want_skip)],
+                                        want_skip)):
+                mismatches += 1
+
+    asyncio.run(sample_pass())
+    block["post_rollback_exactness"] = {"checked": checked,
+                                        "mismatches": mismatches}
+    assert mismatches == 0, (
+        f"post-rollback verdicts diverge from the host oracle: "
+        f"{mismatches}/{checked}")
+    assert rb is not None, (
+        "--poison: the planted constant-deny was NEVER detected — no "
+        "rollback recorded inside the canary window")
+    log(f"change safety: detected in {block.get('detection_latency_ms')}ms, "
+        f"MTTR {block.get('rollback_mttr_ms')}ms, quarantined "
+        f"{(cs['quarantine'] or {}).get('configs')}")
+    return block
 
 
 def run_engine_mode(engine, docs, rows, args):
@@ -1979,6 +2165,19 @@ def main():
                          "incremental compile cache), delta-upload bytes, "
                          "verdict-cache survival rate, p99 impact "
                          "(docs/control_plane.md)")
+    ap.add_argument("--poison", action="store_true",
+                    help="with --churn: plant a constant-deny mutation on "
+                         "the HOT config mid-window (ISSUE 10).  The "
+                         "canary guard must detect it and auto-roll-back; "
+                         "the artifact gains a change_safety block with "
+                         "detection latency, rollback MTTR, the "
+                         "quarantine set, and sampled post-rollback "
+                         "verdict exactness")
+    ap.add_argument("--canary-fraction", type=float, default=0.25,
+                    help="canary cohort fraction for --poison runs "
+                         "(engine --canary-fraction)")
+    ap.add_argument("--canary-window", type=float, default=4.0,
+                    help="canary window seconds for --poison runs")
     ap.add_argument("--chaos", default="",
                     help="arm a fault-injection profile (runtime/faults.py: "
                          "device-down, flaky, flap, slow-device, wedge, or a "
@@ -2057,7 +2256,8 @@ def main():
             # deterministic inputs + one compiled snapshot shared by every
             # trial — rebuilding/recompiling per trial measures nothing new
             configs = build_corpus(args.configs, args.rules)
-            docs = build_docs(args.docs)
+            docs = build_docs(args.docs,
+                              cohort_entropy=getattr(args, "poison", False))
             rng = random.Random(3)
             rows = [rng.randrange(args.configs) for _ in range(args.docs)]
             engine = build_engine(configs, args)

@@ -1,0 +1,652 @@
+"""Change safety (ISSUE 10): canary snapshot swaps, guard-breach
+auto-rollback, and poison-config quarantine.
+
+End-to-end over the real engine dispatch path: a planted constant-deny
+poison config breaches the canary guard, auto-rolls-back, and is
+quarantined with the REST of the reconcile still landing; a clean canary
+promotes at the window end; in-flight batches across a rollback resolve
+and insert verdicts under their own pinned generation (the PR 8 pinning
+regression, extended); a canary-cohort request never observes a torn
+generation across promotion; the leader's rollback record propagates
+through the publisher manifest so replicas converge; and the satellite
+bounds (flight-recorder on-disk retention, replica rejected-digest
+memory) are regression-pinned.
+
+Deliberately import-light: collects on images without `cryptography`;
+JAX_PLATFORMS=cpu."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from authorino_tpu.compiler import ConfigRules, compile_corpus
+from authorino_tpu.expressions import Operator, Pattern
+from authorino_tpu.runtime import EngineEntry, PolicyEngine
+from authorino_tpu.runtime.change_safety import (
+    CanaryGuard,
+    GuardThresholds,
+    _StubHeat,
+    _feed,
+    cohort_bucket,
+    guard_self_test,
+    in_canary_cohort,
+)
+from authorino_tpu.utils import metrics as metrics_mod
+from authorino_tpu.runtime.flight_recorder import RECORDER, FlightRecorder
+from authorino_tpu.snapshots import rules_fingerprint, serialize_policy
+from authorino_tpu.snapshots.distribution import (
+    SnapshotPublisher,
+    SnapshotReplica,
+    load_latest,
+)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def org_corpus(orgs):
+    """name -> org constant; each config allows exactly that org."""
+    return [ConfigRules(name=n,
+                        evaluators=[(None, Pattern("auth.identity.org",
+                                                   Operator.EQ, org))])
+            for n, org in orgs.items()]
+
+
+def entries_of(cfgs):
+    return [EngineEntry(id=c.name, hosts=[c.name], runtime=None, rules=c)
+            for c in cfgs]
+
+
+def build_engine(cfgs=None, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("verdict_cache_size", 4096)
+    engine = PolicyEngine(members_k=4, mesh=None, **kw)
+    if cfgs is not None:
+        engine.apply_snapshot(entries_of(cfgs))
+    return engine
+
+
+def cdoc(j, org):
+    """Request identity varies with j — the cohort hash input."""
+    return {"request": {"host": f"h{j}", "path": f"/p{j}", "method": "GET"},
+            "auth": {"identity": {"org": org}}}
+
+
+def docs_in_cohort(org, want, fraction, canary):
+    """Deterministically pick `want` docs landing in the requested cohort."""
+    out, j = [], 0
+    while len(out) < want:
+        d = cdoc(j, org)
+        if in_canary_cohort(d, fraction) is canary:
+            out.append(d)
+        j += 1
+        assert j < 10000
+    return out
+
+
+# guard thresholds small enough for unit-scale traffic, with the same
+# structure as production defaults
+TH = GuardThresholds(min_requests=8, min_config_requests=4,
+                     min_config_allows=2)
+
+
+async def _wait(pred, timeout_s=20.0, interval=0.02):
+    deadline = time.monotonic() + timeout_s
+    while not pred() and time.monotonic() < deadline:
+        await asyncio.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# guard self-test (the analysis --verify-fixtures gate rides this)
+# ---------------------------------------------------------------------------
+
+
+def test_guard_self_test_is_clean():
+    """A blind or trigger-happy guard fails tier-1, not just the analysis
+    CLI: the planted poison must breach, the clean churn must not."""
+    assert guard_self_test() == []
+
+
+def test_cohort_hash_is_stable_identity():
+    d = cdoc(3, "org-x")
+    assert cohort_bucket(d) == cohort_bucket(json.loads(json.dumps(d)))
+    # fraction monotonicity: a doc in the f cohort stays in every f' > f
+    f = (cohort_bucket(d) + 1) / 10000
+    assert in_canary_cohort(d, f)
+    assert in_canary_cohort(d, min(1.0, f + 0.2))
+
+
+# ---------------------------------------------------------------------------
+# the tentpole end to end: poison -> breach -> rollback -> quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_poison_breach_rolls_back_quarantines_and_releases():
+    """A semantically valid constant-deny on a hot config passes every
+    compile gate, breaches the canary guard under live traffic, is
+    auto-rolled-back and quarantined — while a benign change in the SAME
+    reconcile still lands.  The poison spec resyncing back stays
+    substituted; a fixed spec releases the quarantine."""
+    fraction = 0.5
+    v1 = {"c-poison": "org-p", "c-clean": "org-c", "c-benign": "org-b"}
+    engine = build_engine(org_corpus(v1), canary_fraction=fraction,
+                          canary_window_s=30.0, canary_thresholds=TH,
+                          verdict_cache_size=0, batch_dedup=False)
+    # warm both cohorts' baselines
+    pc = docs_in_cohort("org-p", 6, fraction, canary=True)
+    pb = docs_in_cohort("org-p", 6, fraction, canary=False)
+    cc = docs_in_cohort("org-c", 4, fraction, canary=True)
+    cb = docs_in_cohort("org-c", 4, fraction, canary=False)
+
+    async def pump():
+        outs = await asyncio.gather(
+            *[engine.submit(dict(d), "c-poison") for d in pc + pb],
+            *[engine.submit(dict(d), "c-clean") for d in cc + cb])
+        return [bool(o[0][0]) for o in outs]
+
+    assert all(run(pump()))  # baseline: everything allows
+
+    # the reconcile: c-poison constant-denies (typo'd constant), c-benign
+    # legitimately moves org-b -> org-b2
+    v2 = {"c-poison": "org-NEVER", "c-clean": "org-c", "c-benign": "org-b2"}
+    engine.apply_snapshot(entries_of(org_corpus(v2)))
+    assert engine._canary is not None  # corpus changed -> canary, not swap
+
+    async def drive_until_rollback():
+        async def step():
+            await pump()
+            return engine._canary is None and engine.quarantine_active
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if await step():
+                return True
+        return False
+
+    assert run(drive_until_rollback()), \
+        f"guard never breached: {engine.change_safety_vars()}"
+
+    lr = engine._last_rollback
+    assert lr is not None and lr["reason"] == "guard-breach"
+    assert lr["detect_ms"] is not None
+    assert lr["quarantined"] == ["c-poison"]
+    assert "c-poison" in (lr["detail"] or {}).get("suspects", [])
+    q = engine._quarantine
+    assert sorted(q["configs"]) == ["c-poison"]
+    # flight recorder saw the anomaly + the quarantine
+    with RECORDER._ring_lock:
+        kinds = [e["kind"] for e in RECORDER._ring]
+    assert "snapshot-rollback" in kinds and "quarantine" in kinds
+
+    async def verdicts():
+        o1 = await engine.submit(cdoc(1, "org-p"), "c-poison")
+        o2 = await engine.submit(cdoc(2, "org-b2"), "c-benign")
+        o3 = await engine.submit(cdoc(3, "org-b"), "c-benign")
+        o4 = await engine.submit(cdoc(4, "org-c"), "c-clean")
+        return [bool(o[0][0]) for o in (o1, o2, o3, o4)]
+
+    allowed_p, allowed_b2, allowed_b, allowed_c = run(verdicts())
+    assert allowed_p      # poison quarantined: prior vetted artifact serves
+    assert allowed_b2     # the benign change in the same reconcile LANDED
+    assert not allowed_b  # ...really landed (old constant gone)
+    assert allowed_c      # untouched config unaffected throughout
+
+    # the control plane resyncing the SAME poison spec must not re-serve it
+    gen = engine.generation
+    engine.apply_snapshot(entries_of(org_corpus(v2)))
+    assert engine._canary is None  # substituted corpus is identical: no-op
+    assert engine.quarantine_active
+    assert run(_submit1(engine, cdoc(5, "org-p"), "c-poison"))
+
+    # a FIXED spec releases the quarantine back to the normal canaried path
+    v3 = {"c-poison": "org-p2", "c-clean": "org-c", "c-benign": "org-b2"}
+    engine.apply_snapshot(entries_of(org_corpus(v3)))
+    assert not engine.quarantine_active
+    if engine._canary is not None:  # the fix itself canaries; promote it
+        assert engine.canary_promote()
+    assert run(_submit1(engine, cdoc(6, "org-p2"), "c-poison"))
+    assert engine.generation > gen
+
+
+async def _submit1(engine, doc, host):
+    out = await engine.submit(doc, host)
+    return bool(out[0][0])
+
+
+def test_new_poison_config_quarantines_out_and_persists():
+    """A poison config NEW this reconcile has no prior artifact: it
+    quarantines out entirely — and the quarantine record must survive the
+    re-apply even though nothing substitutes for it (regression: the
+    re-apply used to omit the no-prior entry, the substitution pass read
+    that as 'config changed' and cleared the quarantine it was arming, so
+    every resync of the same bad spec re-canaried forever)."""
+    fraction = 0.5
+    engine = build_engine(org_corpus({"c-base": "org-a"}),
+                          canary_fraction=fraction, canary_window_s=30.0,
+                          canary_thresholds=TH, verdict_cache_size=0,
+                          batch_dedup=False)
+    v2 = {"c-base": "org-a", "c-new": "org-NEVER"}
+    engine.apply_snapshot(entries_of(org_corpus(v2)))
+    assert engine._canary is not None
+    # baseline cohort warms on the unchanged config; the NEW config only
+    # exists in the candidate corpus, so its traffic rides the canary
+    # cohort (the baseline index has no such host)
+    base_docs = docs_in_cohort("org-a", 10, fraction, canary=False)
+    new_docs = docs_in_cohort("org-a", 6, fraction, canary=True)
+
+    async def drive():
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            await asyncio.gather(
+                *[engine.submit(dict(d), "c-base") for d in base_docs],
+                *[engine.submit(dict(d), "c-new") for d in new_docs])
+            if engine._canary is None and engine.quarantine_active:
+                return True
+        return False
+
+    assert run(drive()), \
+        f"guard never breached: {engine.change_safety_vars()}"
+    lr = engine._last_rollback
+    assert lr["reason"] == "guard-breach"
+    assert lr["quarantined"] == ["c-new"]
+    q = engine._quarantine
+    assert sorted(q["configs"]) == ["c-new"]
+    assert q["configs"]["c-new"]["prior"] is None
+    # the same bad spec resyncing stays quarantined out
+    engine.apply_snapshot(entries_of(org_corpus(v2)))
+    assert engine._canary is None
+    assert engine.quarantine_active
+    assert run(_submit1(engine, cdoc(0, "org-a"), "c-base"))
+    # a FIXED spec releases it back to the normal (canaried) path
+    engine.apply_snapshot(entries_of(org_corpus(
+        {"c-base": "org-a", "c-new": "org-ok"})))
+    assert not engine.quarantine_active
+    if engine._canary is not None:
+        assert engine.canary_promote()
+    assert run(_submit1(engine, cdoc(1, "org-ok"), "c-new"))
+
+
+def test_all_error_canary_breaches_error_guard():
+    """A canary whose batches ALL fail accumulates zero decided samples —
+    the error guard gates on ATTEMPTED (decided + errored) counts, so the
+    broken generation cannot ride the min-sample gate to a blind promote
+    (regression: the gate used to require min decided requests)."""
+    heat = _StubHeat(["cfg"])
+    g = CanaryGuard(thresholds=TH, check_interval_s=0.0)
+    _feed(g, False, heat, 0, 64, 0.0)  # healthy baseline cohort
+    g.observe_errors(True, 64)         # canary cohort: every request errors
+    b = g.breach()
+    assert b is not None and "error-rate" in b["guards"]
+
+
+def test_quarantine_record_reaches_manifest(tmp_path):
+    """The quarantine re-apply's snapshot carries the quarantine record
+    BEFORE the swap listeners fire, so the publisher manifest (what
+    replicas and fleet operators read) names the held-out configs
+    (regression: the record used to be stamped after notify, losing the
+    race against the publish thread's read)."""
+    d = str(tmp_path / "pub")
+    leader = build_engine(org_corpus({"c": "org-a"}), strict_verify=True)
+    pub = SnapshotPublisher(d)
+    pub.attach(leader)
+    poisoned = org_corpus({"c": "org-a", "p": "org-NEVER"})
+    fp = rules_fingerprint(poisoned[1])
+    leader._quarantine = {"since": time.time(), "reason": "guard-breach",
+                          "from_generation": 1,
+                          "configs": {"p": {"poison": fp, "prior": None}}}
+    leader._quarantine_prior = {}
+    leader.apply_snapshot(entries_of(poisoned))
+    assert leader.quarantine_active  # no-prior poison stays quarantined
+    assert pub.flush()
+    man = json.loads(open(os.path.join(d, "MANIFEST.json")).read())
+    assert man["quarantine"]["configs"] == ["p"]
+    assert man["quarantine"]["from_generation"] == 1
+
+
+def test_added_config_serves_both_cohorts_mid_canary():
+    """A config ADDED by the canaried reconcile has no baseline artifact:
+    its traffic rides the candidate regardless of cohort (regression: the
+    baseline cohort's batches encoded against the baseline snapshot,
+    KeyError'd, and walked the breaker open on healthy hardware)."""
+    fraction = 0.5
+    engine = build_engine(org_corpus({"c": "org-a"}),
+                          canary_fraction=fraction, canary_window_s=30.0,
+                          canary_thresholds=TH)
+    engine.apply_snapshot(entries_of(org_corpus({"c": "org-a",
+                                                 "n": "org-n"})))
+    assert engine._canary is not None
+    docs = docs_in_cohort("org-n", 3, fraction, canary=False) + \
+        docs_in_cohort("org-n", 3, fraction, canary=True)
+
+    async def body():
+        outs = await asyncio.gather(
+            *[engine.submit(dict(d), "n") for d in docs])
+        return [bool(o[0][0]) for o in outs]
+
+    assert all(run(body()))  # both cohorts decide via the candidate
+    assert not run(_submit1(engine, cdoc(0, "org-x"), "n"))  # denies exact
+    assert engine._canary is not None  # healthy traffic: no breach
+    assert engine._last_rollback is None
+
+
+def test_drain_cancels_canary_window_timer():
+    """SIGTERM mid-canary: the window timer must not fire a promote into
+    a tearing-down process (swap listeners would rebuild stopped
+    frontends); the canary stays undecided through drain."""
+    engine = build_engine(org_corpus({"c": "org-a"}), canary_fraction=0.5,
+                          canary_window_s=0.25, canary_thresholds=TH)
+    engine.apply_snapshot(entries_of(org_corpus({"c": "org-b"})))
+    assert engine._canary is not None
+    fired = []
+    engine.add_swap_listener(lambda: fired.append(1))
+    engine.begin_drain()
+    time.sleep(0.6)  # well past the window expiry
+    assert engine._canary is not None  # undecided, never promoted
+    assert not fired
+
+
+def test_conclude_breach_evaluation_bypasses_rate_limit():
+    """The window-expiry conclusion forces a final guard evaluation: a
+    per-batch check moments earlier must not rate-limit the decision into
+    promoting a breaching canary."""
+    heat = _StubHeat(["cfg"])
+    g = CanaryGuard(thresholds=TH, check_interval_s=3600.0)
+    assert g.breach() is None  # consumes the interval budget
+    _feed(g, False, heat, 0, 64, 0.0)
+    _feed(g, True, heat, 0, 64, 1.0)
+    assert g.breach() is None  # rate-limited: evidence unseen
+    b = g.breach(force=True)   # what _canary_conclude runs
+    assert b is not None and "cfg" in b["suspects"]
+
+
+def test_guard_close_zeros_delta_gauges():
+    """Promote/rollback zeroes the live guard-delta gauges — a
+    breach-level delta must not keep dashboards alerting after the
+    rollback already handled it."""
+    heat = _StubHeat(["cfg"])
+    g = CanaryGuard(thresholds=TH, check_interval_s=0.0)
+    _feed(g, False, heat, 0, 64, 0.0)
+    _feed(g, True, heat, 0, 64, 1.0)
+    assert g.breach() is not None
+    gauge = metrics_mod.canary_guard_delta.labels("deny-rate")
+    assert gauge._value.get() > 0
+    g.close()
+    assert gauge._value.get() == 0.0
+
+
+def test_clean_canary_promotes_at_window_end():
+    """No breach evidence -> the window timer promotes, even with zero
+    canary traffic (an idle canary must not hang the reconcile)."""
+    engine = build_engine(org_corpus({"c": "org-a"}), canary_fraction=0.25,
+                          canary_window_s=0.3, canary_thresholds=TH)
+    engine.apply_snapshot(entries_of(org_corpus({"c": "org-a2"})))
+    assert engine._canary is not None
+    gen_candidate = engine._canary.snap.generation
+    assert run(_wait(lambda: engine._canary is None, timeout_s=10))
+    assert engine._last_rollback is None
+    assert engine._snapshot.generation == gen_candidate
+    assert run(_submit1(engine, cdoc(0, "org-a2"), "c"))
+    assert not run(_submit1(engine, cdoc(1, "org-a"), "c"))
+
+
+def test_identical_resync_swaps_straight_through():
+    """An unchanged-fingerprint resync has nothing to prove: no canary."""
+    v1 = org_corpus({"c": "org-a"})
+    engine = build_engine(v1, canary_fraction=0.5, canary_window_s=30.0)
+    engine.apply_snapshot(entries_of(org_corpus({"c": "org-a"})))
+    assert engine._canary is None
+
+
+def test_reconcile_mid_canary_supersedes():
+    """A newer reconcile landing mid-canary rolls the undecided candidate
+    back first (never two candidate generations), then canaries itself."""
+    engine = build_engine(org_corpus({"c": "org-a"}), canary_fraction=0.5,
+                          canary_window_s=30.0, canary_thresholds=TH)
+    engine.apply_snapshot(entries_of(org_corpus({"c": "org-b"})))
+    first = engine._canary
+    assert first is not None
+    engine.apply_snapshot(entries_of(org_corpus({"c": "org-c"})))
+    assert engine._last_rollback["reason"] == "superseded"
+    assert not engine.quarantine_active  # supersede never quarantines
+    second = engine._canary
+    assert second is not None and second is not first
+    assert engine.canary_promote()
+    assert run(_submit1(engine, cdoc(0, "org-c"), "c"))
+    assert not run(_submit1(engine, cdoc(1, "org-b"), "c"))
+
+
+# ---------------------------------------------------------------------------
+# in-flight batches across rollback / promotion (the PR 8 pinning
+# regression, extended to the change-safety transitions)
+# ---------------------------------------------------------------------------
+
+
+def test_inflight_canary_batch_resolves_across_rollback():
+    """A batch dispatched under the canary generation, still in flight
+    when the rollback lands, resolves with the CANARY snapshot's semantics
+    and inserts its verdicts under that generation's tokens — unreachable
+    from the rolled-back baseline, which serves its own (different)
+    verdict for the same request."""
+    engine = build_engine(org_corpus({"c": "org-a"}), canary_fraction=1.0,
+                          canary_window_s=30.0, canary_thresholds=TH)
+    run(_submit1(engine, cdoc(9, "org-a"), "c"))  # warm jit
+    engine.apply_snapshot(entries_of(org_corpus({"c": "org-b"})))
+    phase = engine._canary
+    assert phase is not None
+
+    gate = threading.Event()
+    real = PolicyEngine._encode_and_launch
+    gated_launches = []
+
+    class GatedHandle:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def is_ready(self):
+            return gate.is_set() and (
+                not hasattr(self.inner, "is_ready")
+                or self.inner.is_ready())
+
+        def __array__(self, dtype=None):
+            return np.asarray(self.inner)
+
+    def gated(snap, batch):
+        item = real(engine, snap, batch)
+        item.handle = GatedHandle(item.handle)
+        gated_launches.append((snap, item))
+        return item
+
+    engine._encode_and_launch = gated
+
+    async def body():
+        d = cdoc(42, "org-a")  # denied by the canary, allowed by baseline
+        fut = asyncio.ensure_future(engine.submit(dict(d), "c"))
+        assert await _wait(lambda: bool(gated_launches), timeout_s=5)
+        engine._encode_and_launch = real.__get__(engine, PolicyEngine)
+        snap_used, _ = gated_launches[0]
+        assert snap_used is phase.snap  # fraction 1.0: rides the canary
+        assert engine.canary_rollback()  # manual, mid-flight
+        assert engine._canary is None
+        adds0 = engine._verdict_cache.adds
+        gate.set()
+        out = await asyncio.wait_for(fut, timeout=10)
+        # pinned semantics: the in-flight batch decided under the canary
+        # corpus (org-a denied), no exception, verdict delivered
+        assert not bool(out[0][0])
+        assert engine._verdict_cache.adds > adds0  # late insert landed
+        # the rolled-back generation serves ITS semantics for the same
+        # request — the canary-token insert is structurally unreachable
+        out2 = await engine.submit(dict(d), "c")
+        assert bool(out2[0][0])
+
+    run(body())
+    assert engine._last_rollback["manual"] is True
+
+
+def test_canary_cohort_never_observes_torn_generation():
+    """Every canary-cohort request decides under the candidate corpus —
+    before, during, and after the promotion race — and every batch rides
+    exactly one generation (cohort-partitioned cuts)."""
+    fraction = 0.5
+    engine = build_engine(org_corpus({"c": "org-a"}), canary_fraction=fraction,
+                          canary_window_s=30.0, canary_thresholds=TH,
+                          verdict_cache_size=0, batch_dedup=False)
+    engine.apply_snapshot(entries_of(org_corpus({"c": "org-b"})))
+    assert engine._canary is not None
+    # org-b docs: candidate allows, baseline denies — a torn read shows up
+    # as a denied canary-cohort verdict
+    docs = docs_in_cohort("org-b", 12, fraction, canary=True)
+
+    async def body():
+        stop = asyncio.Event()
+        results = []
+
+        async def storm():
+            while not stop.is_set():
+                outs = await asyncio.gather(
+                    *[engine.submit(dict(d), "c") for d in docs])
+                results.extend(bool(o[0][0]) for o in outs)
+
+        task = asyncio.ensure_future(storm())
+        await asyncio.sleep(0.15)
+        loop = asyncio.get_running_loop()
+        # promote on a worker thread mid-storm (as /debug/canary does)
+        assert await loop.run_in_executor(None, engine.canary_promote)
+        await asyncio.sleep(0.15)
+        stop.set()
+        await task
+        return results
+
+    results = run(body())
+    assert len(results) >= 12
+    assert all(results), "a canary-cohort request fell back to the " \
+        "baseline generation mid-promotion"
+    assert engine._canary is None
+
+
+# ---------------------------------------------------------------------------
+# manual rollback + bounded generation history
+# ---------------------------------------------------------------------------
+
+
+def test_manual_rollback_walks_bounded_history():
+    engine = build_engine(org_corpus({"c": "org-v1"}), snapshot_history=2)
+    for v in ("org-v2", "org-v3", "org-v4"):
+        engine.apply_snapshot(entries_of(org_corpus({"c": v})))
+    assert [s.generation for s, _ in engine._history] == [2, 3]  # bounded
+    assert engine.canary_rollback()  # no canary active -> history pop
+    assert run(_submit1(engine, cdoc(0, "org-v3"), "c"))
+    assert engine.rollback_last()
+    assert run(_submit1(engine, cdoc(1, "org-v2"), "c"))
+    assert not run(_submit1(engine, cdoc(2, "org-v4"), "c"))
+    assert not engine.rollback_last()  # history exhausted
+    # each rollback was a FRESH generation (monotonic, never reused)
+    assert engine.generation == 6
+    assert engine.change_safety_vars()["last_rollback"]["manual"] is True
+
+
+# ---------------------------------------------------------------------------
+# leader/replica convergence: the manifest carries the decision
+# ---------------------------------------------------------------------------
+
+
+def test_rollback_record_reaches_replica_via_manifest(tmp_path):
+    d = str(tmp_path / "pub")
+    leader = build_engine(org_corpus({"c": "org-v1"}), strict_verify=True,
+                          snapshot_history=4)
+    pub = SnapshotPublisher(d)
+    pub.attach(leader)
+    leader.apply_snapshot(entries_of(org_corpus({"c": "org-v2"})))
+    assert pub.flush()
+
+    replica = build_engine()
+    rep = SnapshotReplica(replica, d)
+    assert rep.poll_once() is True
+    assert run(_submit1(replica, cdoc(0, "org-v2"), "c"))
+
+    assert leader.rollback_last(reason="manual")
+    assert pub.flush()
+    man = json.loads(open(os.path.join(d, "MANIFEST.json")).read())
+    # the manifest names the leader's serving decision + its provenance
+    assert man["active_generation"] == man["generation"]
+    assert man["rollback"]["reason"] == "manual"
+    assert man["rollback"]["from_generation"] == 2
+
+    assert rep.poll_once() is True  # replica converges on the rollback
+    assert run(_submit1(replica, cdoc(1, "org-v1"), "c"))
+    assert not run(_submit1(replica, cdoc(2, "org-v2"), "c"))
+    assert (replica._snapshot.change_safety or {}).get("rollback", {}) \
+        .get("reason") == "manual"
+
+
+# ---------------------------------------------------------------------------
+# satellite bounds: flight-recorder disk retention, replica digest memory
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_disk_retention_bounded(tmp_path):
+    fr = FlightRecorder(capacity=64, dump_dir=str(tmp_path),
+                        min_dump_interval_s=0.0, keep=3)
+    for i in range(7):
+        fr.dump(f"trigger-{i}")
+        time.sleep(0.01)  # distinct mtimes for the prune ordering
+    names = [n for n in os.listdir(str(tmp_path))
+             if n.startswith("flight-") and n.endswith(".json")]
+    assert len(names) == 3
+    # the NEWEST bundles survive — the incident just dumped is never the
+    # one pruned
+    assert any("trigger-6" in n for n in names)
+    assert not any("trigger-0" in n for n in names)
+
+
+def test_replica_rejected_digest_memory_is_one_digest(tmp_path):
+    """The rejected-digest memo is the LAST digest only — O(1) across any
+    number of distinct rejected publishes (a leader stuck publishing bad
+    blobs must not grow replica memory), while still short-circuiting
+    re-polls of the same blob."""
+    d = str(tmp_path / "pub")
+    pub = SnapshotPublisher(d)
+    replica = build_engine(org_corpus({"c": "org-a"}))
+    rep = SnapshotReplica(replica, d)
+    good_snap = replica._snapshot
+
+    def bad_blob(i):
+        cfgs = org_corpus({"c": f"org-bad-{i}"})
+        policy = compile_corpus(cfgs, members_k=4)
+        meta = {"generation": 100 + i, "certified": False,
+                "fingerprints": {c.name: rules_fingerprint(c)
+                                 for c in cfgs},
+                "entries": [{"id": c.name, "hosts": [c.name]}
+                            for c in cfgs]}
+        return serialize_policy(policy, meta=meta)
+
+    for i in range(12):
+        pub.publish_blob(bad_blob(i), 100 + i)
+        assert rep.poll_once() is False
+        assert rep.poll_once() is False  # memoized: no second admission run
+    assert rep.rejected == 12
+    assert isinstance(rep._seen_digest, str)  # one digest, not a set
+    assert replica._snapshot is good_snap  # old snapshot never stopped
+
+
+def test_change_safety_vars_json_safe():
+    engine = build_engine(org_corpus({"c": "org-a"}), canary_fraction=0.5,
+                          canary_window_s=30.0)
+    engine.apply_snapshot(entries_of(org_corpus({"c": "org-b"})))
+    vars1 = engine.change_safety_vars()
+    json.dumps(vars1)  # /debug/vars + /debug/canary must serialize
+    assert vars1["canary"]["fraction"] == 0.5
+    assert engine.canary_promote()
+    json.dumps(engine.change_safety_vars())
