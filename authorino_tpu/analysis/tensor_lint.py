@@ -20,6 +20,10 @@ Checks and their finding kinds (catalogue: docs/static_analysis.md):
                      operand pytrees (to_device host build)
   scatter-cover      a dedup plan's fan-out reproduces the batch exactly
   pack-grid          packed DeviceBatch axes match the policy's padded grid
+  shard-stack        every mesh shard's padded grid matches shard 0's — the
+                     stacked [S]-axis device pytree silently truncates or
+                     misaligns operands if the ShapeTargets union missed an
+                     axis (mesh lane, ISSUE 11)
 """
 
 from __future__ import annotations
@@ -42,7 +46,7 @@ from ..compiler.compile import (
 from . import Finding
 
 __all__ = ["tensor_lint", "lint_snapshot", "lint_scatter_plan",
-           "lint_device_batch"]
+           "lint_device_batch", "lint_sharded_stack"]
 
 _LAYER = "tensor_lint"
 _KNOWN_OPS = (OP_EQ, OP_NEQ, OP_INCL, OP_EXCL, OP_CPU, OP_ERROR,
@@ -387,9 +391,45 @@ def tensor_lint(policy: CompiledPolicy,
     return out
 
 
+def _shard_grid_sig(p: CompiledPolicy) -> tuple:
+    """The padded-grid signature every mesh shard must share for the
+    stacked [S]-axis pytree to be well-formed (one np.stack per leaf)."""
+    return (
+        p.n_attrs, p.n_leaves, p.n_member_attrs, p.members_k,
+        p.n_cpu_leaves, p.n_byte_attrs, p.buffer_size,
+        tuple(p.eval_rule.shape),
+        tuple((tuple(children.shape), int(is_and.shape[0]))
+              for children, is_and in p.levels),
+    )
+
+
+def lint_sharded_stack(sharded: Any) -> List[Finding]:
+    """Mesh stacking invariant (ISSUE 11): every shard compiled against the
+    same ShapeTargets union, so every operand's padded grid is identical
+    across shards — a mismatched shard would make the [S]-axis stack (and
+    with it every launch) silently wrong or impossible.  Host-only, runs
+    BEFORE the upload on the strict-verify path."""
+    out: List[Finding] = []
+    shards = list(getattr(sharded, "shards", ()))
+    if len(shards) < 2:
+        return out
+    ref = _shard_grid_sig(shards[0])
+    for i, p in enumerate(shards[1:], 1):
+        sig = _shard_grid_sig(p)
+        if sig != ref:
+            out.append(_err(
+                "shard-stack",
+                f"shard {i} padded grid {sig} != shard 0 {ref} — the "
+                "ShapeTargets union did not cover every axis; the stacked "
+                "device pytree would misalign",
+                f"shard[{i}]"))
+    return out
+
+
 def lint_snapshot(snap: Any, check_lanes: bool = True) -> List[Finding]:
     """Lint an engine snapshot: the single compiled corpus, or every shard
-    of a mesh-sharded one (runtime/engine.py _Snapshot duck type)."""
+    of a mesh-sharded one (runtime/engine.py _Snapshot duck type) plus the
+    cross-shard stacking invariant."""
     policy = getattr(snap, "policy", None)
     sharded = getattr(snap, "sharded", None)
     if policy is None and sharded is None and isinstance(
@@ -404,4 +444,5 @@ def lint_snapshot(snap: Any, check_lanes: bool = True) -> List[Finding]:
                 f.location = f"shard[{i}].{f.location}" if f.location \
                     else f"shard[{i}]"
                 out.append(f)
+        out += lint_sharded_stack(sharded)
     return out

@@ -117,8 +117,19 @@ REASON_CODES = {
     "cpu-regex": "a regex outside the DFA subset rides the CPU regex lane",
     "cpu-grid-overflow": "incl/excl membership leaves can overflow the "
                          "compact K grid, routing those rows to the host "
-                         "oracle",
+                         "oracle (reported only while the deciding "
+                         "policy's K is below MEMBERS_K_SAFE — mesh grid "
+                         "relief lifts configs out of this caveat)",
 }
+
+# membership grids at least this wide are treated as overflow-proof for the
+# operator-facing lowerability report: role/group lists past 32 entries are
+# pathological, and the host-fallback lane still guarantees exactness for
+# them.  The mesh lane's grid relief (parallel/sharded_eval.py — each mp
+# shard's smaller member grid funds a ~mp× larger K) is what crosses this
+# bound in practice: rule-sharding a corpus across ≥2 devices drops its
+# cpu-grid-overflow count (ISSUE 11).
+MEMBERS_K_SAFE = 32
 
 
 def _err(kind: str, message: str, location: str = "", **detail) -> Finding:
@@ -838,7 +849,11 @@ def snapshot_policies(snap: Any) -> List[CompiledPolicy]:
 
 def _classify_rules(policies: List[CompiledPolicy],
                     name: str) -> List[str]:
-    """Fast-lane caveat codes from one config's compiled CPU-assist leaves."""
+    """Fast-lane caveat codes from one config's compiled CPU-assist leaves.
+    The membership caveat reads the OWNING policy's actual K: a corpus
+    whose compact grid is at least MEMBERS_K_SAFE wide (the mesh lane's
+    grid relief) is overflow-proof for operational purposes and the caveat
+    drops."""
     for policy in policies:
         if name not in policy.config_ids:
             continue
@@ -851,7 +866,8 @@ def _classify_rules(policies: List[CompiledPolicy],
             elif op == OP_CPU:
                 reasons.add("cpu-regex")
             elif op in (OP_INCL, OP_EXCL):
-                reasons.add("cpu-grid-overflow")
+                if int(getattr(policy, "members_k", 0)) < MEMBERS_K_SAFE:
+                    reasons.add("cpu-grid-overflow")
         return sorted(reasons)
     return []
 

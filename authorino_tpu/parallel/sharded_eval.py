@@ -19,11 +19,45 @@ Layout:
     per-evaluator rule/skipped [B, S*G, E] — the same outputs as the
     single-corpus ``eval_full_jit``, so PolicyEngine can serve from a
     sharded snapshot when more than one device is present
+
+Mesh as the first-class lane (ISSUE 11):
+
+  - **shard-map port**: ``jax.shard_map`` only exists on newer jax; this
+    image's jax 0.4.37 ships it as ``jax.experimental.shard_map.shard_map``.
+    ``_shard_map`` resolves the fast path when present and falls back to the
+    experimental module — the seed AttributeError family converts to
+    passing tests.
+  - **grid relief**: each mp shard compiles only its sub-corpus, so its
+    member-attr grid M is ~1/mp of the monolithic corpus — the per-device
+    membership payload budget (M × K) supports a proportionally LARGER
+    compact K.  ``members_k`` is boosted to ``min(members_k * mp,
+    max(members_k, MEMBERS_K_RELIEF_CAP))``: requests whose role lists
+    overflowed the single-corpus K (the ``cpu-grid-overflow`` host-oracle
+    rows) ride the kernel when the corpus is rule-sharded across ≥2
+    devices.
+  - **two-phase staging**: ``defer_upload=True`` compiles and stacks the
+    operands HOST-side only; ``upload()`` stages them onto the mesh.  The
+    engine's --strict-verify lints the packed shards between the two — a
+    corrupt corpus is rejected before any byte touches a device, matching
+    the single-corpus ordering (the PR 4 caveat, fixed).
+  - **per-shard delta uploads**: ``upload(prev=...)`` diffs the stacked
+    host views; the leading axis of every stacked leaf IS the shard axis,
+    so ``plan_delta``'s changed-leading-rows mode ships bytes only to the
+    shard(s) a mutation touched (measured per shard in
+    auth_server_mesh_shard_upload_bytes).
+  - **per-device failover**: ``dispatch_routed`` probes the fault plane per
+    device, keeps per-DEVICE circuit breakers (runtime/breaker.py
+    DeviceBreakerSet, process-wide per mesh so state survives reconciles),
+    and re-dispatches a batch that failed on one device to the healthy
+    device with the emptiest in-flight window (occupancy-aware routing) —
+    host-oracle degrade only begins once EVERY device is down
+    (MeshUnavailable).
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -47,9 +81,29 @@ from ..ops.pattern_eval import (
     unpack_verdicts,
 )
 
-__all__ = ["ShardedPolicyModel", "build_mesh"]
+__all__ = ["ShardedPolicyModel", "build_mesh", "MeshUnavailable",
+           "MEMBERS_K_RELIEF_CAP"]
 
 log = logging.getLogger("authorino_tpu.sharded_eval")
+
+# jax.shard_map is the stable spelling on newer jax; 0.4.37 (this image)
+# only has the experimental module.  Resolve once at import.
+try:
+    _shard_map = jax.shard_map  # type: ignore[attr-defined]
+except AttributeError:  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# grid relief ceiling: rule-sharding shrinks each shard's member-attr grid
+# ~1/mp, so the compact membership K can grow ~mp× inside the same
+# per-device payload budget.  64 covers any operationally plausible role
+# list; beyond it the host-fallback lane remains (exactness is never K's
+# job — K only decides which rows ride the kernel).
+MEMBERS_K_RELIEF_CAP = 64
+
+
+class MeshUnavailable(RuntimeError):
+    """Every mesh device is down (breakers open / probes failing): the
+    caller's host-oracle degrade path is the only lane left."""
 
 
 # jitted sharded steps cached per (mesh, has_dfa, has_matmul, n_levels):
@@ -110,7 +164,7 @@ def _sharded_step(mesh: Mesh, has_dfa: bool, has_matmul: bool, n_levels: int, sp
         if has_dfa
         else (None, None)
     )
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(
@@ -133,6 +187,44 @@ def _sharded_step(mesh: Mesh, has_dfa: bool, has_matmul: bool, n_levels: int, sp
     return step
 
 
+def _eval_stacked(params, attrs_val, members_c, cpu_dense,
+                  attr_bytes, byte_ovf, shard_of, row_of):
+    """Single-DEVICE evaluation of the whole stacked corpus — the failover
+    twin of the shard_map step: vmap over the [S] shard axis replaces the
+    mesh partition, the own-config mask-reduce replaces the psum.  Same
+    operands, same bit-packed [B, ceil((1+2E)/8)] readback, bit-identical
+    verdicts (the kernel is a pure per-row function and vmap is exact)."""
+    def per_shard(sq, av, mc, cd, ab, bo):
+        verdict, (rule, skipped) = eval_verdicts(sq, av, mc, cd, ab, bo)
+        return verdict, rule, skipped
+
+    ops = (jnp.moveaxis(attrs_val, 1, 0), jnp.moveaxis(members_c, 1, 0),
+           jnp.moveaxis(cpu_dense, 1, 0))
+    if attr_bytes is not None:
+        verdict, rule, skipped = jax.vmap(per_shard)(
+            params, *ops, jnp.moveaxis(attr_bytes, 1, 0),
+            jnp.moveaxis(byte_ovf, 1, 0))
+    else:
+        verdict, rule, skipped = jax.vmap(
+            per_shard, in_axes=(0, 0, 0, 0, None, None))(
+            params, *ops, None, None)
+    S, _, G = verdict.shape
+    own_mask = (
+        (shard_of[None, :, None]
+         == jnp.arange(S, dtype=shard_of.dtype)[:, None, None])
+        & (row_of[None, :, None]
+           == jnp.arange(G, dtype=row_of.dtype)[None, None, :])
+    )                                                            # [S, B, G]
+    own = jnp.any(verdict & own_mask, axis=(0, 2))
+    own_rule = jnp.any(rule & own_mask[..., None], axis=(0, 2))
+    own_skip = jnp.any(skipped & own_mask[..., None], axis=(0, 2))
+    return _bitpack_rows(
+        jnp.concatenate([own[:, None], own_rule, own_skip], axis=1))
+
+
+_EVAL_STACKED_JIT = jax.jit(_eval_stacked)
+
+
 def build_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mesh:
     devices = np.asarray(jax.devices()[: n_devices or len(jax.devices())])
     n = devices.size
@@ -140,6 +232,135 @@ def build_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mes
         dp = 2 if n % 2 == 0 and n > 1 else 1
     mp = n // dp
     return Mesh(devices[: dp * mp].reshape(dp, mp), ("dp", "mp"))
+
+
+# ---------------------------------------------------------------------------
+# per-mesh routing state: device breakers, occupancy, failover evidence.
+# Process-wide keyed by the Mesh object (the engine resolves ONE mesh and
+# reuses it across reconciles), so device health and in-flight occupancy
+# survive snapshot swaps — a device is sick or busy, not a snapshot.
+# ---------------------------------------------------------------------------
+
+_MESH_STATE: Dict[Mesh, "MeshState"] = {}
+_MESH_STATE_LOCK = threading.Lock()
+
+
+class MeshState:
+    def __init__(self, mesh: Mesh, threshold: int = 3, reset_s: float = 5.0):
+        from ..runtime.breaker import DeviceBreakerSet
+
+        self.device_ids = [int(d.id) for d in mesh.devices.flat]
+        self.breakers = DeviceBreakerSet("mesh", self.device_ids,
+                                         threshold=threshold, reset_s=reset_s)
+        self.lock = threading.Lock()
+        # Serializes the ENQUEUE of collective-bearing (psum) programs:
+        # concurrent shard_map launches from different dispatcher threads
+        # can interleave the per-device execution queues in inconsistent
+        # order, deadlocking the cross-device rendezvous (observed as stuck
+        # AllReduce participants on forced-host CPU devices; the same
+        # cross-thread enqueue race exists on real chips).  Only the
+        # dispatch call is held — execution and readback stay async, so
+        # pipelining is unaffected.
+        self.launch_lock = threading.Lock()
+        self.occupancy: Dict[int, int] = {d: 0 for d in self.device_ids}
+        self.occupancy_peak: Dict[int, int] = {d: 0 for d in self.device_ids}
+        self.launches: Dict[int, int] = {d: 0 for d in self.device_ids}
+        self.failovers: Dict[int, int] = {d: 0 for d in self.device_ids}
+
+    def acquire(self, model: "ShardedPolicyModel", devices: List[int]
+                ) -> "MeshRoute":
+        from ..utils import metrics as metrics_mod
+
+        with self.lock:
+            for d in devices:
+                n = self.occupancy[d] = self.occupancy.get(d, 0) + 1
+                if n > self.occupancy_peak.get(d, 0):
+                    self.occupancy_peak[d] = n
+                self.launches[d] = self.launches.get(d, 0) + 1
+                metrics_mod.mesh_shard_occupancy.labels(str(d)).set(n)
+        return MeshRoute(self, devices)
+
+    def release(self, devices: List[int]) -> None:
+        from ..utils import metrics as metrics_mod
+
+        with self.lock:
+            for d in devices:
+                n = self.occupancy[d] = max(0, self.occupancy.get(d, 0) - 1)
+                metrics_mod.mesh_shard_occupancy.labels(str(d)).set(n)
+
+    def device_failed(self, device_id: int, lane: str,
+                      failover: bool = True) -> None:
+        """Breaker + evidence fold for one attributed device failure.
+        ``failover=True`` (dispatch-time: the batch re-dispatches elsewhere
+        right now) also counts auth_server_device_failover_total; readback/
+        watchdog failures reported via ``complete_route`` pass False — they
+        feed the breaker, but whether the RETRY resolves on a device or
+        degrades is the engine's story, not this counter's."""
+        from ..runtime.flight_recorder import RECORDER
+        from ..utils import metrics as metrics_mod
+
+        self.breakers.record_failure(device_id)
+        if failover:
+            metrics_mod.device_failover.labels(str(device_id)).inc()
+            with self.lock:
+                self.failovers[device_id] = self.failovers.get(device_id, 0) + 1
+        RECORDER.record("device-failover", lane=lane,
+                        detail={"device": device_id})
+
+    def to_json(self) -> Dict[str, Any]:
+        with self.lock:
+            return {
+                "devices": list(self.device_ids),
+                "occupancy": {str(d): n for d, n in self.occupancy.items()},
+                "occupancy_peak": {str(d): n
+                                   for d, n in self.occupancy_peak.items()},
+                "launches": {str(d): n for d, n in self.launches.items()},
+                "failovers": {str(d): n for d, n in self.failovers.items()},
+                "breakers": self.breakers.to_json(),
+            }
+
+
+def _mesh_state(mesh: Mesh, threshold: int = 3,
+                reset_s: float = 5.0) -> MeshState:
+    """One MeshState per mesh, process-wide.  The breaker knobs apply only
+    at CREATION (device health outlives snapshots by design, so the first
+    engine to touch a mesh fixes its per-device breaker tuning)."""
+    state = _MESH_STATE.get(mesh)
+    if state is None:
+        with _MESH_STATE_LOCK:
+            state = _MESH_STATE.get(mesh)
+            if state is None:
+                state = _MESH_STATE[mesh] = MeshState(
+                    mesh, threshold=threshold, reset_s=reset_s)
+    return state
+
+
+def _reset_mesh_state_for_tests() -> None:
+    """Drop all per-mesh routing state (breakers, occupancy, failover
+    evidence).  Tests only: equal meshes share one MeshState by design (a
+    device's health outlives snapshots), so a fault-injection test must not
+    leak open breakers into its neighbours."""
+    with _MESH_STATE_LOCK:
+        _MESH_STATE.clear()
+
+
+class MeshRoute:
+    """One launched batch's claim on its device windows: which devices it
+    occupies and the idempotent release.  The engine releases on terminal
+    completion (success, degrade, watchdog) and records the per-device
+    breaker outcome via ``ShardedPolicyModel.complete_route``."""
+
+    __slots__ = ("state", "devices", "_done")
+
+    def __init__(self, state: MeshState, devices: List[int]):
+        self.state = state
+        self.devices = list(devices)
+        self._done = False
+
+    def release(self) -> None:
+        if not self._done:
+            self._done = True
+            self.state.release(self.devices)
 
 
 @dataclass
@@ -153,15 +374,34 @@ class _ShardedEncoded:
     row_of: np.ndarray         # [B] row within that shard
     host_fallback: np.ndarray  # [B] bool — exact re-decision on host
 
-
 class ShardedPolicyModel:
-    """Rule corpus partitioned over the 'mp' mesh axis; batch over 'dp'."""
+    """Rule corpus partitioned over the 'mp' mesh axis; batch over 'dp'.
 
-    def __init__(self, configs: Sequence[ConfigRules], mesh: Mesh, members_k: int = 16):
+    Two-phase: the constructor compiles the shards and stacks every operand
+    HOST-side (``host_view``); ``upload()`` stages them onto the mesh (one
+    mesh-sharded device_put per leaf, or a per-shard delta against a
+    previous model).  ``defer_upload=True`` stops after the host phase so a
+    strict-verify lint can gate the upload (ISSUE 11 satellite — the
+    single-corpus path's lint-before-upload ordering, restored here)."""
+
+    def __init__(self, configs: Sequence[ConfigRules], mesh: Mesh,
+                 members_k: int = 16, interner: Optional[StringInterner] = None,
+                 defer_upload: bool = False, grid_relief: bool = True,
+                 breaker_threshold: int = 3, breaker_reset_s: float = 5.0):
         self.mesh = mesh
         S = mesh.shape["mp"]
         self.n_shards = S
-        interner = StringInterner()
+        self.members_k = members_k  # requested (single-corpus-equivalent) K
+        # grid relief (ISSUE 11): each shard's member grid is ~1/mp of the
+        # monolithic corpus, so the same per-device payload budget funds a
+        # ~mp× larger compact K — single-corpus membership-overflow rows
+        # (the cpu-grid-overflow host-oracle caveat) ride the kernel here
+        if grid_relief and S > 1:
+            self.members_k_eff = min(members_k * S,
+                                     max(members_k, MEMBERS_K_RELIEF_CAP))
+        else:
+            self.members_k_eff = members_k
+        self.interner = interner if interner is not None else StringInterner()
         groups: List[List[ConfigRules]] = [[] for _ in range(S)]
         self.locator: Dict[str, Tuple[int, int]] = {}
         for i, cfg in enumerate(configs):
@@ -175,14 +415,16 @@ class ShardedPolicyModel:
         # a dummy lane of the same shape.  One dfa_cache spans both passes
         # and all shards: each distinct regex determinizes exactly once.
         dfa_cache: Dict[str, Any] = {}
+        k = self.members_k_eff
         first = [
-            compile_corpus(g, members_k=members_k, interner=interner, dfa_cache=dfa_cache)
+            compile_corpus(g, members_k=k, interner=self.interner,
+                           dfa_cache=dfa_cache)
             for g in groups
         ]
         targets = ShapeTargets.union([p.shape_targets() for p in first])
         self.shards: List[CompiledPolicy] = [
-            compile_corpus(g, members_k=members_k, interner=interner, targets=targets,
-                           dfa_cache=dfa_cache)
+            compile_corpus(g, members_k=k, interner=self.interner,
+                           targets=targets, dfa_cache=dfa_cache)
             for g in groups
         ]
         self.has_dfa = self.shards[0].n_byte_attrs > 0
@@ -194,23 +436,147 @@ class ShardedPolicyModel:
         # the engine's dedup/cache encode stage
         self.config_cacheable = np.stack(
             [p.config_cacheable for p in self.shards])
-        # host-side staging: stack numpy operands, then ONE mesh-sharded
-        # device_put per leaf — each shard's slice transfers straight to its
-        # devices (no transient 2-3x corpus copy on device 0)
+        # host-side staging: stack numpy operands; upload() ships each
+        # shard's slice straight to its devices via ONE mesh-sharded
+        # device_put per leaf (no transient 2-3x corpus copy on device 0).
+        # The stacked view is retained: the next reconcile diffs against it
+        # for the per-shard delta upload, and the failover path device_puts
+        # it onto a single healthy device.
         per_shard_params = [to_device(p, host=True) for p in self.shards]
-        self.params = jax.tree.map(
+        self.host_view = jax.tree.map(
             lambda *xs: np.stack(xs), *per_shard_params
         )
-        self.has_matmul = self.params.get("matmul") is not None
-        specs = jax.tree.map(lambda _: P("mp"), self.params)
-        self.params = jax.tree.map(
-            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
-            self.params, specs,
-        )
+        self.has_matmul = self.host_view.get("matmul") is not None
+        self.params = None            # set by upload()
+        self.upload_report: Optional[Dict[str, Any]] = None
+        self._step = None
+        # routing state is per-MESH (process-wide): device health and
+        # occupancy survive reconciles (first creator's breaker knobs win)
+        self.state = _mesh_state(mesh, threshold=breaker_threshold,
+                                 reset_s=breaker_reset_s)
+        self._dev_by_id = {int(d.id): d for d in mesh.devices.flat}
+        self._device_params: Dict[int, Any] = {}  # failover staging cache
+        self._device_params_lock = threading.Lock()
+        if not defer_upload:
+            self.upload()
+
+    # ---- staging ----------------------------------------------------------
+
+    def upload(self, prev: "Optional[ShardedPolicyModel]" = None
+               ) -> Dict[str, Any]:
+        """Stage the stacked host operands onto the mesh.  With ``prev`` (a
+        previously-uploaded model on the SAME mesh) a delta plan is
+        computed between the stacked host views: the leading axis of every
+        stacked leaf is the shard axis, so ``plan_delta``'s changed-rows
+        mode ships bytes only to the shard(s) a reconcile touched —
+        unchanged shards receive zero bytes (per-shard delta uploads,
+        measured in auth_server_mesh_shard_upload_bytes{shard}).  Returns
+        the upload report (also retained as ``self.upload_report``)."""
+        from ..snapshots.diff import plan_delta
+        from ..utils import metrics as metrics_mod
+
+        specs = jax.tree.map(lambda _: P("mp"), self.host_view)
+        sharding = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs)
+        plan = None
+        if (prev is not None and prev.mesh is self.mesh
+                and prev.params is not None and prev.host_view is not None):
+            # rows_win_factor=1.0: the leading axis is the shard axis, so
+            # any strict row subset confines traffic to the owning shards
+            plan = plan_delta(prev.host_view, self.host_view,
+                              rows_win_factor=1.0)
+        S = self.n_shards
+        per_shard = [0] * S
+        if plan is None:
+            self.params = jax.tree.map(
+                lambda a, sh: jax.device_put(a, sh),
+                self.host_view, sharding)
+            total = 0
+
+            def _count(a):
+                nonlocal total
+                arr = np.asarray(a)
+                total += arr.nbytes
+                for s in range(min(S, arr.shape[0] if arr.ndim else 0)):
+                    per_shard[s] += arr[s].nbytes
+
+            jax.tree.map(_count, self.host_view)
+            report = {"mode": "full", "upload_bytes": total,
+                      "full_bytes": total, "arrays_reused": 0,
+                      "arrays_touched": []}
+        else:
+            by_name = {e.name: e for e in plan.entries}
+            uploaded = 0
+
+            def leaf(name, new_h, prev_d, sh):
+                nonlocal uploaded
+                e = by_name.get(name)
+                new_h = np.asarray(new_h)
+                if e is None or prev_d is None or e.mode == "full":
+                    uploaded += new_h.nbytes
+                    for s in range(min(S, new_h.shape[0])):
+                        per_shard[s] += new_h[s].nbytes
+                    return jax.device_put(new_h, sh)
+                if e.mode == "reuse":
+                    return prev_d
+                # rows mode: the leading axis is the SHARD axis, so the
+                # changed rows name exactly the shards whose slice this
+                # reconcile rewrote.  Functional scatter: the previous
+                # device buffers stay intact for in-flight batches; the
+                # H2D traffic is the changed shard slices + indices.
+                idx = e.rows
+                uploaded += int(e.upload_bytes)
+                for s in idx.tolist():
+                    if s < S:
+                        per_shard[s] += new_h[s].nbytes
+                out = prev_d.at[jnp.asarray(idx)].set(
+                    jnp.asarray(new_h[idx]))
+                return jax.device_put(out, sh)
+
+            def rebuild(prefix, new_v, prev_v, sh):
+                if new_v is None:
+                    return None
+                if isinstance(new_v, dict):
+                    pd = prev_v if isinstance(prev_v, dict) else {}
+                    sd = sh if isinstance(sh, dict) else {}
+                    return {k: rebuild(f"{prefix}.{k}" if prefix else str(k),
+                                       new_v[k], pd.get(k), sd.get(k))
+                            for k in new_v}
+                if isinstance(new_v, (tuple, list)):
+                    pt = prev_v if isinstance(prev_v, (tuple, list)) else ()
+                    st = sh if isinstance(sh, (tuple, list)) else ()
+                    return tuple(
+                        rebuild(f"{prefix}.{i}", x,
+                                pt[i] if i < len(pt) else None,
+                                st[i] if i < len(st) else None)
+                        for i, x in enumerate(new_v))
+                return leaf(prefix, new_v, prev_v, sh)
+
+            self.params = rebuild("", self.host_view, prev.params, sharding)
+            report = dict(plan.to_json(), upload_bytes=uploaded)
+        report["per_shard_bytes"] = {str(s): int(b)
+                                     for s, b in enumerate(per_shard)}
+        for s, b in enumerate(per_shard):
+            if b:
+                metrics_mod.mesh_shard_upload_bytes.labels(str(s)).inc(b)
+        self.upload_report = report
         n_levels = len(self.shards[0].levels)
         self._step = _sharded_step(
-            mesh, self.has_dfa, self.has_matmul, n_levels, specs
+            self.mesh, self.has_dfa, self.has_matmul, n_levels, specs
         )
+        return report
+
+    def cache_tokens(self, fingerprints: Dict[str, str]):
+        """Per-shard per-row verdict-cache tokens: (encoding_epoch of the
+        OWNING shard's compiled layout, the config's source fingerprint) —
+        the mesh twin of the single-corpus snapshot tokens (ISSUE 11
+        satellite: PR 8 parity).  Indexed [shard_of][row_of] by the
+        engine's dedup/cache stage; entries of configs a reconcile did not
+        touch keep their tokens (same interner ⇒ same epoch) and SURVIVE
+        the swap."""
+        from ..snapshots.fingerprint import cache_tokens as _tokens
+
+        return [_tokens(p, fingerprints) for p in self.shards]
 
     # ------------------------------------------------------------------
 
@@ -314,21 +680,176 @@ class ShardedPolicyModel:
         result [B, 1+2E] (readback copy started eagerly), so the caller can
         keep further batches in flight while this one rides the link — the
         sharded mirror of the engine's pipelined dispatch window."""
-        packed = self._step(
-            self.params,
-            jnp.asarray(encoded.attrs_val),
-            jnp.asarray(encoded.members_c),
-            jnp.asarray(encoded.cpu_dense),
-            jnp.asarray(encoded.attr_bytes) if self.has_dfa else None,
-            jnp.asarray(encoded.byte_ovf) if self.has_dfa else None,
-            jnp.asarray(encoded.shard_of),
-            jnp.asarray(encoded.row_of),
-        )
+        if self._step is None:
+            raise RuntimeError(
+                "ShardedPolicyModel not staged: call upload() after the "
+                "deferred (strict-verify) construction")
+        # launch_lock: enqueue-order consistency for the psum collective
+        # (see MeshState) — held for the async dispatch only
+        with self.state.launch_lock:
+            packed = self._step(
+                self.params,
+                jnp.asarray(encoded.attrs_val),
+                jnp.asarray(encoded.members_c),
+                jnp.asarray(encoded.cpu_dense),
+                jnp.asarray(encoded.attr_bytes) if self.has_dfa else None,
+                jnp.asarray(encoded.byte_ovf) if self.has_dfa else None,
+                jnp.asarray(encoded.shard_of),
+                jnp.asarray(encoded.row_of),
+            )
         try:
             packed.copy_to_host_async()
         except Exception:
             pass  # readback degrades to a blocking copy at np.asarray time
         return packed
+
+    # ---- per-device failover (ISSUE 11) ----------------------------------
+
+    def device_params(self, device_id: int):
+        """The stacked corpus staged onto ONE device (failover lane),
+        cached per device — built lazily the first time a device serves a
+        failover batch, reused for the rest of the incident."""
+        params = self._device_params.get(device_id)
+        if params is None:
+            with self._device_params_lock:
+                params = self._device_params.get(device_id)
+                if params is None:
+                    device = self._dev_by_id[device_id]
+                    params = jax.tree.map(
+                        lambda a: jax.device_put(a, device), self.host_view)
+                    self._device_params[device_id] = params
+        return params
+
+    def dispatch_on_device(self, encoded: _ShardedEncoded, device_id: int):
+        """Single-device launch of one batch against the WHOLE stacked
+        corpus (vmap over the shard axis replaces the mesh partition) —
+        the failover lane when part of the mesh is down.  Same bit-packed
+        own-rows readback as ``dispatch_full``."""
+        device = self._dev_by_id[device_id]
+
+        def put(a):
+            return jax.device_put(np.asarray(a), device) if a is not None \
+                else None
+
+        packed = _EVAL_STACKED_JIT(
+            self.device_params(device_id),
+            put(encoded.attrs_val),
+            put(encoded.members_c),
+            put(encoded.cpu_dense),
+            put(encoded.attr_bytes) if self.has_dfa else None,
+            put(encoded.byte_ovf) if self.has_dfa else None,
+            put(encoded.shard_of),
+            put(encoded.row_of),
+        )
+        try:
+            packed.copy_to_host_async()
+        except Exception:
+            pass
+        return packed
+
+    def dispatch_routed(self, encoded: _ShardedEncoded, lane: str = "engine"
+                        ) -> Tuple[Any, MeshRoute]:
+        """Breaker- and occupancy-aware launch (the engine's mesh entry):
+
+        1. every device healthy → the full-mesh shard_map launch;
+        2. a device fails its fault probe / launch → its per-device breaker
+           records the failure and the batch re-dispatches to the healthy
+           device with the EMPTIEST in-flight window (occupancy-aware
+           routing) — before any host-oracle involvement;
+        3. no device left → MeshUnavailable (the caller's host-oracle
+           degrade is the only lane past this point).
+
+        Returns (on-device packed handle, MeshRoute).  The route carries
+        the occupied device windows; the caller releases it at terminal
+        completion via ``complete_route``."""
+        from ..runtime import faults
+
+        state = self.state
+        tried: set = set()
+        full_mesh_eligible = state.breakers.all_closed()
+        while True:
+            if full_mesh_eligible:
+                full_mesh_eligible = False
+                try:
+                    if faults.ACTIVE:
+                        for d in state.device_ids:
+                            faults.FAULTS.check("kernel", lane, device=d)
+                    handle = self.dispatch_full(encoded)
+                    return handle, state.acquire(self, list(state.device_ids))
+                except MeshUnavailable:
+                    raise
+                except Exception as e:
+                    dev = getattr(e, "device_id", None)
+                    if dev is None:
+                        raise  # unattributed: the engine's retry/degrade owns it
+                    state.device_failed(int(dev), lane)
+                    tried.add(int(dev))
+                    log.warning(
+                        "mesh device %d failed a full-mesh launch probe: "
+                        "failing the batch over to a healthy device", dev)
+                    continue
+            cands = [d for d in state.breakers.candidates() if d not in tried]
+            if not cands:
+                raise MeshUnavailable(
+                    f"no healthy mesh device left (excluded {sorted(tried)})")
+            # DUE PROBES first: an open-past-cooldown device only recovers
+            # if some batch actually probes it, and the breaker's single
+            # probe slot (allow_device) keeps every other batch on healthy
+            # devices while the probe is in flight — closed-first ordering
+            # would starve the probe and strand the mesh in single-device
+            # dispatch forever.  Within each class, emptiest in-flight
+            # window first (the occupancy-aware cut).
+            from ..runtime.breaker import CLOSED
+
+            with state.lock:
+                cands.sort(key=lambda d: (
+                    state.breakers.get(d).state == CLOSED,
+                    state.occupancy.get(d, 0)))
+            dev = cands[0]
+            if not state.breakers.get(dev).allow_device():
+                tried.add(dev)
+                continue
+            try:
+                if faults.ACTIVE:
+                    faults.FAULTS.check("kernel", lane, device=dev)
+                handle = self.dispatch_on_device(encoded, dev)
+                return handle, state.acquire(self, [dev])
+            except Exception:
+                state.device_failed(dev, lane)
+                tried.add(dev)
+                continue
+
+    def complete_route(self, route: Optional[MeshRoute], ok: bool,
+                       lane: str = "engine") -> None:
+        """Terminal accounting for one routed batch: per-device breaker
+        verdicts (a single-device route's failure is attributable; a
+        full-mesh readback failure is not — the lane-global breaker owns
+        those) and the idempotent occupancy release."""
+        if route is None:
+            return
+        try:
+            if ok:
+                self.state.breakers.record_success(route.devices)
+            elif len(route.devices) == 1:
+                self.state.device_failed(route.devices[0], lane,
+                                         failover=False)
+        finally:
+            route.release()
+
+    def mesh_vars(self) -> Dict[str, Any]:
+        """JSON-safe mesh-lane state for /debug/vars + bench artifacts."""
+        out = self.state.to_json()
+        out.update({
+            "dp": int(self.mesh.shape["dp"]),
+            "mp": int(self.mesh.shape["mp"]),
+            "members_k": self.members_k,
+            "members_k_eff": self.members_k_eff,
+            "configs_per_shard": self.configs_per_shard,
+            "upload": self.upload_report,
+        })
+        return out
+
+    # ------------------------------------------------------------------
 
     def _run_step(self, encoded: _ShardedEncoded) -> np.ndarray:
         """Own-rows result [B, 1+2E] bool, decoded from the bit-packed

@@ -110,7 +110,8 @@ class _Snapshot:
 
     def __init__(self, entries: Sequence[EngineEntry], members_k: int = 16,
                  mesh=None, strict_verify: bool = False,
-                 compile_cache=None, prev: "Optional[_Snapshot]" = None):
+                 compile_cache=None, prev: "Optional[_Snapshot]" = None,
+                 breaker_threshold: int = 3, breaker_reset_s: float = 5.0):
         self.by_id: Dict[str, EngineEntry] = {e.id: e for e in entries}
         rules = [e.rules for e in entries if e.rules is not None]
         self.policy: Optional[CompiledPolicy] = None
@@ -152,26 +153,60 @@ class _Snapshot:
         # rule heat map (ISSUE 9): built at install time by
         # _install_snapshot (kernel rows → authconfig/rule-source labels)
         self.heat = None
+        # mesh verdict-cache tokens (ISSUE 11): [shard][row] → (encoding
+        # epoch, rules fingerprint), the PR 8 keying the mesh lane now
+        # shares with the single corpus (generation keying retired)
+        self.mesh_tokens = None
         if rules:
             if mesh is not None:
-                from ..parallel import ShardedPolicyModel
-
-                t0 = time.monotonic()
-                self.sharded = ShardedPolicyModel(rules, mesh, members_k=members_k)
-                self.phase_s["compile"] = time.monotonic() - t0
-                if strict_verify:
-                    # sharded caveat: ShardedPolicyModel compiles AND stages
-                    # per-shard operands internally, so this lint runs after
-                    # the device upload (unlike the single-corpus branch
-                    # below) — rejection still precedes the swap, so a
-                    # corrupt corpus never SERVES, but the upload itself is
-                    # not gated on this path
-                    t0 = time.monotonic()
-                    self._verify()
-                    self.phase_s["validate"] = time.monotonic() - t0
+                self._compile_mesh(rules, members_k, mesh, strict_verify,
+                                   prev, breaker_threshold, breaker_reset_s)
             else:
                 self._compile_single(rules, members_k, strict_verify,
                                      compile_cache, prev)
+
+    def _compile_mesh(self, rules, members_k: int, mesh,
+                      strict_verify: bool,
+                      prev: "Optional[_Snapshot]",
+                      breaker_threshold: int = 3,
+                      breaker_reset_s: float = 5.0) -> None:
+        """Mesh compile → verify → delta upload, each phase timed (the
+        control-plane parity half of ISSUE 11):
+
+        - the previous mesh snapshot's INTERNER is adopted (insert-only, so
+          ids are stable), which keeps each untouched shard's encoding
+          epoch — and with it the verdict-cache tokens — identical across
+          the swap;
+        - with --strict-verify the packed shards are linted HOST-side,
+          BEFORE the device upload (the PR 4 ordering caveat, fixed:
+          a corrupt corpus never stages a byte);
+        - the upload is a per-shard DELTA against the previous stacked host
+          view: a one-config mutation ships rows only to its owning
+          shard(s)."""
+        from ..parallel import ShardedPolicyModel
+        from ..snapshots.fingerprint import rules_fingerprint
+
+        t0 = time.monotonic()
+        prev_ok = (prev is not None and prev.sharded is not None
+                   and prev.sharded.mesh is mesh)
+        self.sharded = ShardedPolicyModel(
+            rules, mesh, members_k=members_k,
+            interner=(prev.sharded.interner if prev_ok else None),
+            defer_upload=True, breaker_threshold=breaker_threshold,
+            breaker_reset_s=breaker_reset_s)
+        self.phase_s["compile"] = time.monotonic() - t0
+        memo: Dict[int, str] = {}
+        self.fingerprints = {c.name: rules_fingerprint(c, memo)
+                             for c in rules}
+        if strict_verify:
+            t0 = time.monotonic()
+            self._verify()
+            self.phase_s["validate"] = time.monotonic() - t0
+        self.mesh_tokens = self.sharded.cache_tokens(self.fingerprints)
+        t0 = time.monotonic()
+        self.upload = self.sharded.upload(
+            prev.sharded if prev_ok else None)
+        self.phase_s["upload"] = time.monotonic() - t0
 
     def _compile_single(self, rules, members_k: int, strict_verify: bool,
                         compile_cache, prev: "Optional[_Snapshot]") -> None:
@@ -287,6 +322,7 @@ class _Snapshot:
         snap.translation = (loaded.meta or {}).get("translation")
         snap.fingerprints = loaded.fingerprints
         snap.cache_tokens = None
+        snap.mesh_tokens = None
         snap.compile_report = None
         snap.upload = None
         snap.phase_s = {}
@@ -406,7 +442,7 @@ class _Inflight:
     np.asarray-ability — tests substitute stubs for both."""
 
     __slots__ = ("engine", "batch", "handle", "finalize", "binfo", "waits",
-                 "t_launch", "snap", "attempt")
+                 "t_launch", "snap", "attempt", "route")
 
     def __init__(self, engine, batch, handle, finalize, binfo, waits,
                  snap=None, attempt=0):
@@ -419,6 +455,7 @@ class _Inflight:
         self.t_launch = time.monotonic()
         self.snap = snap          # pinned snapshot (retry/degrade path)
         self.attempt = attempt    # 0 = first dispatch, 1 = the one retry
+        self.route = None         # mesh lane: occupied device windows
 
     def ready(self) -> bool:
         is_ready = getattr(self.handle, "is_ready", None)
@@ -719,7 +756,9 @@ class PolicyEngine:
                              mesh=self._resolve_mesh(),
                              strict_verify=self.strict_verify,
                              compile_cache=self.compile_cache,
-                             prev=self._snapshot)
+                             prev=self._snapshot,
+                             breaker_threshold=self.breaker.threshold,
+                             breaker_reset_s=self.breaker.reset_s)
         except SnapshotRejected as e:
             metrics_mod.snapshot_rejected.labels("engine").inc()
             RECORDER.record("snapshot-rejected", lane="engine", detail={
@@ -863,19 +902,25 @@ class PolicyEngine:
     # ---- change safety (ISSUE 10): canary, rollback, quarantine ----------
 
     def _should_canary(self, snap: "_Snapshot") -> bool:
-        """A swap canaries when it can (both generations single-corpus —
-        the mesh lane has no per-request split) and should (the compiled
-        corpus actually changed; an identical-fingerprint resync swaps
-        straight through, it has nothing to prove)."""
+        """A swap canaries when it can (both generations on the SAME lane —
+        single-corpus↔single-corpus or mesh↔mesh; cohort routing has no
+        meaning across a lane change) and should (the compiled corpus
+        actually changed; an identical-fingerprint resync swaps straight
+        through, it has nothing to prove).  Mesh↔mesh canaries (ISSUE 11)
+        work exactly like single-corpus ones: cohorts are stamped at
+        submit, batch cuts partition by cohort, and the guards read the
+        shard-stacked attribution columns."""
         if not (self.canary_fraction > 0.0 and self.canary_window_s > 0.0):
             return False
         if self._draining:
             return False
         prev = self._snapshot
-        if prev is None or prev.policy is None or prev.sharded is not None:
+        if prev is None or (prev.policy is None and prev.sharded is None):
             return False
-        if snap.policy is None or snap.sharded is not None:
+        if snap.policy is None and snap.sharded is None:
             return False
+        if (prev.sharded is None) != (snap.sharded is None):
+            return False  # lane change: swap through, nothing to compare
         return snap.fingerprints != prev.fingerprints
 
     def _enter_canary(self, snap: "_Snapshot",
@@ -965,8 +1010,9 @@ class PolicyEngine:
                 return False
             self._canary = None
             self._snapshot = phase.snap
-            if phase.baseline is not None and \
-                    phase.baseline.policy is not None:
+            if phase.baseline is not None and (
+                    phase.baseline.policy is not None
+                    or phase.baseline.sharded is not None):
                 self._history.append((phase.baseline, phase.baseline_index))
             metrics_mod.snapshot_generation.labels("engine").set(
                 phase.snap.generation)
@@ -1399,6 +1445,14 @@ class PolicyEngine:
                 "n_attrs": int(getattr(policy, "n_attrs", 0)) if policy else 0,
                 "n_leaves": int(getattr(policy, "n_leaves", 0)) if policy else 0,
             }
+            if snap.sharded is not None:
+                # mesh lane (ISSUE 11): per-device breaker trail, occupancy
+                # windows, failover counts, and the per-shard upload bytes
+                # of the serving snapshot
+                try:
+                    out["mesh"] = snap.sharded.mesh_vars()
+                except Exception:
+                    out["mesh"] = None
         return out
 
     # ---- request path ----------------------------------------------------
@@ -1907,11 +1961,31 @@ class PolicyEngine:
                 self._brownout_inflight -= 1
             self._maybe_dispatch()
 
+    @staticmethod
+    def _route_done(item: "_Inflight", ok: bool) -> None:
+        """Terminal mesh-route accounting for one in-flight batch:
+        per-device breaker verdicts + occupancy release (idempotent; no-op
+        on the single-corpus lane)."""
+        route = item.route
+        if route is None:
+            return
+        item.route = None
+        try:
+            sharded = getattr(item.snap, "sharded", None) \
+                if item.snap is not None else None
+            if sharded is not None:
+                sharded.complete_route(route, ok, lane="engine")
+            else:
+                route.release()
+        except Exception:
+            log.exception("mesh route accounting failed (batch unaffected)")
+
     def _watchdog_fire(self, item: "_Inflight") -> None:
         """Completer watchdog hand-off: an in-flight batch wedged past
         --device-timeout is abandoned (its readback may still arrive — the
         handle is simply dropped) and fed the retry/degrade path as a
         breaker-counted failure."""
+        self._route_done(item, ok=False)
         metrics_mod.watchdog_timeouts.labels("engine").inc()
         RECORDER.record("watchdog-timeout", lane="engine", detail={
             "requests": len(item.batch), "attempt": item.attempt,
@@ -1973,8 +2047,10 @@ class PolicyEngine:
         key per config: (encoding epoch, config source fingerprint, row
         bytes) — entries for configs a swap did NOT touch stay reachable
         across the swap (ISSUE 8: the verdict cache survives churn).  Mesh
-        snapshots fall back to PR 3's generation keying (one shard compile
-        is monolithic there)."""
+        snapshots carry the same tokens per (shard, row)
+        (snap.mesh_tokens, built in _encode_and_launch_sharded); the
+        generation fallback here only serves snapshots with no tokens at
+        all (loaded replicas)."""
         if keys is None or self._verdict_cache is None:
             return None
         tokens = snap.cache_tokens
@@ -2161,9 +2237,17 @@ class PolicyEngine:
         keys = (sharded.row_keys(enc, n)
                 if n and (self.batch_dedup or self._verdict_cache is not None)
                 else None)
-        # mesh lane: per-config tokens are single-corpus only — generation
-        # keying (PR 3 semantics) still applies here
-        ckeys = self._cache_keys(keys, n, snap)
+        # mesh verdict-cache keying (ISSUE 11, PR 8 parity): (encoding
+        # epoch of the owning shard, config source fingerprint) tokens —
+        # entries of configs a reconcile did not touch survive the swap;
+        # generation keying remains only as the loaded-snapshot fallback
+        tokens = getattr(snap, "mesh_tokens", None)
+        if keys is not None and self._verdict_cache is not None \
+                and tokens is not None:
+            ckeys = [(tokens[enc.shard_of[r]][enc.row_of[r]], keys[r])
+                     for r in range(n)]
+        else:
+            ckeys = self._cache_keys(keys, n, snap)
 
         def eligible(r: int) -> bool:
             return (bool(sharded.config_cacheable[enc.shard_of[r],
@@ -2187,11 +2271,19 @@ class PolicyEngine:
             "engine", "encode", time.monotonic() - t0)
         t1 = time.monotonic()
         binfo["start_ns"] = time.time_ns()
+        route = None
         if enc_u is not None:
             if faults.ACTIVE:
                 faults.FAULTS.check("h2d", "engine")
                 faults.FAULTS.check("kernel", "engine")
-            handle = sharded.dispatch_full(enc_u)
+            # breaker-aware routed launch (ISSUE 11): full-mesh shard_map
+            # when every device is healthy; a device that fails its probe
+            # records on ITS breaker and the batch fails over to the
+            # healthy device with the emptiest in-flight window.
+            # MeshUnavailable (all devices down) propagates into the
+            # existing retry-once-then-degrade path — host-oracle decisions
+            # begin only past that point.
+            handle, route = sharded.dispatch_routed(enc_u, lane="engine")
             if faults.ACTIVE:
                 handle = faults.FAULTS.wrap_handle(handle, "engine")
         else:
@@ -2222,7 +2314,9 @@ class PolicyEngine:
                                      own_skipped, shards=enc.shard_of[:n])
             return own_rule, own_skipped, None
 
-        return _Inflight(self, batch, handle, finalize, binfo, waits)
+        item = _Inflight(self, batch, handle, finalize, binfo, waits)
+        item.route = route
+        return item
 
     def _complete(self, item: _Inflight) -> None:
         """Completion stage (worker pool, handed off by the completer once
@@ -2240,9 +2334,16 @@ class PolicyEngine:
             packed = np.asarray(item.handle)
             own_rule, own_skipped, fallback_n = item.finalize(packed)
         except Exception as e:
-            # device/readback failure: retry once, then host-oracle degrade
+            # device/readback failure: per-device breaker attribution +
+            # occupancy release for a routed mesh batch, then retry once
+            # (the fresh dispatch routes around the sick device), then
+            # host-oracle degrade
+            self._route_done(item, ok=False)
             self._batch_failed(item.snap, item.batch, item.attempt, e)
             return
+        # the mesh devices answered: per-device breaker success + window
+        # release, before any telemetry that could fail host-side
+        self._route_done(item, ok=True)
         slo_counted = False
         try:
             # the device answered: clear the breaker's consecutive-failure

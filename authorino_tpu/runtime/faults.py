@@ -20,15 +20,26 @@ bench --chaos)::
     mode  := raise | hang | delay
     keys  := p=<probability 0..1> n=<max firings> delay=<seconds>
              for=<seconds active> after=<seconds before active>
-             lane=<engine|native>
+             lane=<engine|native> device=<device id>
 
 Named profiles::
 
     device-down   kernel:raise               every dispatch fails
+    one-device-down  kernel:raise:device=0   mesh device 0 fails, rest healthy
     flaky         kernel:raise:p=0.3         ~1 in 3 dispatches fails
     flap          kernel:raise:for=2         device down 2s, then recovers
     slow-device   kernel:delay:delay=0.05    +50ms readback latency/batch
     wedge         kernel:hang                readbacks never arrive
+
+``device=`` scopes a rule to ONE mesh device (jax device id): it fires only
+for probes that name that device (the sharded dispatcher probes each mesh
+device before a launch — parallel/sharded_eval.py dispatch_routed), so a
+multi-chip lane can lose exactly one chip while its neighbours keep
+serving.  Device-scoped raises carry ``device_id`` on the exception — the
+failover path's attribution.  The converse also holds: per-device probes
+fire ONLY device-scoped rules — generic rules get their once-per-batch
+chance at the lane-level check that precedes every launch, so arming e.g.
+``flaky`` keeps the same per-batch probability on a mesh as on one chip.
 
 ``hang`` is realized by wrapping the in-flight result handle: is_ready()
 stays False (until the rule's ``for=`` window closes), which is exactly
@@ -61,6 +72,7 @@ ACTIVE = False
 
 PROFILES = {
     "device-down": "kernel:raise",
+    "one-device-down": "kernel:raise:device=0",
     "flaky": "kernel:raise:p=0.3",
     "flap": "kernel:raise:for=2",
     "slow-device": "kernel:delay:delay=0.05",
@@ -73,7 +85,13 @@ _MODES = ("raise", "hang", "delay")
 
 class InjectedFault(RuntimeError):
     """Raised by an armed ``raise`` rule — the synthetic stand-in for a
-    failed H2D transfer / kernel launch / readback."""
+    failed H2D transfer / kernel launch / readback.  ``device_id`` names
+    the mesh device a device-scoped rule fired for (None otherwise) — the
+    per-device failover path reads it for breaker attribution."""
+
+    def __init__(self, message: str, device_id: Optional[int] = None):
+        super().__init__(message)
+        self.device_id = device_id
 
 
 @dataclass
@@ -81,6 +99,7 @@ class FaultRule:
     stage: str                    # encode | h2d | kernel | readback
     mode: str                     # raise | hang | delay
     lane: str = "*"               # engine | native | *
+    device: Optional[int] = None  # scope to one mesh device id (None = any)
     p: float = 1.0                # firing probability per eligible batch
     n: int = -1                   # max firings (-1 = unlimited)
     delay_s: float = 0.05         # mode=delay: added latency
@@ -101,6 +120,8 @@ class FaultRule:
         extras = []
         if self.lane != "*":
             extras.append(f"lane={self.lane}")
+        if self.device is not None:
+            extras.append(f"device={self.device}")
         if self.p < 1.0:
             extras.append(f"p={self.p}")
         if self.n >= 0:
@@ -174,6 +195,8 @@ def _parse_rule(text: str) -> FaultRule:
             rule.after_s = float(v)
         elif k == "lane":
             rule.lane = v.strip().lower()
+        elif k == "device":
+            rule.device = int(v)
         else:
             raise ValueError(f"fault rule {text!r}: unknown key {k!r}")
     return rule
@@ -234,7 +257,8 @@ class FaultPlane:
 
     # -- hooks (hot path; callers gate on faults.ACTIVE) -------------------
 
-    def _match(self, stage: str, lane: str) -> Optional[FaultRule]:
+    def _match(self, stage: str, lane: str,
+               device: Optional[int] = None) -> Optional[FaultRule]:
         with self._lock:
             elapsed = time.monotonic() - self._armed_at
             for r in self._rules:
@@ -249,6 +273,17 @@ class FaultPlane:
                     continue
                 if r.lane not in ("*", lane):
                     continue
+                if r.device is not None and r.device != device:
+                    # device-scoped rule: fires only for probes that name
+                    # this exact mesh device (sharded dispatch_routed)
+                    continue
+                if device is not None and r.device is None:
+                    # per-device probe, generic rule: the lane-level check
+                    # that precedes every mesh launch already gave it its
+                    # once-per-batch chance — matching here too would
+                    # multiply p by the device count and pin a lane-wide
+                    # fault on one device's breaker
+                    continue
                 if not r.live(elapsed):
                     continue
                 if r.p < 1.0 and self._rng.random() >= r.p:
@@ -259,17 +294,24 @@ class FaultPlane:
                 return r
         return None
 
-    def check(self, stage: str, lane: str) -> None:
+    def check(self, stage: str, lane: str,
+              device: Optional[int] = None) -> None:
         """Raise/delay hook for one batch at ``stage``.  ``hang`` rules are
-        not handled here — they ride ``wrap_handle`` at launch."""
-        rule = self._match(stage, lane)
+        not handled here — they ride ``wrap_handle`` at launch.  ``device``
+        is the mesh device id a per-device probe names; device-scoped rules
+        fire only when it matches."""
+        rule = self._match(stage, lane, device=device)
         if rule is None:
             return
         from ..utils import metrics as metrics_mod
 
         metrics_mod.injected_faults.labels(stage, rule.mode, lane).inc()
         if rule.mode == "raise":
-            raise InjectedFault(f"injected {stage} fault ({lane} lane)")
+            raise InjectedFault(
+                f"injected {stage} fault ({lane} lane"
+                + (f", device {device}" if rule.device is not None else "")
+                + ")",
+                device_id=rule.device if rule.device is not None else None)
         if rule.mode == "delay":
             time.sleep(rule.delay_s)
 
@@ -286,6 +328,8 @@ class FaultPlane:
             for r in self._rules:
                 if r.mode not in ("hang", "delay") or r.stage == "encode":
                     continue
+                if r.device is not None:
+                    continue  # device scoping is raise-only (probe-time)
                 if r.lane not in ("*", lane):
                     continue
                 if not r.live(elapsed):

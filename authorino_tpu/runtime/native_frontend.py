@@ -1136,17 +1136,19 @@ class NativeFrontend:
             p0 = sh.shards[0]
             S, A, M, K = sh.n_shards, p0.n_attrs, p0.n_member_attrs, p0.members_k
             C, NB = p0.n_cpu_leaves, max(p0.n_byte_attrs, 1)
-            out = sh._step(
-                sh.params,
-                jnp.asarray(np.zeros((pad, S, A), dtype=np.int32)),
-                jnp.asarray(np.full((pad, S, M, K), PAD, dtype=np.int32)),
-                jnp.asarray(np.zeros((pad, S, C), dtype=bool)),
-                jnp.asarray(np.zeros((pad, S, NB, eff), dtype=np.uint8))
-                if eff else None,
-                jnp.asarray(np.zeros((pad, S, NB), dtype=bool)) if eff else None,
-                jnp.asarray(np.zeros((pad,), dtype=np.int32)),
-                jnp.asarray(np.zeros((pad,), dtype=np.int32)),
-            )
+            with sh.state.launch_lock:  # psum enqueue-order consistency
+                out = sh._step(
+                    sh.params,
+                    jnp.asarray(np.zeros((pad, S, A), dtype=np.int32)),
+                    jnp.asarray(np.full((pad, S, M, K), PAD, dtype=np.int32)),
+                    jnp.asarray(np.zeros((pad, S, C), dtype=bool)),
+                    jnp.asarray(np.zeros((pad, S, NB, eff), dtype=np.uint8))
+                    if eff else None,
+                    jnp.asarray(np.zeros((pad, S, NB), dtype=bool))
+                    if eff else None,
+                    jnp.asarray(np.zeros((pad,), dtype=np.int32)),
+                    jnp.asarray(np.zeros((pad,), dtype=np.int32)),
+                )
             jax.block_until_ready(out)
             rec.warm.add((pad, eff))
             return
@@ -1970,19 +1972,20 @@ class NativeFrontend:
                 faults.FAULTS.check("h2d", "native")
                 faults.FAULTS.check("kernel", "native")
             if rec.sharded is not None:
-                packed = sh._step(
-                    sh.params,
-                    jnp.asarray(sel("attrs_val")),
-                    jnp.asarray(sel("members")),
-                    jnp.asarray(sel("cpu_dense").view(bool)),
-                    jnp.asarray(np.ascontiguousarray(
-                        sel("attr_bytes")[..., :eff]))
-                    if has_dfa else None,
-                    jnp.asarray(sel("byte_ovf").view(bool))
-                    if has_dfa else None,
-                    jnp.asarray(sel("shard_of")),
-                    jnp.asarray(sel("config_id")),
-                )
+                with sh.state.launch_lock:  # psum enqueue-order consistency
+                    packed = sh._step(
+                        sh.params,
+                        jnp.asarray(sel("attrs_val")),
+                        jnp.asarray(sel("members")),
+                        jnp.asarray(sel("cpu_dense").view(bool)),
+                        jnp.asarray(np.ascontiguousarray(
+                            sel("attr_bytes")[..., :eff]))
+                        if has_dfa else None,
+                        jnp.asarray(sel("byte_ovf").view(bool))
+                        if has_dfa else None,
+                        jnp.asarray(sel("shard_of")),
+                        jnp.asarray(sel("config_id")),
+                    )
             else:
                 packed = eval_bitpacked_jit(
                     rec.params,

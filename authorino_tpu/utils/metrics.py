@@ -748,6 +748,36 @@ full_upload_bytes = _counter(
     "shipped (the delta baseline; the monolithic pre-ISSUE-8 behavior).",
     ("lane",),
 )
+# ---------------------------------------------------------------------------
+# Multi-chip mesh lane (ISSUE 11, docs/performance.md "Multi-chip mesh"):
+# per-device occupancy, breaker-aware failover, and per-shard delta bytes.
+# ---------------------------------------------------------------------------
+
+mesh_shard_occupancy = _gauge(
+    "auth_server_mesh_shard_occupancy",
+    "In-flight micro-batches currently occupying one mesh device (full-mesh "
+    "launches count on every device; failover single-device dispatches on "
+    "their target only).  The occupancy-aware router sends failover batches "
+    "to the emptiest window.",
+    ("device",),
+)
+device_failover = _counter(
+    "auth_server_device_failover_total",
+    "Micro-batches re-dispatched AWAY from one mesh device after it failed "
+    "a launch/probe (per-device circuit breaker attribution) — the batch "
+    "resolved on a healthy device, not the host oracle.  device = the "
+    "device that FAILED.",
+    ("device",),
+)
+mesh_shard_upload_bytes = _counter(
+    "auth_server_mesh_shard_upload_bytes_total",
+    "Reconcile upload bytes shipped to each mesh shard (the 'mp' rule "
+    "slice).  A one-config mutation ships rows only to the shard(s) owning "
+    "it; unchanged shards receive zero bytes (per-shard delta uploads, "
+    "ISSUE 11).",
+    ("shard",),
+)
+
 snapshot_distribution = _counter(
     "auth_server_snapshot_distribution_total",
     "Leader/replica snapshot distribution outcomes: role = leader | "

@@ -2089,6 +2089,229 @@ def run_mix_mode(args):
     return results
 
 
+# ---------------------------------------------------------------------------
+# --mode mesh: the multi-chip mesh lane artifact (ISSUE 11, MULTICHIP_r06).
+# Runs on forced host devices (--devices 8) on the CPU image, so every
+# throughput claim is RATIO-based (shape vs the 1×1 mesh in the same
+# process) per the ROADMAP bench-reality note — virtual devices share the
+# same cores, absolute RPS means nothing here.  The hard evidence blocks
+# are parity (mesh vs single-corpus vs expression oracle), per-shard delta
+# bytes under a one-config mutation, failover counts + per-device breaker
+# trail under an injected one-device-down, and the occupancy histogram.
+# ---------------------------------------------------------------------------
+
+
+def parse_mesh_shapes(spec, n_devices):
+    default = [(1, 1), (2, 1), (2, 2), (4, 2)]
+    if spec:
+        shapes = []
+        for part in spec.replace(",", " ").split():
+            dp, mp = part.lower().split("x")
+            shapes.append((int(dp), int(mp)))
+    else:
+        shapes = default
+    return [(dp, mp) for dp, mp in shapes if dp * mp <= n_devices]
+
+
+def mesh_parity_block(model, single_policy, configs, docs, names):
+    """Mesh decide() vs single-corpus decide() vs the expression oracle,
+    including membership-overflow (host-fallback) rows."""
+    from authorino_tpu.models import PolicyModel
+
+    single = PolicyModel(single_policy)
+    got_mesh = model.decide(docs, names)
+    got_single = single.decide(docs, names)
+    by_name = {c.name: c for c in configs}
+    oracle = [bool(by_name[n].evaluators[0][1].matches(d))
+              for d, n in zip(docs, names)]
+    enc = model.encode(docs, names)
+    return {
+        "requests": len(docs),
+        "host_fallback_rows": int(enc.host_fallback[: len(docs)].sum()),
+        "mesh_vs_oracle_exact": got_mesh == oracle,
+        "single_vs_oracle_exact": got_single == oracle,
+        "mesh_vs_single_exact": got_mesh == got_single,
+    }
+
+
+def mesh_throughput(model, docs, names, seconds):
+    """Closed-loop run_full throughput (model level, no wire)."""
+    B = len(docs)
+    model.run_full(docs, names)  # warm the jit cache for this shape
+    t0 = time.perf_counter()
+    total = 0
+    while time.perf_counter() - t0 < seconds:
+        model.run_full(docs, names)
+        total += B
+    return total / (time.perf_counter() - t0)
+
+
+def mesh_churn_block(engine, configs, mutate_name):
+    """One-config mutation through the engine's reconcile: the upload must
+    be a per-shard delta whose bytes land only on the owning shard."""
+    from authorino_tpu.runtime import EngineEntry
+
+    owner, _ = engine._snapshot.sharded.locator[mutate_name]
+    # Shape-preserving mutation (same leaves, same padded grids): anything
+    # that adds a selector changes the layout and forces a full restage,
+    # which is exactly what this block must show we avoid.
+    mutated = [_mutate_config(c, "mesh-r06") if c.name == mutate_name else c
+               for c in configs]
+    t0 = time.perf_counter()
+    engine.apply_snapshot(
+        [EngineEntry(id=c.name, hosts=[c.name], runtime=None, rules=c)
+         for c in mutated])
+    reconcile_s = time.perf_counter() - t0
+    up = dict(engine._snapshot.upload or {})
+    per_shard = up.get("per_shard_bytes", {})
+    touched = sorted(s for s, b in per_shard.items() if b)
+    return {
+        "mutated_config": mutate_name,
+        "owning_shard": owner,
+        "reconcile_s": round(reconcile_s, 3),
+        "mode": up.get("mode"),
+        "upload_bytes": up.get("upload_bytes"),
+        "full_bytes": up.get("full_bytes"),
+        "delta_vs_full_ratio": round(
+            up.get("upload_bytes", 0) / max(1, up.get("full_bytes", 1)), 6),
+        "per_shard_bytes": per_shard,
+        "shards_touched": touched,
+        # a mutated config MUST ship bytes somewhere — an empty touched set
+        # means the delta path (or the mutation) broke, not that it confined
+        "delta_confined_to_owner": touched == [str(owner)],
+    }
+
+
+def mesh_failover_block(engine, docs, names, seconds):
+    """Inject one-device-down (fault plane, device-scoped) over live engine
+    traffic: batches must resolve on healthy devices with ZERO host-degrade
+    decisions, and the per-device breaker trail must show the sick device."""
+    import asyncio
+
+    from authorino_tpu.runtime import faults as faults_mod
+
+    down = engine._snapshot.sharded.state.device_ids[0]
+    degraded0 = degradation_counters("engine")["degraded_decisions"]
+
+    async def round_():
+        return await asyncio.gather(
+            *(engine.submit(d, n) for d, n in zip(docs, names)))
+
+    loop = asyncio.new_event_loop()
+    n_requests = 0
+    faults_mod.FAULTS.arm(f"kernel:raise:device={down}")
+    t0 = time.perf_counter()
+    try:
+        while time.perf_counter() - t0 < seconds:
+            outs = loop.run_until_complete(round_())
+            n_requests += len(outs)
+    finally:
+        faults_mod.FAULTS.disarm()
+    mesh_vars = engine.debug_vars().get("mesh") or {}
+    degraded = degradation_counters("engine")["degraded_decisions"] - degraded0
+    return {
+        "injected_down_device": down,
+        "requests_during_incident": n_requests,
+        "host_degrade_decisions": degraded,
+        "zero_degrade": degraded == 0,
+        "failover_batches": mesh_vars.get("failovers", {}),
+        "breaker_trail": {
+            d: {"state": b.get("state"),
+                "transitions": b.get("transitions", [])[-4:]}
+            for d, b in (mesh_vars.get("breakers") or {}).items()},
+        "occupancy_peak": mesh_vars.get("occupancy_peak", {}),
+        "launches": mesh_vars.get("launches", {}),
+    }
+
+
+def run_mesh_mode(args):
+    import jax
+
+    from authorino_tpu.compiler import compile_corpus
+    from authorino_tpu.parallel import ShardedPolicyModel, build_mesh
+    from authorino_tpu.runtime import EngineEntry, PolicyEngine
+
+    n_dev = len(jax.devices())
+    shapes = parse_mesh_shapes(args.mesh, n_dev)
+    n_cfg = min(args.configs, 256)  # mesh sweep compiles per shape: keep sane
+    configs = build_corpus(n_cfg, args.rules)
+    rng = random.Random(11)
+    docs = build_docs(2048)
+    # membership-overflow rows (the grid-relief / host-fallback evidence)
+    for _ in range(64):
+        docs.append({"request": {"method": "GET", "url_path": "/x",
+                                 "headers": {}},
+                     "auth": {"identity": {
+                         "org": "org-1",
+                         "roles": [f"role-z{k}" for k in range(70)],
+                         "groups": []}}})
+    names = [f"cfg-{rng.randrange(n_cfg)}" for _ in docs]
+    single_policy = compile_corpus(configs, members_k=16)
+
+    per_shape = {}
+    rps_by_shape = {}
+    for dp, mp in shapes:
+        mesh = build_mesh(n_devices=dp * mp, dp=dp)
+        model = ShardedPolicyModel(configs, mesh, members_k=16)
+        label = f"{dp}x{mp}"
+        log(f"mesh shape {label}: compiling + parity + throughput")
+        block = {
+            "parity": mesh_parity_block(model, single_policy, configs,
+                                        docs[:512], names[:512]),
+            "members_k_eff": model.members_k_eff,
+            "configs_per_shard": model.configs_per_shard,
+        }
+        rps = mesh_throughput(model, docs[:args.batch], names[:args.batch],
+                              max(1.0, args.seconds / max(1, len(shapes))))
+        rps_by_shape[label] = round(rps, 1)
+        block["rps"] = round(rps, 1)
+        per_shape[label] = block
+
+    base_shape = "1x1" if "1x1" in rps_by_shape else next(iter(rps_by_shape))
+    base = rps_by_shape[base_shape]
+    scaling = {k: round(v / max(base, 1e-9), 3) for k, v in rps_by_shape.items()}
+
+    # engine-level blocks on the widest shape
+    dp, mp = shapes[-1]
+    engine = PolicyEngine(max_batch=256, members_k=16,
+                          mesh=build_mesh(n_devices=dp * mp, dp=dp),
+                          verdict_cache_size=0, batch_dedup=False)
+    engine.apply_snapshot(
+        [EngineEntry(id=c.name, hosts=[c.name], runtime=None, rules=c)
+         for c in configs])
+    churn = mesh_churn_block(engine, configs, configs[0].name)
+    failover = mesh_failover_block(
+        engine, docs[:128], names[:128], seconds=min(3.0, args.seconds))
+
+    artifact = {
+        "round": "r06",
+        "issue": 11,
+        "n_devices": n_dev,
+        "forced_host_devices": "--xla_force_host_platform_device_count" in
+                               os.environ.get("XLA_FLAGS", ""),
+        "caveat": "virtual host devices share the same CPU cores: only "
+                  "RATIOS are meaningful here (ROADMAP bench-reality "
+                  "note); absolute RPS requires real chips",
+        "shapes": per_shape,
+        "ratio_baseline_shape": base_shape,
+        "rps_ratio_vs_1x1": scaling,
+        "churn": churn,
+        "failover": failover,
+        "grid_relief": {
+            "members_k": 16,
+            "members_k_eff_by_shape": {
+                k: per_shape[k]["members_k_eff"] for k in per_shape},
+            "overflow_rows_in_corpus": 64,
+        },
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "MULTICHIP_r06.json")
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+    log(f"wrote {path}")
+    return artifact
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", type=int, default=1000)
@@ -2099,14 +2322,25 @@ def main():
     ap.add_argument("--workers", type=int, default=12,
                     help="concurrent in-flight batches (pipelined mode)")
     ap.add_argument("--mode", choices=["native", "mix", "slowlane", "pipelined",
-                                       "serial", "engine", "grpc"],
+                                       "serial", "engine", "grpc", "mesh"],
                     default="native",
                     help="native (default): full-wire Check() through the C++ "
                          "device-owner frontend + C++ loadgen; mix: the five "
                          "BASELINE config classes, one wire number each; "
                          "pipelined/serial: model-level loops; engine: through "
                          "PolicyEngine.submit micro-batching; grpc: full-wire "
-                         "over grpc.aio (Python)")
+                         "over grpc.aio (Python); mesh: the multi-chip lane "
+                         "sweep (parity, per-shard delta, failover, "
+                         "occupancy) → MULTICHIP_r06.json")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="force N virtual host devices "
+                         "(XLA_FLAGS --xla_force_host_platform_device_count) "
+                         "so the mesh lane runs on the CPU-only image; "
+                         "implies JAX_PLATFORMS=cpu")
+    ap.add_argument("--mesh", default="",
+                    help='mesh mode: dp×mp shape(s), e.g. "2x4" or '
+                         '"1x1,2x1,2x2,4x2" (default: the acceptance sweep '
+                         "that fits the visible devices)")
     ap.add_argument("--producers", type=int, default=8,
                     help="engine/grpc: concurrent producer tasks")
     ap.add_argument("--depth", type=int, default=512,
@@ -2201,6 +2435,18 @@ def main():
     if args.serial:
         args.mode = "serial"
 
+    if args.devices:
+        # must land before the first backend initialization (jax import may
+        # already have happened via sitecustomize; backend init is lazy, so
+        # the env still takes effect here)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.devices}"
+            ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
     t0 = time.perf_counter()
     import jax
 
@@ -2210,6 +2456,28 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     log(f"jax {jax.__version__} devices={jax.devices()} (init {time.perf_counter()-t0:.1f}s)")
+
+    if args.mode == "mesh":
+        artifact = run_mesh_mode(args)
+        widest = max(artifact["rps_ratio_vs_1x1"],
+                     key=lambda k: artifact["rps_ratio_vs_1x1"][k])
+        ratio_base = artifact["ratio_baseline_shape"]
+        print(json.dumps({
+            "metric": f"mesh_rps_ratio_vs_{ratio_base}",
+            "value": artifact["rps_ratio_vs_1x1"][widest],
+            "unit": f"x ({widest} vs {ratio_base}, ratio — see caveat)",
+            "detail": {
+                "caveat": artifact["caveat"],
+                "parity_exact": all(
+                    s["parity"]["mesh_vs_oracle_exact"]
+                    and s["parity"]["mesh_vs_single_exact"]
+                    for s in artifact["shapes"].values()),
+                "delta_vs_full_ratio": artifact["churn"][
+                    "delta_vs_full_ratio"],
+                "failover_zero_degrade": artifact["failover"]["zero_degrade"],
+            },
+        }))
+        return
 
     if args.mode == "slowlane":
         r = run_slowlane_mode(args)

@@ -19,3 +19,20 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh_devices():
+    """The forced 8-device virtual CPU mesh (ISSUE 11 satellite): the
+    XLA_FLAGS export above runs BEFORE jax import, so dp×mp shapes up to
+    4×2 exercise the real shard_map partitioning on the CPU-only image.
+    Fails loudly (not skips) if the forcing stopped working — tier-1 mesh
+    coverage must never silently evaporate."""
+    devices = jax.devices()
+    assert len(devices) >= 8, (
+        "expected >= 8 virtual CPU devices "
+        "(XLA_FLAGS=--xla_force_host_platform_device_count=8 was exported "
+        f"too late?), got {len(devices)}")
+    return devices[:8]

@@ -46,9 +46,11 @@ def build_engine(mesh, **kw) -> PolicyEngine:
 
 
 def overflow_doc(allow: bool) -> dict:
-    # 10 members > members_k=4, with the deciding one LAST — the compact
-    # payload truncates it away, so only the host oracle answers correctly
-    roles = [f"r{k}" for k in range(10)] + (["admin"] if allow else [])
+    # 70 members overflow members_k=4 AND the mesh lane's grid-relief K
+    # (≤ MEMBERS_K_RELIEF_CAP = 64), with the deciding one LAST — the
+    # compact payload truncates it away, so only the host oracle answers
+    # correctly on either lane
+    roles = [f"r{k}" for k in range(70)] + (["admin"] if allow else [])
     return {"auth": {"identity": {"roles": roles, "groups": []}}}
 
 

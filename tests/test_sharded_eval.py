@@ -173,7 +173,12 @@ def test_engine_serves_from_sharded_snapshot():
         )
     engine.apply_snapshot(entries)
     assert engine._snapshot.sharded is not None  # 8 virtual devices → sharded
-    assert engine._snapshot.sharded.shards[0].members_k == 4
+    # the base K is plumbed through; shards compile at the grid-relief K
+    # (mp shards → ~mp× larger compact membership grid, capped)
+    sharded = engine._snapshot.sharded
+    assert sharded.members_k == 4
+    assert sharded.shards[0].members_k == sharded.members_k_eff
+    assert sharded.members_k_eff == 4 * sharded.n_shards
 
     docs = [
         {"request": {"method": "GET", "url_path": "/pub-2/x"},
