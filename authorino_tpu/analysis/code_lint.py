@@ -64,7 +64,12 @@ _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
 # turns backpressure into the collapse it guards against.
 _DRAIN_PATH = re.compile(
     r"(drain|stop|shutdown|teardown|close|probe|watchdog|breaker"
-    r"|admi(t|ssion)|brownout|overload|adaptive)",
+    r"|admi(t|ssion)|brownout|overload|adaptive"
+    # lane selection + speculative dual-dispatch (ISSUE 12): the
+    # selection/cancellation paths run exactly when one lane is slow or
+    # half-open — an unbounded wait there turns the latency rescue into
+    # the latency it rescues from
+    r"|lane|speculat|cost_model)",
     re.IGNORECASE)
 _WAITISH_METHODS = {"wait", "join"}
 

@@ -126,6 +126,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Rows per brownout spill batch (small by design: "
                         "the host lane absorbs latency-critical work, not "
                         "bulk throughput)")
+    s.add_argument("--no-lane-select", action="store_true",
+                   default=not env_var("LANE_SELECT", True),
+                   help="Disable cost-model lane selection (docs/"
+                        "performance.md 'Lane selection'): the host "
+                        "oracle then serves only as brownout/degrade "
+                        "fallback and every batch cut rides the device — "
+                        "light-load p50 returns to one device RTT")
+    s.add_argument("--lane-host-max-rows", type=int,
+                   default=env_var("LANE_HOST_MAX_ROWS", 64),
+                   help="Largest batch cut the cost model may answer "
+                        "host-side (larger cuts are batch-shaped work: "
+                        "the device amortizes its RTT over full pads)")
+    s.add_argument("--no-speculative-dispatch", action="store_true",
+                   default=not env_var("SPECULATIVE_DISPATCH", True),
+                   help="Disable speculative dual-dispatch of the circuit "
+                        "breaker's half-open probe batch (normally the "
+                        "probe rides BOTH lanes and resolves first-wins, "
+                        "so clients never wait out a probe against a "
+                        "still-sick device)")
     s.add_argument("--expose-deny-reason", action="store_true",
                    default=env_var("EXPOSE_DENY_REASON", False),
                    help="PRIVACY KNOB (decision provenance): name the "
@@ -387,6 +406,10 @@ async def run_server(args) -> None:
         adaptive_window=not getattr(args, "no_adaptive_window", False),
         brownout=not getattr(args, "no_brownout", False),
         brownout_max_batch=int(getattr(args, "brownout_max_batch", 32)),
+        lane_select=not getattr(args, "no_lane_select", False),
+        lane_host_max_rows=int(getattr(args, "lane_host_max_rows", 64)),
+        speculative_dispatch=not getattr(args, "no_speculative_dispatch",
+                                         False),
         max_inflight_batches=args.max_inflight_batches,
         dispatch_workers=args.dispatch_workers,
         verdict_cache_size=args.verdict_cache_size,
@@ -542,6 +565,9 @@ async def run_server(args) -> None:
                     args, "admission_target_ms", 50.0)) / 1e3,
                 brownout=not getattr(args, "no_brownout", False),
                 brownout_max_rows=int(getattr(args, "brownout_max_batch", 32)),
+                lane_select=not getattr(args, "no_lane_select", False),
+                lane_host_max_rows=int(getattr(args, "lane_host_max_rows",
+                                               64)),
                 slo_ms=float(getattr(args, "slo_ms", 0.0)),
             )
             native_fe.start()

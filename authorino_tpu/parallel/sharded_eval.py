@@ -836,6 +836,24 @@ class ShardedPolicyModel:
         finally:
             route.release()
 
+    def cost_feed(self) -> float:
+        """Mesh-lane cost multiplier for the lane-selection cost model
+        (ISSUE 12, runtime/lane_select.py): ≥ 1.0, rising as devices trip
+        their breakers — a partially-down mesh concentrates the same load
+        on the survivors, so a device dispatch is expected to cost
+        proportionally more than the healthy-mesh RTT EWMA says.  All
+        devices down returns the full device count (the selector then
+        prefers the host lane for everything it is allowed to take, which
+        is exactly the degrade behavior the breaker enforces anyway)."""
+        from ..runtime.breaker import CLOSED
+
+        breakers = self.state.breakers.breakers
+        total = len(breakers)
+        if not total:
+            return 1.0
+        healthy = sum(1 for b in breakers.values() if b.state == CLOSED)
+        return float(total) / float(max(1, healthy))
+
     def mesh_vars(self) -> Dict[str, Any]:
         """JSON-safe mesh-lane state for /debug/vars + bench artifacts."""
         out = self.state.to_json()

@@ -106,6 +106,13 @@ class AdmissionController:
         self._drop_next = 0.0
         self._drop_count = 0
         self._last_wait_obs = 0.0
+        # lane-aware doomed-deadline floor (ISSUE 12): a callable returning
+        # the FASTEST serving lane's expected service time in seconds.
+        # With lane selection on, a deadline only the microsecond host lane
+        # can meet is no longer doomed just because the device RTT says so
+        # — the host lane will answer it.  None = device-RTT-only (the
+        # pre-lane-selection behavior).
+        self.lane_floor: Optional[Any] = None
         self.rejected: Dict[str, int] = {}
         self._g_state = metrics_mod.admission_state.labels(lane)
         self._g_state.set(0)
@@ -193,8 +200,17 @@ class AdmissionController:
 
     def _doomed(self, depth: int, now: float, deadline: Optional[float],
                 rtt_s: float) -> bool:
-        return (deadline is not None
-                and deadline - now <= self.predicted_wait(depth) + rtt_s)
+        if deadline is None:
+            return False
+        if self.lane_floor is not None:
+            # predicted-wait is lane-aware: the service-time term is the
+            # FASTEST lane's, not the device RTT — the cost model routes
+            # tight-deadline work host-side instead of shedding it
+            try:
+                rtt_s = min(rtt_s, float(self.lane_floor()))
+            except Exception:
+                pass
+        return deadline - now <= self.predicted_wait(depth) + rtt_s
 
     def _maybe_idle_reset(self, now: float) -> None:
         """Clear a stale OVERLOADED flag once the load has vanished (no

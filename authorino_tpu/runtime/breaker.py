@@ -78,20 +78,29 @@ class CircuitBreaker:
         """True when this batch may touch the device.  OPEN past the
         cooldown atomically claims the single half-open probe slot; every
         other caller stays host-side until that probe resolves."""
+        return self.admit_device()[0]
+
+    def admit_device(self) -> "tuple[bool, bool]":
+        """(allowed, probe): like ``allow_device``, but reports whether
+        this admission claimed the half-open probe slot.  Speculative
+        dual-dispatch (ISSUE 12, runtime/lane_select.py) arms exactly on
+        probes: the probe batch rides BOTH lanes and resolves first-wins,
+        so clients never wait out a probe against a still-sick device —
+        while the device half's outcome still decides the breaker."""
         with self._lock:
             if self._state == CLOSED:
-                return True
+                return True, False
             if self._state == OPEN:
                 if time.monotonic() - self._opened_at < self.reset_s:
-                    return False
+                    return False, False
                 self._transition(HALF_OPEN, "cooldown elapsed; probing")
                 self._probe_inflight = True
-                return True
+                return True, True
             # HALF_OPEN: exactly one probe at a time
             if self._probe_inflight:
-                return False
+                return False, False
             self._probe_inflight = True
-            return True
+            return True, True
 
     # -- batch outcomes ----------------------------------------------------
 

@@ -72,6 +72,15 @@ from . import change_safety as safety_mod
 from .admission import AdaptiveWindow, AdmissionController
 from .breaker import CircuitBreaker
 from .flight_recorder import RECORDER
+from .lane_select import (
+    DEVICE as L_DEVICE,
+    HOST as L_HOST,
+    R_COST,
+    R_DEADLINE,
+    R_SPECULATIVE,
+    LaneSelector,
+    Speculation,
+)
 
 __all__ = ["PolicyEngine", "EngineEntry", "SnapshotRejected"]
 
@@ -442,7 +451,7 @@ class _Inflight:
     np.asarray-ability — tests substitute stubs for both."""
 
     __slots__ = ("engine", "batch", "handle", "finalize", "binfo", "waits",
-                 "t_launch", "snap", "attempt", "route")
+                 "t_launch", "snap", "attempt", "route", "spec")
 
     def __init__(self, engine, batch, handle, finalize, binfo, waits,
                  snap=None, attempt=0):
@@ -456,6 +465,7 @@ class _Inflight:
         self.snap = snap          # pinned snapshot (retry/degrade path)
         self.attempt = attempt    # 0 = first dispatch, 1 = the one retry
         self.route = None         # mesh lane: occupied device windows
+        self.spec = None          # speculative dual-dispatch token (ISSUE 12)
 
     def ready(self) -> bool:
         is_ready = getattr(self.handle, "is_ready", None)
@@ -497,6 +507,9 @@ class PolicyEngine:
         adaptive_window: bool = True,
         brownout: bool = True,
         brownout_max_batch: int = 32,
+        lane_select: bool = True,
+        lane_host_max_rows: int = 64,
+        speculative_dispatch: bool = True,
         slo_ms: float = 0.0,
         canary_fraction: float = 0.0,
         canary_window_s: float = 30.0,
@@ -570,6 +583,22 @@ class PolicyEngine:
         windows spill small head-of-queue batches to the exact host oracle
         (``brownout_max_batch`` rows at a time): overload degrades
         throughput, never correctness.
+
+        Lane selection (ISSUE 12, docs/performance.md "Lane selection"):
+        ``lane_select`` promotes the exact host oracle from brownout
+        fallback to a FIRST-CLASS serving lane — at every batch cut a
+        cost model (EWMAs of host per-row service time, device RTT, queue
+        depth, window occupancy, per-lane SLO burn) decides whether the
+        cut is answered host-side (light-load p50 in single-digit ms
+        instead of one device RTT) or rides the device (full pads under
+        load — throughput preserved by construction); the
+        latency-critical head of a device cut (by propagated deadline) is
+        rescued host-side instead of shed.  ``lane_host_max_rows`` caps
+        what the host lane may take per cut; ``speculative_dispatch``
+        dual-dispatches the breaker's half-open probe batch to BOTH lanes
+        and resolves first-wins (verdicts are bit-identical by PR 6's
+        certification, so the race is safe — and the device half still
+        decides the breaker).
 
         Change safety (ISSUE 10, docs/robustness.md "Change safety"):
         with ``canary_fraction`` > 0, a reconcile that actually changes
@@ -664,6 +693,26 @@ class PolicyEngine:
         self._brownout_limit = max(1, self.dispatch_workers // 2)
         self._brownout_inflight = 0
         self._brownout_total = 0
+        # lane selection (ISSUE 12, docs/performance.md "Lane selection"):
+        # the host oracle as a FIRST-CLASS serving lane — a per-batch-cut
+        # cost model decides host vs device (brownout stays the separate
+        # overload spill), the latency-critical head of a device cut is
+        # rescued host-side by propagated deadline, and a half-open
+        # breaker probe dual-dispatches the same rows to both lanes,
+        # resolving first-wins (verdicts are bit-identical by PR 6's
+        # certification, so the race is safe)
+        self.lanes = LaneSelector(
+            "engine", enabled=lane_select,
+            host_max_rows=lane_host_max_rows,
+            speculative=speculative_dispatch,
+            host_concurrency=max(1, self.dispatch_workers // 2))
+        if lane_select:
+            # predicted-wait is lane-aware at admission: a deadline only
+            # the microsecond host lane can meet is no longer doomed —
+            # but only while the host lane has concurrency headroom to
+            # actually take it (the floor collapses to the device RTT
+            # when the cap is saturated: backpressure stays honest)
+            self.admission.lane_floor = self.lanes.admission_floor
         # decision observability (ISSUE 9, docs/observability.md): the SLO
         # burn-rate tracker (--slo-ms; 0 = off) and the flight-recorder
         # debug-vars provider.  The rule heat map lives on each snapshot
@@ -1410,6 +1459,9 @@ class PolicyEngine:
                 "concurrency_limit": self._brownout_limit,
                 "decisions": self._brownout_total,
             },
+            # lane selection (ISSUE 12): cost-model EWMAs, per-reason
+            # decision counts, rows served per lane, speculative outcomes
+            "lane_select": self.lanes.to_json(),
             "faults": (faults.FAULTS.describe() if faults.ACTIVE else
                        {"armed": False}),
             # decision observability (ISSUE 9, docs/observability.md):
@@ -1632,6 +1684,7 @@ class PolicyEngine:
         brownout: docs/robustness.md "Overload & brownout"."""
         while True:
             brown = False
+            hostsel = None
             with self._queue_lock:
                 depth = len(self._queue)
                 if not self._queue:
@@ -1646,12 +1699,22 @@ class PolicyEngine:
                     # controller's advisory target would fragment standing
                     # queues into cold pad shapes — see AdaptiveWindow
                     n = min(depth, self.max_batch)
+                    # lane selection (ISSUE 12): the cost model decides at
+                    # the cut whether these rows are answered host-side
+                    # (small cut, host_cost < device_cost) or ride the
+                    # device — the host lane consumes NO window slot
+                    which, why = self.lanes.decide(
+                        n, self._inflight, self.controller.window)
                     batch = [self._queue.popleft() for _ in range(n)]
                     parts = _split_cohorts(batch, phase)
-                    self._inflight += len(parts)
-                    if self._inflight > self.inflight_peak:
-                        self.inflight_peak = self._inflight
-                    inflight = self._inflight
+                    if which == L_HOST:
+                        self.lanes.host_inflight += len(parts)
+                        hostsel = why
+                    else:
+                        self._inflight += len(parts)
+                        if self._inflight > self.inflight_peak:
+                            self.inflight_peak = self._inflight
+                        inflight = self._inflight
                 elif (self.brownout
                       and self._brownout_inflight < self._brownout_limit
                       and (time.monotonic() - self._queue[0].t_enq)
@@ -1666,6 +1729,15 @@ class PolicyEngine:
                     brown = True
                 else:
                     break
+            if not brown:
+                # ONE decision per CUT (the metric's unit), outside the
+                # queue lock, even when a canary splits the cut into
+                # cohort parts.  The inflight counters stay per PART —
+                # each part is its own job and decrements once, so the
+                # accounting balances (during a canary the host bound may
+                # transiently sit one above host_limit: a throttle, not
+                # an invariant)
+                self.lanes.count(which, why)
             for is_canary, part in parts:
                 # pinned per batch: double-buffer swap safety.  During a
                 # canary the cohort picks its generation; a phase that
@@ -1675,6 +1747,9 @@ class PolicyEngine:
                 if brown:
                     _encode_pool(self.dispatch_workers).submit(
                         self._brownout_job, snap, part)
+                elif hostsel is not None:
+                    _encode_pool(self.dispatch_workers).submit(
+                        self._host_lane_job, snap, part, None, hostsel)
                 else:
                     self._g_inflight.set(inflight)
                     _encode_pool(self.dispatch_workers).submit(
@@ -1687,7 +1762,8 @@ class PolicyEngine:
         return phase.snap if is_canary else phase.baseline
 
     def _encode_launch_job(self, snap: Optional[_Snapshot],
-                           batch: List[_Pending], attempt: int = 0) -> None:
+                           batch: List[_Pending], attempt: int = 0,
+                           spec: Optional[Speculation] = None) -> None:
         """Encode stage (dispatch-worker thread): host encode + fused H2D
         staging + non-blocking kernel launch, then hand the in-flight batch
         to the completion stage.  Never blocks on the device.
@@ -1695,28 +1771,75 @@ class PolicyEngine:
         Fault-tolerant (ISSUE 5): expired-deadline requests are shed before
         encode; an open circuit breaker skips the device and decides the
         whole batch through the host oracle; any launch failure routes to
-        the retry-once-then-degrade path (_batch_failed)."""
-        batch = self._shed_expired(batch)
+        the retry-once-then-degrade path (_batch_failed).
+
+        Lane selection (ISSUE 12): the latency-critical head — requests
+        whose propagated deadline lands inside the expected device answer
+        but which the host lane can still meet — is rescued host-side
+        BEFORE the shedder would fail it typed; and when this dispatch
+        claims the breaker's half-open probe slot, the batch additionally
+        rides the host lane speculatively, resolving first-wins (``spec``
+        carries the first-wins token across the retry path)."""
+        if attempt == 0 and spec is None:
+            batch = self._rescue_urgent(snap, batch)
+        if spec is None:
+            # speculative retries skip the shedder: the host twin owns the
+            # deadline story for this batch (it either already answered or
+            # will shed at horizon 0 itself) — shedding here too would
+            # double-count deadline_shed for rows the twin resolved
+            batch = self._shed_expired(batch)
         if not batch:
             self._launch_done()
             return
         if snap is None or (snap.policy is None and snap.sharded is None):
+            if spec is not None and not spec.acquire(L_DEVICE):
+                self._launch_done()
+                return  # the host twin answered: nothing left to fail
             self._resolve_error(batch, CheckAbort(
                 UNAVAILABLE, "no compiled policy snapshot"))
             self._launch_done()
             return
-        if not self.breaker.allow_device():
+        allowed, probe = self.breaker.admit_device()
+        if not allowed:
+            # a speculative retry arriving into a re-opened breaker must
+            # ACQUIRE before degrading (the docstring contract of
+            # _batch_failed): a host twin finishing mid-degrade would
+            # otherwise fold provenance and count SLO/service twice
+            if spec is not None and not spec.acquire(L_DEVICE):
+                self.lanes.count_speculative("device-fail")
+                self._launch_done()
+                return
             self._degrade_batch(snap, batch, reason="breaker-open")
             self._launch_done()
             return
+        if (probe and spec is None and attempt == 0
+                and self.lanes.enabled and self.lanes.speculative):
+            # speculative dual-dispatch: the probe batch is the one batch
+            # whose device answer is in genuine doubt (the breaker just
+            # half-opened) — race the exact host twin against it so the
+            # clients never wait out a probe against a still-sick device.
+            # The device half keeps the window slot AND the breaker
+            # verdict; the host half is bounded by the host concurrency
+            # cap (skipped, not queued, when the cap is taken).
+            with self._queue_lock:
+                if self.lanes.host_inflight < self.lanes.host_limit:
+                    self.lanes.host_inflight += 1
+                    spec = Speculation("engine")
+            if spec is not None:
+                self.lanes.count(L_HOST, R_SPECULATIVE)
+                self.lanes.count_speculative("launched")
+                _encode_pool(self.dispatch_workers).submit(
+                    self._host_lane_job, snap, list(batch), spec,
+                    R_SPECULATIVE)
         try:
             if faults.ACTIVE:
                 faults.FAULTS.check("encode", "engine")
             item = self._encode_and_launch(snap, batch)
             item.snap = snap
             item.attempt = attempt
+            item.spec = spec
         except Exception as e:
-            self._batch_failed(snap, batch, attempt, e)
+            self._batch_failed(snap, batch, attempt, e, spec=spec)
             return
         _completer_submit(item)
 
@@ -1745,29 +1868,163 @@ class PolicyEngine:
         return live
 
     def _batch_failed(self, snap: _Snapshot, batch: List[_Pending],
-                      attempt: int, exc: Exception) -> None:
+                      attempt: int, exc: Exception,
+                      spec: Optional[Speculation] = None) -> None:
         """One launched (or launching) micro-batch failed: count it against
         the circuit breaker, retry ONCE on a fresh dispatch, then re-decide
         every request exactly through the host expression oracle.  The
         in-flight window slot stays held until the batch finally resolves
-        (the retry owns it; _launch_done runs exactly once per cut)."""
+        (the retry owns it; _launch_done runs exactly once per cut).
+
+        Speculative batches (ISSUE 12): when the host twin already WON the
+        race, the clients are answered — the device half's only remaining
+        job was the breaker verdict (recorded above), so the slot frees
+        without a retry or a second resolution; otherwise the device path
+        acquires the batch before degrading, so a host twin finishing
+        mid-degrade can never double-resolve or double-fold."""
         self.breaker.record_failure()
+        if spec is not None and spec.winner == L_HOST:
+            self.lanes.count_speculative("device-fail")
+            self._launch_done()
+            return
         if attempt == 0:
             metrics_mod.batch_retries.labels("engine").inc()
             log.warning("micro-batch of %d failed (%r): retrying once on a "
                         "fresh dispatch", len(batch), exc)
             _encode_pool(self.dispatch_workers).submit(
-                self._encode_launch_job, snap, batch, 1)
+                self._encode_launch_job, snap, batch, 1, spec)
+            return
+        if spec is not None and not spec.acquire(L_DEVICE):
+            # the host twin answered while the retry was in flight
+            self.lanes.count_speculative("device-fail")
+            self._launch_done()
             return
         self._degrade_batch(snap, batch, exc=exc)
         self._launch_done()
 
-    def _host_decide_batch(self, snap: _Snapshot, batch: List[_Pending]):
+    def _rescue_urgent(self, snap: Optional[_Snapshot],
+                       batch: List[_Pending]) -> List[_Pending]:
+        """Latency-critical head of a device cut (ISSUE 12): requests whose
+        propagated deadline lands inside the expected device answer time —
+        exactly the set the deadline shedder would fail typed — are peeled
+        off and answered on the host lane instead, when its cost model says
+        it can make them.  Bounded by the host concurrency cap: past it the
+        batch ships whole and the shedder keeps the old behavior."""
+        if (not self.lanes.enabled or snap is None
+                or all(p.deadline is None for p in batch)):
+            return batch
+        # the device horizon is the LARGER of the cost model's estimate and
+        # the shedder's own EWMA (_shed_expired's horizon): anything the
+        # shedder would fail is by definition rescue-eligible, even before
+        # the cost model has observed a single device batch
+        host = self.lanes.cost.host_cost(1)
+        dev = max(self.lanes.cost.device_cost(self._inflight,
+                                              self.controller.window),
+                  self._device_ewma)
+        if not (dev > 0.0) or host >= dev:
+            return batch
+        now = time.monotonic()
+        urgent = [p for p in batch
+                  if p.deadline is not None
+                  and p.deadline <= now + dev      # device cannot make it
+                  and p.deadline > now + host]     # ... but the host can
+        if not urgent:
+            return batch
+        # bound the rescue like any host cut (host_max_rows, tightest
+        # deadlines first) and re-test against the CAPPED batch's actual
+        # host cost: the oracle decides row-by-row, so admitting 500 rows
+        # against host_cost(1) would blow the very deadlines the rescue
+        # promised to meet
+        urgent.sort(key=lambda p: p.deadline)
+        urgent = urgent[:self.lanes.host_max_rows]
+        bound = now + self.lanes.cost.host_cost(len(urgent))
+        urgent = [p for p in urgent if p.deadline > bound]
+        if not urgent:
+            return batch
+        with self._queue_lock:
+            if self.lanes.host_inflight >= self.lanes.host_limit:
+                return batch
+            self.lanes.host_inflight += 1
+        self.lanes.count(L_HOST, R_DEADLINE)
+        _encode_pool(self.dispatch_workers).submit(
+            self._host_lane_job, snap, urgent, None, R_DEADLINE)
+        u = set(id(p) for p in urgent)
+        return [p for p in batch if id(p) not in u]
+
+    def _host_lane_job(self, snap: Optional[_Snapshot],
+                       batch: List[_Pending],
+                       spec: Optional[Speculation] = None,
+                       reason: str = R_COST) -> None:
+        """First-class host serving lane (ISSUE 12, encode-pool thread):
+        one batch decided through the exact host oracle because the cost
+        model chose it (small cut / deadline rescue / speculative twin) —
+        NOT a failure and NOT overload spill (the breaker and the brownout
+        counters stay untouched).  Holds no window slot; bounded by the
+        lane's own concurrency counter.
+
+        Speculative twins resolve first-wins: the twin acquires the batch
+        before any request-level effect (resolution, SLO burn, admission
+        service count, provenance fold), so whichever lane loses the race
+        contributes nothing but its own cost-model observation."""
+        try:
+            # host lane horizon 0: the oracle answers in microseconds, so
+            # only already-expired deadlines shed here
+            live = self._shed_expired(batch, horizon_s=0.0)
+            if not live:
+                return
+            if snap is None or (snap.policy is None and snap.sharded is None):
+                if spec is None or spec.acquire(L_HOST):
+                    self._resolve_error(live, CheckAbort(
+                        UNAVAILABLE, "no compiled policy snapshot"))
+                return
+            by_loop, failed, n_ok, results = self._host_decide_batch(
+                snap, live, fold=False)
+            if spec is not None:
+                if failed:
+                    # exactness first: a partially-failed host twin never
+                    # claims — the device half owns the whole batch
+                    self.lanes.count_speculative("host-fail")
+                    return
+                if not spec.acquire(L_HOST):
+                    return  # the device answered first: confirmation only
+                self.lanes.count_speculative("host-win")
+            # request-level effects — exactly once per batch, winner-only
+            self._fold_host_provenance(snap, live, results,
+                                       lane="engine-host")
+            if n_ok:
+                self.lanes.count_rows(L_HOST, n_ok)
+                self.admission.observe_service(n_ok)
+                n_bad = 0
+                if self.slo is not None:
+                    now = time.monotonic()
+                    n_bad = min(n_ok, sum(
+                        1 for p in live
+                        if p.t_enq and now - p.t_enq > self.slo.slo_s))
+                    self.slo.observe(n_ok, n_bad)
+                self.lanes.cost.observe_slo(L_HOST, n_ok, n_bad)
+            self._resolve_host_decisions(by_loop, failed)
+        except Exception:
+            log.exception("host-lane batch failed")
+            if spec is not None:
+                self.lanes.count_speculative("host-fail")
+            else:
+                self._resolve_error(batch, CheckAbort(
+                    UNAVAILABLE, "policy evaluation unavailable"))
+        finally:
+            with self._queue_lock:
+                self.lanes.host_inflight -= 1
+            self._maybe_dispatch()
+
+    def _host_decide_batch(self, snap: _Snapshot, batch: List[_Pending],
+                           fold: bool = True, lane: str = "engine"):
         """Row-by-row exact host decisions for one batch (the oracle is the
         kernel's differential-test reference, membership overflow
         included).  Returns (resolutions-by-loop, failed-futures-by-loop,
-        n_ok); rows whose oracle run itself failed land in ``failed`` and
-        resolve typed UNAVAILABLE, fail closed.
+        n_ok, results); rows whose oracle run itself failed land in
+        ``failed`` and resolve typed UNAVAILABLE, fail closed.
+        ``fold=False`` defers the provenance fold to the caller — the
+        speculative host twin must not fold until it WINS the race
+        (exactly one fold per batch, whoever resolves).
 
         Attribution (ISSUE 9): the oracle's (rule, skipped) columns fold
         into the SAME heat map / decision log as the device lane — a
@@ -1775,6 +2032,7 @@ class PolicyEngine:
         decision it replaced (the oracle is the kernel's reference)."""
         from ..models.policy_model import host_results
 
+        t0 = time.monotonic()
         by_loop: Dict[Any, list] = {}
         failed: Dict[Any, list] = {}
         n_ok = 0
@@ -1799,11 +2057,18 @@ class PolicyEngine:
                 n_ok += 1
                 by_loop.setdefault(p.loop, []).append(
                     (p.future,) + tuple(res) + (snap,))
-        self._fold_host_provenance(snap, batch, results)
-        return by_loop, failed, n_ok
+        # cost-model feed (ISSUE 12): EVERY host-oracle batch teaches the
+        # per-row service EWMA — lane-selected, brownout and degrade alike
+        # (an engine that spent its warm-up degrading must not enter lane
+        # selection with the optimistic cold-start estimate)
+        if batch:
+            self.lanes.cost.observe_host(time.monotonic() - t0, len(batch))
+        if fold:
+            self._fold_host_provenance(snap, batch, results, lane=lane)
+        return by_loop, failed, n_ok, results
 
     def _fold_host_provenance(self, snap: _Snapshot, batch: List[_Pending],
-                              results) -> None:
+                              results, lane: str = "engine") -> None:
         """Heat-map/decision-log fold for the host-oracle lanes (degrade +
         brownout): stack the per-row (rule, skipped) columns and run the
         same per-batch fold the device completion uses."""
@@ -1830,7 +2095,7 @@ class PolicyEngine:
                 snap, pendings, np.asarray(rows), np.stack(rules),
                 np.stack(skips),
                 shards=(np.asarray(shards) if snap.sharded is not None
-                        else None))
+                        else None), lane=lane)
         except Exception:
             log.exception("host-lane provenance fold failed "
                           "(decision unaffected)")
@@ -1894,7 +2159,7 @@ class PolicyEngine:
         """Final fallback lane: every request re-decided row-by-row through
         the host expression oracle.  Fail-closed typed UNAVAILABLE ONLY for
         rows where the oracle itself fails."""
-        by_loop, failed, n_ok = self._host_decide_batch(snap, batch)
+        by_loop, failed, n_ok, _ = self._host_decide_batch(snap, batch)
         if n_ok:
             metrics_mod.degraded_decisions.labels("engine").inc(n_ok)
             self.admission.observe_service(n_ok)
@@ -1938,7 +2203,7 @@ class PolicyEngine:
                 self._resolve_error(batch, CheckAbort(
                     UNAVAILABLE, "no compiled policy snapshot"))
                 return
-            by_loop, failed, n_ok = self._host_decide_batch(snap, batch)
+            by_loop, failed, n_ok, _ = self._host_decide_batch(snap, batch)
             if n_ok:
                 metrics_mod.brownout_decisions.labels("engine").inc(n_ok)
                 metrics_mod.brownout_batches.labels("engine").inc()
@@ -1994,7 +2259,8 @@ class PolicyEngine:
                     "--device-timeout %.3fs: abandoning the handle",
                     len(item.batch), item.attempt, self.device_timeout_s)
         self._batch_failed(item.snap, item.batch, item.attempt,
-                           TimeoutError("device readback watchdog timeout"))
+                           TimeoutError("device readback watchdog timeout"),
+                           spec=item.spec)
 
     # ---- graceful drain --------------------------------------------------
 
@@ -2031,7 +2297,8 @@ class PolicyEngine:
         while time.monotonic() < deadline:
             with self._queue_lock:
                 idle = (not self._queue and self._inflight == 0
-                        and self._brownout_inflight == 0)
+                        and self._brownout_inflight == 0
+                        and self.lanes.host_inflight == 0)
             if idle:
                 return True
             time.sleep(0.01)
@@ -2332,14 +2599,23 @@ class PolicyEngine:
             if faults.ACTIVE:
                 faults.FAULTS.check("readback", "engine")
             packed = np.asarray(item.handle)
-            own_rule, own_skipped, fallback_n = item.finalize(packed)
+            # speculative first-wins (ISSUE 12): acquire BEFORE finalize —
+            # a batch the host twin already resolved skips finalize (and
+            # with it the provenance fold + cache insert) entirely; the
+            # device readback was confirmation + the breaker's probe
+            # verdict.  acquire() is idempotent for the device lane, so
+            # the finalize-failure path below keeps ownership.
+            spec_won = item.spec is None or item.spec.acquire(L_DEVICE)
+            if spec_won:
+                own_rule, own_skipped, fallback_n = item.finalize(packed)
         except Exception as e:
             # device/readback failure: per-device breaker attribution +
             # occupancy release for a routed mesh batch, then retry once
             # (the fresh dispatch routes around the sick device), then
             # host-oracle degrade
             self._route_done(item, ok=False)
-            self._batch_failed(item.snap, item.batch, item.attempt, e)
+            self._batch_failed(item.snap, item.batch, item.attempt, e,
+                               spec=item.spec)
             return
         # the mesh devices answered: per-device breaker success + window
         # release, before any telemetry that could fail host-side
@@ -2357,11 +2633,40 @@ class PolicyEngine:
             dur = t_done - item.t_launch
             self._device_ewma = (dur if not self._device_ewma
                                  else 0.8 * self._device_ewma + 0.2 * dur)
+            # lane-selection cost model (ISSUE 12): every device completion
+            # feeds the RTT/congestion EWMAs the next cut decides on —
+            # EXCEPT fully cache-resolved batches (zero device rows): they
+            # never touched the link, and their ~100µs turnaround would
+            # read as a fast device and pin small cuts device-side under
+            # cache-hit-heavy traffic (the exact regression this lane
+            # removes; the native lane has the same guard)
+            if item.binfo.get("device_rows", 1) != 0:
+                self.lanes.cost.observe_device(
+                    dur, item.binfo["batch_size"], len(self._queue),
+                    self._inflight, self.controller.window)
+            sharded = (getattr(item.snap, "sharded", None)
+                       if item.snap is not None else None)
+            if sharded is not None:
+                # mesh lane cost feed (ISSUE 12): a partially-down mesh
+                # concentrates load on the survivors — the device cost the
+                # selector compares against rises accordingly
+                try:
+                    self.lanes.cost.mesh_penalty = sharded.cost_feed()
+                except Exception:
+                    pass
             # overload controllers: the batch's device round trip + size
             # steps the adaptive window/cut; completed rows feed the
             # admission gate's service-rate estimate
             self.controller.observe_batch(dur, item.binfo["batch_size"],
                                           len(self._queue), now=t_done)
+            if not spec_won:
+                # the host twin already answered the clients: request-level
+                # accounting (admission service, SLO, spans, resolution)
+                # happened exactly once on the host side
+                return
+            if item.spec is not None:
+                self.lanes.count_speculative("device-win")
+            self.lanes.count_rows(L_DEVICE, item.binfo["batch_size"])
             self.admission.observe_service(item.binfo["batch_size"],
                                            now=t_done)
             if self.slo is not None:
@@ -2371,6 +2676,10 @@ class PolicyEngine:
                 n_bad = int(np.count_nonzero(lat > self.slo.slo_s))
                 self.slo.observe(len(item.batch), n_bad)
                 slo_counted = True
+                # per-lane burn bias feed (ISSUE 12): selection leans
+                # toward the lane that is NOT burning budget
+                self.lanes.cost.observe_slo(L_DEVICE, len(item.batch),
+                                            n_bad)
                 # SLO-delta canary guard feed (ISSUE 10): per-cohort bad
                 # fractions ride the same per-batch counts
                 phase = self._canary
@@ -2416,7 +2725,8 @@ class PolicyEngine:
             # device and could walk the breaker open off exporter noise.
             log.exception("post-completion work failed (batch verdicts "
                           "already computed)")
-            self._resolve_error(item.batch, e, slo_counted=slo_counted)
+            if spec_won:
+                self._resolve_error(item.batch, e, slo_counted=slo_counted)
         finally:
             self._launch_done()
 

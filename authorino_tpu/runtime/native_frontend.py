@@ -74,6 +74,7 @@ from . import provenance as prov_mod
 from .admission import AdmissionController
 from .breaker import CircuitBreaker
 from .flight_recorder import RECORDER
+from .lane_select import DEVICE as L_DEVICE, HOST as L_HOST, LaneSelector
 
 log = logging.getLogger("authorino_tpu.native_frontend")
 
@@ -612,9 +613,15 @@ class _SnapRec:
     # (mesh corpora, or pre-fingerprint snapshots) falls back to PR 3's
     # snap_id keying.
     cache_tokens: Optional[list] = None
-    # lazily-built host (numpy) operand pytree for the degraded lane: the
-    # same kernel on the CPU backend when the device path fails/trips
+    # host (numpy) operand pytree for the host serving lane (ISSUE 12) and
+    # the degraded lane: the same kernel on the CPU backend.  Built eagerly
+    # by the pre-warm thread at snapshot swap (lazily as a fallback), so
+    # the first host-lane decision after a reconcile is not a CPU
+    # jit-compile latency spike.
     host_params: Any = None
+    # CPU-backend jit variants already compiled against host_params:
+    # (batch_pad, byte_eff) pairs — _host_eval rounds up into this set
+    host_warm: set = field(default_factory=set)
     # decision provenance (ISSUE 9): the rule heat map binding this
     # snapshot's kernel rows to (authconfig, rule source) — shared with the
     # engine snapshot's instance when one exists, so both lanes fold into
@@ -635,6 +642,7 @@ class NativeFrontend:
                  breaker_threshold: int = 5, breaker_reset_s: float = 5.0,
                  admission_target_s: float = 0.05,
                  brownout: bool = True, brownout_max_rows: int = 64,
+                 lane_select: bool = True, lane_host_max_rows: int = 64,
                  slo_ms: float = 0.0):
         self.engine = engine
         # fault tolerance (ISSUE 5, docs/robustness.md): a failed device
@@ -754,6 +762,27 @@ class NativeFrontend:
         # mid-_host_eval completing into a torn-down C++ server would be
         # a native use-after-stop
         self._brownout_live = 0
+        # lane selection (ISSUE 12, docs/performance.md "Lane selection"):
+        # slot-level lane choice — a small gathered slot whose host-twin
+        # cost beats the device round trip is answered on the CPU-backend
+        # kernel even when the window is NOT saturated (brownout keeps its
+        # distinct overload trigger and counters).  Speculative dual-
+        # dispatch stays an engine-lane feature: a C++ slot completes via
+        # fe_complete_batch exactly once, so racing two completions against
+        # one slot has no safe first-wins seam here.
+        self.lanes = LaneSelector(
+            "native", enabled=lane_select,
+            host_max_rows=min(int(lane_host_max_rows), self.max_batch),
+            speculative=False, host_concurrency=2)
+        # persistent workers for cost-model-selected host slots: this is
+        # the LIGHT-LOAD latency path, so thread-per-slot churn (the
+        # brownout pattern, fine under rare saturation spills) would eat
+        # a measurable slice of the very p50 the lane buys down
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._host_pool = ThreadPoolExecutor(
+            max_workers=self.lanes.host_limit,
+            thread_name_prefix="atpu-fe-lane-host")
         # slow-lane service-rate estimator state (owned by the drain loop)
         self._slow_last: Dict[str, float] = {"slow": 0.0, "t": 0.0}
         # decision observability (ISSUE 9): per-lane SLO burn-rate tracker
@@ -845,6 +874,12 @@ class NativeFrontend:
             self._fe_stopped = True
             self._mod.fe_stop()
         self._drain_wake.set()
+        # host-lane pool: its tasks are counted in _brownout_live, which
+        # the drain above already waited out — shutdown is bookkeeping
+        try:
+            self._host_pool.shutdown(wait=False)
+        except Exception:
+            pass
         for t in self._threads:
             t.join(timeout=5)
         # pre-warm compiles can't be interrupted mid-XLA; they bail between
@@ -951,6 +986,15 @@ class NativeFrontend:
                 "decisions": self._brownout_total,
                 "batches": self._brownout_batches,
             },
+            # lane selection (ISSUE 12): slot-level cost-model decisions,
+            # rows served per lane, cost EWMAs, warmed host shapes
+            # tuple() first: the pre-warm thread and host-eval workers
+            # add() concurrently — iterating the live set can raise
+            "lane_select": dict(
+                self.lanes.to_json(),
+                host_warm_shapes=(sorted(list(s)
+                                         for s in tuple(rec.host_warm))
+                                  if rec is not None else [])),
             "provenance": {
                 "heat": (rec.heat.to_json()
                          if rec is not None and rec.heat is not None
@@ -1170,6 +1214,16 @@ class NativeFrontend:
 
     def _prewarm_rest(self, rec: _SnapRec, grid: List[Tuple[int, int]]) -> None:
         try:
+            # host-lane jit first (ISSUE 12 satellite): with lane selection
+            # on, the very next light-load slot after this swap will ride
+            # the CPU-backend twin — its small pad shapes must be warm
+            # before the long tail of device variants compiles (the same
+            # latency-spike class as the brownout worker-thread fix)
+            if self.lanes.enabled:
+                try:
+                    self._warm_host(rec)
+                except Exception:
+                    log.exception("host-lane jit pre-warm failed")
             for pad, eff in grid:
                 # bail once superseded: a draining snapshot never sees new
                 # shapes, and its compiles would contend with the successor's
@@ -1184,6 +1238,59 @@ class NativeFrontend:
             log.exception("jit pre-warm failed")
         finally:
             rec.warm_done.set()
+
+    def _warm_host(self, rec: _SnapRec) -> None:
+        """Compile the CPU-backend (host-lane) jit variants for the common
+        SMALL pad shapes — the shapes cost-model-selected slots and the
+        degrade path actually produce under light load.  Large pads stay
+        cold on purpose: the cost model never routes a large cut host-side
+        (R_BATCH), so warming them would burn reconcile-time CPU for
+        shapes that only the saturated-brownout edge could ever hit."""
+        if rec.sharded is not None or rec.policy is None:
+            return
+        has_dfa = rec.params is not None and rec.params["dfa_tables"] is not None
+        effs = [DFA_VALUE_BYTES] if has_dfa else [0]
+        for pad in (16, 32):
+            if pad > self.max_batch:
+                break
+            for eff in effs:
+                if (not self._running or rec.snap_id not in self._snaps
+                        or rec.snap_id != self._next_snap_id - 1):
+                    return
+                if (pad, eff) not in rec.host_warm:
+                    self._warm_host_one(rec, pad, eff)
+
+    def _warm_host_one(self, rec: _SnapRec, pad: int, eff: int) -> None:
+        """Compile (and cache) the CPU-backend jit variant for one bucket
+        shape using throwaway zero operands — the host-lane mirror of
+        _warm_one.  Also builds rec.host_params eagerly, so the first real
+        host-lane slot pays neither the operand-pytree build nor the XLA
+        compile."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.pattern_eval import eval_bitpacked_jit, to_device
+
+        if rec.host_params is None:
+            rec.host_params = to_device(rec.policy, host=True)
+        policy = rec.policy
+        dt = wire_dtype(policy)
+        A, M, K = policy.n_attrs, policy.n_member_attrs, policy.members_k
+        C, NB = policy.n_cpu_leaves, max(policy.n_byte_attrs, 1)
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            out = eval_bitpacked_jit(
+                rec.host_params,
+                jnp.asarray(np.zeros((pad, A), dtype=dt)),
+                jnp.asarray(np.full((pad, M, K), PAD, dtype=dt)),
+                jnp.asarray(np.zeros((pad, C), dtype=bool)),
+                jnp.asarray(np.zeros((pad,), dtype=np.int32)),
+                jnp.asarray(np.zeros((pad, NB, eff), dtype=np.uint8))
+                if eff else None,
+                jnp.asarray(np.zeros((pad, NB), dtype=bool)) if eff else None,
+            )
+            jax.block_until_ready(out)
+        rec.host_warm.add((pad, eff))
 
     def _pick_warm_shape(self, rec: _SnapRec, count: int, eff: int) -> Tuple[int, int]:
         """Smallest warmed (pad ≥ count, eff' ≥ eff); falls back to the
@@ -1899,10 +2006,48 @@ class NativeFrontend:
         from ..ops.pattern_eval import eval_bitpacked_jit
 
         rec = self._snaps[snap_id]
-        if not self.breaker.allow_device():
+        allowed, probe = self.breaker.admit_device()
+        if not allowed:
             self._degrade_slot(rec, snap_id, slot, count)
             return
-        if (spill and self.brownout and count <= self.brownout_max_rows
+        # a claimed half-open PROBE must reach the device: routing it
+        # host-side (lane choice or brownout) would strand _probe_inflight
+        # forever — no breaker verdict ever lands, every later slot skips
+        # the device, and a transiently-sick device becomes a permanent
+        # host-only degrade.  (The engine lane turns probes into
+        # speculative dual-dispatch instead; this lane has no first-wins
+        # seam, so the probe simply rides the device alone.)
+        if (spill and not probe and self.lanes.enabled
+                and rec.sharded is None and rec.policy is not None
+                and count <= self.lanes.host_max_rows):
+            # slot-level lane choice (ISSUE 12): a small gathered slot the
+            # cost model says the CPU-backend twin answers FASTER than a
+            # device round trip rides the host lane — light-load latency
+            # stops paying the H2D/D2H trip.  Same worker-thread + live-
+            # counter discipline as brownout (stop() waits these out), but
+            # its own trigger and counters: this is a latency choice, not
+            # an overload spill.
+            which, why = self.lanes.decide(count, self._rb_inflight,
+                                           self.slots)
+            if which == L_HOST:
+                taken = False
+                with self._rb_lock:
+                    if self.lanes.host_inflight < self.lanes.host_limit:
+                        self.lanes.host_inflight += 1
+                        self._brownout_live += 1
+                        taken = True
+                if taken:
+                    self.lanes.count(L_HOST, why)
+                    self._host_pool.submit(self._brownout_slot, rec,
+                                           snap_id, slot, count, why=why)
+                    return
+                # a concurrent host worker filled the cap between decide()
+                # and the under-lock re-check: the slot rides the device —
+                # record THAT, or dispatched slots stop summing up
+                which, why = L_DEVICE, "host-busy"
+            self.lanes.count(L_DEVICE, why)
+        if (spill and not probe and self.brownout
+                and count <= self.brownout_max_rows
                 and self._rb_inflight >= self._brownout_threshold
                 and rec.sharded is None and rec.policy is not None):
             # device pipeline saturated (nearly every slot in flight) and
@@ -2016,12 +2161,18 @@ class NativeFrontend:
         self._rb_evt.set()
 
     def _brownout_slot(self, rec: _SnapRec, snap_id: int, slot: int,
-                       count: int) -> None:
-        """Answer one small slot on the CPU-backend kernel while the device
-        window is saturated (worker thread — see _dispatch).  If the host
-        eval itself fails, the slot falls back to a normal device dispatch
-        (spill=False so it cannot loop back here).  Exactness: same kernel,
-        same encoded operands — only the execution backend differs."""
+                       count: int, why: str = "brownout") -> None:
+        """Answer one small slot on the CPU-backend kernel (worker thread —
+        see _dispatch).  Two distinct triggers share this execution path:
+        ``why="brownout"`` = the device window is saturated (overload
+        spill, PR 7 counters); any other ``why`` = the ISSUE 12 cost model
+        simply chose the host lane as FASTER (counted in
+        auth_server_lane_decisions_total instead).  If the host eval
+        itself fails, the slot falls back to a normal device dispatch
+        (spill=False so it cannot loop back here).  Exactness: same
+        kernel, same encoded operands — only the execution backend
+        differs."""
+        lane_sel = why != "brownout"
         try:
             t0 = time.monotonic()
             t0_ns = time.time_ns()
@@ -2029,21 +2180,26 @@ class NativeFrontend:
             try:
                 verdict, firing = self._host_eval(rec, slot, count)
             except Exception:
-                log.exception("native brownout eval failed; batch rides the "
-                              "device instead")
+                log.exception("native host-lane eval failed; batch rides "
+                              "the device instead")
                 try:
                     self._dispatch(snap_id, slot, count, spill=False)
                 except Exception as e:
-                    log.exception("post-brownout device dispatch failed")
+                    log.exception("post-host-lane device dispatch failed")
                     try:
                         self._native_batch_failed(snap_id, slot, count, 0, e)
                     except Exception:
                         log.exception("native batch failure handling failed")
                 return
-            metrics_mod.brownout_decisions.labels("native").inc(count)
-            metrics_mod.brownout_batches.labels("native").inc()
-            self._brownout_total += count
-            self._brownout_batches += 1
+            dur = time.monotonic() - t0
+            self.lanes.cost.observe_host(dur, count)
+            if lane_sel:
+                self.lanes.count_rows(L_HOST, count)
+            else:
+                metrics_mod.brownout_decisions.labels("native").inc(count)
+                metrics_mod.brownout_batches.labels("native").inc()
+                self._brownout_total += count
+                self._brownout_batches += 1
             if not self._fe_stopped:
                 self._mod.fe_complete_batch(snap_id, slot, verdict.ctypes.data)
             try:
@@ -2051,15 +2207,16 @@ class NativeFrontend:
                 # exact, while the device-occupancy series never sees a
                 # batch that deliberately skipped the device
                 self._post_complete_telemetry(rec, count, 0, 0, rows, None,
-                                              verdict,
-                                              time.monotonic() - t0, t0_ns,
+                                              verdict, dur, t0_ns,
                                               device_rows=0, device=False,
                                               firing=firing)
             except Exception:
-                log.exception("brownout telemetry failed")
+                log.exception("host-lane telemetry failed")
         finally:
             with self._rb_lock:
                 self._brownout_live -= 1
+                if lane_sel:
+                    self.lanes.host_inflight -= 1
 
     def _readback_loop(self) -> None:
         """Completion stage: finalize in-flight batches as their readbacks
@@ -2291,7 +2448,12 @@ class NativeFrontend:
                 # attribution rows copied BEFORE completion: the C++
                 # encoder may refill the slot once fe_complete_batch runs
                 rows = rec.arrays[slot]["config_id"][:count].copy()
+                t0 = time.monotonic()
                 verdict, firing = self._host_eval(rec, slot, count)
+                # degraded host evals teach the cost model too (ISSUE 12):
+                # a frontend that spent its warm-up degrading must not
+                # enter lane selection on the cold-start estimate
+                self.lanes.cost.observe_host(time.monotonic() - t0, count)
             except Exception:
                 log.exception("native host degrade failed (fail-closed deny)")
         if verdict is not None:
@@ -2340,6 +2502,18 @@ class NativeFrontend:
         pad = min(bucket_pow2(count), self.max_batch)
         eff = (_trim_bytes(a["attr_bytes"][:count]).shape[-1]
                if has_dfa else 0)
+        # round up into an already-warmed CPU variant (ISSUE 12 satellite:
+        # the pre-warm thread compiles the small shapes at snapshot swap,
+        # so a live host-lane slot pays no inline XLA compile; rows past
+        # the count carry stale operands and their results are discarded —
+        # the same discipline as the device lane's _pick_warm_shape)
+        if (pad, eff) not in rec.host_warm:
+            best = None
+            for p, e in tuple(rec.host_warm):
+                if p >= count and e >= eff and (best is None or (p, e) < best):
+                    best = (p, e)
+            if best is not None:
+                pad, eff = best
         cpu = jax.devices("cpu")[0]
         with jax.default_device(cpu):
             packed = eval_bitpacked_jit(
@@ -2354,6 +2528,7 @@ class NativeFrontend:
                 if has_dfa else None,
             )
             out = np.asarray(packed)
+        rec.host_warm.add((pad, eff))  # compiled now, warm from here on
         E = rec.heat.E if rec.heat is not None else 0
         if E:
             verdict, firing = unpack_attribution(out[:count], E)
@@ -2393,9 +2568,22 @@ class NativeFrontend:
         if self.slo is not None and count:
             # the native SLI is the batch's on-box round trip (per-request
             # waits are C++-clocked): every member shares the batch verdict
-            self.slo.observe(count,
-                             count if dispatch_s > self.slo.slo_s else 0)
+            n_bad = count if dispatch_s > self.slo.slo_s else 0
+            self.slo.observe(count, n_bad)
+            # per-lane burn bias feed (ISSUE 12): selection leans toward
+            # the lane that is not burning budget
+            self.lanes.cost.observe_slo(L_DEVICE if device else L_HOST,
+                                        count, n_bad)
         if device:
+            if device_rows is None or device_rows > 0:
+                # lane-selection cost model: every device completion feeds
+                # the RTT/occupancy EWMAs the next slot decision compares
+                # against (cache-only batches skip it — they never touched
+                # the link, and their sub-ms turnaround would read as a
+                # fast device)
+                self.lanes.cost.observe_device(dispatch_s, count, 0,
+                                               self._rb_inflight, self.slots)
+            self.lanes.count_rows(L_DEVICE, count)
             metrics_mod.observe_batch("native", count, pad, None, dispatch_s,
                                       device_rows=device_rows)
             metrics_mod.observe_pipeline_stage("native", "device", dispatch_s)

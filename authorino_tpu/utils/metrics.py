@@ -695,6 +695,44 @@ brownout_batches = _counter(
     _LANE_LABELS,
 )
 
+# ---------------------------------------------------------------------------
+# Lane selection (ISSUE 12): the host twin as a first-class serving lane —
+# per-batch-cut cost-model decisions and speculative dual-dispatch while a
+# lane breaker is half-open.  See runtime/lane_select.py +
+# docs/performance.md "Lane selection".
+# ---------------------------------------------------------------------------
+
+lane_decisions = _counter(
+    "auth_server_lane_decisions_total",
+    "Batch-cut lane decisions by the cost model (runtime/lane_select.py): "
+    "lane = <serving lane>-host / <serving lane>-device, reason = "
+    "cost-model (the winning cost estimate), deadline (latency-critical "
+    "head rescued host-side), speculative (dual-dispatch twin while the "
+    "breaker is half-open), batch (cut too large for the host lane), "
+    "host-busy (host concurrency cap), slo-burn (burn bias flipped the "
+    "raw cost verdict), explore (periodic device probe keeping the RTT "
+    "EWMA fresh during host-only regimes), disabled.",
+    _LANE_LABELS + ("reason",),
+)
+lane_cost_ewma = _gauge(
+    "auth_server_lane_cost_ewma_seconds",
+    "Live cost-model EWMAs per lane: host = seconds per host-decided ROW, "
+    "device = seconds per device batch round trip.  The decision law "
+    "compares host_row x cut_size against device_rtt x (1 + occupancy).",
+    _LANE_LABELS + ("which",),
+)
+speculative_dispatch = _counter(
+    "auth_server_speculative_dispatch_total",
+    "Speculative dual-dispatch outcomes (breaker half-open): launched = "
+    "one batch sent to BOTH lanes, host-win / device-win = which lane "
+    "resolved the futures first (the loser's work is ignored — verdicts "
+    "are bit-identical by construction), host-fail = the host twin "
+    "raised or partially failed (the device half owns the batch), "
+    "device-fail = the device half failed while the host half answered "
+    "(the probe's breaker verdict).",
+    ("outcome",),
+)
+
 host_fallback_total = _counter(
     "auth_server_host_fallback_total",
     "Requests re-decided by the host expression oracle because the compact "

@@ -362,6 +362,37 @@ def provenance_block(engine=None, fe=None, configs=None, docs=None,
     return block
 
 
+def lane_selection_block(engine, enabled_block, baseline_block):
+    """The ISSUE 12 artifact block: per-lane decision counts + rows,
+    per-class latency split (from the bimodal pass), speculative
+    wins/cancels, the cost-model EWMA snapshot, and the batch-class
+    throughput ratio against the device-only baseline (the acceptance
+    shape: interactive p50 < 10 ms with the ratio within 5%)."""
+    ls = engine.debug_vars()["lane_select"]
+    cls_on = enabled_block.get("classes") or {}
+    cls_off = baseline_block.get("classes") or {}
+    batch_on = (cls_on.get("batch") or {}).get("achieved_rps")
+    batch_off = (cls_off.get("batch") or {}).get("achieved_rps")
+    return {
+        "decisions": ls["decisions"],
+        "rows": ls["rows"],
+        "speculative": ls["speculative_outcomes"],
+        "cost_model": ls["cost"],
+        "interactive_p50_ms": (cls_on.get("interactive") or {}).get(
+            "co_corrected_p50_ms"),
+        "interactive_p50_ms_device_only": (cls_off.get("interactive")
+                                           or {}).get("co_corrected_p50_ms"),
+        "interactive_p99_ms": (cls_on.get("interactive") or {}).get(
+            "co_corrected_p99_ms"),
+        "batch_rps": batch_on,
+        "batch_rps_device_only": batch_off,
+        "batch_throughput_ratio": (round(batch_on / batch_off, 4)
+                                   if batch_on and batch_off else None),
+        "verdicts_exact_sampled": enabled_block.get(
+            "verdicts_exact_sampled"),
+    }
+
+
 def build_engine(configs, args):
     from authorino_tpu.runtime import EngineEntry, PolicyEngine
 
@@ -850,6 +881,33 @@ def open_loop_offsets(rps, seconds, shape, burst_factor=2.0):
     return out
 
 
+def bimodal_offsets(rps, seconds, interactive_frac=0.05, burst_span=0.2):
+    """Bimodal arrival timetable (ISSUE 12): an INTERACTIVE trickle (evenly
+    spaced lone requests — the light-load shape whose p50 used to sit at
+    one device RTT) interleaved with BATCH bursts (the rest of the offered
+    rate, concentrated into a ``burst_span``-second burst each second —
+    full-pad device work).  Returns (offsets, classes) sorted by time;
+    classes tag each request "interactive" or "batch" so the artifact can
+    split latency percentiles per class — the lane-selection acceptance
+    shape: interactive p50 < 10 ms while batch throughput holds."""
+    inter_rate = max(20.0, rps * interactive_frac)
+    tagged = []
+    t = 0.0
+    while t < seconds:
+        tagged.append((t, "interactive"))
+        t += 1.0 / inter_rate
+    per_burst = int(max(0.0, rps - inter_rate) * 1.0)  # one 1 s window each
+    t0 = 0.0
+    while t0 < seconds:
+        for k in range(per_burst):
+            off = t0 + 0.3 + burst_span * k / max(1, per_burst)
+            if off < seconds:
+                tagged.append((off, "batch"))
+        t0 += 1.0
+    tagged.sort()
+    return [o for o, _ in tagged], [c for _, c in tagged]
+
+
 def run_engine_open_loop(engine, docs, rows, args, rps, seconds=None):
     """Open-loop pass against PolicyEngine.submit at offered ``rps``.
     Returns the overload artifact block: offered vs achieved RPS,
@@ -863,8 +921,12 @@ def run_engine_open_loop(engine, docs, rows, args, rps, seconds=None):
     seconds = seconds or args.seconds
     slo_s = args.slo_ms / 1e3
     deadline_s = (args.deadline_ms / 1e3) if args.deadline_ms else None
-    offsets = open_loop_offsets(rps, seconds, args.shape,
-                                args.burst_factor)
+    if args.shape == "bimodal":
+        offsets, classes = bimodal_offsets(rps, seconds)
+    else:
+        offsets = open_loop_offsets(rps, seconds, args.shape,
+                                    args.burst_factor)
+        classes = None
     n_docs = len(docs)
     # zipf key skew (--key-repeat): hot tenants/tokens repeat, exercising
     # dedup/caching under overload exactly like the wire shaping does
@@ -881,35 +943,62 @@ def run_engine_open_loop(engine, docs, rows, args, rps, seconds=None):
     gen_lag = []           # generator lateness: actual submit - intended
     rejects = {}           # typed CheckAbort code -> count
     raw_errors = [0]
-    exact = {"checked": 0, "mismatches": 0}
+    # sampled exactness: verdict AND attribution vs the host expression
+    # rules — with lane selection on, samples land on whichever lane
+    # served them, so a non-zero host/device split in the lane block makes
+    # this a cross-lane parity assertion (ISSUE 12)
+    exact = {"checked": 0, "mismatches": 0, "attr_mismatches": 0}
     done_n = [0]
+    lat_cls = ({"interactive": [], "batch": []}
+               if classes is not None else None)
+    done_cls = ({"interactive": 0, "batch": 0}
+                if classes is not None else None)
 
-    async def one(j, intended, seq):
+    async def one(j, intended, seq, cls=None):
         try:
             # deadline on the engine's clock (time.monotonic — perf_counter
             # has an unrelated epoch on some platforms); latency math stays
             # on perf_counter throughout
             dl = (time.monotonic() + deadline_s) if deadline_s else None
-            rule, _ = await engine.submit(docs[j], f"cfg-{rows[j]}",
-                                          deadline=dl)
+            rule, skipped = await engine.submit(docs[j], f"cfg-{rows[j]}",
+                                                deadline=dl)
         except CheckAbort as e:
             rejects[e.code] = rejects.get(e.code, 0) + 1
         except Exception:
             raw_errors[0] += 1
         else:
             done_n[0] += 1
-            lat_ok.append(time.perf_counter() - intended)
+            v = time.perf_counter() - intended
+            lat_ok.append(v)
+            if cls is not None:
+                lat_cls[cls].append(v)
+                done_cls[cls] += 1
             if seq % 97 == 0:
                 # sampled exactness: the served verdict must equal the host
                 # expression rule — overload may shed, it must never
-                # approximate
+                # approximate — and the firing column (deny attribution)
+                # must match the reference short-circuit order
+                import numpy as _np
+
+                from authorino_tpu.ops.pattern_eval import firing_columns
+
                 exact["checked"] += 1
-                cond, expr = None, None
                 evs = args._configs[rows[j]].evaluators
-                cond, expr = evs[0]
-                want = bool(expr.matches(docs[j]))
-                if bool(rule[0]) != want:
+                want_rule = []
+                for _cond, expr in evs:
+                    want_rule.append(bool(expr.matches(docs[j])))
+                if bool(rule[0]) != want_rule[0]:
                     exact["mismatches"] += 1
+                E = len(rule)
+                wr = _np.ones(E, dtype=bool)
+                wr[:len(want_rule)] = want_rule
+                want_fire = int(firing_columns(
+                    wr[None, :], _np.zeros((1, E), dtype=bool))[0])
+                got_fire = int(firing_columns(
+                    _np.asarray(rule, dtype=bool)[None, :],
+                    _np.asarray(skipped, dtype=bool)[None, :])[0])
+                if got_fire != want_fire:
+                    exact["attr_mismatches"] += 1
 
     async def run():
         tasks = set()
@@ -922,7 +1011,8 @@ def run_engine_open_loop(engine, docs, rows, args, rps, seconds=None):
             else:
                 gen_lag.append(now - target)
             j = order[seq] if order is not None else seq % n_docs
-            t = asyncio.ensure_future(one(j, target, seq))
+            cls = classes[seq] if classes is not None else None
+            t = asyncio.ensure_future(one(j, target, seq, cls))
             tasks.add(t)
             t.add_done_callback(tasks.discard)
         if tasks:
@@ -958,6 +1048,19 @@ def run_engine_open_loop(engine, docs, rows, args, rps, seconds=None):
         "verdicts_exact_sampled": dict(exact),
         "key_repeat": args.key_repeat or None,
     }
+    if classes is not None:
+        # bimodal: per-class latency split — the lane-selection evidence
+        # (interactive rides the host lane, batch rides the device)
+        block["classes"] = {}
+        for cls in ("interactive", "batch"):
+            arr = sorted(lat_cls[cls])
+            n_off = sum(1 for c in classes if c == cls)
+            block["classes"][cls] = {
+                "offered_rps": round(n_off / seconds, 1),
+                "achieved_rps": round(done_cls[cls] / elapsed, 1),
+                "co_corrected_p50_ms": pct(arr, 0.5),
+                "co_corrected_p99_ms": pct(arr, 0.99),
+            }
     log(f"open-loop [{args.shape}] offered={block['offered_rps']:,.0f} "
         f"achieved={block['achieved_rps']:,.0f} "
         f"goodput(SLO {args.slo_ms:.0f}ms)={block['goodput_rps_in_slo']:,.0f} "
@@ -2367,12 +2470,18 @@ def main():
                          "timetable; latency is coordinated-omission-"
                          "corrected (measured from intended arrival); "
                          "typed rejections are outcomes, not errors")
-    ap.add_argument("--shape", choices=["steady", "burst", "diurnal"],
+    ap.add_argument("--shape", choices=["steady", "burst", "diurnal",
+                                        "bimodal"],
                     default="burst",
                     help="open-loop traffic shape: steady rate; burst = "
                          "alternating 1s windows of base and factor x base "
                          "(the MEAN equals the requested rate); diurnal = "
-                         "one sinusoid cycle between 0.5x and 1.5x")
+                         "one sinusoid cycle between 0.5x and 1.5x; "
+                         "bimodal = an interactive trickle (lone evenly-"
+                         "spaced requests) interleaved with batch bursts "
+                         "(ISSUE 12) — the artifact splits latency per "
+                         "class and gains a lane_selection block with a "
+                         "device-only baseline ratio")
     ap.add_argument("--burst-factor", type=float, default=2.0,
                     help="burst shape: peak-to-base ratio of the "
                          "alternating windows")
@@ -2646,8 +2755,29 @@ def main():
                 log("open-loop warm-up pass (unrecorded)...")
                 run_engine_open_loop(engine, docs, rows, args, base,
                                      seconds=min(4.0, args.seconds))
-                detail["overload"] = run_engine_open_loop(
-                    engine, docs, rows, args, base)
+                if args.shape == "bimodal":
+                    # lane-selection acceptance pass (ISSUE 12): a device-
+                    # only baseline first (lane selection forced off), then
+                    # the measured pass with the cost model live — the
+                    # artifact carries the batch-class throughput ratio and
+                    # the interactive-class p50 the host lane buys
+                    log("bimodal baseline pass (lane selection OFF, "
+                        "device only)...")
+                    engine.lanes.enabled = False
+                    engine.admission.lane_floor = None
+                    baseline = run_engine_open_loop(engine, docs, rows,
+                                                    args, base)
+                    engine.lanes.enabled = True
+                    engine.admission.lane_floor = engine.lanes.admission_floor
+                    log("bimodal measured pass (lane selection ON)...")
+                    detail["overload"] = run_engine_open_loop(
+                        engine, docs, rows, args, base)
+                    detail["lane_selection"] = lane_selection_block(
+                        engine, detail["overload"], baseline)
+                    log(f"lane_selection: {detail['lane_selection']}")
+                else:
+                    detail["overload"] = run_engine_open_loop(
+                        engine, docs, rows, args, base)
                 if args.chaos:
                     from authorino_tpu.runtime import faults as faults_mod
 

@@ -72,6 +72,10 @@ def entries_of(cfgs):
 def build_engine(cfgs=None, **kw):
     kw.setdefault("max_batch", 8)
     kw.setdefault("verdict_cache_size", 4096)
+    # device-path contracts (canary cohorts riding gated DEVICE batches,
+    # generation-token cache keying): routing must stay deterministic —
+    # lane-selection semantics are pinned in tests/test_lane_select.py
+    kw.setdefault("lane_select", False)
     engine = PolicyEngine(members_k=4, mesh=None, **kw)
     if cfgs is not None:
         engine.apply_snapshot(entries_of(cfgs))

@@ -157,6 +157,11 @@ RULE_EVIL = Pattern("auth.identity.org", Operator.EQ, "evil")
 
 def build_engine(rule=RULE_ACME, name="c", **kw) -> PolicyEngine:
     kw.setdefault("max_batch", 8)
+    # dedup + verdict-cache contracts live on the DEVICE encode path; the
+    # cost model would route these small warm-RTT cuts host-side (which
+    # legitimately bypasses encode and the cache — lane-selection
+    # semantics are pinned in tests/test_lane_select.py)
+    kw.setdefault("lane_select", False)
     engine = PolicyEngine(members_k=4, mesh=None, **kw)
     engine.apply_snapshot([
         EngineEntry(id=name, hosts=[name], runtime=None,

@@ -240,9 +240,11 @@ def entries_for(configs):
 
 def test_mesh_cache_tokens_survive_one_of_n_mutation(mesh_devices):
     N = 40
+    # lane selection off: cache-token survival is a DEVICE encode-path
+    # contract (host-lane routing skips encode and the verdict cache)
     engine = PolicyEngine(max_batch=64, members_k=4,
                           mesh=build_mesh(n_devices=8, dp=2),
-                          verdict_cache_size=4096)
+                          verdict_cache_size=4096, lane_select=False)
     engine.apply_snapshot(entries_for([config_i(i) for i in range(N)]))
     snap_old = engine._snapshot
     assert snap_old.mesh_tokens is not None  # PR 8 keying, not generations
@@ -412,11 +414,14 @@ def test_open_device_reprobes_and_rejoins_the_mesh(mesh_devices):
     strand the mesh in single-device dispatch forever), and a successful
     probe returns the lane to full-mesh launches."""
     # breaker_threshold reaches the per-DEVICE mesh breakers too (the
-    # engine plumbs it into MeshState at first touch of the mesh)
+    # engine plumbs it into MeshState at first touch of the mesh).
+    # Lane selection off: the probe must come from live DEVICE traffic —
+    # with the cost model live, these small cuts would ride the host lane
+    # and the reprobe timing would depend on explore cadence instead
     engine = PolicyEngine(max_batch=8, members_k=4,
                           mesh=build_mesh(n_devices=8, dp=2),
                           verdict_cache_size=0, batch_dedup=False,
-                          breaker_threshold=3)
+                          breaker_threshold=3, lane_select=False)
     engine.apply_snapshot(entries_for([config_i(i) for i in range(4)]))
     FAULTS.arm("one-device-down")  # kernel:raise:device=0
     try:

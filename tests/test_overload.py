@@ -323,7 +323,11 @@ class TestEngineAdmission:
         assert stub.launched_batches == 1
 
     def test_doomed_deadline_rejected_at_admission_before_encode(self):
-        engine = build_engine(max_batch=4, brownout=False)
+        # lane selection OFF: with it on, the lane-aware admission floor
+        # ADMITS this deadline and the host lane rescues it (pinned in
+        # tests/test_lane_select.py) — this test pins the legacy contract
+        engine = build_engine(max_batch=4, brownout=False,
+                              lane_select=False)
         stub = SlowStubDevice(engine, latency_s=30.0)
         engine._device_ewma = 5.0  # one expected device round trip = 5s
         shed0 = sample("auth_server_deadline_shed_total", {"lane": "engine"})

@@ -250,7 +250,10 @@ def test_inflight_batches_survive_snapshot_swap():
     """Batches launched against generation G resolve with G's verdicts
     while apply_snapshot swaps to G+1 (double-buffer guarantee, now with
     the completion deferred past the swap)."""
-    engine = build_engine(rule=RULE_ACME, max_batch=4)
+    # lane selection OFF: this test gates DEVICE launches, and with the
+    # cost model live the small warm-RTT cuts would ride the host lane
+    # (host/device swap parity is pinned in tests/test_lane_select.py)
+    engine = build_engine(rule=RULE_ACME, max_batch=4, lane_select=False)
     run(engine.submit(doc(), "c"))  # warm both jit caches
     gate = threading.Event()
     real = PolicyEngine._encode_and_launch

@@ -102,6 +102,10 @@ def oracle_firing(policy, doc, row) -> int:
 
 
 def build_engine(configs, **kw) -> PolicyEngine:
+    # attribution parity across cache/dedup/degrade needs the DEVICE
+    # path deterministically; host-lane attribution parity is pinned in
+    # tests/test_lane_select.py
+    kw.setdefault("lane_select", False)
     engine = PolicyEngine(max_batch=32, members_k=4, mesh=None, **kw)
     engine.apply_snapshot([
         EngineEntry(id=c.name, hosts=[c.name], runtime=None, rules=c)

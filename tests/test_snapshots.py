@@ -89,6 +89,10 @@ def entries_of(cfgs):
 def build_engine(cfgs=None, **kw):
     kw.setdefault("max_batch", 8)
     kw.setdefault("verdict_cache_size", 4096)
+    # cache-token survival contracts live on the DEVICE encode path —
+    # host-lane routing (which skips encode and the verdict cache by
+    # design) is pinned in tests/test_lane_select.py
+    kw.setdefault("lane_select", False)
     engine = PolicyEngine(members_k=4, mesh=None, **kw)
     if cfgs is not None:
         engine.apply_snapshot(entries_of(cfgs))
