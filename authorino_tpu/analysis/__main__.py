@@ -118,6 +118,128 @@ def _run_verify_fixtures() -> List[Finding]:
         errors.append(Finding(
             kind="guard-blind", layer="change_safety", message=msg,
             location="fixtures"))
+
+    # replay self-test (ISSUE 13): a planted one-rule mutation MUST be
+    # detected over replayed fixture traffic and attributed to exactly the
+    # mutated rule, a clean churn MUST diff empty, and a capture segment
+    # MUST round-trip bit-identically — a blind differ (or a lossy capture
+    # container) fails this command, and with it tier-1
+    errors += _replay_selftest(policy)
+    return errors
+
+
+def _replay_selftest(policy) -> List[Finding]:
+    import os
+    import tempfile
+
+    from ..compiler.compile import compile_corpus
+    from ..expressions.ast import And, Operator, Or, Pattern
+    from ..replay.capture import (
+        CAPTURE_SCHEMA,
+        CaptureFormatError,
+        read_segment,
+        write_segment,
+    )
+    from ..replay.pregate import pregate_check
+    from ..replay.replay import replay_records
+    from ..runtime.change_safety import GuardThresholds
+    from .fixtures import fixture_configs
+
+    errors: List[Finding] = []
+
+    def _err(msg: str) -> None:
+        errors.append(Finding(kind="replay-blind", layer="replay",
+                              message=msg, location="fixtures"))
+
+    # a captured traffic window over the fixture corpus: 'api' requests the
+    # corpus ALLOWS (these must flip under the planted mutation) plus
+    # 'admin' / 'public' bystander traffic (these must NOT)
+    api_doc = {"request": {"method": "GET", "url_path": "/api/v1/x",
+                           "host": "h", "headers": {"x-tag": "aa"}},
+               "auth": {"identity": {"org": "acme", "roles": ["admin"],
+                                     "groups": []}}}
+    admin_doc = {"request": {"method": "GET", "url_path": "/x", "host": "h",
+                             "headers": {}},
+                 "auth": {"identity": {"org": "acme", "roles": ["admin"],
+                                       "groups": []}}}
+    records = []
+    for i in range(16):
+        records.append({"schema": CAPTURE_SCHEMA, "t": 1.0 + i * 0.01,
+                        "authconfig": "api", "doc": api_doc,
+                        "verdict": "allow", "rule_index": -1,
+                        "lane": "engine", "generation": 1})
+        records.append({"schema": CAPTURE_SCHEMA, "t": 1.005 + i * 0.01,
+                        "authconfig": "admin", "doc": admin_doc,
+                        "verdict": "allow", "rule_index": -1,
+                        "lane": "engine", "generation": 1})
+
+    # capture container round-trip: bit-identical records, and a corrupted
+    # blob must be rejected typed (never misparsed)
+    tmp = tempfile.mktemp(suffix=".atpucap")
+    try:
+        write_segment(tmp, records)
+        _, rt = read_segment(tmp)
+        if rt != records:
+            _err("capture segment did not round-trip bit-identically")
+        with open(tmp, "rb") as f:
+            blob = bytearray(f.read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(tmp, "wb") as f:
+            f.write(bytes(blob))
+        try:
+            read_segment(tmp)
+            _err("corrupted capture segment was NOT rejected")
+        except CaptureFormatError:
+            pass
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+    # clean churn: an identical corpus (fresh tree objects) must diff EMPTY
+    clean = replay_records(policy, compile_corpus(fixture_configs()),
+                           records)
+    if clean["flips"]["total"] != 0:
+        _err(f"identical corpora produced a non-empty verdict diff: "
+             f"{clean['by_rule']}")
+
+    # planted one-rule mutation: 'api' evaluator 0's method guard flips
+    # from NEQ TRACE to NEQ GET — every captured GET the corpus allowed is
+    # now denied BY THAT RULE, and nothing else moves
+    def _flip_method(expr):
+        if isinstance(expr, Pattern):
+            if expr.selector == "request.method":
+                return Pattern(expr.selector, Operator.NEQ, "GET")
+            return expr
+        kids = tuple(_flip_method(c) for c in expr.children)
+        return And(kids) if isinstance(expr, And) else Or(kids)
+
+    mutated = fixture_configs()
+    mutated[0] = type(mutated[0])(name="api", evaluators=[
+        (cond, _flip_method(rule) if e == 0 else rule)
+        for e, (cond, rule) in enumerate(mutated[0].evaluators)
+    ])
+    diff = replay_records(policy, compile_corpus(mutated), records)
+    if diff["flips"]["newly_denied"] != 16 or \
+            diff["flips"]["newly_allowed"] != 0:
+        _err(f"replay differ BLIND: planted mutation should newly-deny "
+             f"exactly the 16 captured 'api' allows, got "
+             f"{diff['flips']}")
+    wrong = [g for g in diff["by_rule"]
+             if g["authconfig"] != "api" or g["rule_index"] != 0
+             or g["direction"] != "newly-denied"]
+    if wrong or not diff["by_rule"]:
+        _err(f"replay differ mis-attributed the planted flip (want only "
+             f"api rule[0] newly-denied): {diff['by_rule']}")
+
+    # the pregate must breach on that diff (with 'api' the suspect) and
+    # stay quiet on the clean one
+    th = GuardThresholds(min_requests=8, min_config_requests=4,
+                         min_config_allows=2)
+    b = pregate_check(diff, th, changed={"api"})
+    if b is None or "api" not in b.get("suspects", []):
+        _err(f"replay pregate BLIND to the planted flip: {b}")
+    if pregate_check(clean, th, changed={"api"}) is not None:
+        _err("replay pregate breached on a CLEAN churn")
     return errors
 
 
@@ -249,6 +371,44 @@ def _run_snapshot_diff(old_path: str, new_path: str) -> dict:
     }
 
 
+def _load_snapshot_arg(path: str):
+    """A serialized snapshot blob file OR a publish directory / HTTP
+    mirror (snapshots/distribution.py MANIFEST layout) → LoadedSnapshot."""
+    import os
+
+    from ..snapshots.distribution import load_latest, load_snapshot_blob
+
+    if os.path.isdir(path) or path.startswith(("http://", "https://")):
+        return load_latest(path)
+    with open(path, "rb") as f:
+        return load_snapshot_blob(f.read())
+
+
+def _run_replay(old_path: str, new_path: str, log_src: str,
+                budget_s=None) -> dict:
+    """Offline what-if replay (ISSUE 13, docs/replay.md): re-decide a
+    captured traffic log against two published snapshots through the
+    exact host oracle and report the verdict diff — which requests flip
+    allow<->deny, attributed to which (authconfig, rule) on the flipping
+    side.  The same seam the in-process --replay-pregate judges, so the
+    offline run reproduces the gate's verdict exactly."""
+    from ..replay.capture import read_capture
+    from ..replay.pregate import pregate_check
+    from ..replay.replay import replay_records
+
+    old, new = _load_snapshot_arg(old_path), _load_snapshot_arg(new_path)
+    records = read_capture(log_src)
+    report = replay_records(old, new, records, time_budget_s=budget_s)
+    # judged with the DEFAULT guard thresholds and the fingerprint-diff
+    # changed set, exactly like the engine's pregate would
+    from ..snapshots.diff import snapshot_diff
+
+    changed = set(snapshot_diff(old.fingerprints or {},
+                                new.fingerprints or {})["recompile"]) or None
+    report["pregate"] = pregate_check(report, changed=changed)
+    return report
+
+
 def _run_metrics_catalog() -> dict:
     """Metrics-catalogue drift gate (ISSUE 9 satellite): every family
     registered in utils/metrics.py must appear in docs/observability.md
@@ -335,6 +495,20 @@ def _print_flight_bundle(bundle: dict) -> None:
         print(f" {mark} {_fmt_ts(e.get('t'))} "
               f"{str(e.get('lane', '')):<8} {e.get('kind'):<22} "
               f"{detail_s[:100]}")
+    # replay-pregate breaches (ISSUE 13): the bundle froze the top-N
+    # attributed verdict-diff rows — the WHY of the rejected swap
+    for e in events:
+        if e.get("kind") != "replay-pregate-breach":
+            continue
+        b = (e.get("detail") or {}).get("breach") or {}
+        print(f"replay-pregate breach at {_fmt_ts(e.get('t'))}: "
+              f"guards={','.join(b.get('guards', []))} "
+              f"replayed={b.get('replayed')} "
+              f"suspects={','.join(b.get('suspects', []))}")
+        for g in b.get("top_flips", []):
+            print(f"    {g.get('direction'):<14} {g.get('count'):>6}  "
+                  f"{g.get('authconfig')}  rule[{g.get('rule_index')}] "
+                  f"{g.get('rule')}")
     if bundle.get("metrics"):
         print(f"  (+ {len(bundle['metrics'])} bytes of /metrics exposition "
               f"in the bundle)")
@@ -383,6 +557,20 @@ def main(argv=None) -> int:
                          "snapshots (blob files or publish directories): "
                          "configs recompiled, operand rows touched, delta "
                          "vs full upload bytes (docs/control_plane.md)")
+    ap.add_argument("--replay", nargs=2, metavar=("OLD", "NEW"),
+                    help="what-if replay (docs/replay.md): re-decide the "
+                         "captured traffic in --log against two serialized "
+                         "snapshots (blob files or publish directories) "
+                         "and report the verdict diff — which requests "
+                         "flip allow<->deny, attributed per (authconfig, "
+                         "rule).  Exit 1 when any request flips")
+    ap.add_argument("--log", metavar="SRC",
+                    help="capture log for --replay: a *.atpucap segment "
+                         "file or a capture directory (--capture-log-dir / "
+                         "bench --capture-log)")
+    ap.add_argument("--replay-budget-s", type=float, default=None,
+                    help="optional wall-clock bound for --replay (records "
+                         "past it are reported as truncated)")
     ap.add_argument("--metrics-catalog", action="store_true",
                     help="drift gate: every metric family registered in "
                          "utils/metrics.py must appear in "
@@ -442,6 +630,23 @@ def main(argv=None) -> int:
             print(report["text"])
         return 0
 
+    if args.replay:
+        if not args.log:
+            ap.error("--replay requires --log (a capture segment or "
+                     "directory)")
+        from ..replay.replay import format_replay_report
+
+        report = _run_replay(*args.replay, args.log,
+                             budget_s=args.replay_budget_s)
+        if args.as_json:
+            print(json.dumps(report, indent=2, sort_keys=True, default=str))
+        else:
+            print(format_replay_report(report))
+            gate = report.get("pregate")
+            print(f"pregate verdict (default thresholds): "
+                  f"{'BREACH ' + ','.join(gate['guards']) if gate else 'pass'}")
+        return 1 if report["flips"]["total"] else 0
+
     if args.metrics_catalog:
         report = _run_metrics_catalog()
         if args.as_json:
@@ -460,6 +665,18 @@ def main(argv=None) -> int:
 
     if args.decisions:
         report = _load_json_source(args.decisions)
+        # schema gate (ISSUE 13 satellite): refuse version-skewed logs
+        # with a typed error instead of misparsing the records
+        from ..runtime.provenance import (
+            DecisionSchemaError,
+            check_decision_schema,
+        )
+
+        try:
+            check_decision_schema(report)
+        except DecisionSchemaError as e:
+            print(f"DecisionSchemaError: {e}", file=sys.stderr)
+            return 1
         if args.as_json:
             print(json.dumps(report, indent=2, sort_keys=True))
         else:

@@ -182,6 +182,51 @@ def build_parser() -> argparse.ArgumentParser:
                    default=env_var("CANARY_WINDOW_S", 30.0),
                    help="Canary observation window in seconds before a "
                         "clean new generation promotes to 100%%")
+    s.add_argument("--capture", action="store_true",
+                   default=env_var("CAPTURE", False),
+                   help="TRAFFIC REPLAY (docs/replay.md): arm the opt-in "
+                        "full-fidelity capture log — sampled decisions "
+                        "(authconfig + raw authorization JSON + verdict + "
+                        "attributed rule) land in a byte-bounded in-memory "
+                        "ring, fed off the hot path by the capture drain "
+                        "thread.  The ring is what --replay-pregate "
+                        "replays; add --capture-log-dir to persist it")
+    s.add_argument("--capture-log-dir",
+                   default=env_var("CAPTURE_LOG_DIR", ""),
+                   help="Persist captured records as rotated checksummed "
+                        "segments (*.atpucap) in this directory, pruned to "
+                        "--capture-log-size-mb, readable offline by "
+                        "'analysis --replay OLD NEW --log DIR' and "
+                        "'bench.py --replay-log DIR'.  Implies --capture")
+    s.add_argument("--capture-log-size-mb", type=float,
+                   default=env_var("CAPTURE_LOG_SIZE_MB", 64.0),
+                   help="Capture budget in MB of ENCODED record bytes — "
+                        "bounds the in-memory ring (oldest evicted) AND "
+                        "the on-disk segment directory (oldest pruned); "
+                        "bytes, not records, so fat documents cannot blow "
+                        "the bound")
+    s.add_argument("--capture-sample", type=int,
+                   default=env_var("CAPTURE_SAMPLE", 1),
+                   help="Capture 1-in-N decisions (1 = every decision; "
+                        "the sampler is a per-batch stride, zero "
+                        "per-request work)")
+    s.add_argument("--replay-pregate", action="store_true",
+                   default=env_var("REPLAY_PREGATE", False),
+                   help="CHANGE SAFETY (docs/replay.md): before a "
+                        "corpus-changing reconcile starts its canary, "
+                        "replay the candidate snapshot against the live "
+                        "capture ring through the exact host oracle; a "
+                        "verdict diff breaching the canary guard "
+                        "thresholds REJECTS the swap (typed "
+                        "SnapshotRejected + replay-pregate-breach flight "
+                        "bundle) with zero live exposure; a clean "
+                        "preflight tightens the canary's guards")
+    s.add_argument("--replay-pregate-budget-ms", type=float,
+                   default=env_var("REPLAY_PREGATE_BUDGET_MS", 2000.0),
+                   help="Wall-clock bound on the reconcile-path pregate "
+                        "replay; records past the budget are reported as "
+                        "truncated (partial evidence), never silently "
+                        "skipped")
     s.add_argument("--snapshot-history", type=int,
                    default=env_var("SNAPSHOT_HISTORY", 4),
                    help="Previous snapshot generations retained for "
@@ -376,6 +421,22 @@ async def run_server(args) -> None:
         enabled=not getattr(args, "no_flight_recorder", False),
         keep=int(getattr(args, "flight_keep", 16)))
 
+    # traffic capture (ISSUE 13, docs/replay.md): opt-in — a persistence
+    # dir implies capture (persisting an unarmed log captures nothing)
+    from .replay.capture import CAPTURE
+
+    capture_dir = str(getattr(args, "capture_log_dir", "") or "")
+    if getattr(args, "capture", False) or capture_dir:
+        CAPTURE.configure(
+            enabled=True,
+            directory=capture_dir or None,
+            size_mb=float(getattr(args, "capture_log_size_mb", 64.0)),
+            sample_n=int(getattr(args, "capture_sample", 1)))
+        log.info("traffic capture ARMED: sample 1-in-%d, %.1f MB budget%s",
+                 CAPTURE.sample_n, CAPTURE.size_bytes / 1048576,
+                 f", persisting to {capture_dir}" if capture_dir else
+                 " (in-memory ring only)")
+
     fault_profile = str(getattr(args, "fault_profile", "") or "")
     if fault_profile:
         from .runtime import faults
@@ -422,6 +483,9 @@ async def run_server(args) -> None:
         canary_fraction=float(getattr(args, "canary_fraction", 0.0)),
         canary_window_s=float(getattr(args, "canary_window", 30.0)),
         snapshot_history=int(getattr(args, "snapshot_history", 4)),
+        replay_pregate=bool(getattr(args, "replay_pregate", False)),
+        replay_pregate_budget_s=float(
+            getattr(args, "replay_pregate_budget_ms", 2000.0)) / 1e3,
     )
 
     # snapshot distribution (ISSUE 8, docs/control_plane.md): a compile
@@ -667,6 +731,11 @@ async def run_server(args) -> None:
             log.warning("engine drain failed: %r", e)
         log.info("drain %s", "complete" if drained else
                  "TIMED OUT (undrained work abandoned)")
+        if CAPTURE.enabled:
+            # persist the capture tail segment: a replayable log must not
+            # lose its newest window to an orderly shutdown
+            await best_effort(loop.run_in_executor(
+                None, lambda: CAPTURE.flush(min(2.0, drain_left()))))
         await best_effort(runner.cleanup())
         await best_effort(oidc_runner.cleanup())
         from .utils.tracing import shutdown_tracing

@@ -346,7 +346,8 @@ class CanaryPhase:
 
     def __init__(self, snap, baseline, entries, index, baseline_index,
                  fraction: float, window_s: float,
-                 guard: Optional[CanaryGuard] = None):
+                 guard: Optional[CanaryGuard] = None,
+                 preflight: Optional[Dict[str, Any]] = None):
         self.snap = snap
         self.baseline = baseline
         self.entries = list(entries)
@@ -355,6 +356,11 @@ class CanaryPhase:
         self.fraction = float(fraction)
         self.window_s = float(window_s)
         self.guard = guard or CanaryGuard()
+        # replay preflight summary (ISSUE 13): a candidate that survived
+        # the pregate carries the evidence here — /debug/canary shows it,
+        # and the engine tightened this phase's guard thresholds when the
+        # preflighted diff was clean
+        self.preflight = preflight
         self.t_start = time.monotonic()
         self.started_unix = time.time()
         self._timer: Optional[threading.Timer] = None
@@ -391,6 +397,7 @@ class CanaryPhase:
             "age_s": round(time.monotonic() - self.t_start, 3),
             "started_unix": self.started_unix,
             "guard": self.guard.to_json(),
+            "preflight": self.preflight,
         }
 
 

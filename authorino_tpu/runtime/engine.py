@@ -69,6 +69,7 @@ from ..utils.verdict_cache import VerdictCache
 from . import faults
 from . import provenance as prov_mod
 from . import change_safety as safety_mod
+from ..replay.capture import CAPTURE
 from .admission import AdaptiveWindow, AdmissionController
 from .breaker import CircuitBreaker
 from .flight_recorder import RECORDER
@@ -515,6 +516,8 @@ class PolicyEngine:
         canary_window_s: float = 30.0,
         canary_thresholds=None,
         snapshot_history: int = 4,
+        replay_pregate: bool = False,
+        replay_pregate_budget_s: float = 2.0,
     ):
         """``mesh="auto"`` shards the rule corpus over all visible devices
         when more than one is present (dp × mp ShardedPolicyModel);
@@ -611,7 +614,23 @@ class PolicyEngine:
         its device buffers are retained) and quarantines the poison
         configs, a clean window promotes.  ``snapshot_history`` bounds how
         many previous (snapshot, index) generations are retained for
-        manual rollback."""
+        manual rollback.
+
+        Replay preflight (ISSUE 13, docs/replay.md): with
+        ``replay_pregate``, a corpus-changing reconcile is first REPLAYED
+        against the in-process capture ring (replay/capture.py CAPTURE —
+        arm it with --capture) through the exact host oracle on both the
+        serving and the candidate snapshot; a verdict diff breaching the
+        canary guard thresholds rejects the swap as typed
+        SnapshotRejected BEFORE any live request reaches the candidate
+        (zero live exposure, vs the canary's ~seconds of detection
+        latency), with the attributed diff frozen into a
+        replay-pregate-breach flight bundle.  A clean preflight annotates
+        the canary phase and HALVES its deny-delta guard thresholds (the
+        change already proved behavior-preserving on yesterday's
+        traffic).  ``replay_pregate_budget_s`` bounds the reconcile-path
+        replay cost; records past the budget are reported as truncated,
+        never silently skipped."""
         self.index: HostIndex[EngineEntry] = HostIndex()
         self.generation = 0  # bumped per apply_snapshot (gauge + /debug/vars)
         self.max_batch = max_batch
@@ -736,6 +755,11 @@ class PolicyEngine:
         self._last_rollback: Optional[Dict[str, Any]] = None
         self._g_canary = metrics_mod.canary_state.labels("engine")
         self._g_quarantine = metrics_mod.quarantined_configs.labels("engine")
+        # traffic replay preflight (ISSUE 13): gate state + last verdict
+        self.replay_pregate = bool(replay_pregate)
+        self.replay_pregate_budget_s = float(replay_pregate_budget_s)
+        self._last_pregate: Optional[Dict[str, Any]] = None
+        self._g_replay_flips = metrics_mod.replay_diff_flips.labels("engine")
         RECORDER.register_provider("engine", self, "debug_vars")
 
     # swap listeners: the native frontend rebuilds its C++ snapshot after
@@ -828,8 +852,18 @@ class PolicyEngine:
             snap.change_safety = {"quarantine": {
                 "configs": sorted(q["configs"]),
                 "from_generation": q["from_generation"]}}
+        # replay preflight (ISSUE 13): judge a corpus-changing swap on
+        # REPLAYED captured traffic before any live request can see it —
+        # a breaching diff raises SnapshotRejected here (the old snapshot
+        # keeps serving, zero live exposure); quarantine/rollback
+        # re-applies (allow_canary=False) skip it, they must always land
+        preflight = None
+        if self.replay_pregate and allow_canary and not self._draining \
+                and self._comparable_change(snap):
+            preflight = self._run_replay_pregate(snap)
         if allow_canary and self._should_canary(snap):
-            self._enter_canary(snap, entries, override=override)
+            self._enter_canary(snap, entries, override=override,
+                               preflight=preflight)
         else:
             self._install_snapshot(snap, entries, override=override)
         if self.analyze_policies:
@@ -963,6 +997,14 @@ class PolicyEngine:
             return False
         if self._draining:
             return False
+        return self._comparable_change(snap)
+
+    def _comparable_change(self, snap: "_Snapshot") -> bool:
+        """True when the incoming snapshot actually CHANGES the compiled
+        corpus and both generations are comparable (same lane) — the
+        precondition shared by the canary split and the replay pregate:
+        an identical-fingerprint resync has nothing to prove, a lane
+        change has nothing to compare against."""
         prev = self._snapshot
         if prev is None or (prev.policy is None and prev.sharded is None):
             return False
@@ -972,9 +1014,138 @@ class PolicyEngine:
             return False  # lane change: swap through, nothing to compare
         return snap.fingerprints != prev.fingerprints
 
+    def _run_replay_pregate(self, snap: "_Snapshot") -> Dict[str, Any]:
+        """Replay the candidate snapshot against the live capture ring and
+        judge the verdict diff (ISSUE 13, docs/replay.md "Preflight
+        gate").  Returns the preflight summary on pass/skip; raises typed
+        SnapshotRejected on breach — the caller's old snapshot keeps
+        serving and the candidate never sees a live request.
+
+        Runs on the reconcile path but bounded: the replay stops at
+        ``replay_pregate_budget_s`` and reports what it could not cover
+        (a truncated preflight is partial evidence, not full coverage)."""
+        from ..replay import pregate as pregate_mod
+        from ..snapshots.diff import snapshot_diff
+
+        t0 = time.monotonic()
+        baseline = self._snapshot
+        thresholds = self.canary_thresholds or safety_mod.GuardThresholds()
+        records = CAPTURE.ring_records()
+        if len(records) < thresholds.min_requests:
+            self._last_pregate = {
+                "result": "skipped",
+                "reason": (f"capture ring holds {len(records)} record(s) < "
+                           f"min_requests {thresholds.min_requests} — not "
+                           f"enough replay evidence to judge"
+                           + ("" if CAPTURE.enabled else
+                              " (capture is OFF: arm --capture)")),
+                "replayed": 0,
+            }
+            metrics_mod.replay_pregate.labels("skipped").inc()
+            RECORDER.record("replay-pregate", lane="engine",
+                            detail=self._last_pregate)
+            log.warning("replay pregate SKIPPED: %s",
+                        self._last_pregate["reason"])
+            return self._last_pregate
+        changed = set(snapshot_diff(baseline.fingerprints or {},
+                                    snap.fingerprints or {})["recompile"])
+        try:
+            pf = pregate_mod.preflight(
+                baseline, snap, records, thresholds, changed=changed,
+                time_budget_s=self.replay_pregate_budget_s)
+        except Exception:
+            # a pregate bug must never block the control plane: the swap
+            # proceeds under its normal canary protection, loudly
+            log.exception("replay pregate errored (swap proceeds under "
+                          "canary protection only)")
+            self._last_pregate = {"result": "skipped",
+                                  "reason": "pregate error (see logs)",
+                                  "replayed": 0}
+            metrics_mod.replay_pregate.labels("skipped").inc()
+            return self._last_pregate
+        report, breach = pf["report"], pf["breach"]
+        self._g_replay_flips.set(report["flips"]["total"])
+        elapsed_ms = round((time.monotonic() - t0) * 1e3, 3)
+        if breach is None and report["replayed"] < thresholds.min_requests:
+            # the ring LOOKED big enough, but the replay itself could not
+            # re-decide min_requests records (every config missing on one
+            # side, or the time budget truncated almost everything) — that
+            # is ABSENT evidence, not clean evidence: record skipped, so
+            # the canary keeps its normal (untightened) guards
+            self._last_pregate = {
+                "result": "skipped",
+                "reason": (f"only {report['replayed']} of "
+                           f"{len(records)} record(s) re-decided "
+                           f"(missing configs / time budget) < "
+                           f"min_requests {thresholds.min_requests}"),
+                "replayed": report["replayed"],
+                "skipped_detail": report["skipped"],
+                "elapsed_ms": elapsed_ms,
+            }
+            metrics_mod.replay_pregate.labels("skipped").inc()
+            RECORDER.record("replay-pregate", lane="engine",
+                            detail=self._last_pregate)
+            log.warning("replay pregate SKIPPED: %s",
+                        self._last_pregate["reason"])
+            return self._last_pregate
+        if breach is not None:
+            metrics_mod.replay_pregate.labels("breach").inc()
+            metrics_mod.snapshot_rejected.labels("engine").inc()
+            self._last_pregate = {
+                "result": "breach",
+                "replayed": report["replayed"],
+                "flips_total": report["flips"]["total"],
+                "flips": report["flips"],
+                "guards": breach["guards"],
+                "suspects": breach["suspects"],
+                "elapsed_ms": elapsed_ms,
+            }
+            # the anomaly kind auto-dumps a flight bundle with the top-N
+            # attributed verdict-diff rows frozen as incident evidence
+            RECORDER.record(pregate_mod.PREGATE_ANOMALY, lane="engine",
+                            detail={
+                                "baseline_generation": baseline.generation,
+                                "breach": breach,
+                                "replayed": report["replayed"],
+                                "elapsed_ms": elapsed_ms,
+                            })
+            top = breach["top_flips"][:3]
+            findings = [
+                f"replay pregate breach: {', '.join(breach['guards'])} over "
+                f"{report['replayed']} replayed request(s) "
+                f"({report['flips']['newly_denied']} newly denied, "
+                f"{report['flips']['newly_allowed']} newly allowed)"
+            ] + [
+                f"{g['authconfig']} rule[{g['rule_index']}] {g['rule']} "
+                f"{g['direction']} {g['count']} replayed request(s)"
+                for g in top
+            ]
+            log.error("replay pregate REJECTED the candidate snapshot "
+                      "(generation %d keeps serving, zero live exposure): "
+                      "%s", baseline.generation, "; ".join(findings))
+            exc = SnapshotRejected(findings)
+            exc.replay_diff = breach  # the full attributed evidence
+            raise exc
+        self._last_pregate = {
+            "result": "pass",
+            "replayed": report["replayed"],
+            "flips_total": report["flips"]["total"],
+            "flips": report["flips"],
+            "truncated": report["skipped"]["truncated"],
+            "elapsed_ms": elapsed_ms,
+        }
+        metrics_mod.replay_pregate.labels("pass").inc()
+        RECORDER.record("replay-pregate", lane="engine",
+                        detail=self._last_pregate)
+        log.info("replay pregate PASS: %d record(s) replayed, %d flip(s), "
+                 "%.0fms", report["replayed"], report["flips"]["total"],
+                 elapsed_ms)
+        return self._last_pregate
+
     def _enter_canary(self, snap: "_Snapshot",
                       entries: Sequence[EngineEntry],
-                      override: bool = True) -> None:
+                      override: bool = True,
+                      preflight: Optional[Dict[str, Any]] = None) -> None:
         """Start the canary phase: the reconcile's host index (pipeline
         semantics) lands immediately, but the compiled VERDICT lane splits
         — the hash-fraction cohort rides the new generation, everyone else
@@ -996,12 +1167,29 @@ class PolicyEngine:
 
         changed = set(snapshot_diff(baseline.fingerprints or {},
                                     snap.fingerprints or {})["recompile"])
+        # preflight-tightened guards (ISSUE 13): a candidate whose replay
+        # diff came back CLEAN over a real traffic window has already
+        # proved itself on yesterday's requests — its canary watches with
+        # halved deny-delta thresholds, so a live-only regression (a
+        # metadata dependency, a traffic shift the capture window missed)
+        # trips earlier.  A skipped/flipping-but-under-threshold preflight
+        # keeps the operator's thresholds untouched.
+        thresholds = self.canary_thresholds
+        if preflight is not None and preflight.get("result") == "pass" \
+                and not preflight.get("flips_total"):
+            import dataclasses
+
+            base_th = thresholds or safety_mod.GuardThresholds()
+            thresholds = dataclasses.replace(
+                base_th, deny_delta=base_th.deny_delta / 2,
+                config_deny_delta=base_th.config_deny_delta / 2)
+            preflight = dict(preflight, guards_tightened=True)
         phase = safety_mod.CanaryPhase(
             snap=snap, baseline=baseline, entries=entries,
             index=new_index, baseline_index=self.index,
             fraction=self.canary_fraction, window_s=self.canary_window_s,
-            guard=safety_mod.CanaryGuard(self.canary_thresholds,
-                                         changed=changed))
+            guard=safety_mod.CanaryGuard(thresholds, changed=changed),
+            preflight=preflight)
         with self._swap_lock:
             self.generation += 1
             snap.generation = self.generation
@@ -1485,6 +1673,16 @@ class PolicyEngine:
             "slo": self.slo.to_json() if self.slo is not None else None,
             "flight_recorder": RECORDER.to_json(),
             "change_safety": self.change_safety_vars(),
+            # traffic replay (ISSUE 13, docs/replay.md): capture-log state
+            # + the last preflight verdict (also on /debug/replay)
+            "replay": {
+                "capture": CAPTURE.to_json(),
+                "pregate": {
+                    "enabled": self.replay_pregate,
+                    "budget_s": self.replay_pregate_budget_s,
+                    "last": self._last_pregate,
+                },
+            },
             "snapshot": None,
         }
         if snap is not None:
@@ -2122,6 +2320,17 @@ class PolicyEngine:
                 latency_ms=((time.monotonic() - p.t_enq) * 1e3
                             if p is not None and p.t_enq else 0.0),
                 generation=snap.generation)
+            # traffic capture (ISSUE 13): the full-fidelity sampled request
+            # log rides the same per-batch seam as the decision sampler —
+            # one enabled check per batch when off; when on, each sampled
+            # decision's raw (authconfig, doc, verdict) tuple is queued for
+            # the capture log's own drain thread (encode/persist happen
+            # there, never here)
+            if CAPTURE.enabled:
+                for i in CAPTURE.sample_indices(len(pendings)):
+                    pi = pendings[i]
+                    CAPTURE.offer(pi.config_name, pi.doc, int(firing[i]),
+                                  lane, snap.generation)
             # canary guards (ISSUE 10): the SAME attribution columns feed
             # the per-cohort deny-rate comparison — batches are cohort-
             # homogeneous, so the evaluating snapshot names the cohort

@@ -45,6 +45,9 @@ BUNDLE_SCHEMA = 1
 ANOMALY_KINDS = frozenset({
     "breaker-open", "watchdog-timeout", "snapshot-rejected",
     "admission-overloaded", "snapshot-rollback",
+    # ISSUE 13: a reconcile whose replay preflight breached — the bundle
+    # freezes the top-N verdict-diff rows (attributed flips) as evidence
+    "replay-pregate-breach",
 })
 
 

@@ -45,7 +45,8 @@ import numpy as np
 from ..utils import metrics as metrics_mod
 
 __all__ = ["EXPOSE_DENY_REASON", "RULE_LABEL_MAX", "HeatMap", "DecisionLog",
-           "DECISIONS", "rule_label", "deny_provenance", "deny_reason",
+           "DECISIONS", "DecisionSchemaError", "check_decision_schema",
+           "rule_label", "deny_provenance", "deny_reason",
            "dead_rule_report", "fired_pairs", "fold_and_sample",
            "flush_heatmaps"]
 
@@ -353,10 +354,34 @@ def dead_rule_report(heat: Optional[HeatMap],
 # ---------------------------------------------------------------------------
 
 # pinned record schema (tests/test_provenance.py): every record carries
-# exactly these keys, so downstream log pipelines can rely on the shape
-DECISION_SCHEMA = 1
-DECISION_FIELDS = ("t", "lane", "host", "authconfig", "verdict", "rule",
-                   "rule_index", "latency_ms", "generation")
+# exactly these keys, so downstream log pipelines can rely on the shape.
+# Schema 2 (ISSUE 13 satellite): each RECORD is stamped with the schema it
+# was written under — a saved /debug/decisions JSON (or a capture segment
+# embedding decision fields) names its own version, so offline readers
+# reject skew with the typed DecisionSchemaError instead of misparsing.
+DECISION_SCHEMA = 2
+DECISION_FIELDS = ("schema", "t", "lane", "host", "authconfig", "verdict",
+                   "rule", "rule_index", "latency_ms", "generation")
+
+
+class DecisionSchemaError(ValueError):
+    """A decision-log payload was written under a different schema version
+    than this reader understands.  Typed so offline tooling (analysis
+    --decisions, replay readers) fails loudly instead of misparsing."""
+
+
+def check_decision_schema(payload: Any) -> None:
+    """Raise :class:`DecisionSchemaError` when ``payload`` (a
+    /debug/decisions-shaped dict) names a schema this reader does not
+    speak.  A payload without a schema field predates versioning and is
+    rejected too — silence is exactly the misparse this gate exists to
+    stop."""
+    got = payload.get("schema") if isinstance(payload, dict) else None
+    if got != DECISION_SCHEMA:
+        raise DecisionSchemaError(
+            f"decision-log schema skew: payload schema {got!r} != reader "
+            f"schema {DECISION_SCHEMA} (refusing to misparse; re-save the "
+            f"log with a matching build)")
 
 
 class DecisionLog:
@@ -406,6 +431,7 @@ class DecisionLog:
                rule: Optional[str], rule_index: int, latency_ms: float,
                generation: Any) -> None:
         rec = {
+            "schema": DECISION_SCHEMA,
             "t": time.time(),
             "lane": lane,
             "host": host,

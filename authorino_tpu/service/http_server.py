@@ -261,6 +261,30 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
                 return web.Response(status=400, text="bad n")
         return web.json_response(prov_mod.DECISIONS.to_json(n=n))
 
+    async def debug_replay(request: web.Request):
+        """Traffic-replay state (ISSUE 13, docs/replay.md): capture-log
+        accounting (ring bytes/records, drops, segments) and the last
+        replay-preflight verdict.  ``?flush=1`` (POST) forces the pending
+        capture segment to disk — handy before pointing
+        ``analysis --replay ... --log DIR`` at a live server's capture
+        directory."""
+        import asyncio as _asyncio
+
+        from ..replay.capture import CAPTURE
+
+        if request.query.get("flush") and request.method == "POST":
+            await _asyncio.get_running_loop().run_in_executor(
+                None, CAPTURE.flush)
+        return web.json_response({
+            "capture": CAPTURE.to_json(),
+            "pregate": {
+                "enabled": getattr(engine, "replay_pregate", False),
+                "budget_s": getattr(engine, "replay_pregate_budget_s",
+                                    None),
+                "last": getattr(engine, "_last_pregate", None),
+            },
+        })
+
     async def debug_canary(request: web.Request):
         """Change-safety state + manual override (ISSUE 10,
         docs/robustness.md "Change safety"): GET returns the canary/
@@ -350,6 +374,8 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
     app.router.add_get("/debug/decisions", debug_decisions)
     app.router.add_get("/debug/canary", debug_canary)
     app.router.add_post("/debug/canary", debug_canary)
+    app.router.add_get("/debug/replay", debug_replay)
+    app.router.add_post("/debug/replay", debug_replay)
     app.router.add_get("/debug/profile", debug_profile)
     # catch-all LAST: Envoy's HTTP ext_authz filter forwards the ORIGINAL
     # request path (path_prefix + :path), so /check is just the conventional

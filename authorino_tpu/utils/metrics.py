@@ -934,3 +934,38 @@ canary_guard_delta = _gauge(
     "and triggers automatic rollback.",
     ("guard",),
 )
+
+# ---------------------------------------------------------------------------
+# Traffic replay & what-if preflight (ISSUE 13, docs/replay.md): the opt-in
+# full-fidelity capture log, the reconcile replay pregate, and the live
+# verdict-diff evidence gauge.
+# ---------------------------------------------------------------------------
+
+capture_records = _counter(
+    "auth_server_capture_records_total",
+    "Sampled full-fidelity capture-log records by result: stored (encoded "
+    "into the byte-bounded ring, and persisted when --capture-log-dir is "
+    "set) vs dropped (offer-queue overflow or an unencodable document — "
+    "capture loss is accounted, never backpressure on the serving path). "
+    "Ring evictions against --capture-log-size-mb are normal operation "
+    "and ride /debug/replay, not this counter.",
+    ("result",),
+)
+replay_pregate = _counter(
+    "auth_server_replay_pregate_total",
+    "Reconcile replay preflights by result: pass (verdict diff under the "
+    "canary guard thresholds — the swap proceeds to its canary with "
+    "tightened guards), breach (the candidate snapshot was REJECTED "
+    "before serving any live request; a replay-pregate-breach flight "
+    "bundle carries the attributed diff), skipped (capture ring below "
+    "min_requests — not enough replay evidence to judge).",
+    ("result",),
+)
+replay_diff_flips = _gauge(
+    "auth_server_replay_diff_flips",
+    "Verdict flips (allow<->deny, both directions) found by the most "
+    "recent replay preflight on this lane — 0 after a clean preflight; a "
+    "breach leaves the flip count that rejected the swap standing as "
+    "incident evidence until the next preflight.",
+    _LANE_LABELS,
+)

@@ -1069,6 +1069,128 @@ def run_engine_open_loop(engine, docs, rows, args, rps, seconds=None):
     return block
 
 
+def run_engine_replay(engine, args):
+    """Replayed-traffic open-loop pass (ISSUE 13, docs/replay.md): the
+    arrival timetable, request keys and documents come from a CAPTURED
+    traffic log (--replay-log) instead of a synthetic shape — BENCH
+    numbers reproducible against recorded traffic.  The block is stamped
+    load_model='replay' + platform (the honest-labeling rule PR 7 set for
+    closed-loop rows), so replay numbers can never masquerade as
+    synthetic open-loop ones."""
+    import asyncio
+
+    import jax
+
+    from authorino_tpu.replay.bench_load import load_timetable
+    from authorino_tpu.utils.rpc import CheckAbort
+
+    offsets, names, docs, meta = load_timetable(
+        args.replay_log, speed=args.replay_speed,
+        limit=args.replay_limit or None)
+    snap = engine._snapshot
+    known = set(snap.by_id) if snap is not None else set()
+    slo_s = args.slo_ms / 1e3
+    deadline_s = (args.deadline_ms / 1e3) if args.deadline_ms else None
+    lat_ok = []
+    gen_lag = []
+    rejects = {}
+    raw_errors = [0]
+    done_n = [0]
+    verdicts = {"allow": 0, "deny": 0}
+    skipped_unknown = sum(1 for n in names if n not in known)
+    if skipped_unknown:
+        # no silent caps: records whose authconfig is not in the serving
+        # corpus are dropped loudly (a replay against a different corpus
+        # is measuring something else)
+        log(f"replay: skipping {skipped_unknown} record(s) whose "
+            f"authconfig is not in the serving corpus")
+
+    async def one(j, intended):
+        try:
+            dl = (time.monotonic() + deadline_s) if deadline_s else None
+            rule, skipped = await engine.submit(docs[j], names[j],
+                                                deadline=dl)
+        except CheckAbort as e:
+            rejects[e.code] = rejects.get(e.code, 0) + 1
+        except Exception:
+            raw_errors[0] += 1
+        else:
+            done_n[0] += 1
+            lat_ok.append(time.perf_counter() - intended)
+            import numpy as _np
+
+            from authorino_tpu.ops.pattern_eval import firing_columns
+
+            f = int(firing_columns(
+                _np.asarray(rule, dtype=bool)[None, :],
+                _np.asarray(skipped, dtype=bool)[None, :])[0])
+            verdicts["allow" if f < 0 else "deny"] += 1
+
+    async def run():
+        tasks = set()
+        t0 = time.perf_counter()
+        for seq, off in enumerate(offsets):
+            if names[seq] not in known:
+                continue
+            target = t0 + off
+            now = time.perf_counter()
+            if target > now:
+                await asyncio.sleep(target - now)
+            else:
+                gen_lag.append(now - target)
+            t = asyncio.ensure_future(one(seq, target))
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        return time.perf_counter() - t0
+
+    elapsed = asyncio.run(run())
+    lat_ok.sort()
+    gen_lag.sort()
+
+    def pct(arr, q):
+        return round(arr[min(len(arr) - 1, int(len(arr) * q))] * 1e3, 3) \
+            if arr else None
+
+    in_slo = sum(1 for v in lat_ok if v <= slo_s)
+    code_names = {4: "DEADLINE_EXCEEDED", 8: "RESOURCE_EXHAUSTED",
+                  14: "UNAVAILABLE"}
+    n_done = done_n[0]
+    block = {
+        "load_model": "replay",
+        "platform": f"jax {jax.__version__} {jax.devices()}",
+        "replay_log": meta,
+        "slo_ms": args.slo_ms,
+        "deadline_ms": args.deadline_ms or None,
+        "offered_rps": meta["offered_rps"],
+        "achieved_rps": round(n_done / elapsed, 1) if elapsed else 0.0,
+        "goodput_rps_in_slo": round(in_slo / elapsed, 1) if elapsed else 0.0,
+        "co_corrected_p50_ms": pct(lat_ok, 0.5),
+        "co_corrected_p99_ms": pct(lat_ok, 0.99),
+        "rejected": {code_names.get(c, str(c)): n
+                     for c, n in sorted(rejects.items())},
+        "rejected_total": sum(rejects.values()),
+        "raw_exceptions": raw_errors[0],
+        "generator_lag_ms_p99": pct(gen_lag, 0.99) or 0.0,
+        "skipped_unknown_config": skipped_unknown,
+        "verdicts": dict(verdicts),
+        # parity evidence: the served deny rate over the replayed window
+        # vs the rate recorded at capture time (a corpus-identical replay
+        # should match; a drifted corpus shows up here)
+        "replayed_deny_rate": round(verdicts["deny"] / n_done, 4)
+        if n_done else None,
+        "captured_deny_rate": meta["captured_deny_rate"],
+    }
+    log(f"replay [{meta['source']}] {meta['records']} record(s) over "
+        f"{meta['span_s']}s (x{meta['speed']}) offered="
+        f"{block['offered_rps']} achieved={block['achieved_rps']} "
+        f"co-p99={block['co_corrected_p99_ms']}ms "
+        f"deny={block['replayed_deny_rate']} "
+        f"(captured {block['captured_deny_rate']})")
+    return block
+
+
 def build_wire_entries(args, provider_for):
     """The wire-bench corpus: n_cfg pattern-only AuthConfigs over request
     headers (identity is anonymous on this path), one host each."""
@@ -2495,6 +2617,27 @@ def main():
     ap.add_argument("--admission-target-ms", type=float, default=50.0,
                     help="open-loop engine: CoDel admission wait target "
                          "fed to the engine under test")
+    ap.add_argument("--capture-log", default="",
+                    help="engine mode (ISSUE 13, docs/replay.md): arm the "
+                         "traffic-capture log for the measured window and "
+                         "persist rotated *.atpucap segments into this "
+                         "directory — the input for --replay-log and for "
+                         "'analysis --replay OLD NEW --log DIR'")
+    ap.add_argument("--capture-sample", type=int, default=1,
+                    help="with --capture-log: capture 1-in-N decisions")
+    ap.add_argument("--replay-log", default="",
+                    help="engine mode (ISSUE 13): REPLAY a captured "
+                         "traffic log as the open-loop timetable — "
+                         "recorded inter-arrival gaps, keys and documents "
+                         "instead of synthetic shapes.  The artifact is "
+                         "stamped load_model='replay' so replay numbers "
+                         "cannot masquerade as synthetic open-loop ones")
+    ap.add_argument("--replay-speed", type=float, default=1.0,
+                    help="with --replay-log: time-compression factor "
+                         "(2.0 replays twice as fast)")
+    ap.add_argument("--replay-limit", type=int, default=0,
+                    help="with --replay-log: replay only the first N "
+                         "captured records (0 = all)")
     ap.add_argument("--key-repeat", type=float, default=0.0,
                     help="native mode: zipf exponent (> 1) shaping the wire "
                          "payload sequence so request keys REPEAT (hot "
@@ -2640,6 +2783,32 @@ def main():
             engine = build_engine(configs, args)
             args._configs = configs  # open-loop exactness sampling
             maybe_verify_snapshot(args, engine=engine)
+            if args.capture_log:
+                # traffic capture (ISSUE 13): record the measured window
+                # into rotated segments — the corpus for --replay-log and
+                # analysis --replay
+                from authorino_tpu.replay.capture import CAPTURE
+
+                CAPTURE.configure(enabled=True, directory=args.capture_log,
+                                  sample_n=max(1, args.capture_sample))
+                log(f"traffic capture ARMED → {args.capture_log} "
+                    f"(1-in-{CAPTURE.sample_n})")
+            if args.replay_log:
+                # replayed-traffic load model (ISSUE 13): the captured
+                # timetable IS the pass — no synthetic trials
+                block = run_engine_replay(engine, args)
+                if args.capture_log:
+                    from authorino_tpu.replay.capture import CAPTURE
+
+                    CAPTURE.flush()
+                    block["capture_log"] = CAPTURE.to_json()
+                print(json.dumps({
+                    "metric": "replay_rps_engine",
+                    "value": block["achieved_rps"],
+                    "unit": "req/s",
+                    **block,
+                }))
+                return
         chaos_before = None
         if args.chaos and args.mode == "engine" and not args.open_loop:
             # with --open-loop the chaos window covers the OPEN-LOOP pass
@@ -2800,6 +2969,14 @@ def main():
                 detail["admission"] = dv["admission"]
                 detail["adaptive"] = dv["adaptive"]
                 detail["brownout"] = dv["brownout"]
+        if args.mode == "engine" and args.capture_log:
+            from authorino_tpu.replay.capture import CAPTURE
+
+            CAPTURE.flush()
+            detail["capture_log"] = CAPTURE.to_json()
+            log(f"capture log flushed: {CAPTURE.stored_total} record(s), "
+                f"{CAPTURE.segments_written} segment(s) in "
+                f"{args.capture_log}")
         print(json.dumps(detail))
         return
 
