@@ -125,6 +125,44 @@ def _run_verify_fixtures() -> List[Finding]:
     # MUST round-trip bit-identically — a blind differ (or a lossy capture
     # container) fails this command, and with it tier-1
     errors += _replay_selftest(policy)
+
+    # compiled relations self-test (ISSUE 14): the relations fixture
+    # corpus (deep/diamond hierarchy, numeric comparators, large-set
+    # assist) must lint + certify clean AND round-trip the container
+    # bit-identically, and every planted hierarchy-closure /
+    # numeric-encoder miscompile class must be REJECTED by the certifier
+    errors += _relations_selftest()
+    return errors
+
+
+def _relations_selftest() -> List[Finding]:
+    import numpy as np
+
+    from ..snapshots.serialize import deserialize_policy, serialize_policy
+    from .fixtures import relations_fixture_policy
+    from .tensor_lint import tensor_lint
+    from .translation_validate import relations_mutation_self_test
+
+    errors: List[Finding] = []
+    policy = relations_fixture_policy()
+    errors += tensor_lint(policy)
+    errors += relations_mutation_self_test(policy)
+    try:
+        loaded, _meta = deserialize_policy(serialize_policy(policy))
+        for name in ("rel_bits", "leaf_rel_slot", "leaf_rel_col",
+                     "num_attr_slot", "leaf_const"):
+            if not np.array_equal(getattr(policy, name),
+                                  getattr(loaded, name)):
+                errors.append(Finding(
+                    kind="serialize-lossy", layer="snapshots",
+                    message=f"relation corpus round-trip changed {name}",
+                    location="relations_selftest"))
+        errors += tensor_lint(loaded)
+    except Exception as e:
+        errors.append(Finding(
+            kind="serialize-lossy", layer="snapshots",
+            message=f"relation corpus failed container round-trip: {e!r}",
+            location="relations_selftest"))
     return errors
 
 
@@ -385,20 +423,29 @@ def _load_snapshot_arg(path: str):
 
 
 def _run_replay(old_path: str, new_path: str, log_src: str,
-                budget_s=None) -> dict:
+                budget_s=None, metadata_docs_src: str = "") -> dict:
     """Offline what-if replay (ISSUE 13, docs/replay.md): re-decide a
     captured traffic log against two published snapshots through the
     exact host oracle and report the verdict diff — which requests flip
     allow<->deny, attributed to which (authconfig, rule) on the flipping
     side.  The same seam the in-process --replay-pregate judges, so the
-    offline run reproduces the gate's verdict exactly."""
+    offline run reproduces the gate's verdict exactly.
+
+    ``metadata_docs_src`` (--metadata-docs, ISSUE 14) un-blinds metadata-
+    dependent configs: a {config: {metadata_name: document}} JSON file
+    (MetadataPrefetcher.export_docs shape) substituted into auth.metadata
+    before re-deciding; captured metadata_doc_digest mismatches are
+    counted in the report's metadata block."""
     from ..replay.capture import read_capture
     from ..replay.pregate import pregate_check
     from ..replay.replay import replay_records
 
     old, new = _load_snapshot_arg(old_path), _load_snapshot_arg(new_path)
     records = read_capture(log_src)
-    report = replay_records(old, new, records, time_budget_s=budget_s)
+    metadata_docs = (_load_json_source(metadata_docs_src)
+                     if metadata_docs_src else None)
+    report = replay_records(old, new, records, time_budget_s=budget_s,
+                            metadata_docs=metadata_docs)
     # judged with the DEFAULT guard thresholds and the fingerprint-diff
     # changed set, exactly like the engine's pregate would
     from ..snapshots.diff import snapshot_diff
@@ -527,14 +574,24 @@ def _run_change_safety_override(server: str, action: str) -> dict:
 
 
 def _run_coverage_report() -> dict:
-    """Lowerability report over the fixture corpus (ISSUE 6 layer 3)."""
+    """Lowerability report over the fixture corpus (ISSUE 6 layer 3; the
+    ISSUE 14 relations fixtures widen it with numeric/relation/assist
+    configs, and the blocking_reasons rollup makes per-reason progress
+    visible)."""
     from ..compiler.compile import compile_corpus
-    from .fixtures import lowerability_fixture_entries
+    from .fixtures import (
+        FixtureEntry,
+        lowerability_fixture_entries,
+        relations_fixture_configs,
+    )
     from .translation_validate import lowerability_report
 
     entries = lowerability_fixture_entries()
+    entries += [FixtureEntry(id=c.name, hosts=[c.name], rules=c)
+                for c in relations_fixture_configs()]
     rules = [e.rules for e in entries if e.rules is not None]
-    return lowerability_report(entries, compile_corpus(rules))
+    return lowerability_report(entries,
+                               compile_corpus(rules, ovf_assist=True))
 
 
 def main(argv=None) -> int:
@@ -571,6 +628,13 @@ def main(argv=None) -> int:
     ap.add_argument("--replay-budget-s", type=float, default=None,
                     help="optional wall-clock bound for --replay (records "
                          "past it are reported as truncated)")
+    ap.add_argument("--metadata-docs", metavar="FILE", default="",
+                    help="un-blind --replay for metadata-dependent configs "
+                         "(docs/replay.md): a {config: {name: document}} "
+                         "JSON of pinned prefetched metadata documents "
+                         "substituted into auth.metadata before "
+                         "re-deciding; captured metadata_doc_digest "
+                         "mismatches are counted in the report")
     ap.add_argument("--metrics-catalog", action="store_true",
                     help="drift gate: every metric family registered in "
                          "utils/metrics.py must appear in "
@@ -637,7 +701,8 @@ def main(argv=None) -> int:
         from ..replay.replay import format_replay_report
 
         report = _run_replay(*args.replay, args.log,
-                             budget_s=args.replay_budget_s)
+                             budget_s=args.replay_budget_s,
+                             metadata_docs_src=args.metadata_docs)
         if args.as_json:
             print(json.dumps(report, indent=2, sort_keys=True, default=str))
         else:
@@ -730,6 +795,12 @@ def main(argv=None) -> int:
                 reasons = (" [" + ", ".join(info["reasons"]) + "]"
                            if info["reasons"] else "")
                 print(f"  {info['lane']:<5} {name}{reasons}")
+            blocking = coverage.get("blocking_reasons") or {}
+            if blocking:
+                print("blocking reasons (would-be-fast-if-fixed):")
+                for reason, b in blocking.items():
+                    print(f"  {reason:<24} {b['configs']} config(s), "
+                          f"{b['sole_blocker']} sole-blocked")
         print(f"{'OK' if report['ok'] else 'FAIL'}: "
               f"{len(findings)} finding(s)")
     return 0 if report["ok"] else 1

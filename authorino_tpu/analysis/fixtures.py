@@ -14,10 +14,13 @@ from types import SimpleNamespace
 from typing import Any, List, Optional
 
 from ..compiler.compile import CompiledPolicy, ConfigRules, compile_corpus
-from ..expressions import All, Any_, Operator, Pattern
+from ..expressions import All, Any_, InGroup, Operator, Pattern
+from ..relations.closure import RelationClosure
 
 __all__ = ["fixture_configs", "fixture_policy", "finding_fixture_configs",
-           "FixtureEntry", "lowerability_fixture_entries"]
+           "FixtureEntry", "lowerability_fixture_entries",
+           "relations_fixture_configs", "relations_fixture_policy",
+           "fixture_relation"]
 
 
 def fixture_configs() -> List[ConfigRules]:
@@ -69,6 +72,53 @@ def finding_fixture_configs() -> List[ConfigRules]:
 
 def fixture_policy(members_k: int = 8) -> CompiledPolicy:
     return compile_corpus(fixture_configs(), members_k=members_k)
+
+
+def fixture_relation() -> RelationClosure:
+    """A deliberately awkward hierarchy: 9 levels deep with a diamond
+    (alice reaches `all` through two distinct paths) and a disjoint
+    branch — the shapes the closure fixpoint must not miscount."""
+    chain = [(f"lvl{i}", f"lvl{i + 1}") for i in range(9)]
+    return RelationClosure(chain + [
+        ("alice", "eng"), ("alice", "ops"),        # diamond top
+        ("eng", "staff"), ("ops", "staff"),        # diamond join
+        ("staff", "all"), ("bob", "qa"), ("qa", "all"),
+        ("eve", "guests"), ("lvl0", "all"),
+    ])
+
+
+def relations_fixture_configs() -> List[ConfigRules]:
+    """ISSUE 14 fixture corpus: relation leaves over a deep/diamond
+    hierarchy (two queried groups — the col-redirect mutant needs a second
+    column), numeric comparators on two attrs (the slot-collision mutant
+    needs a second slot), bounded-arithmetic constants, and a large
+    incl/excl config for the ovf_assist lane."""
+    rel = fixture_relation()
+    return [
+        ConfigRules(name="hier", evaluators=[
+            (None, All(InGroup("auth.identity.sub", "staff", rel),
+                       Pattern("request.method", Operator.NEQ, "TRACE"))),
+            (Pattern("request.path", Operator.EQ, "/admin"),
+             InGroup("auth.identity.sub", "all", rel)),
+        ]),
+        ConfigRules(name="quota", evaluators=[
+            (None, All(Pattern("request.size", Operator.GE, "0"),
+                       Pattern("request.size", Operator.LE, "1024*1024"))),
+            (None, Any_(Pattern("auth.identity.level", Operator.GT, "3"),
+                        InGroup("auth.identity.sub", "staff", rel))),
+        ]),
+        ConfigRules(name="roles", evaluators=[
+            (None, All(Pattern("auth.identity.roles", Operator.INCL, "admin"),
+                       Pattern("auth.identity.roles", Operator.EXCL,
+                               "banned"))),
+        ]),
+    ]
+
+
+def relations_fixture_policy(members_k: int = 8,
+                             ovf_assist: bool = True) -> CompiledPolicy:
+    return compile_corpus(relations_fixture_configs(), members_k=members_k,
+                          ovf_assist=ovf_assist)
 
 
 @dataclass

@@ -39,6 +39,7 @@ import numpy as np
 
 from ..compiler.compile import (
     FALSE_SLOT,
+    NUMERIC_OPS,
     OP_CPU,
     OP_EQ,
     OP_ERROR,
@@ -46,6 +47,7 @@ from ..compiler.compile import (
     OP_INCL,
     OP_NEQ,
     OP_REGEX_DFA,
+    OP_RELATION,
     TRUE_SLOT,
     CompiledPolicy,
 )
@@ -99,6 +101,24 @@ class _Circuit:
             rx = p.leaf_regex[leaf]
             return ("r", attr, rx.pattern if rx is not None else leaf), \
                 False, None
+        if op in NUMERIC_OPS:
+            # one free atom per (numeric op, attr, folded const): ge/lt are
+            # NOT complements (a non-integer value makes all four False),
+            # and order relations between constants are not modeled —
+            # sound-not-complete, like the rest of the atom model
+            return ("n", op, attr, const), False, None
+        if op == OP_RELATION:
+            # (attr, closure digest, group): two leaves share an atom iff
+            # they query the same group of the same closed relation on the
+            # same selector — mirrored by the host side's InGroup key
+            col = int(p.leaf_rel_col[leaf])
+            if p.rel_col_names is not None and 0 <= col < len(p.rel_col_names):
+                inst, group = p.rel_col_names[col]
+                digest = p.rel_instances[inst].digest \
+                    if 0 <= inst < len(p.rel_instances) else f"<inst:{inst}>"
+            else:
+                digest, group = f"<col:{col}>", ""
+            return ("G", attr, digest, group), False, None
         return ("t", leaf), False, None  # OP_TREE_CPU: opaque per-leaf atom
 
     def support(self, buf: int, memo: Dict[int, frozenset]) -> frozenset:

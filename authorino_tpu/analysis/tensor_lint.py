@@ -33,6 +33,7 @@ from typing import Any, List, Sequence
 import numpy as np
 
 from ..compiler.compile import (
+    NUMERIC_OPS,
     OP_CPU,
     OP_EQ,
     OP_ERROR,
@@ -40,6 +41,7 @@ from ..compiler.compile import (
     OP_INCL,
     OP_NEQ,
     OP_REGEX_DFA,
+    OP_RELATION,
     OP_TREE_CPU,
     CompiledPolicy,
 )
@@ -50,7 +52,7 @@ __all__ = ["tensor_lint", "lint_snapshot", "lint_scatter_plan",
 
 _LAYER = "tensor_lint"
 _KNOWN_OPS = (OP_EQ, OP_NEQ, OP_INCL, OP_EXCL, OP_CPU, OP_ERROR,
-              OP_TREE_CPU, OP_REGEX_DFA)
+              OP_TREE_CPU, OP_REGEX_DFA) + NUMERIC_OPS + (OP_RELATION,)
 
 
 def _err(kind: str, message: str, location: str = "", **detail) -> Finding:
@@ -204,6 +206,53 @@ def _check_operands(policy: CompiledPolicy, out: List[Finding]) -> None:
             "operand-range",
             f"{ma.shape[0]} member attrs exceed the padded grid M={M}",
             "member_attrs"))
+    # numeric lane (ISSUE 14)
+    NN = int(getattr(policy, "n_num_attrs", 0) or 0)
+    nas = getattr(policy, "num_attr_slot", None)
+    if nas is not None and nas.size and (
+            int(nas.min()) < -1 or int(nas.max()) >= max(NN, 1)):
+        out.append(_err(
+            "operand-range",
+            f"num_attr_slot outside [-1, NN={NN})", "num_attr_slot"))
+    if np.isin(lo, NUMERIC_OPS).any() and NN == 0:
+        out.append(_err(
+            "operand-range",
+            "numeric leaves present but n_num_attrs == 0 (no value lane)",
+            "num_attr_slot"))
+    # relation lane (ISSUE 14)
+    NR = int(getattr(policy, "n_rel_slots", 0) or 0)
+    rb = getattr(policy, "rel_bits", None)
+    has_rel_leaf = bool((lo == OP_RELATION).any()) if lo.size else False
+    if has_rel_leaf and (NR == 0 or rb is None):
+        out.append(_err(
+            "operand-range",
+            "relation leaves present but the relation lane is absent",
+            "rel_bits"))
+    if rb is not None:
+        if rb.ndim != 2 or rb.dtype != np.uint8:
+            out.append(_err(
+                "operand-range",
+                f"rel_bits must be a [Rp, W] uint8 bitmatrix, got "
+                f"{rb.dtype} {rb.shape}", "rel_bits"))
+        elif rb.shape[0] and rb[0].any():
+            out.append(_err(
+                "operand-range",
+                "rel_bits row 0 (the reserved unknown-entity row) has set "
+                "bits: unknown principals would gain memberships",
+                "rel_bits"))
+        lrs = getattr(policy, "leaf_rel_slot", None)
+        if lrs is not None and lrs.size and (
+                int(lrs.min()) < 0 or int(lrs.max()) >= max(NR, 1)):
+            out.append(_err(
+                "operand-range",
+                f"leaf_rel_slot outside [0, NR={NR})", "leaf_rel_slot"))
+        lrc = getattr(policy, "leaf_rel_col", None)
+        if lrc is not None and rb.ndim == 2 and lrc.size and (
+                int(lrc.min()) < 0 or int(lrc.max()) >= rb.shape[1] * 8):
+            out.append(_err(
+                "operand-range",
+                f"leaf_rel_col outside the bitmatrix width "
+                f"[0, {rb.shape[1] * 8})", "leaf_rel_col"))
 
 
 _INT_DTYPES = (np.int32, np.int64)
@@ -373,6 +422,25 @@ def lint_device_batch(policy: CompiledPolicy, db: Any) -> List[Finding]:
             out.append(_err("pack-grid",
                             f"attr_bytes shape {db.attr_bytes.shape} != "
                             f"[B={B}, NB={NB}, ...]", "attr_bytes"))
+    NN = int(getattr(policy, "n_num_attrs", 0) or 0)
+    for name, want in (
+        ("attrs_num", (B, NN)),
+        ("num_valid", (B, NN)),
+        ("rel_rows", (B, int(getattr(policy, "n_rel_slots", 0) or 0))),
+        ("member_ovf", (B, policy.n_member_attrs)),
+    ):
+        arr = getattr(db, name, None)
+        if arr is not None and arr.shape != want:
+            out.append(_err("pack-grid",
+                            f"{name} shape {arr.shape} != padded grid "
+                            f"{want}", name))
+    rr = getattr(db, "rel_rows", None)
+    rb = getattr(policy, "rel_bits", None)
+    if rr is not None and rb is not None and rr.size and (
+            int(rr.min()) < 0 or int(rr.max()) >= rb.shape[0]):
+        out.append(_err("pack-grid",
+                        f"rel_rows outside the bitmatrix row axis "
+                        f"[0, {rb.shape[0]})", "rel_rows"))
     return out
 
 
@@ -400,6 +468,11 @@ def _shard_grid_sig(p: CompiledPolicy) -> tuple:
         tuple(p.eval_rule.shape),
         tuple((tuple(children.shape), int(is_and.shape[0]))
               for children, is_and in p.levels),
+        int(getattr(p, "n_num_attrs", 0) or 0),
+        int(getattr(p, "n_rel_slots", 0) or 0),
+        tuple(p.rel_bits.shape) if getattr(p, "rel_bits", None) is not None
+        else (),
+        bool(getattr(p, "ovf_assist", False)),
     )
 
 

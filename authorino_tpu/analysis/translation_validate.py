@@ -66,25 +66,32 @@ import numpy as np
 from ..compiler.compile import (
     DFA_VALUE_BYTES,
     FALSE_SLOT,
+    NUMERIC_OPS,
     OP_CPU,
     OP_EQ,
     OP_ERROR,
     OP_EXCL,
     OP_INCL,
     OP_NEQ,
+    OP_NUM_GE,
+    OP_NUM_GT,
+    OP_NUM_LE,
+    OP_NUM_LT,
     OP_REGEX_DFA,
+    OP_RELATION,
     OP_TREE_CPU,
     TRUE_SLOT,
     CompiledPolicy,
     _has_invalid_regex,
 )
-from ..expressions.ast import And, Expression, Operator, Pattern
+from ..expressions.ast import And, Expression, InGroup, Operator, Pattern
 from . import Finding
 from .policy_analysis import MAX_ATOMS, _Circuit
 
 __all__ = [
     "Certificate", "certify_config", "certify_snapshot",
     "config_fingerprint", "lowerability_report", "mutation_self_test",
+    "relations_mutation_self_test",
     "clear_certificate_cache", "certificate_cache_len", "snapshot_policies",
     "LANE_FAST", "LANE_SLOW", "REASON_CODES", "SAMPLES_DEFAULT",
 ]
@@ -113,13 +120,19 @@ REASON_CODES = {
                            "per request",
     # fast lane caveats
     "invalid-regex-fallback": "a whole-tree CPU-fallback leaf (invalid "
-                              "regex) is re-evaluated host-side per request",
+                              "regex or unfoldable numeric constant) is "
+                              "re-evaluated host-side per request",
     "cpu-regex": "a regex outside the DFA subset rides the CPU regex lane",
     "cpu-grid-overflow": "incl/excl membership leaves can overflow the "
                          "compact K grid, routing those rows to the host "
                          "oracle (reported only while the deciding "
                          "policy's K is below MEMBERS_K_SAFE — mesh grid "
-                         "relief lifts configs out of this caveat)",
+                         "relief or the ovf_assist in-kernel overflow "
+                         "lane lifts configs out of this caveat)",
+    "metadata-prefetch": "metadata evaluators serve from the reconcile-"
+                         "cadence prefetch cache (pinned documents with a "
+                         "staleness bound); a stale/unfetched document "
+                         "falls through to the live fetch per request",
 }
 
 # membership grids at least this wide are treated as overflow-proof for the
@@ -185,24 +198,49 @@ class _TVCircuit(_Circuit):
         return atom, neg, const
 
 
+_HOST_NUM_OP = {
+    Operator.GT: OP_NUM_GT,
+    Operator.GE: OP_NUM_GE,
+    Operator.LT: OP_NUM_LT,
+    Operator.LE: OP_NUM_LE,
+}
+
+
+def _host_attr_of(attr_of: Dict[str, int], selector: str) -> int:
+    attr = attr_of.get(selector)
+    if attr is None:
+        # the compiler never saw this selector: give it a fresh atom keyed
+        # by the selector string — it can only DIFFER from the compiled
+        # side, which is exactly the mismatch we want to surface
+        attr = -1 - abs(hash(selector)) % (1 << 30)
+    return attr
+
+
 def _host_atom(policy: CompiledPolicy, attr_of: Dict[str, int],
                p: Pattern) -> Tuple[Optional[tuple], bool, Optional[bool]]:
     """(atom, negated, constant) for one ORIGINAL Pattern leaf, mirroring
     the compiled side's atom keys exactly.  Valid-regex patterns only —
     invalid-regex trees are handled wholesale by the caller."""
-    attr = attr_of.get(p.selector)
-    if attr is None:
-        # the compiler never saw this selector: give it a fresh atom keyed
-        # by the selector string — it can only DIFFER from the compiled
-        # side, which is exactly the mismatch we want to surface
-        attr = -1 - abs(hash(p.selector)) % (1 << 30)
+    attr = _host_attr_of(attr_of, p.selector)
     op = p.operator
     if op is Operator.MATCHES:
         return ("r", attr, p.value), False, None
+    if op in _HOST_NUM_OP:
+        # the compiled side keys numeric atoms by (op, attr, FOLDED const);
+        # an unfoldable const never reaches here (whole-tree fallback)
+        return ("n", _HOST_NUM_OP[op], attr,
+                int(p._num_const)), False, None  # type: ignore[attr-defined]
     const = policy.interner.lookup(p.value)
     if op in (Operator.EQ, Operator.NEQ):
         return ("v", attr, const), op is Operator.NEQ, None
     return ("m", attr, const), op is Operator.EXCL, None
+
+
+def _host_relation_atom(attr_of: Dict[str, int], g: InGroup) -> tuple:
+    """InGroup leaf → the same ("G", attr, closure digest, group) key the
+    compiled side derives from its (slot, column) bindings."""
+    return ("G", _host_attr_of(attr_of, g.selector),
+            g.relation.digest, g.group)
 
 
 def _host_support(policy: CompiledPolicy, attr_of: Dict[str, int],
@@ -217,6 +255,9 @@ def _host_support(policy: CompiledPolicy, attr_of: Dict[str, int],
         atom, _, _ = _host_atom(policy, attr_of, expr)
         if atom is not None:
             acc.add(atom)
+        return
+    if isinstance(expr, InGroup):
+        acc.add(_host_relation_atom(attr_of, expr))
         return
     for c in expr.children:
         _host_support(policy, attr_of, c, acc)
@@ -236,6 +277,8 @@ def _host_eval(policy: CompiledPolicy, attr_of: Dict[str, int],
             return np.full(n, bool(const))
         v = cols[atom]
         return ~v if neg else v
+    if isinstance(expr, InGroup):
+        return cols[_host_relation_atom(attr_of, expr)]
     is_and = isinstance(expr, And)
     acc: Optional[np.ndarray] = None
     for c in expr.children:
@@ -469,6 +512,128 @@ def _check_dfa_leaf(policy: CompiledPolicy, leaf: int,
 
 
 # ---------------------------------------------------------------------------
+# Layer 2b: relation tables ↔ source closures, numeric lane bindings
+# ---------------------------------------------------------------------------
+
+
+def _check_relation_leaf(policy: CompiledPolicy, leaf: int,
+                         memo: Dict[int, List[Finding]]) -> List[Finding]:
+    """Audit one OP_RELATION leaf: its (slot, column) bindings and the
+    FULL column against a fresh recomputation from the source closure —
+    the relation twin of the regex↔DFA witness check.  A flipped bit or a
+    redirected column is invisible to the truth-table layer (the bitmatrix
+    is params, not atoms), so this check is what makes relation-table
+    miscompiles rejectable."""
+    loc = f"leaf[{leaf}]"
+    col = int(policy.leaf_rel_col[leaf])
+    slot = int(policy.leaf_rel_slot[leaf])
+    findings: List[Finding] = []
+    names = policy.rel_col_names or []
+    insts = policy.rel_instances or []
+    slots = policy.rel_slots or []
+    if not (0 <= col < len(names)):
+        return [_err("relation-mismatch",
+                     f"relation leaf column {col} outside the column "
+                     f"registry [0, {len(names)})", loc, leaf=leaf)]
+    inst, group = names[col]
+    if not (0 <= inst < len(insts)):
+        return [_err("relation-mismatch",
+                     f"column {col} references relation instance {inst} "
+                     f"outside [0, {len(insts)})", loc, leaf=leaf)]
+    closure = insts[inst]
+    rows = (policy.rel_entity_rows[inst]
+            if policy.rel_entity_rows and inst < len(policy.rel_entity_rows)
+            else {})
+    # slot binding — PER LEAF, never memoized: two leaves can share a
+    # column (same closure+group on different selectors) while each reads
+    # its own slot, and a swapped binding on EITHER makes the encoder
+    # resolve the wrong attribute's entity row for that leaf
+    if not (0 <= slot < len(slots)) or \
+            slots[slot] != (int(policy.leaf_attr[leaf]), inst):
+        findings.append(_err(
+            "relation-mismatch",
+            f"relation leaf slot {slot} is bound to "
+            f"{slots[slot] if 0 <= slot < len(slots) else '<missing>'} but "
+            f"the leaf reads (attr {int(policy.leaf_attr[leaf])}, "
+            f"instance {inst})", loc, leaf=leaf, slot=slot))
+    # column-bits audit — memoized per column (a pure function of the
+    # compiled table + the source closure, shared across sharers)
+    hit = memo.get(col)
+    if hit is not None:
+        return findings + list(hit)
+    col_findings: List[Finding] = []
+    if policy.rel_bits is None or col >= int(policy.rel_bits.shape[1]) * 8:
+        col_findings.append(_err(
+            "relation-mismatch",
+            f"column {col} outside the compiled bitmatrix", loc, leaf=leaf))
+        memo[col] = col_findings
+        return findings + list(col_findings)
+    bits = ((policy.rel_bits[:, col >> 3] >> np.uint8(col & 7)) & 1) != 0
+    expected = np.zeros(bits.shape[0], dtype=bool)
+    overrun = False
+    for entity, row in rows.items():
+        if not (0 <= row < bits.shape[0]):
+            overrun = True
+            continue
+        expected[row] = closure.contains(entity, group)
+    if overrun:
+        col_findings.append(_err(
+            "relation-mismatch",
+            f"entity rows of instance {inst} overrun the bitmatrix "
+            f"[{bits.shape[0]} rows]", f"rel_bits[:, {col}]", col=col))
+    diff = np.nonzero(bits != expected)[0]
+    if diff.size:
+        r = int(diff[0])
+        entity = next((e for e, rr in rows.items() if rr == r), f"<row {r}>")
+        col_findings.append(_err(
+            "relation-mismatch",
+            f"relation table bit ({r}, {col}) = {bool(bits[r])} but the "
+            f"closure says {entity!r} ∈ {group!r} is {bool(expected[r])} "
+            "(flipped/corrupted hierarchy closure)",
+            f"rel_bits[{r}, {col}]", row=r, col=col, entity=entity,
+            group=group))
+    memo[col] = col_findings
+    return findings + list(col_findings)
+
+
+def _numeric_lane_findings(policy: CompiledPolicy) -> List[Finding]:
+    """Numeric-lane binding audit (once per snapshot): every numeric leaf's
+    attr must own a distinct in-range value slot — a slot COLLISION makes
+    the encoder overwrite one attr's value with another's, which no
+    truth-table over atoms can see."""
+    findings: List[Finding] = []
+    if not getattr(policy, "n_num_attrs", 0):
+        if np.isin(policy.leaf_op, NUMERIC_OPS).any():
+            findings.append(_err(
+                "numeric-mismatch",
+                "corpus has numeric leaves but no numeric lane",
+                "num_attr_slot"))
+        return findings
+    NN = int(policy.n_num_attrs)
+    seen: Dict[int, int] = {}
+    for leaf in range(policy.n_leaves):
+        if int(policy.leaf_op[leaf]) not in NUMERIC_OPS:
+            continue
+        attr = int(policy.leaf_attr[leaf])
+        slot = int(policy.num_attr_slot[attr])
+        if not (0 <= slot < NN):
+            findings.append(_err(
+                "numeric-mismatch",
+                f"numeric leaf {leaf} reads attr {attr} whose value slot "
+                f"{slot} is outside [0, NN={NN})", f"leaf[{leaf}]",
+                leaf=leaf, attr=attr))
+            continue
+        owner = seen.setdefault(slot, attr)
+        if owner != attr:
+            findings.append(_err(
+                "numeric-mismatch",
+                f"numeric value slot {slot} is shared by attrs {owner} and "
+                f"{attr}: the encoder writes one attr's value over the "
+                "other's", "num_attr_slot", slot=slot))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Canonical semantic fingerprints
 # ---------------------------------------------------------------------------
 
@@ -483,6 +648,12 @@ def _tree_digest(expr: Expression, memo: Dict[int, str]) -> str:
         return hit
     if isinstance(expr, Pattern):
         d = _sha(repr(("p", expr.selector, expr.operator.value, expr.value)))
+    elif isinstance(expr, InGroup):
+        # the closure digest IS the relation's semantics: a changed edge
+        # set re-fingerprints (and thus re-certifies / recompiles) exactly
+        # the configs reading the relation
+        d = _sha(repr(("g", expr.selector, expr.group,
+                       expr.relation.digest)))
     else:
         tag = "a" if isinstance(expr, And) else "o"
         d = _sha(repr((tag, tuple(_tree_digest(c, memo)
@@ -513,6 +684,38 @@ def _slot_digest(policy: CompiledPolicy, circ: _Circuit, slot: int,
             const = rev.get(int(policy.leaf_const[leaf]),
                             f"<id:{int(policy.leaf_const[leaf])}>")
             d = _sha(repr(("L", op, sel, const)))
+        elif op in NUMERIC_OPS:
+            # numeric consts are raw int32, not interner ids
+            d = _sha(repr(("N", op, sel, int(policy.leaf_const[leaf]))))
+        elif op == OP_RELATION:
+            # the certificate vouches for the leaf's (slot, column)
+            # bindings AND the column's bits: all of it must ride the
+            # fingerprint or the cache would mask a corrupted table
+            col = int(policy.leaf_rel_col[leaf])
+            slot = int(policy.leaf_rel_slot[leaf])
+            art = hashlib.sha256()
+            if policy.rel_col_names is not None and \
+                    0 <= col < len(policy.rel_col_names):
+                inst, group = policy.rel_col_names[col]
+                digest = (policy.rel_instances[inst].digest
+                          if 0 <= inst < len(policy.rel_instances)
+                          else f"<inst:{inst}>")
+                art.update(repr((digest, group)).encode())
+            else:
+                art.update(f"<col:{col}>".encode())
+            if policy.rel_bits is not None and \
+                    0 <= col < int(policy.rel_bits.shape[1]) * 8:
+                art.update(((policy.rel_bits[:, col >> 3]
+                             >> np.uint8(col & 7)) & 1).tobytes())
+            slot_attr = (int(policy.rel_slot_attr[slot])
+                         if policy.rel_slot_attr is not None
+                         and 0 <= slot < policy.rel_slot_attr.shape[0]
+                         else -1)
+            slot_sel = (policy.attr_selectors[slot_attr]
+                        if 0 <= slot_attr < len(policy.attr_selectors)
+                        else "?")
+            art.update(slot_sel.encode("utf-8", "replace"))
+            d = _sha(repr(("G", sel, art.hexdigest())))
         elif op in (OP_CPU, OP_REGEX_DFA):
             rx = policy.leaf_regex[leaf]
             pat = rx.pattern if rx is not None else ""
@@ -623,6 +826,7 @@ def certify_config(policy: CompiledPolicy, row: int, name: str = "",
                    dfa_memo: Optional[Dict[tuple, Any]] = None,
                    fp: Optional[str] = None,
                    pad_findings: Optional[List[Finding]] = None,
+                   rel_memo: Optional[Dict[int, Any]] = None,
                    ) -> Tuple[Certificate, List[Finding]]:
     """Certify one config row: circuit equivalence against the original
     expression trees + DFA equivalence for every regex leaf it reaches.
@@ -697,18 +901,25 @@ def certify_config(policy: CompiledPolicy, row: int, name: str = "",
                 f"{name}/evaluator[{e}]", config=name, evaluator=e,
                 witness=witness, mode=mode))
 
-    # layer 2: every regex-DFA leaf this config's circuit can read
+    # layer 2: every regex-DFA leaf this config's circuit can read; layer
+    # 2b: every relation leaf's table column vs its source closure
     all_slots = [s for pair in slots for s in pair if s is not None]
     dfa_rows = 0
     dfa_wit = 0
     dfa_skip = 0
+    if rel_memo is None:
+        rel_memo = {}
     for leaf in _reachable_leaves(circ, all_slots):
-        if int(policy.leaf_op[leaf]) != OP_REGEX_DFA:
+        op = int(policy.leaf_op[leaf])
+        if op == OP_RELATION:
+            f = _check_relation_leaf(policy, leaf, rel_memo)
+        elif op == OP_REGEX_DFA:
+            f, w, sk = _check_dfa_leaf(policy, leaf, dfa_memo)
+            dfa_rows += 1
+            dfa_wit += w
+            dfa_skip += sk
+        else:
             continue
-        f, w, sk = _check_dfa_leaf(policy, leaf, dfa_memo)
-        dfa_rows += 1
-        dfa_wit += w
-        dfa_skip += sk
         # COPY memoized findings before attributing them: the memo entry is
         # shared across configs reaching the same deduped table, and every
         # sharer must report its own name
@@ -760,11 +971,15 @@ def certify_snapshot(policy: CompiledPolicy, use_cache: bool = True,
 
     circ = _TVCircuit(policy)
     dfa_memo: Dict[tuple, Any] = {}
+    rel_memo: Dict[int, Any] = {}
     digest_memo: Dict[int, str] = {}
     certs: List[Certificate] = []
     failures: List[Finding] = []
     stats = {"validated": 0, "cache_hits": 0, "failed": 0, "sampled": 0,
              "dfa_witnesses": 0}
+    # numeric-lane binding audit (once per snapshot, never cached: slot
+    # layout is corpus-global, not per-config semantic)
+    failures += _numeric_lane_findings(policy)
     for name in sorted(policy.config_ids, key=policy.config_ids.get):
         row = policy.config_ids[name]
         fp = config_fingerprint(policy, row, circ=circ, memo=digest_memo)
@@ -796,7 +1011,8 @@ def certify_snapshot(policy: CompiledPolicy, use_cache: bool = True,
                 continue
         cert, findings = certify_config(
             policy, row, name=name, seed=seed, samples=samples,
-            circ=circ, dfa_memo=dfa_memo, fp=fp, pad_findings=pad_findings)
+            circ=circ, dfa_memo=dfa_memo, fp=fp, pad_findings=pad_findings,
+            rel_memo=rel_memo)
         certs.append(cert)
         failures += findings
         if cert.mode == "sampled":
@@ -866,7 +1082,11 @@ def _classify_rules(policies: List[CompiledPolicy],
             elif op == OP_CPU:
                 reasons.add("cpu-regex")
             elif op in (OP_INCL, OP_EXCL):
-                if int(getattr(policy, "members_k", 0)) < MEMBERS_K_SAFE:
+                # the ovf_assist lane (ISSUE 14) answers overflow rows
+                # in-kernel from the exact precomputed columns — no host
+                # fallback left to caveat
+                if int(getattr(policy, "members_k", 0)) < MEMBERS_K_SAFE \
+                        and not getattr(policy, "ovf_assist", False):
                     reasons.add("cpu-grid-overflow")
         return sorted(reasons)
     return []
@@ -885,10 +1105,21 @@ def classify_entry(entry: Any, policy: Any = None,
     if rules is None:
         slow = True
         reasons.append("no-authorization-rules")
+    prefetched = False
     if runtime is not None:
-        if getattr(runtime, "metadata", None):
-            slow = True
-            reasons.append("metadata-dependency")
+        md_confs = getattr(runtime, "metadata", None) or ()
+        if md_confs:
+            # a config whose metadata evaluators ALL serve from the
+            # prefetch cache (ISSUE 14: request-independent documents
+            # pinned at reconcile cadence) pays no per-request external
+            # fetch — it leaves the slow lane with a visible caveat code
+            if all(getattr(m, "prefetchable", False)
+                   and getattr(m, "prefetch_pinned", False)
+                   for m in md_confs):
+                prefetched = True
+            else:
+                slow = True
+                reasons.append("metadata-dependency")
         for az in getattr(runtime, "authorization", ()) or ():
             az_type = getattr(az, "type", "")
             if az_type == "PATTERN_MATCHING":
@@ -909,6 +1140,8 @@ def classify_entry(entry: Any, policy: Any = None,
     if not slow:
         name = getattr(rules, "name", "") or getattr(entry, "id", "")
         reasons = _classify_rules(_policies_of(policy), name)
+        if prefetched:
+            reasons = sorted(set(reasons) | {"metadata-prefetch"})
     return (LANE_SLOW if slow else LANE_FAST), reasons
 
 
@@ -919,8 +1152,10 @@ def lowerability_report(entries: Sequence[Any], policy: Any = None,
     counts are complete; the per-config listing is bounded at
     ``max_listed`` (100k-config corpora must not bloat /debug/vars)."""
     out: Dict[str, Any] = {"fast": 0, "slow": 0,
-                           "by_reason": {}, "configs": {}, "series": []}
+                           "by_reason": {}, "configs": {}, "series": [],
+                           "blocking_reasons": {}}
     series: Dict[Tuple[str, str], int] = {}
+    blocking: Dict[str, Dict[str, int]] = {}
     policies = _policies_of(policy)
     for entry in entries:
         lane, reasons = classify_entry(entry, policy=policies)
@@ -929,6 +1164,17 @@ def lowerability_report(entries: Sequence[Any], policy: Any = None,
             series[(lane, r)] = series.get((lane, r), 0) + 1
         for r in reasons:
             out["by_reason"][r] = out["by_reason"].get(r, 0) + 1
+        if lane == LANE_SLOW:
+            # per-reason would-be-fast-if-fixed rollup (ISSUE 14
+            # satellite): "sole_blocker" counts configs this reason ALONE
+            # exiles — fixing it moves exactly that many to the fast lane;
+            # "configs" counts every slow config carrying it, so progress
+            # on one reason is visible per corpus even when multi-blocked
+            for r in reasons:
+                b = blocking.setdefault(r, {"configs": 0, "sole_blocker": 0})
+                b["configs"] += 1
+                if len(reasons) == 1:
+                    b["sole_blocker"] += 1
         if len(out["configs"]) < max_listed:
             cfg_id = getattr(entry, "id", None) or getattr(
                 getattr(entry, "rules", None), "name", "?")
@@ -938,6 +1184,7 @@ def lowerability_report(entries: Sequence[Any], policy: Any = None,
     # JSON-safe (lane, reason, count) triples — the per-reconcile
     # increments for auth_server_lowerability_configs_total{lane,reason}
     out["series"] = [[lane, r, n] for (lane, r), n in sorted(series.items())]
+    out["blocking_reasons"] = {r: blocking[r] for r in sorted(blocking)}
     return out
 
 
@@ -1043,25 +1290,101 @@ _MUTANTS = (
 )
 
 
-def mutation_self_test(policy: Optional[CompiledPolicy] = None,
-                       ) -> List[Finding]:
-    """Plant one miscompile per class into the fixture corpus and demand
-    the validator rejects every one (and passes the clean corpus).  A
-    mutant that certifies clean is a ``validator-blind`` ERROR — wire this
-    into CI and --verify-fixtures so the validator can never silently rot."""
+# --- ISSUE 14 mutation classes: relation tables + numeric encoders --------
+
+
+def _referenced_rel_leaves(p: CompiledPolicy) -> List[int]:
+    return [i for i in range(p.n_leaves)
+            if int(p.leaf_op[i]) == OP_RELATION]
+
+
+def _mut_relation_bit_flip(p: CompiledPolicy) -> None:
+    """Flip one closure bit in a column a relation leaf actually reads —
+    invisible to the truth-table layer, MUST be caught by the relation
+    witness check."""
+    leaves = _referenced_rel_leaves(p)
+    if not leaves or p.rel_bits is None:
+        raise AssertionError("corpus has no relation lane")
+    col = int(p.leaf_rel_col[leaves[0]])
+    inst, _group = p.rel_col_names[col]
+    rows = list(p.rel_entity_rows[inst].values())
+    if not rows:
+        raise AssertionError("relation instance has no entities")
+    p.rel_bits = p.rel_bits.copy()
+    p.rel_bits[rows[0], col >> 3] ^= np.uint8(1 << (col & 7))
+
+
+def _mut_relation_col_redirect(p: CompiledPolicy) -> None:
+    """Rebind a relation leaf to a DIFFERENT queried column (another
+    group's): the leaf then answers the wrong membership question."""
+    leaves = _referenced_rel_leaves(p)
+    for leaf in leaves:
+        cur = int(p.leaf_rel_col[leaf])
+        other = next((c for c in range(len(p.rel_col_names or ()))
+                      if c != cur), None)
+        if other is not None:
+            p.leaf_rel_col = p.leaf_rel_col.copy()
+            p.leaf_rel_col[leaf] = other
+            return
+    raise AssertionError("corpus has fewer than two relation columns")
+
+
+def _mut_numeric_const(p: CompiledPolicy) -> None:
+    """Shift a numeric leaf's folded constant by one (off-by-one boundary
+    miscompile — the classic numeric-encoder bug)."""
+    for i in range(p.n_leaves):
+        if int(p.leaf_op[i]) in NUMERIC_OPS:
+            p.leaf_const = p.leaf_const.copy()
+            p.leaf_const[i] = int(p.leaf_const[i]) + 1
+            return
+    raise AssertionError("corpus has no numeric leaf")
+
+
+def _mut_numeric_op_flip(p: CompiledPolicy) -> None:
+    """GT↔GE (strictness flip): the boundary value decides differently."""
+    for i in range(p.n_leaves):
+        op = int(p.leaf_op[i])
+        if op in NUMERIC_OPS:
+            p.leaf_op = p.leaf_op.copy()
+            p.leaf_op[i] = {OP_NUM_GT: OP_NUM_GE, OP_NUM_GE: OP_NUM_GT,
+                            OP_NUM_LT: OP_NUM_LE, OP_NUM_LE: OP_NUM_LT}[op]
+            return
+    raise AssertionError("corpus has no numeric leaf")
+
+
+def _mut_numeric_slot_collision(p: CompiledPolicy) -> None:
+    """Two numeric attrs sharing one value slot: the encoder overwrites
+    one attr's value with the other's — invisible to the truth table,
+    MUST be caught by the numeric-lane binding audit."""
+    attrs = [a for a in (p.num_attrs.tolist() if p.num_attrs is not None
+                         else [])]
+    if len(attrs) < 2:
+        raise AssertionError("corpus has fewer than two numeric attrs")
+    p.num_attr_slot = p.num_attr_slot.copy()
+    p.num_attr_slot[attrs[1]] = int(p.num_attr_slot[attrs[0]])
+
+
+_RELATION_MUTANTS = (
+    ("relation-bit-flip", _mut_relation_bit_flip),
+    ("relation-col-redirect", _mut_relation_col_redirect),
+    ("numeric-const-corrupt", _mut_numeric_const),
+    ("numeric-op-flip", _mut_numeric_op_flip),
+    ("numeric-slot-collision", _mut_numeric_slot_collision),
+)
+
+
+def _run_mutants(base: CompiledPolicy, mutants,
+                 location: str) -> List[Finding]:
     from copy import deepcopy
 
-    from .fixtures import fixture_policy
-
-    base = policy if policy is not None else fixture_policy()
     out: List[Finding] = []
     _, clean_failures, _ = certify_snapshot(base, use_cache=False)
     if clean_failures:
         out.append(_err(
             "self-test",
             f"clean fixture corpus failed certification: "
-            f"{clean_failures[0]}", "mutation_self_test"))
-    for mname, mutate in _MUTANTS:
+            f"{clean_failures[0]}", location))
+    for mname, mutate in mutants:
         mutant = deepcopy(base)
         try:
             mutate(mutant)
@@ -1073,7 +1396,7 @@ def mutation_self_test(policy: Optional[CompiledPolicy] = None,
             out.append(_err(
                 "validator-blind",
                 f"mutant {mname!r} could not be planted: {e!r}",
-                "mutation_self_test", mutant=mname))
+                location, mutant=mname))
             continue
         _, failures, _ = certify_snapshot(mutant, use_cache=False)
         if not failures:
@@ -1081,5 +1404,30 @@ def mutation_self_test(policy: Optional[CompiledPolicy] = None,
                 "validator-blind",
                 f"planted miscompile {mname!r} certified CLEAN — the "
                 "translation validator is blind to this class",
-                "mutation_self_test", mutant=mname))
+                location, mutant=mname))
     return out
+
+
+def mutation_self_test(policy: Optional[CompiledPolicy] = None,
+                       ) -> List[Finding]:
+    """Plant one miscompile per class into the fixture corpus and demand
+    the validator rejects every one (and passes the clean corpus).  A
+    mutant that certifies clean is a ``validator-blind`` ERROR — wire this
+    into CI and --verify-fixtures so the validator can never silently rot."""
+    from .fixtures import fixture_policy
+
+    base = policy if policy is not None else fixture_policy()
+    return _run_mutants(base, _MUTANTS, "mutation_self_test")
+
+
+def relations_mutation_self_test(policy: Optional[CompiledPolicy] = None,
+                                 ) -> List[Finding]:
+    """ISSUE 14 twin of mutation_self_test over the relations fixture
+    corpus: hierarchy-closure and numeric-encoder miscompile classes —
+    flipped closure bits, redirected group columns, off-by-one constants,
+    strictness flips, and value-slot collisions — must ALL be rejected."""
+    from .fixtures import relations_fixture_policy
+
+    base = policy if policy is not None else relations_fixture_policy()
+    return _run_mutants(base, _RELATION_MUTANTS,
+                        "relations_mutation_self_test")

@@ -258,6 +258,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "a named profile (device-down, flaky, flap, "
                         "slow-device, wedge) or a rule spec — see "
                         "runtime/faults.py and docs/robustness.md")
+    s.add_argument("--ovf-assist", action="store_true",
+                   default=env_var("AUTHORINO_TPU_OVF_ASSIST", False),
+                   help="ISSUE 14: answer membership-overflow rows "
+                        "IN-KERNEL from exact precomputed assist columns "
+                        "under a compact overflow mask, instead of routing "
+                        "whole requests to the host oracle — the "
+                        "cpu-grid-overflow lowerability caveat drops for "
+                        "assisted corpora (the host-fallback lane remains "
+                        "the degrade backstop)")
+    s.add_argument("--no-metadata-prefetch", action="store_true",
+                   default=not env_var("AUTHORINO_TPU_METADATA_PREFETCH",
+                                       True),
+                   help="Disable the metadata prefetch cache (ISSUE 14, "
+                        "relations/prefetch.py): request-independent "
+                        "external-metadata documents are pinned at "
+                        "reconcile cadence and served with zero network "
+                        "I/O; stale pins fall through to the live fetch")
+    s.add_argument("--metadata-max-age", type=float,
+                   default=env_var("METADATA_PREFETCH_MAX_AGE_S", 300.0),
+                   help="Staleness bound in seconds for pinned prefetched "
+                        "metadata documents: past it the pipeline falls "
+                        "through to the live fetch (typed, exact)")
+    s.add_argument("--metadata-refresh", type=float,
+                   default=env_var("METADATA_PREFETCH_REFRESH_S", 60.0),
+                   help="Background re-pin cadence in seconds for "
+                        "prefetched metadata documents")
     s.add_argument("--strict-verify", action="store_true",
                    default=env_var("STRICT_VERIFY", False),
                    help="Tensor-lint every compiled snapshot before the "
@@ -486,6 +512,12 @@ async def run_server(args) -> None:
         replay_pregate=bool(getattr(args, "replay_pregate", False)),
         replay_pregate_budget_s=float(
             getattr(args, "replay_pregate_budget_ms", 2000.0)) / 1e3,
+        ovf_assist=bool(getattr(args, "ovf_assist", False)) or None,
+        metadata_prefetch=not getattr(args, "no_metadata_prefetch", False),
+        metadata_prefetch_max_age_s=float(
+            getattr(args, "metadata_max_age", 300.0)),
+        metadata_prefetch_refresh_s=float(
+            getattr(args, "metadata_refresh", 60.0)),
     )
 
     # snapshot distribution (ISSUE 8, docs/control_plane.md): a compile

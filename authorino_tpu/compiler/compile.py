@@ -39,12 +39,22 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..expressions.ast import And, Expression, Operator, Or, Pattern
+from ..expressions.ast import (
+    NUMERIC_OPERATORS,
+    And,
+    Expression,
+    InGroup,
+    Operator,
+    Or,
+    Pattern,
+)
+from ..relations.closure import RelationClosure
 from .intern import PAD, StringInterner
 
 __all__ = [
     "OP_EQ", "OP_NEQ", "OP_INCL", "OP_EXCL", "OP_CPU", "OP_ERROR", "OP_TREE_CPU",
-    "OP_REGEX_DFA",
+    "OP_REGEX_DFA", "OP_NUM_GT", "OP_NUM_GE", "OP_NUM_LT", "OP_NUM_LE",
+    "OP_RELATION", "NUMERIC_OPS",
     "ConfigRules", "CompiledPolicy", "ShapeTargets", "compile_corpus",
     "TRUE_SLOT", "FALSE_SLOT", "DFA_VALUE_BYTES",
 ]
@@ -52,6 +62,17 @@ __all__ = [
 OP_EQ, OP_NEQ, OP_INCL, OP_EXCL, OP_CPU, OP_ERROR, OP_TREE_CPU, OP_REGEX_DFA = (
     0, 1, 2, 3, 4, 5, 6, 7,
 )
+# numeric comparator lane + compiled relation tables (ISSUE 14)
+OP_NUM_GT, OP_NUM_GE, OP_NUM_LT, OP_NUM_LE, OP_RELATION = 8, 9, 10, 11, 12
+
+NUMERIC_OPS = (OP_NUM_GT, OP_NUM_GE, OP_NUM_LT, OP_NUM_LE)
+
+_NUM_OP_OF = {
+    Operator.GT: OP_NUM_GT,
+    Operator.GE: OP_NUM_GE,
+    Operator.LT: OP_NUM_LT,
+    Operator.LE: OP_NUM_LE,
+}
 
 # max value length evaluated on the device regex lane; longer values (or
 # values containing NUL) fall back to the CPU regex lane per request — an
@@ -110,6 +131,14 @@ class ShapeTargets:
     # eval-table rows (configs per shard) — unified so per-shard device
     # pytrees (incl. the matmul lane's [G*E, cursor] one-hots) stack
     n_configs: int = 1
+    # numeric comparator lane (ISSUE 14): compact [B, NN] int32 value slots.
+    # 0 = no lane anywhere in the union (structural, like n_byte_attrs)
+    n_num_attrs: int = 0
+    # compiled relation tables (ISSUE 14): [Rp, W] bitmatrix rows/width and
+    # the [B, NR] entity-row operand slots.  n_rel_slots == 0 = no lane
+    n_rel_slots: int = 0
+    n_rel_rows: int = 1
+    n_rel_width: int = 1
 
     @staticmethod
     def union(shapes: Sequence["ShapeTargets"]) -> "ShapeTargets":
@@ -131,6 +160,10 @@ class ShapeTargets:
             n_byte_attrs=max(s.n_byte_attrs for s in shapes),
             n_dfa_tables=max(s.n_dfa_tables for s in shapes),
             n_configs=max(s.n_configs for s in shapes),
+            n_num_attrs=max(s.n_num_attrs for s in shapes),
+            n_rel_slots=max(s.n_rel_slots for s in shapes),
+            n_rel_rows=max(s.n_rel_rows for s in shapes),
+            n_rel_width=max(s.n_rel_width for s in shapes),
         )
 
 
@@ -151,11 +184,23 @@ class _Leaf:
     const: int
     regex: Optional[str] = None  # for CPU lane
     tree: Optional[Expression] = None  # for OP_TREE_CPU whole-tree fallback
+    rel: Optional[RelationClosure] = None  # for OP_RELATION
+    group: Optional[str] = None            # for OP_RELATION
 
 
 def _has_invalid_regex(expr: Expression) -> bool:
+    """A leaf whose evaluation can only ERROR (invalid regex, unfoldable
+    numeric constant): the containing tree keeps the reference's error
+    short-circuit semantics via the whole-tree CPU fallback.  The name
+    predates the numeric lane; it now covers every invalid-leaf kind."""
     if isinstance(expr, Pattern):
-        return expr.operator is Operator.MATCHES and getattr(expr, "_regex", None) is None
+        if expr.operator is Operator.MATCHES:
+            return getattr(expr, "_regex", None) is None
+        if expr.operator in NUMERIC_OPERATORS:
+            return getattr(expr, "_num_const", None) is None
+        return False
+    if isinstance(expr, InGroup):
+        return False
     return any(_has_invalid_regex(c) for c in expr.children)
 
 
@@ -217,6 +262,36 @@ class CompiledPolicy:
     # never depends on it — cache keys are full encoded-row digests.
     config_cacheable: np.ndarray = None  # [G] bool
 
+    # --- numeric comparator lane (ISSUE 14; empty when no numeric leaf) ---
+    # attr → compact numeric-value slot (-1: attr has no numeric leaf)
+    num_attr_slot: np.ndarray = None     # [A] int32
+    num_attrs: np.ndarray = None         # [NN_real] int32
+    n_num_attrs: int = 0                 # NN (padded; 0 = no lane)
+
+    # --- compiled relation tables (ISSUE 14; empty when no InGroup leaf) --
+    # the per-snapshot ancestor-closure bitmatrix: row = (relation
+    # instance, entity), col = (relation instance, queried group); row 0 is
+    # the reserved all-zero row unknown entities resolve to.  Bit order is
+    # LITTLE within each byte (bit j of byte k = column k*8+j).
+    rel_bits: np.ndarray = None          # [Rp, W] uint8
+    leaf_rel_slot: np.ndarray = None     # [L] int32 (slot in rel_rows; 0 dflt)
+    leaf_rel_col: np.ndarray = None      # [L] int32 (column; 0 default)
+    rel_slot_attr: np.ndarray = None     # [NRp] int32 (attr of each slot)
+    n_rel_slots: int = 0                 # NR (padded; 0 = no lane)
+    # host metadata: closure instances (deduped by digest), per-instance
+    # entity → global row map, per-slot (attr, instance), per-col
+    # (instance, group) — the encoder's and certifier's view of the lane
+    rel_instances: List[RelationClosure] = None
+    rel_entity_rows: List[Dict[str, int]] = None
+    rel_slots: List[Tuple[int, int]] = None
+    rel_col_names: List[Tuple[int, str]] = None
+
+    # membership-overflow in-kernel assist (ISSUE 14): when True the
+    # encoder's exact per-leaf overflow answers ride dense CPU-lane columns
+    # and the kernel selects them under the [B, M] member_ovf mask —
+    # overflow rows stay on the device lane instead of host_fallback
+    ovf_assist: bool = False
+
     def rule_sources(self) -> List[List[str]]:
         """Decision provenance (ISSUE 9): per config row, the source string
         of each evaluator's rule expression — the rule-index → (authconfig,
@@ -276,6 +351,10 @@ class CompiledPolicy:
             self.n_cpu_leaves,
             tuple((lv[0].shape, ) for lv in self.levels),
             self.eval_rule.shape,
+            self.n_num_attrs,
+            self.n_rel_slots,
+            tuple(self.rel_bits.shape) if self.rel_bits is not None else (),
+            bool(self.ovf_assist),
         )
 
     def shape_targets(self) -> ShapeTargets:
@@ -291,6 +370,12 @@ class CompiledPolicy:
             n_byte_attrs=self.n_byte_attrs,
             n_dfa_tables=int(self.dfa_tables.shape[0]),
             n_configs=self.n_configs,
+            n_num_attrs=self.n_num_attrs,
+            n_rel_slots=self.n_rel_slots,
+            n_rel_rows=int(self.rel_bits.shape[0])
+            if self.rel_bits is not None else 1,
+            n_rel_width=int(self.rel_bits.shape[1])
+            if self.rel_bits is not None else 1,
         )
 
 
@@ -346,8 +431,39 @@ class _Lowerer:
             self.attrs[selector] = i
         return i
 
+    def lower_relation_leaf(self, g: InGroup) -> int:
+        """Hierarchical-membership leaf: one atom per (selector, closure,
+        group), deduped across configs — configs declaring identical edge
+        sets share one compiled relation table (closure digest identity)."""
+        attr = self.attr_idx(g.selector)
+        key = (OP_RELATION, attr, 0, f"{g.relation.digest}:{g.group}")
+        idx = self.leaf_dedupe.get(key)
+        if idx is None:
+            idx = len(self.leaves)
+            self.leaves.append(_Leaf(op=OP_RELATION, attr=attr, const=0,
+                                     rel=g.relation, group=g.group))
+            self.leaf_dedupe[key] = idx
+        buf = _LEAF_BASE + idx
+        self.depth_of[buf] = 0
+        return buf
+
     def lower_leaf(self, p: Pattern) -> int:
         attr = self.attr_idx(p.selector)
+        if p.operator in NUMERIC_OPERATORS:
+            # constant folded + int32-bounded at Pattern construction;
+            # unfoldable constants never reach here (_has_invalid_regex
+            # routes the whole tree to the CPU oracle)
+            key = (_NUM_OP_OF[p.operator], attr,
+                   int(p._num_const), None)  # type: ignore[attr-defined]
+            idx = self.leaf_dedupe.get(key)
+            if idx is None:
+                idx = len(self.leaves)
+                self.leaves.append(
+                    _Leaf(op=key[0], attr=attr, const=key[2]))
+                self.leaf_dedupe[key] = idx
+            buf = _LEAF_BASE + idx
+            self.depth_of[buf] = 0
+            return buf
         if p.operator is Operator.MATCHES:
             rx = getattr(p, "_regex", None)
             if rx is None:
@@ -395,6 +511,8 @@ class _Lowerer:
             return self.lower_tree_cpu(expr)
         if isinstance(expr, Pattern):
             return self.lower_leaf(expr)
+        if isinstance(expr, InGroup):
+            return self.lower_relation_leaf(expr)
         is_and = isinstance(expr, And)
         children = [self.lower(c) for c in expr.children]
         if not children:
@@ -423,6 +541,7 @@ def compile_corpus(
     interner: Optional[StringInterner] = None,
     enable_dfa: bool = True,
     dfa_cache: Optional[Dict[str, Any]] = None,
+    ovf_assist: Optional[bool] = None,
 ) -> CompiledPolicy:
     """Compile all configs' pattern rules into one CompiledPolicy.
 
@@ -430,7 +549,18 @@ def compile_corpus(
     byte axes, so tensor-parallel shards stack uniformly (must dominate the
     natural shapes); ``interner`` lets shards share one global string table;
     ``enable_dfa=False`` routes all regexes to the CPU lane (tests and manual
-    fallback — the sharded model rides the device DFA lane by default)."""
+    fallback — the sharded model rides the device DFA lane by default).
+
+    ``ovf_assist`` (ISSUE 14; default off, env AUTHORINO_TPU_OVF_ASSIST=1)
+    keeps membership-overflow rows on the device lane: incl/excl leaves gain
+    dense CPU-assist columns carrying the encoder's exact per-leaf overflow
+    answers and the kernel selects them under the [B, M] overflow mask —
+    the cpu-grid-overflow lowerability caveat drops for assisted corpora.
+    Off by default so the host-fallback lane (the degrade backstop) keeps
+    its full test surface."""
+    if ovf_assist is None:
+        ovf_assist = os.environ.get(
+            "AUTHORINO_TPU_OVF_ASSIST", "") in ("1", "true", "yes")
     interner = interner if interner is not None else StringInterner()
     lw = _Lowerer(interner, members_k, enable_dfa=enable_dfa, dfa_cache=dfa_cache)
 
@@ -538,6 +668,16 @@ def compile_corpus(
     leaf_is_membership = np.zeros((Lp,), dtype=bool)
     leaf_dfa_row = np.zeros((Lp,), dtype=np.int32)
     dfa_rows: List[Tuple[int, Any]] = []  # (attr, DFA) per device-regex leaf
+    # relation lane registry (ISSUE 14): closure instances deduped by
+    # digest, (attr, instance) operand slots, (instance, group) columns
+    leaf_rel_slot = np.zeros((Lp,), dtype=np.int32)
+    leaf_rel_col = np.zeros((Lp,), dtype=np.int32)
+    rel_instances: List[RelationClosure] = []
+    rel_inst_idx: Dict[str, int] = {}
+    rel_slot_idx: Dict[Tuple[int, int], int] = {}
+    rel_slots_list: List[Tuple[int, int]] = []
+    rel_col_idx: Dict[Tuple[int, str], int] = {}
+    rel_col_names_list: List[Tuple[int, str]] = []
     for i, leaf in enumerate(lw.leaves):
         leaf_op[i] = leaf.op
         leaf_attr[i] = leaf.attr
@@ -550,6 +690,21 @@ def compile_corpus(
             dfa_rows.append((leaf.attr, lw._dfa_for(leaf.regex)))
         if leaf.op == OP_TREE_CPU:
             leaf_tree[i] = leaf.tree
+        if leaf.op == OP_RELATION:
+            inst = rel_inst_idx.get(leaf.rel.digest)
+            if inst is None:
+                inst = rel_inst_idx[leaf.rel.digest] = len(rel_instances)
+                rel_instances.append(leaf.rel)
+            slot = rel_slot_idx.get((leaf.attr, inst))
+            if slot is None:
+                slot = rel_slot_idx[(leaf.attr, inst)] = len(rel_slots_list)
+                rel_slots_list.append((leaf.attr, inst))
+            col = rel_col_idx.get((inst, leaf.group))
+            if col is None:
+                col = rel_col_idx[(inst, leaf.group)] = len(rel_col_names_list)
+                rel_col_names_list.append((inst, leaf.group))
+            leaf_rel_slot[i] = slot
+            leaf_rel_col[i] = col
 
     n_attrs = len(lw.attrs)
     Ap = _round_up(n_attrs) if pad else max(n_attrs, 1)
@@ -614,6 +769,64 @@ def compile_corpus(
     for sel, idx in lw.attrs.items():
         attr_selectors[idx] = sel
 
+    # 5b. numeric comparator lane: attrs with numeric leaves get compact
+    # [B, NN] value slots (the encoder parses the rendered value once per
+    # attr; the kernel compares int32 against the folded constants)
+    num_attr_slot = np.full((Ap,), -1, dtype=np.int32)
+    num_attrs_list: List[int] = []
+    for i in range(n_leaves):
+        if leaf_op[i] in NUMERIC_OPS:
+            a_i = int(leaf_attr[i])
+            if num_attr_slot[a_i] < 0:
+                num_attr_slot[a_i] = len(num_attrs_list)
+                num_attrs_list.append(a_i)
+    NN_real = len(num_attrs_list)
+    NN = NN_real
+    if targets is not None:
+        assert targets.n_num_attrs >= NN_real, "targets.n_num_attrs too small"
+        NN = targets.n_num_attrs
+    elif pad and NN_real:
+        NN = _round_up(NN_real, minimum=2)
+
+    # 5c. relation tables: close every instance's edges into the bitmatrix.
+    # Row 0 is the reserved all-zero row (unknown entities); each
+    # instance's entities occupy a contiguous row block.  Columns exist
+    # only for QUERIED (instance, group) pairs, so W tracks the policy
+    # surface, not the hierarchy size.
+    rel_entity_rows: List[Dict[str, int]] = []
+    next_row = 1
+    for rel in rel_instances:
+        rel_entity_rows.append(
+            {e: next_row + j for j, e in enumerate(rel.entities)})
+        next_row += len(rel.entities)
+    NR_real = len(rel_slots_list)
+    n_rel_cols = len(rel_col_names_list)
+    R_real = next_row
+    Rp = _round_up(R_real) if pad else max(R_real, 1)
+    W = max((n_rel_cols + 7) // 8, 1)
+    NRp = NR_real
+    if targets is not None:
+        assert targets.n_rel_slots >= NR_real, "targets.n_rel_slots too small"
+        assert targets.n_rel_rows >= R_real, "targets.n_rel_rows too small"
+        assert targets.n_rel_width >= W or not NR_real, \
+            "targets.n_rel_width too small"
+        NRp, Rp = targets.n_rel_slots, targets.n_rel_rows
+        W = max(W, targets.n_rel_width)
+    has_rel = NRp > 0
+    if has_rel:
+        rel_bits = np.zeros((Rp, W), dtype=np.uint8)
+        for c, (inst, group) in enumerate(rel_col_names_list):
+            closure = rel_instances[inst]
+            for entity, row in rel_entity_rows[inst].items():
+                if closure.contains(entity, group):
+                    rel_bits[row, c >> 3] |= np.uint8(1 << (c & 7))
+        rel_slot_attr = np.zeros((max(NRp, 1),), dtype=np.int32)
+        for s, (attr, _inst) in enumerate(rel_slots_list):
+            rel_slot_attr[s] = attr
+    else:
+        rel_bits = None
+        rel_slot_attr = np.zeros((1,), dtype=np.int32)
+
     # 6. per-config CPU metadata
     config_attrs: List[List[int]] = []
     config_cpu_leaves: List[List[int]] = []
@@ -626,6 +839,9 @@ def compile_corpus(
         if _has_invalid_regex(expr):
             # whole tree rode the CPU-fallback leaf; no attrs were lowered
             acc_cpu.add(lw.tree_leaf_by_expr[id(expr)])
+            return
+        if isinstance(expr, InGroup):
+            acc_attrs.add(lw.attrs[expr.selector])
             return
         if isinstance(expr, Pattern):
             attr = lw.attrs[expr.selector]
@@ -687,9 +903,13 @@ def compile_corpus(
     M = targets.n_member_attrs if targets is not None else max(len(member_attrs_list), 1)
     assert M >= max(len(member_attrs_list), 1), "targets.n_member_attrs too small"
 
+    # membership leaves join the dense assist columns under ovf_assist:
+    # their exact overflow answers (already computed by the encoder) travel
+    # to the device and the kernel selects them under the overflow mask
     cpu_leaf_list_: List[int] = [
         i for i in range(n_leaves)
         if leaf_op[i] in (OP_CPU, OP_TREE_CPU, OP_REGEX_DFA)
+        or (ovf_assist and leaf_op[i] in (OP_INCL, OP_EXCL))
     ]
     C = targets.n_cpu_leaves if targets is not None else max(len(cpu_leaf_list_), 1)
     assert C >= max(len(cpu_leaf_list_), 1), "targets.n_cpu_leaves too small"
@@ -726,4 +946,17 @@ def compile_corpus(
         config_exprs=[list(cfg.evaluators) for cfg in configs]
         + [[] for _ in range(Gp - n_configs)],
         config_cacheable=config_cacheable,
+        num_attr_slot=num_attr_slot,
+        num_attrs=np.asarray(num_attrs_list, dtype=np.int32),
+        n_num_attrs=NN,
+        rel_bits=rel_bits,
+        leaf_rel_slot=leaf_rel_slot,
+        leaf_rel_col=leaf_rel_col,
+        rel_slot_attr=rel_slot_attr,
+        n_rel_slots=NRp,
+        rel_instances=rel_instances,
+        rel_entity_rows=rel_entity_rows,
+        rel_slots=rel_slots_list,
+        rel_col_names=rel_col_names_list,
+        ovf_assist=bool(ovf_assist),
     )

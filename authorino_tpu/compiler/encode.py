@@ -20,6 +20,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..authjson import selector as sel
+from ..expressions.ast import parse_int_value
 from .compile import (
     DFA_VALUE_BYTES,
     OP_CPU,
@@ -44,6 +45,13 @@ class EncodedBatch:
     config_id: np.ndarray      # [B] int32
     attr_bytes: np.ndarray     # [B, NB, DFA_VALUE_BYTES] uint8 (device regex lane)
     byte_ovf: np.ndarray       # [B, NB] bool — value too long / has NUL → CPU lane
+    # numeric comparator lane (ISSUE 14): parsed int32 value + validity per
+    # compact numeric slot (None when the corpus has no numeric leaves)
+    attrs_num: Optional[np.ndarray] = None   # [B, NN] int32
+    num_valid: Optional[np.ndarray] = None   # [B, NN] bool
+    # relation lane (ISSUE 14): entity row per (attr, relation) slot — row
+    # 0 is the reserved empty row unknown entities resolve to
+    rel_rows: Optional[np.ndarray] = None    # [B, NR] int32
 
 
 _MISSING = object()
@@ -154,6 +162,14 @@ def encode_batch_py(
     attr_bytes = np.zeros((B, NB, DFA_VALUE_BYTES), dtype=np.uint8)
     byte_ovf = np.zeros((B, NB), dtype=bool)
     attr_byte_slot = policy.attr_byte_slot
+    # numeric + relation lanes (ISSUE 14) — inert (None) when absent
+    NN = int(getattr(policy, "n_num_attrs", 0) or 0)
+    num_attr_slot = policy.num_attr_slot if NN else None
+    attrs_num = np.zeros((B, NN), dtype=np.int32) if NN else None
+    num_valid = np.zeros((B, NN), dtype=bool) if NN else None
+    NR = int(getattr(policy, "n_rel_slots", 0) or 0)
+    rel_rows = np.zeros((B, NR), dtype=np.int32) if NR else None
+    rel_slots_of_attr = _rel_slots_of_attr(policy) if NR else None
 
     lookup = policy.interner.lookup
     resolvers = _fast_resolvers(policy)
@@ -203,6 +219,17 @@ def encode_batch_py(
                     byte_ovf_attrs.add(attr)
                 elif raw:
                     attr_bytes[r, slot, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+            if num_attr_slot is not None:
+                ns = num_attr_slot[attr]
+                if ns >= 0:
+                    nv = parse_int_value(rendered)
+                    if nv is not None:
+                        attrs_num[r, ns] = nv
+                        num_valid[r, ns] = True
+            if rel_slots_of_attr is not None:
+                for rs, inst in rel_slots_of_attr.get(attr, ()):
+                    rel_rows[r, rs] = policy.rel_entity_rows[inst].get(
+                        rendered, 0)
             # gjson Array(): list → elements; null/missing → []; scalar → [v]
             if isinstance(v, list):
                 for k, e in enumerate(v[:K]):
@@ -277,4 +304,20 @@ def encode_batch_py(
         config_id=config_id,
         attr_bytes=attr_bytes,
         byte_ovf=byte_ovf,
+        attrs_num=attrs_num,
+        num_valid=num_valid,
+        rel_rows=rel_rows,
     )
+
+
+def _rel_slots_of_attr(policy: CompiledPolicy):
+    """attr → [(slot, instance), ...] for the relation lane, cached on the
+    policy (the slot registry is frozen at compile time)."""
+    cached = getattr(policy, "_rel_slots_of_attr", None)
+    if cached is not None:
+        return cached
+    out: dict = {}
+    for slot, (attr, inst) in enumerate(policy.rel_slots or ()):
+        out.setdefault(int(attr), []).append((slot, int(inst)))
+    policy._rel_slots_of_attr = out  # type: ignore[attr-defined]
+    return out

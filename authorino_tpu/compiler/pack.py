@@ -56,6 +56,11 @@ class DeviceBatch:
     attr_bytes: Optional[np.ndarray]  # [B, NB, LB] uint8 (None: no DFA lane)
     byte_ovf: Optional[np.ndarray]    # [B, NB] bool
     host_fallback: np.ndarray  # [B] bool — HOST-side only, never transferred
+    # ISSUE 14 lanes (None when the corpus lacks them):
+    attrs_num: Optional[np.ndarray] = None   # [B, NN] int32 numeric values
+    num_valid: Optional[np.ndarray] = None   # [B, NN] bool
+    rel_rows: Optional[np.ndarray] = None    # [B, NR] int32 entity rows
+    member_ovf: Optional[np.ndarray] = None  # [B, M] bool (ovf_assist only)
 
 
 def wire_dtype(policy: CompiledPolicy):
@@ -130,9 +135,18 @@ def pack_batch(policy: CompiledPolicy, enc: EncodedBatch,
         cpu_dense = np.zeros((B, C), dtype=bool)
         cpu_dense[:, :c_real] = enc.cpu_lane[:, cpu_list]
 
-    # membership overflow on an attr the kernel reads → the compact form is
-    # lossy for this request; route it to the host oracle
-    host_fallback = enc.overflow[:, member_attrs].any(axis=1)
+    # membership overflow on an attr the kernel reads: without the assist
+    # the compact form is lossy for this request → host oracle; WITH the
+    # assist (ISSUE 14) the exact per-leaf answers ride the dense columns
+    # and the [B, M] overflow mask selects them in-kernel — no fallback
+    assist = bool(getattr(policy, "ovf_assist", False))
+    if assist:
+        host_fallback = np.zeros((B,), dtype=bool)
+        member_ovf = np.zeros((B, M), dtype=bool)
+        member_ovf[:, :m_real] = enc.overflow[:, member_attrs]
+    else:
+        host_fallback = enc.overflow[:, member_attrs].any(axis=1)
+        member_ovf = None
 
     has_dfa = policy.n_byte_attrs > 0
     return DeviceBatch(
@@ -144,6 +158,10 @@ def pack_batch(policy: CompiledPolicy, enc: EncodedBatch,
         if has_dfa else None,
         byte_ovf=enc.byte_ovf if has_dfa else None,
         host_fallback=host_fallback,
+        attrs_num=enc.attrs_num,
+        num_valid=enc.num_valid,
+        rel_rows=enc.rel_rows,
+        member_ovf=member_ovf,
     )
 
 
@@ -187,7 +205,8 @@ def batch_row_keys(db: DeviceBatch, n: int) -> List[bytes]:
     """Canonical row keys for one DeviceBatch (dedup + verdict-cache keys)."""
     return row_key_bytes(
         [db.config_id, db.attrs_val, db.members_c, db.cpu_dense,
-         db.attr_bytes, db.byte_ovf, db.host_fallback], n)
+         db.attr_bytes, db.byte_ovf, db.host_fallback,
+         db.attrs_num, db.num_valid, db.rel_rows, db.member_ovf], n)
 
 
 def select_rows(db: DeviceBatch, rows: Sequence[int],
@@ -209,7 +228,9 @@ def select_rows(db: DeviceBatch, rows: Sequence[int],
         attrs_val=take(db.attrs_val), members_c=take(db.members_c),
         cpu_dense=take(db.cpu_dense), config_id=take(db.config_id),
         attr_bytes=take(db.attr_bytes), byte_ovf=take(db.byte_ovf),
-        host_fallback=take(db.host_fallback))
+        host_fallback=take(db.host_fallback),
+        attrs_num=take(db.attrs_num), num_valid=take(db.num_valid),
+        rel_rows=take(db.rel_rows), member_ovf=take(db.member_ovf))
 
 
 def dedup_rows(keys: Sequence[bytes],

@@ -34,12 +34,15 @@ from ..evaluators.identity import APIKey, HMAC, KubernetesAuth, MTLS, Noop, OAut
 from ..evaluators.metadata import UMA, GenericHttp, UserInfo
 from ..evaluators.response import DynamicJSON, SigningKey, Wristband
 from ..evaluators.response import Plain as PlainResponse
-from ..expressions.ast import All, Any_, Expression, Operator, Pattern
+from ..expressions.ast import All, Any_, Expression, InGroup, Operator, Pattern
 from ..k8s.client import ClusterReader, LabelSelector
+from ..relations.closure import RelationClosure
+from ..relations.prefetch import mark_prefetchable
 from ..runtime.engine import EngineEntry, PolicyEngine
 from ..utils.oauth2cc import ClientCredentials
 
-__all__ = ["TranslationError", "translate_auth_config", "build_expression"]
+__all__ = ["TranslationError", "translate_auth_config", "build_expression",
+           "build_relations"]
 
 
 class TranslationError(Exception):
@@ -51,33 +54,66 @@ class TranslationError(Exception):
 # pattern expressions (ref :805 buildJSONExpression)
 # ---------------------------------------------------------------------------
 
-def _one_pattern(item: Dict[str, Any], named: Dict[str, List[dict]]) -> Expression:
+def _one_pattern(item: Dict[str, Any], named: Dict[str, List[dict]],
+                 relations: Optional[Dict[str, RelationClosure]] = None,
+                 ) -> Expression:
     if "patternRef" in item and item["patternRef"]:
         ref = item["patternRef"]
         patterns = named.get(ref)
         if patterns is None:
             raise TranslationError(f"referenced pattern not found: {ref!r}")
-        return All(*[_one_pattern(p, named) for p in patterns])
+        return All(*[_one_pattern(p, named, relations) for p in patterns])
     if item.get("all") is not None:
-        return All(*[_one_pattern(p, named) for p in item["all"]])
+        return All(*[_one_pattern(p, named, relations) for p in item["all"]])
     if item.get("any") is not None:
-        return Any_(*[_one_pattern(p, named) for p in item["any"]])
+        return Any_(*[_one_pattern(p, named, relations) for p in item["any"]])
     selector = item.get("selector", "")
     operator = item.get("operator", "")
     value = item.get("value", "")
     if not operator:
         raise TranslationError(f"invalid pattern expression: {item!r}")
+    if operator == "ingroup":
+        # hierarchical membership (ISSUE 14): `value` names the group,
+        # `relation` the spec.relations edge set whose ancestor closure
+        # decides it — compiled to an in-kernel bitmask gather
+        rel_name = item.get("relation", "")
+        closure = (relations or {}).get(rel_name)
+        if closure is None:
+            raise TranslationError(
+                f"pattern references unknown relation {rel_name!r} "
+                "(declare it under spec.relations)")
+        return InGroup(selector, str(value), closure)
     return Pattern(selector, Operator.from_string(operator), str(value))
 
 
 def build_expression(
-    items: Optional[List[dict]], named: Optional[Dict[str, List[dict]]] = None
+    items: Optional[List[dict]], named: Optional[Dict[str, List[dict]]] = None,
+    relations: Optional[Dict[str, RelationClosure]] = None,
 ) -> Optional[Expression]:
     """A `when`/patterns list is a logical AND of its items."""
     if not items:
         return None
     named = named or {}
-    return All(*[_one_pattern(i, named) for i in items])
+    return All(*[_one_pattern(i, named, relations) for i in items])
+
+
+def build_relations(spec: Optional[Dict[str, Any]],
+                    ) -> Dict[str, RelationClosure]:
+    """spec.relations → named ancestor closures (ISSUE 14).  Accepted
+    forms: {name: {"edges": [[child, parent], ...]}} or the bare edge
+    list.  Closure computation happens HERE, at reconcile time — request
+    evaluation only ever reads the precomputed table."""
+    out: Dict[str, RelationClosure] = {}
+    for rname, rspec in (spec or {}).items():
+        edges = rspec.get("edges") if isinstance(rspec, dict) else rspec
+        if not isinstance(edges, list) or any(
+                not isinstance(e, (list, tuple)) or len(e) != 2
+                for e in edges):
+            raise TranslationError(
+                f"relation {rname!r} must declare edges as "
+                "[[child, parent], ...]")
+        out[rname] = RelationClosure(edges)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -164,10 +200,11 @@ def _cache(spec: Optional[dict]) -> Optional[cache_mod.EvaluatorCache]:
     return cache_mod.EvaluatorCache(key, int(spec.get("ttl", 60) or 60))
 
 
-def _common(spec: dict, named: Dict[str, List[dict]]) -> dict:
+def _common(spec: dict, named: Dict[str, List[dict]],
+            relations: Optional[Dict[str, RelationClosure]] = None) -> dict:
     return {
         "priority": int(spec.get("priority", 0) or 0),
-        "conditions": build_expression(spec.get("when"), named),
+        "conditions": build_expression(spec.get("when"), named, relations),
         "cache": _cache(spec.get("cache")),
         "metrics": bool(spec.get("metrics", False)),
     }
@@ -218,9 +255,10 @@ async def translate_auth_config(
     """Returns the EngineEntry (runtime graph + compilable rules)."""
     cfg_id = f"{namespace}/{name}"
     named: Dict[str, List[dict]] = spec.get("patterns") or {}
+    relations = build_relations(spec.get("relations"))
     runtime = RuntimeAuthConfig(
         labels={"namespace": namespace, "name": name, **(labels or {})},
-        conditions=build_expression(spec.get("when"), named),
+        conditions=build_expression(spec.get("when"), named, relations),
     )
 
     oidc_by_name: Dict[str, OIDC] = {}
@@ -312,7 +350,7 @@ async def translate_auth_config(
                 type=etype,
                 credentials=creds,
                 extended_properties=extensions,
-                **_common(aspec, named),
+                **_common(aspec, named, relations),
             )
         )
 
@@ -344,14 +382,14 @@ async def translate_auth_config(
             etype = "METADATA_UMA"
         else:
             raise TranslationError(f"unknown metadata method for {md_name!r}")
-        runtime.metadata.append(MetadataConfig(md_name, ev, type=etype, **_common(mspec, named)))
+        runtime.metadata.append(MetadataConfig(md_name, ev, type=etype, **_common(mspec, named, relations)))
 
     # ---- authorization (ref :367-455) ----
     pattern_slots: List[Tuple[Optional[Expression], Expression]] = []
     for az_name, azspec in (spec.get("authorization") or {}).items():
-        common = _common(azspec, named)
+        common = _common(azspec, named, relations)
         if azspec.get("patternMatching") is not None:
-            rules = build_expression(azspec["patternMatching"].get("patterns"), named)
+            rules = build_expression(azspec["patternMatching"].get("patterns"), named, relations)
             if rules is None:
                 rules = All()
             slot = len(pattern_slots)
@@ -472,7 +510,7 @@ async def translate_auth_config(
     runtime.deny_with = deny_with
 
     async def build_success(resp_name: str, rspec: dict, wrapper: str) -> ResponseConfig:
-        common = _common(rspec, named)
+        common = _common(rspec, named, relations)
         if rspec.get("wristband") is not None:
             w = rspec["wristband"]
             signing_keys: List[SigningKey] = []
@@ -525,8 +563,15 @@ async def translate_auth_config(
             raise TranslationError(f"unknown callback method for {cb_name!r}")
         ev = await _build_generic_http(cbspec["http"], namespace, cluster)
         runtime.callbacks.append(
-            CallbackConfig(cb_name, ev, type="CALLBACK_HTTP", **_common(cbspec, named))
+            CallbackConfig(cb_name, ev, type="CALLBACK_HTTP", **_common(cbspec, named, relations))
         )
+
+    # metadata prefetchability (ISSUE 14): request-independent metadata
+    # evaluators are marked here so the engine's prefetcher can pin their
+    # documents at reconcile cadence and the lowerability classifier can
+    # lift the config out of the metadata-dependency exile
+    for md in runtime.metadata:
+        mark_prefetchable(md)
 
     hosts = list(spec.get("hosts") or [])
     if not hosts:

@@ -98,6 +98,23 @@ _STRING_MAPS = (
     ("context", "context_extensions"),
 )
 
+# input paths that are provably INTEGERS when present (ISSUE 14: the
+# numeric-comparator fragment).  True = always set in the wellknown doc.
+# Soundness of lowering `input.<path> <op> <int const>` to a numeric
+# Pattern: present → both sides compare the same integer (gjson renders an
+# int as its decimal string; parse_int_value restores it exactly);
+# missing → Rego undefined (body fails, False) and the pattern parses ""
+# as non-numeric (False).  Non-integer values cannot occur on these paths
+# (the wellknown builder types them), so the interpreter's
+# TypeError-→False cross-type branch is never reachable — no other path
+# qualifies: a string-valued selector compares False in Rego but
+# numerically in the pattern once it happens to render as digits.
+_INT_SCALARS = {
+    ("request", "size"): True,
+    ("source", "port"): False,        # peer dicts filter falsy fields
+    ("destination", "port"): False,
+}
+
 # selector path segments must survive the gjson-ish selector parser
 # unmangled: dots/pipes/hashes/escapes would change the parse
 _SAFE_KEY = re.compile(r"^[A-Za-z0-9_:\-]+$")
@@ -128,6 +145,107 @@ def _const_str(term: Any) -> Optional[str]:
     if isinstance(term, rego.Const) and isinstance(term.value, str):
         return term.value
     return None
+
+
+_INT32 = 1 << 31
+
+
+def _const_int(term: Any) -> Optional[int]:
+    """An int Const STRICTLY inside the numeric lane's int32 bound (the
+    open range matches parse_int_const: values saturate to the closed
+    endpoints, so a constant AT an endpoint would make the saturated
+    compare diverge from the interpreter's true-magnitude compare; bools
+    are int subclasses in Python and must not qualify; constant
+    arithmetic is already folded to Const by the parser's _fold_const)."""
+    if isinstance(term, rego.Const) and isinstance(term.value, int) \
+            and not isinstance(term.value, bool) \
+            and -_INT32 < term.value < _INT32 - 1:
+        return term.value
+    return None
+
+
+def _int_ref_selector(term: Any) -> Optional[Tuple[str, bool]]:
+    """(selector, always_present) for an input Ref that is provably an
+    INTEGER when present (_INT_SCALARS), else None."""
+    if not isinstance(term, rego.Ref) or term.base != "input":
+        return None
+    keys: List[str] = []
+    for seg in term.path:
+        if isinstance(seg, rego.Const):
+            seg = seg.value
+        if not isinstance(seg, str) or not _SAFE_KEY.match(seg):
+            return None
+        keys.append(seg)
+    t = tuple(keys)
+    if t in _INT_SCALARS:
+        return ".".join(keys), _INT_SCALARS[t]
+    return None
+
+
+# rego comparison op (with the ref on the LEFT) → numeric pattern operator
+_NUM_OPS = {"<": Operator.LT, "<=": Operator.LE,
+            ">": Operator.GT, ">=": Operator.GE}
+_NUM_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+# negation under DEFINED operands: not (x < c) ≡ x >= c
+_NUM_NEG = {"<": Operator.GE, "<=": Operator.GT,
+            ">": Operator.LE, ">=": Operator.LT}
+
+
+def _normalize_num_cmp(expr: Any) -> Optional[Tuple[str, bool, str, int]]:
+    """(selector, always_present, rego op with ref-on-left, const) for a
+    BinExpr comparing a provably-int input Ref against an int Const —
+    either operand order — else None."""
+    if not isinstance(expr, rego.BinExpr):
+        return None
+    op = "==" if expr.op == "=" else expr.op
+    if op not in ("==", "!=", "<", "<=", ">", ">="):
+        return None
+    left, right = expr.left, expr.right
+    c = _const_int(right)
+    if c is None:
+        c = _const_int(left)
+        if c is None:
+            return None
+        left = expr.right
+        if op in _NUM_FLIP:
+            op = _NUM_FLIP[op]
+    ref = _int_ref_selector(left)
+    if ref is None:
+        return None
+    return ref[0], ref[1], op, c
+
+
+def _lower_num_cmp(norm: Tuple[str, bool, str, int],
+                   negated: bool = False) -> Optional[Pattern]:
+    """Numeric fragment (ISSUE 14): comparisons of provably-int selectors
+    lower into the kernel's int32 comparator lane.  Soundness table in
+    _INT_SCALARS; the subtle rows are missing-key ones:
+
+      <,<=,>,>=   missing → Rego undefined (False) and the pattern parses
+                  "" as non-numeric (False) — sound even maybe-missing.
+      ==          sound maybe-missing: "" == "c" is False for c != "".
+      !=          present-only (missing: Rego False, pattern "" != c True).
+      not (cmp)   present-only: the inner undefined flips to True in Rego
+                  but every numeric pattern reads False on "".
+    """
+    sel, present, op, c = norm
+    if negated:
+        if not present:
+            return None
+        if op in _NUM_NEG:
+            return Pattern(sel, _NUM_NEG[op], str(c))
+        if op == "==":
+            return Pattern(sel, Operator.NEQ, str(c))
+        return Pattern(sel, Operator.EQ, str(c))  # not (x != c)
+    if op in _NUM_OPS:
+        return Pattern(sel, _NUM_OPS[op], str(c))
+    if op == "==":
+        # rendered-string equality IS int equality for int-typed paths
+        # (gjson renders ints as their decimal form); missing-safe
+        return Pattern(sel, Operator.EQ, str(c))
+    if not present:
+        return None
+    return Pattern(sel, Operator.NEQ, str(c))
 
 
 def _regex_rejects_empty(pattern: str) -> Optional[bool]:
@@ -168,11 +286,25 @@ def _lower_expr(expr: Any) -> Optional[Optional[Pattern]]:
         if expr.value is False:
             return False
         return None
-    if isinstance(expr, rego.BinExpr) and expr.op in ("==", "!=", "="):
+    if isinstance(expr, rego.BinExpr) and \
+            expr.op in ("==", "!=", "=", "<", "<=", ">", ">="):
         if isinstance(expr.left, rego.Const) and isinstance(expr.right, rego.Const):
-            # static: Python equality IS the interpreter's == (rego._compare)
-            eq = expr.left.value == expr.right.value
-            return eq if expr.op != "!=" else not eq
+            # static: Python semantics ARE the interpreter's (_compare,
+            # incl. the TypeError-→False cross-type branch)
+            a, b = expr.left.value, expr.right.value
+            op0 = "==" if expr.op == "=" else expr.op
+            try:
+                got = {"==": lambda: a == b, "!=": lambda: a != b,
+                       "<": lambda: a < b, "<=": lambda: a <= b,
+                       ">": lambda: a > b, ">=": lambda: a >= b}[op0]()
+            except TypeError:
+                got = False
+            return bool(got)
+        nnorm = _normalize_num_cmp(expr)
+        if nnorm is not None:
+            return _lower_num_cmp(nnorm)
+        if expr.op not in ("==", "!=", "="):
+            return None  # ordered comparison outside the int fragment
         norm = _normalize_cmp(expr)
         if norm is None:
             return None
@@ -186,6 +318,9 @@ def _lower_expr(expr: Any) -> Optional[Optional[Pattern]]:
             return None
         return Pattern(sel, Operator.NEQ, want)
     if isinstance(expr, rego.NotExpr):
+        nnorm = _normalize_num_cmp(expr.expr)
+        if nnorm is not None:
+            return _lower_num_cmp(nnorm, negated=True)
         norm = _normalize_cmp(expr.expr)
         if norm is None:
             return None

@@ -5,6 +5,7 @@ from .ast import (  # noqa: F401
     And,
     Any_,
     Expression,
+    InGroup,
     Operator,
     Or,
     Pattern,

@@ -117,6 +117,8 @@ class PolicyModel:
         return self.encode([json.loads(pt) for pt in parts], config_rows, batch_pad)
 
     def apply(self, db: DeviceBatch) -> Tuple[np.ndarray, np.ndarray]:
+        from ..ops.pattern_eval import _extra_operands
+
         has_dfa = self.params["dfa_tables"] is not None
         own, verdict = self._apply(
             self.params,
@@ -126,6 +128,7 @@ class PolicyModel:
             jnp.asarray(db.config_id),
             jnp.asarray(db.attr_bytes) if has_dfa else None,
             jnp.asarray(db.byte_ovf) if has_dfa else None,
+            *_extra_operands(db),
         )
         return np.asarray(own), np.asarray(verdict)
 
@@ -157,6 +160,8 @@ class PolicyModel:
             full = np.zeros(attr_bytes.shape[:-1] + (DFA_VALUE_BYTES,), dtype=np.uint8)
             full[..., : attr_bytes.shape[-1]] = attr_bytes
             attr_bytes = full
+        from ..ops.pattern_eval import _extra_operands
+
         args = (
             self.params,
             jnp.asarray(db.attrs_val),
@@ -165,5 +170,6 @@ class PolicyModel:
             jnp.asarray(db.config_id),
             jnp.asarray(attr_bytes) if has_dfa else None,
             jnp.asarray(db.byte_ovf) if has_dfa else None,
+            *_extra_operands(db),
         )
         return forward, args

@@ -353,6 +353,15 @@ def get_native_encoder(policy: CompiledPolicy) -> Optional[NativeEncoder]:
     cached = getattr(policy, "_native_encoder", None)
     if cached is not None:
         return cached if cached is not False else None
+    if (int(getattr(policy, "n_num_attrs", 0) or 0)
+            or int(getattr(policy, "n_rel_slots", 0) or 0)
+            or getattr(policy, "ovf_assist", False)):
+        # the C encoder predates the numeric/relation lanes and the
+        # overflow assist (ISSUE 14): corpora using them fall back to the
+        # Python encoder until encoder.cpp learns the new operands —
+        # exactness over speed, never a partially-filled batch
+        policy._native_encoder = False  # type: ignore[attr-defined]
+        return None
     from . import load_library
 
     mod = load_library()

@@ -59,13 +59,19 @@ import numpy as np
 
 from ..compiler.compile import (
     FALSE_SLOT,
+    NUMERIC_OPS,
     OP_CPU,
     OP_EQ,
     OP_ERROR,
     OP_EXCL,
     OP_INCL,
     OP_NEQ,
+    OP_NUM_GE,
+    OP_NUM_GT,
+    OP_NUM_LE,
+    OP_NUM_LT,
     OP_REGEX_DFA,
+    OP_RELATION,
     OP_TREE_CPU,
     TRUE_SLOT,
     CompiledPolicy,
@@ -149,6 +155,36 @@ def _matmul_operands(policy: CompiledPolicy, row_slot: np.ndarray, device=None) 
         "rule_m": rule_m.astype(cdt),
         "cond_m": cond_m.astype(cdt),
     }
+
+    # numeric lane (ISSUE 14): int32 compares happen slot-wise (exact, no
+    # f32 round-trip for the values); this bool mask spreads each slot's
+    # verdict onto its leaves via a masked any-reduce — gather-free
+    if getattr(policy, "n_num_attrs", 0):
+        NN = policy.n_num_attrs
+        num_mask = np.zeros((NN, L), dtype=bool)
+        is_num = np.isin(policy.leaf_op, NUMERIC_OPS)
+        if is_num.any():
+            slots = np.maximum(
+                policy.num_attr_slot[policy.leaf_attr[is_num]], 0)
+            num_mask[slots, np.nonzero(is_num)[0]] = True
+        out["num_slot_leaf_mask"] = num_mask
+
+    # relation lane (ISSUE 14): per (entity row, leaf) bit matrix — each
+    # relation leaf's column unpacked onto its leaf slot, selected by an
+    # exact one-hot over the row axis (0/1 products: exact in bf16)
+    if getattr(policy, "n_rel_slots", 0):
+        Rp = int(policy.rel_bits.shape[0])
+        NR = policy.n_rel_slots
+        is_rel = policy.leaf_op == OP_RELATION
+        rel_leaf_mat = np.zeros((Rp, L), dtype=np.float32)
+        rel_slot_leaf = np.zeros((NR, L), dtype=np.float32)
+        for l in np.nonzero(is_rel)[0]:
+            c = int(policy.leaf_rel_col[l])
+            rel_leaf_mat[:, l] = (policy.rel_bits[:, c >> 3]
+                                  >> np.uint8(c & 7)) & 1
+            rel_slot_leaf[int(policy.leaf_rel_slot[l]), l] = 1.0
+        out["rel_leaf_mat"] = rel_leaf_mat.astype(cdt)
+        out["rel_slot_leaf_oh"] = rel_slot_leaf.astype(cdt)
 
     # device regex lane: matmul-form transition tables + spread one-hots.
     # The compiled tables are table-deduped ([T, S, 256] + row→table map);
@@ -241,6 +277,19 @@ def to_device(policy: CompiledPolicy, device=None, lane: Optional[str] = None,
         if policy.n_byte_attrs else None,
         "dfa_byte_slot": put(dfa_byte_slot.astype(np.int32)) if policy.n_byte_attrs else None,
         "leaf_dfa_row": put(policy.leaf_dfa_row) if policy.n_byte_attrs else None,
+        # numeric comparator lane (ISSUE 14): leaf → compact value slot;
+        # the constants ride leaf_const (folded int32 at compile time)
+        "leaf_num_slot": put(np.maximum(
+            policy.num_attr_slot[policy.leaf_attr], 0).astype(np.int32))
+        if getattr(policy, "n_num_attrs", 0) else None,
+        # relation lane (ISSUE 14): the per-snapshot closure bitmatrix +
+        # leaf → (entity-row slot, group column) bindings
+        "rel_bits": put(policy.rel_bits)
+        if getattr(policy, "n_rel_slots", 0) else None,
+        "leaf_rel_slot": put(policy.leaf_rel_slot)
+        if getattr(policy, "n_rel_slots", 0) else None,
+        "leaf_rel_col": put(policy.leaf_rel_col)
+        if getattr(policy, "n_rel_slots", 0) else None,
     }
 
 
@@ -256,23 +305,48 @@ def _cpu_full(params, cpu_dense):
     return buf[:, :L]
 
 
-def _leaf_op_cascade(leaf_op, eq, incl, dfa_leaf_val, cpu_lane):
+def _leaf_op_cascade(leaf_op, eq, incl, dfa_leaf_val, cpu_lane,
+                     num_cmp=None, rel_res=None, leaf_movf=None):
     """Shared op-code dispatch: per-leaf boolean results from the lane's
-    primitive comparisons (identical semantics in both lanes)."""
+    primitive comparisons (identical semantics in both lanes).
+
+    ``num_cmp`` is the numeric lane's (gt, ge, lt, le) [B, L] quadruple
+    (None: no numeric leaves); ``rel_res`` the relation lane's [B, L]
+    bitmask-gather result; ``leaf_movf`` the membership-overflow mask
+    spread to the leaf axis (ovf_assist): overflowed incl/excl leaves read
+    their exact precomputed answer from the dense CPU columns — note the
+    EXCL branch reads ``cpu_lane`` directly (the encoder stores the final
+    excl answer, not the membership bit)."""
     op = leaf_op[None, :]
+    if leaf_movf is None:
+        incl_eff, excl_eff = incl, ~incl
+    else:
+        incl_eff = jnp.where(leaf_movf, cpu_lane, incl)
+        excl_eff = jnp.where(leaf_movf, cpu_lane, ~incl)
+    if num_cmp is None:
+        num_res = False
+    else:
+        gt, ge, lt, le = num_cmp
+        num_res = jnp.where(
+            op == OP_NUM_GT, gt,
+            jnp.where(op == OP_NUM_GE, ge,
+                      jnp.where(op == OP_NUM_LT, lt, le)))
+    tail = jnp.where(
+        (op == OP_CPU) | (op == OP_TREE_CPU), cpu_lane,
+        jnp.where(op >= OP_NUM_GT,
+                  jnp.where(op == OP_RELATION,
+                            rel_res if rel_res is not None else False,
+                            num_res),
+                  False))  # OP_ERROR → False
     return jnp.where(
         op == OP_EQ, eq,
         jnp.where(
             op == OP_NEQ, ~eq,
             jnp.where(
-                op == OP_INCL, incl,
+                op == OP_INCL, incl_eff,
                 jnp.where(
-                    op == OP_EXCL, ~incl,
-                    jnp.where(
-                        op == OP_REGEX_DFA, dfa_leaf_val,
-                        # OP_CPU (regex) and OP_TREE_CPU ride the lane; OP_ERROR → False
-                        jnp.where((op == OP_CPU) | (op == OP_TREE_CPU), cpu_lane, False),
-                    ),
+                    op == OP_EXCL, excl_eff,
+                    jnp.where(op == OP_REGEX_DFA, dfa_leaf_val, tail),
                 ),
             ),
         ),
@@ -293,7 +367,8 @@ def _verdict_from_tables(params, cond, rule):
 
 
 def _eval_verdicts_matmul(params, attrs_val, members_c, cpu_dense,
-                          attr_bytes, byte_ovf):
+                          attr_bytes, byte_ovf, attrs_num=None,
+                          num_valid=None, rel_rows=None, member_ovf=None):
     mm = params["matmul"]
     f32 = jnp.float32
     cdt = mm["rule_m"].dtype
@@ -357,7 +432,45 @@ def _eval_verdicts_matmul(params, attrs_val, members_c, cpu_dense,
     else:
         dfa_leaf_val = cpu_lane  # regexes ride the CPU lane entirely
 
-    res = _leaf_op_cascade(params["leaf_op"], eq, incl, dfa_leaf_val, cpu_lane)
+    # ---- numeric lane: slot-wise int32 compares, mask-spread (no gather,
+    # no f32 round-trip of the values — exactness by construction) --------
+    num_cmp = None
+    if params.get("leaf_num_slot") is not None and attrs_num is not None:
+        num_mask = mm["num_slot_leaf_mask"]                  # [NN, L] bool
+        iconst = params["leaf_const"][None, None, :]         # [1, 1, L] i32
+        v = attrs_num[:, :, None]                            # [B, NN, 1]
+        lane_ok = num_valid[:, :, None] & num_mask[None]     # [B, NN, L]
+        num_cmp = (
+            jnp.any(lane_ok & (v > iconst), axis=1),
+            jnp.any(lane_ok & (v >= iconst), axis=1),
+            jnp.any(lane_ok & (v < iconst), axis=1),
+            jnp.any(lane_ok & (v <= iconst), axis=1),
+        )
+
+    # ---- relation lane: exact one-hot row selection per slot over the
+    # unpacked per-leaf column matrix (0/1 products: exact) ---------------
+    rel_res = None
+    if params.get("rel_bits") is not None and rel_rows is not None:
+        rel_mat = mm["rel_leaf_mat"]                         # [Rp, L]
+        Rp = rel_mat.shape[0]
+        iota_r = jnp.arange(Rp, dtype=f32)
+        acc = jnp.zeros((B, rel_mat.shape[1]), dtype=f32)
+        for n_i in range(mm["rel_slot_leaf_oh"].shape[0]):   # static, small
+            oh = (rel_rows[:, n_i].astype(f32)[:, None]
+                  == iota_r[None, :]).astype(cdt)            # [B, Rp]
+            vals = jnp.matmul(oh, rel_mat, preferred_element_type=f32)
+            acc = acc + vals * mm["rel_slot_leaf_oh"][n_i][None, :].astype(f32)
+        rel_res = acc > 0.5
+
+    # ---- membership-overflow assist: spread the [B, M] mask to leaves ---
+    leaf_movf = None
+    if member_ovf is not None:
+        leaf_movf = jnp.matmul(
+            member_ovf.astype(cdt), mm["memb_onehot"].astype(cdt),
+            preferred_element_type=f32) > 0.5                # [B, L]
+
+    res = _leaf_op_cascade(params["leaf_op"], eq, incl, dfa_leaf_val,
+                           cpu_lane, num_cmp, rel_res, leaf_movf)
 
     # ---- boolean circuit: per-level count matmuls ------------------------
     true_col = jnp.ones((B, 1), dtype=bool)
@@ -387,7 +500,8 @@ def _eval_verdicts_matmul(params, attrs_val, members_c, cpu_dense,
 
 
 def _eval_verdicts_gather(params, attrs_val, members_c, cpu_dense,
-                          attr_bytes, byte_ovf):
+                          attr_bytes, byte_ovf, attrs_num=None,
+                          num_valid=None, rel_rows=None, member_ovf=None):
     leaf_op = params["leaf_op"]          # [L]
     leaf_attr = params["leaf_attr"]      # [L]
     leaf_const = params["leaf_const"]    # [L]
@@ -425,7 +539,32 @@ def _eval_verdicts_gather(params, attrs_val, members_c, cpu_dense,
     else:
         dfa_leaf_val = cpu_lane  # regexes ride the CPU lane entirely
 
-    res = _leaf_op_cascade(leaf_op, eq, incl, dfa_leaf_val, cpu_lane)
+    # ---- numeric lane: gather each leaf's slot value, compare int32 ------
+    num_cmp = None
+    if params.get("leaf_num_slot") is not None and attrs_num is not None:
+        lv = jnp.take(attrs_num, params["leaf_num_slot"], axis=1)    # [B, L]
+        lok = jnp.take(num_valid, params["leaf_num_slot"], axis=1)
+        ic = leaf_const[None, :]
+        num_cmp = (lok & (lv > ic), lok & (lv >= ic),
+                   lok & (lv < ic), lok & (lv <= ic))
+
+    # ---- relation lane: bitmask gather through (entity row, group col) ---
+    rel_res = None
+    if params.get("rel_bits") is not None and rel_rows is not None:
+        rows_l = jnp.take(rel_rows, params["leaf_rel_slot"], axis=1)  # [B, L]
+        col = params["leaf_rel_col"]                                  # [L]
+        byte = params["rel_bits"][rows_l, (col >> 3)[None, :]].astype(
+            jnp.int32)                                                # [B, L]
+        rel_res = ((byte >> (col & 7)[None, :]) & 1) != 0
+
+    # ---- membership-overflow assist ---------------------------------------
+    leaf_movf = None
+    if member_ovf is not None:
+        leaf_movf = jnp.take(member_ovf, params["member_slot_of_leaf"],
+                             axis=1)                                  # [B, L]
+
+    res = _leaf_op_cascade(leaf_op, eq, incl, dfa_leaf_val, cpu_lane,
+                           num_cmp, rel_res, leaf_movf)
 
     # ---- boolean-circuit reduction, level by level -----------------------
     true_col = jnp.ones((B, 1), dtype=bool)
@@ -453,6 +592,10 @@ def eval_verdicts(
     cpu_dense: jnp.ndarray,      # [B, C] bool (dense CPU lane)
     attr_bytes: Optional[jnp.ndarray] = None,  # [B, NB, LB] uint8
     byte_ovf: Optional[jnp.ndarray] = None,    # [B, NB] bool
+    attrs_num: Optional[jnp.ndarray] = None,   # [B, NN] int32 (numeric lane)
+    num_valid: Optional[jnp.ndarray] = None,   # [B, NN] bool
+    rel_rows: Optional[jnp.ndarray] = None,    # [B, NR] int32 (relation lane)
+    member_ovf: Optional[jnp.ndarray] = None,  # [B, M] bool (ovf_assist)
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """Returns (verdict [B, G] bool, (rule_results [B, G, E], skipped [B, G, E]))."""
     # ids travel as int16 when the interner fits (compiler/pack.py
@@ -463,10 +606,12 @@ def eval_verdicts(
         members_c = members_c.astype(jnp.int32)
     if params.get("matmul") is not None:
         return _eval_verdicts_matmul(
-            params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf
+            params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf,
+            attrs_num, num_valid, rel_rows, member_ovf
         )
     return _eval_verdicts_gather(
-        params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf
+        params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf,
+        attrs_num, num_valid, rel_rows, member_ovf
     )
 
 
@@ -477,12 +622,14 @@ def _select_own(config_id: jnp.ndarray, n_configs: int) -> jnp.ndarray:
 
 
 def forward(params, attrs_val, members_c, cpu_dense, config_id,
-            attr_bytes=None, byte_ovf=None):
+            attr_bytes=None, byte_ovf=None, attrs_num=None, num_valid=None,
+            rel_rows=None, member_ovf=None):
     """Canonical forward step: encoded micro-batch → (own verdicts [B],
     full verdict matrix [B, G]).  The single source of truth for
     verdict-selection logic (PolicyModel and the engine both use it)."""
     verdict, _ = eval_verdicts(
-        params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf
+        params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf,
+        attrs_num, num_valid, rel_rows, member_ovf
     )
     own_mask = _select_own(config_id, verdict.shape[1])
     own = jnp.any(verdict & own_mask, axis=1)
@@ -494,12 +641,14 @@ _eval_jit = jax.jit(forward)
 
 @partial(jax.jit, static_argnames=())
 def eval_full_jit(params, attrs_val, members_c, cpu_dense, config_id,
-                  attr_bytes=None, byte_ovf=None):
+                  attr_bytes=None, byte_ovf=None, attrs_num=None,
+                  num_valid=None, rel_rows=None, member_ovf=None):
     """Like _eval_jit but also returns each request's own per-evaluator rule
     results + skipped flags [B, E] — what the pipeline's batched
     pattern-matching evaluators consume (runtime/engine.py)."""
     verdict, (rule, skipped) = eval_verdicts(
-        params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf
+        params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf,
+        attrs_num, num_valid, rel_rows, member_ovf
     )
     own_mask = _select_own(config_id, verdict.shape[1])
     own = jnp.any(verdict & own_mask, axis=1)
@@ -510,13 +659,15 @@ def eval_full_jit(params, attrs_val, members_c, cpu_dense, config_id,
 
 @partial(jax.jit, static_argnames=())
 def eval_packed_jit(params, attrs_val, members_c, cpu_dense, config_id,
-                    attr_bytes=None, byte_ovf=None):
+                    attr_bytes=None, byte_ovf=None, attrs_num=None,
+                    num_valid=None, rel_rows=None, member_ovf=None):
     """Hot-path variant: one packed [B, 1+2E] bool result (own verdict,
     own rule results, own skipped) so the device→host read is a single
     small transfer — the link's round-trip latency dominates the batch
     budget, so one readback per batch is the contract."""
     own, own_rule, own_skipped = eval_full_jit(
-        params, attrs_val, members_c, cpu_dense, config_id, attr_bytes, byte_ovf
+        params, attrs_val, members_c, cpu_dense, config_id, attr_bytes,
+        byte_ovf, attrs_num, num_valid, rel_rows, member_ovf
     )
     return jnp.concatenate([own[:, None], own_rule, own_skipped], axis=1)
 
@@ -588,12 +739,21 @@ def unpack_attribution(packed, n_evaluators: int):
 
 @partial(jax.jit, static_argnames=())
 def eval_bitpacked_jit(params, attrs_val, members_c, cpu_dense, config_id,
-                       attr_bytes=None, byte_ovf=None):
+                       attr_bytes=None, byte_ovf=None, attrs_num=None,
+                       num_valid=None, rel_rows=None, member_ovf=None):
     """eval_packed_jit with the result bit-packed on device: the D2H
     readback is [B, ceil((1+2E)/8)] uint8 instead of [B, 1+2E] bool."""
     return _bitpack_rows(eval_packed_jit(
         params, attrs_val, members_c, cpu_dense, config_id,
-        attr_bytes, byte_ovf))
+        attr_bytes, byte_ovf, attrs_num, num_valid, rel_rows, member_ovf))
+
+
+def _extra_operands(db) -> tuple:
+    """The ISSUE 14 operand tail of one DeviceBatch, as jnp arrays (None
+    entries stay None — structural, like the DFA lane)."""
+    return tuple(
+        jnp.asarray(a) if a is not None else None
+        for a in (db.attrs_num, db.num_valid, db.rel_rows, db.member_ovf))
 
 
 def dispatch_packed(params, db, bitpack: bool = False) -> "jax.Array":
@@ -612,6 +772,7 @@ def dispatch_packed(params, db, bitpack: bool = False) -> "jax.Array":
         jnp.asarray(db.config_id),
         jnp.asarray(db.attr_bytes) if has_dfa else None,
         jnp.asarray(db.byte_ovf) if has_dfa else None,
+        *_extra_operands(db),
     )
 
 
@@ -632,7 +793,8 @@ def dispatch_packed(params, db, bitpack: bool = False) -> "jax.Array":
 # per-operand transfers if the backend disagrees (big-endian hosts).
 
 _FUSED_FIELDS = ("attrs_val", "members_c", "cpu_dense", "config_id",
-                 "attr_bytes", "byte_ovf")
+                 "attr_bytes", "byte_ovf", "attrs_num", "num_valid",
+                 "rel_rows", "member_ovf")
 
 
 def fuse_batch(db) -> Tuple[np.ndarray, tuple]:
@@ -681,6 +843,8 @@ def eval_fused_jit(params, buf, layout):
     return _bitpack_rows(eval_packed_jit(
         params, ops["attrs_val"], ops["members_c"], ops["cpu_dense"],
         ops["config_id"], ops.get("attr_bytes"), ops.get("byte_ovf"),
+        ops.get("attrs_num"), ops.get("num_valid"), ops.get("rel_rows"),
+        ops.get("member_ovf"),
     ))
 
 
@@ -744,5 +908,6 @@ def eval_batch_jit(params, db) -> Tuple[np.ndarray, np.ndarray]:
         jnp.asarray(db.config_id),
         jnp.asarray(db.attr_bytes) if has_dfa else None,
         jnp.asarray(db.byte_ovf) if has_dfa else None,
+        *_extra_operands(db),
     )
     return np.asarray(own), np.asarray(verdict)

@@ -106,17 +106,19 @@ class MeshUnavailable(RuntimeError):
     caller's host-oracle degrade path is the only lane left."""
 
 
-# jitted sharded steps cached per (mesh, has_dfa, has_matmul, n_levels):
+# jitted sharded steps cached per (mesh, lane flags, n_levels):
 # reconcile-time apply_snapshot builds a fresh ShardedPolicyModel, and a
 # per-model jax.jit(shard_map(...)) closure would force a full XLA recompile
 # on every snapshot even at unchanged shapes — the sharded analog of the
 # module-level eval_packed_jit cache on the single-corpus path.  The flags
 # pin the params/specs pytree STRUCTURE (lane presence changes it), so a
-# gather-lane model can never reuse a matmul-traced step.
-_STEP_CACHE: Dict[Tuple[Mesh, bool, bool, int], Any] = {}
+# gather-lane model can never reuse a matmul-traced step.  ``extras`` is
+# the (has_num, has_rel, has_ovf) triple of the ISSUE 14 operand lanes.
+_STEP_CACHE: Dict[Tuple[Mesh, bool, bool, int, tuple], Any] = {}
 
 
-def _sharded_step(mesh: Mesh, has_dfa: bool, has_matmul: bool, n_levels: int, specs):
+def _sharded_step(mesh: Mesh, has_dfa: bool, has_matmul: bool, n_levels: int,
+                  specs, extras: Tuple[bool, bool, bool] = (False, False, False)):
     """Own-config evaluation step over the mesh: each mp shard evaluates its
     sub-corpus, selects the rows of requests whose config it owns, and the
     tiny [B], [B, E] results combine with one psum over 'mp' — so the
@@ -124,13 +126,15 @@ def _sharded_step(mesh: Mesh, has_dfa: bool, has_matmul: bool, n_levels: int, sp
     (the sharded analog of eval_packed_jit's one-small-readback contract).
     ``specs`` mirrors the stacked-params structure (P('mp') on every leaf);
     the cache key's flags pin that structure."""
-    key = (mesh, has_dfa, has_matmul, n_levels)
+    has_num, has_rel, has_ovf = extras
+    key = (mesh, has_dfa, has_matmul, n_levels, extras)
     step = _STEP_CACHE.get(key)
     if step is not None:
         return step
 
     def local_eval(params, attrs_val, members_c, cpu_dense,
-                   attr_bytes, byte_ovf, shard_of, row_of):
+                   attr_bytes, byte_ovf, attrs_num, num_valid, rel_rows,
+                   member_ovf, shard_of, row_of):
         # params leading axis is the local S slice (size 1 per mp shard)
         sq = jax.tree_util.tree_map(lambda a: a[0], params)
         verdict, (rule, skipped) = eval_verdicts(
@@ -140,6 +144,10 @@ def _sharded_step(mesh: Mesh, has_dfa: bool, has_matmul: bool, n_levels: int, sp
             cpu_dense[:, 0],
             attr_bytes[:, 0] if has_dfa else None,
             byte_ovf[:, 0] if has_dfa else None,
+            attrs_num[:, 0] if has_num else None,
+            num_valid[:, 0] if has_num else None,
+            rel_rows[:, 0] if has_rel else None,
+            member_ovf[:, 0] if has_ovf else None,
         )
         # own-config one-hot rows local to this shard (other shards see all-
         # False masks for the request); psum over mp merges the disjoint parts
@@ -164,6 +172,10 @@ def _sharded_step(mesh: Mesh, has_dfa: bool, has_matmul: bool, n_levels: int, sp
         if has_dfa
         else (None, None)
     )
+    num_specs = ((P("dp", "mp", None), P("dp", "mp", None))
+                 if has_num else (None, None))
+    rel_specs = ((P("dp", "mp", None),) if has_rel else (None,))
+    ovf_specs = ((P("dp", "mp", None),) if has_ovf else (None,))
     mapped = _shard_map(
         local_eval,
         mesh=mesh,
@@ -172,7 +184,8 @@ def _sharded_step(mesh: Mesh, has_dfa: bool, has_matmul: bool, n_levels: int, sp
             P("dp", "mp", None),
             P("dp", "mp", None, None),
             P("dp", "mp", None),
-        ) + byte_specs + (P("dp"), P("dp")),
+        ) + byte_specs + num_specs + rel_specs + ovf_specs
+        + (P("dp"), P("dp")),
         out_specs=P("dp"),
     )
 
@@ -188,26 +201,31 @@ def _sharded_step(mesh: Mesh, has_dfa: bool, has_matmul: bool, n_levels: int, sp
 
 
 def _eval_stacked(params, attrs_val, members_c, cpu_dense,
-                  attr_bytes, byte_ovf, shard_of, row_of):
+                  attr_bytes, byte_ovf, attrs_num, num_valid, rel_rows,
+                  member_ovf, shard_of, row_of):
     """Single-DEVICE evaluation of the whole stacked corpus — the failover
     twin of the shard_map step: vmap over the [S] shard axis replaces the
     mesh partition, the own-config mask-reduce replaces the psum.  Same
     operands, same bit-packed [B, ceil((1+2E)/8)] readback, bit-identical
     verdicts (the kernel is a pure per-row function and vmap is exact)."""
-    def per_shard(sq, av, mc, cd, ab, bo):
-        verdict, (rule, skipped) = eval_verdicts(sq, av, mc, cd, ab, bo)
+    def per_shard(sq, av, mc, cd, ab, bo, an, nv, rr, mo):
+        verdict, (rule, skipped) = eval_verdicts(
+            sq, av, mc, cd, ab, bo, an, nv, rr, mo)
         return verdict, rule, skipped
 
-    ops = (jnp.moveaxis(attrs_val, 1, 0), jnp.moveaxis(members_c, 1, 0),
-           jnp.moveaxis(cpu_dense, 1, 0))
-    if attr_bytes is not None:
-        verdict, rule, skipped = jax.vmap(per_shard)(
-            params, *ops, jnp.moveaxis(attr_bytes, 1, 0),
-            jnp.moveaxis(byte_ovf, 1, 0))
-    else:
-        verdict, rule, skipped = jax.vmap(
-            per_shard, in_axes=(0, 0, 0, 0, None, None))(
-            params, *ops, None, None)
+    ops = [jnp.moveaxis(attrs_val, 1, 0), jnp.moveaxis(members_c, 1, 0),
+           jnp.moveaxis(cpu_dense, 1, 0)]
+    axes = [0, 0, 0, 0]
+    for a in (attr_bytes, byte_ovf, attrs_num, num_valid, rel_rows,
+              member_ovf):
+        if a is not None:
+            ops.append(jnp.moveaxis(a, 1, 0))
+            axes.append(0)
+        else:
+            ops.append(None)
+            axes.append(None)
+    verdict, rule, skipped = jax.vmap(per_shard, in_axes=tuple(axes))(
+        params, *ops)
     S, _, G = verdict.shape
     own_mask = (
         (shard_of[None, :, None]
@@ -373,6 +391,11 @@ class _ShardedEncoded:
     shard_of: np.ndarray       # [B] which shard owns the request's config
     row_of: np.ndarray         # [B] row within that shard
     host_fallback: np.ndarray  # [B] bool — exact re-decision on host
+    # ISSUE 14 lanes (None when the stacked corpus lacks them)
+    attrs_num: Optional[np.ndarray] = None   # [B, S, NN] int32
+    num_valid: Optional[np.ndarray] = None   # [B, S, NN] bool
+    rel_rows: Optional[np.ndarray] = None    # [B, S, NR] int32
+    member_ovf: Optional[np.ndarray] = None  # [B, S, M] bool
 
 class ShardedPolicyModel:
     """Rule corpus partitioned over the 'mp' mesh axis; batch over 'dp'.
@@ -387,7 +410,8 @@ class ShardedPolicyModel:
     def __init__(self, configs: Sequence[ConfigRules], mesh: Mesh,
                  members_k: int = 16, interner: Optional[StringInterner] = None,
                  defer_upload: bool = False, grid_relief: bool = True,
-                 breaker_threshold: int = 3, breaker_reset_s: float = 5.0):
+                 breaker_threshold: int = 3, breaker_reset_s: float = 5.0,
+                 ovf_assist: Optional[bool] = None):
         self.mesh = mesh
         S = mesh.shape["mp"]
         self.n_shards = S
@@ -418,16 +442,21 @@ class ShardedPolicyModel:
         k = self.members_k_eff
         first = [
             compile_corpus(g, members_k=k, interner=self.interner,
-                           dfa_cache=dfa_cache)
+                           dfa_cache=dfa_cache, ovf_assist=ovf_assist)
             for g in groups
         ]
         targets = ShapeTargets.union([p.shape_targets() for p in first])
         self.shards: List[CompiledPolicy] = [
             compile_corpus(g, members_k=k, interner=self.interner,
-                           targets=targets, dfa_cache=dfa_cache)
+                           targets=targets, dfa_cache=dfa_cache,
+                           ovf_assist=ovf_assist)
             for g in groups
         ]
         self.has_dfa = self.shards[0].n_byte_attrs > 0
+        # ISSUE 14 lane flags: structural across shards (ShapeTargets union)
+        self.has_num = int(getattr(self.shards[0], "n_num_attrs", 0)) > 0
+        self.has_rel = int(getattr(self.shards[0], "n_rel_slots", 0)) > 0
+        self.has_ovf = bool(getattr(self.shards[0], "ovf_assist", False))
         # targets unified every operand shape (incl. eval-table rows), so
         # the whole per-shard device pytree — gather lane, matmul lane, DFA
         # lane — stacks on a leading [S] axis with one tree.map
@@ -562,7 +591,8 @@ class ShardedPolicyModel:
         self.upload_report = report
         n_levels = len(self.shards[0].levels)
         self._step = _sharded_step(
-            self.mesh, self.has_dfa, self.has_matmul, n_levels, specs
+            self.mesh, self.has_dfa, self.has_matmul, n_levels, specs,
+            extras=(self.has_num, self.has_rel, self.has_ovf),
         )
         return report
 
@@ -605,6 +635,16 @@ class ShardedPolicyModel:
             byte_ovf = np.zeros((B, S, NB), dtype=bool)
         else:
             attr_bytes = byte_ovf = None
+        if self.has_num:
+            NN = p0.n_num_attrs
+            attrs_num = np.zeros((B, S, NN), dtype=np.int32)
+            num_valid = np.zeros((B, S, NN), dtype=bool)
+        else:
+            attrs_num = num_valid = None
+        rel_rows = (np.zeros((B, S, p0.n_rel_slots), dtype=np.int32)
+                    if self.has_rel else None)
+        member_ovf = (np.zeros((B, S, M), dtype=bool)
+                      if self.has_ovf else None)
         shard_of = np.zeros((B,), dtype=np.int32)
         row_of = np.zeros((B,), dtype=np.int32)
         host_fallback = np.zeros((B,), dtype=bool)
@@ -631,6 +671,13 @@ class ShardedPolicyModel:
                 lb = db.attr_bytes.shape[-1]
                 attr_bytes[rs, shard, :, :lb] = db.attr_bytes[: len(rs)]
                 byte_ovf[rs, shard] = db.byte_ovf[: len(rs)]
+            if self.has_num:
+                attrs_num[rs, shard] = db.attrs_num[: len(rs)]
+                num_valid[rs, shard] = db.num_valid[: len(rs)]
+            if self.has_rel:
+                rel_rows[rs, shard] = db.rel_rows[: len(rs)]
+            if self.has_ovf:
+                member_ovf[rs, shard] = db.member_ovf[: len(rs)]
             host_fallback[rs] = db.host_fallback[: len(rs)]
         if self.has_dfa:
             from ..compiler.pack import _trim_bytes
@@ -639,6 +686,8 @@ class ShardedPolicyModel:
         return _ShardedEncoded(
             attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf,
             shard_of, row_of, host_fallback,
+            attrs_num=attrs_num, num_valid=num_valid,
+            rel_rows=rel_rows, member_ovf=member_ovf,
         )
 
     def row_keys(self, encoded: _ShardedEncoded, n: int):
@@ -650,7 +699,8 @@ class ShardedPolicyModel:
         return row_key_bytes(
             [encoded.shard_of, encoded.row_of, encoded.attrs_val,
              encoded.members_c, encoded.cpu_dense, encoded.attr_bytes,
-             encoded.byte_ovf, encoded.host_fallback], n)
+             encoded.byte_ovf, encoded.host_fallback, encoded.attrs_num,
+             encoded.num_valid, encoded.rel_rows, encoded.member_ovf], n)
 
     def select_rows(self, encoded: _ShardedEncoded, rows: Sequence[int],
                     batch_pad: int = 0) -> _ShardedEncoded:
@@ -673,6 +723,10 @@ class ShardedPolicyModel:
             take(encoded.cpu_dense), take(encoded.attr_bytes),
             take(encoded.byte_ovf), take(encoded.shard_of),
             take(encoded.row_of), take(encoded.host_fallback),
+            attrs_num=take(encoded.attrs_num),
+            num_valid=take(encoded.num_valid),
+            rel_rows=take(encoded.rel_rows),
+            member_ovf=take(encoded.member_ovf),
         )
 
     def dispatch_full(self, encoded: _ShardedEncoded):
@@ -694,6 +748,10 @@ class ShardedPolicyModel:
                 jnp.asarray(encoded.cpu_dense),
                 jnp.asarray(encoded.attr_bytes) if self.has_dfa else None,
                 jnp.asarray(encoded.byte_ovf) if self.has_dfa else None,
+                jnp.asarray(encoded.attrs_num) if self.has_num else None,
+                jnp.asarray(encoded.num_valid) if self.has_num else None,
+                jnp.asarray(encoded.rel_rows) if self.has_rel else None,
+                jnp.asarray(encoded.member_ovf) if self.has_ovf else None,
                 jnp.asarray(encoded.shard_of),
                 jnp.asarray(encoded.row_of),
             )
@@ -738,6 +796,10 @@ class ShardedPolicyModel:
             put(encoded.cpu_dense),
             put(encoded.attr_bytes) if self.has_dfa else None,
             put(encoded.byte_ovf) if self.has_dfa else None,
+            put(encoded.attrs_num) if self.has_num else None,
+            put(encoded.num_valid) if self.has_num else None,
+            put(encoded.rel_rows) if self.has_rel else None,
+            put(encoded.member_ovf) if self.has_ovf else None,
             put(encoded.shard_of),
             put(encoded.row_of),
         )

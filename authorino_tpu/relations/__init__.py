@@ -1,0 +1,9 @@
+"""Compiled relations (ISSUE 14): Cedar-style hierarchical entity/group
+membership precomputed at reconcile time into per-snapshot bitmatrix
+relation tables (closure.py), plus the metadata prefetch cache that lets
+metadata-dependent configs evaluate against pinned documents on the fast
+lane (prefetch.py)."""
+
+from .closure import RelationClosure
+
+__all__ = ["RelationClosure"]

@@ -52,8 +52,12 @@ log = logging.getLogger("authorino_tpu.replay.capture")
 
 # capture record schema: bumped whenever the per-record field set changes,
 # so offline readers (analysis --replay, bench --replay-log) can refuse
-# version-skewed logs with a typed error instead of misparsing
-CAPTURE_SCHEMA = 1
+# version-skewed logs with a typed error instead of misparsing.
+# v2 (ISSUE 14): + metadata_doc_digest — the combined digest of the
+# prefetch cache's pinned metadata documents the decision evaluated under
+# (None for configs with no pinned metadata), making metadata-dependent
+# replays reproducible (docs/replay.md)
+CAPTURE_SCHEMA = 2
 CAPTURE_FORMAT_VERSION = 1
 MAGIC = b"ATPUCAP1\x00"
 _DIGEST_LEN = 32
@@ -62,7 +66,8 @@ SEGMENT_SUFFIX = ".atpucap"
 # pinned record shape (tests/test_replay.py): every captured record carries
 # exactly these keys
 CAPTURE_FIELDS = ("schema", "t", "authconfig", "doc", "verdict",
-                  "rule_index", "lane", "generation")
+                  "rule_index", "lane", "generation",
+                  "metadata_doc_digest")
 
 
 class CaptureFormatError(ValueError):
@@ -283,10 +288,14 @@ class CaptureLog:
         return out
 
     def offer(self, authconfig: str, doc: Any, rule_index: int, lane: str,
-              generation: Any, t: Optional[float] = None) -> None:
+              generation: Any, t: Optional[float] = None,
+              metadata_doc_digest: Optional[str] = None) -> None:
         """Queue one sampled decision for capture.  Bounded queue,
         drop-and-count on overflow — the serving path never blocks on and
-        never pays for capture encoding."""
+        never pays for capture encoding.  ``metadata_doc_digest`` pins
+        which prefetched metadata documents the decision evaluated under
+        (ISSUE 14: replays of metadata-dependent configs are reproducible
+        and digest-checkable)."""
         if not self.enabled:
             return
         if len(self._queue) >= self.queue_max:
@@ -295,7 +304,7 @@ class CaptureLog:
             return
         self._queue.append((t if t is not None else time.time(),
                             authconfig, doc, int(rule_index), lane,
-                            generation))
+                            generation, metadata_doc_digest))
         self._wake.set()
 
     # -- drain thread ------------------------------------------------------
@@ -319,7 +328,7 @@ class CaptureLog:
                 self._ingest(item)
 
     def _ingest(self, item: Tuple) -> None:
-        t, authconfig, doc, rule_index, lane, generation = item
+        t, authconfig, doc, rule_index, lane, generation, md_digest = item
         rec = {
             "schema": CAPTURE_SCHEMA,
             "t": t,
@@ -329,6 +338,7 @@ class CaptureLog:
             "rule_index": rule_index,
             "lane": lane,
             "generation": generation,
+            "metadata_doc_digest": md_digest,
         }
         try:
             enc = encode_record(rec)

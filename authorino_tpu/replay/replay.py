@@ -21,6 +21,7 @@ newly-allowed one to the OLD side's (the rule that used to).  Consumed by
 
 from __future__ import annotations
 
+import hashlib
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -129,7 +130,9 @@ def _doc_identity(doc: Any) -> str:
 
 def replay_records(old: Any, new: Any, records: Sequence[Dict[str, Any]],
                    *, time_budget_s: Optional[float] = None,
-                   max_examples: int = 3) -> Dict[str, Any]:
+                   max_examples: int = 3,
+                   metadata_docs: Optional[Dict[str, Dict[str, Any]]] = None,
+                   ) -> Dict[str, Any]:
     """Replay every captured record through BOTH snapshots' host oracles
     and diff the verdicts.  ``old``/``new`` accept anything
     :meth:`SnapshotOracle.of` does.
@@ -138,13 +141,32 @@ def replay_records(old: Any, new: Any, records: Sequence[Dict[str, Any]],
     budget): replay stops at the budget and the report says how many
     records were NOT evaluated (``skipped.truncated`` — no silent caps, a
     truncated preflight must read as partial evidence, not full
-    coverage)."""
+    coverage).
+
+    ``metadata_docs`` un-blinds metadata-dependent configs (ISSUE 14):
+    {config_id: {metadata_name: document}} — the prefetch cache's pinned
+    documents (MetadataPrefetcher.export_docs / --metadata-docs FILE).
+    Records of listed configs re-decide with ``auth.metadata`` overridden
+    by the pinned documents on BOTH sides (a consistent what-if under
+    today's metadata), counted in ``metadata.substituted``; records whose
+    captured ``metadata_doc_digest`` disagrees with the pinned set are
+    additionally counted in ``metadata.digest_mismatches`` (the capture
+    window saw different documents — verdicts may differ from what was
+    served, by design of the what-if)."""
+    from ..relations.prefetch import doc_digest as _md_digest
     from ..ops.pattern_eval import firing_columns
     from ..runtime.provenance import rule_label
 
     old_o = old if isinstance(old, SnapshotOracle) else SnapshotOracle.of(old)
     new_o = new if isinstance(new, SnapshotOracle) else SnapshotOracle.of(new)
     t0 = time.monotonic()
+    md_substituted = md_mismatch = 0
+    pinned_digest: Dict[str, str] = {}
+    if metadata_docs:
+        for cfg, docs in metadata_docs.items():
+            parts = sorted((n, _md_digest(d)) for n, d in docs.items())
+            pinned_digest[cfg] = hashlib.sha256(
+                repr(parts).encode()).hexdigest()
 
     kept: List[Dict[str, Any]] = []
     o_rules: List[np.ndarray] = []
@@ -176,6 +198,21 @@ def replay_records(old: Any, new: Any, records: Sequence[Dict[str, Any]],
             missing_new.add(name)
             missing_n += 1
             continue
+        if metadata_docs and name in metadata_docs and isinstance(doc, dict):
+            # pinned-document substitution: shallow-copy the doc and its
+            # auth subtree so the caller's records stay untouched (a
+            # non-dict doc — corrupt/hand-built log — skips substitution
+            # and takes its chances with the oracle's own error handling)
+            auth = dict(doc.get("auth") or {})
+            md = dict(auth.get("metadata") or {})
+            md.update(metadata_docs[name])
+            auth["metadata"] = md
+            doc = dict(doc)
+            doc["auth"] = auth
+            md_substituted += 1
+            cap_digest = rec.get("metadata_doc_digest")
+            if cap_digest and cap_digest != pinned_digest.get(name):
+                md_mismatch += 1
         try:
             ro, so = old_o.decide(name, doc)
             rn, sn = new_o.decide(name, doc)
@@ -258,6 +295,11 @@ def replay_records(old: Any, new: Any, records: Sequence[Dict[str, Any]],
         "new_generation": new_o.generation,
         "elapsed_ms": round((time.monotonic() - t0) * 1e3, 3),
         "evaluators": {"old": E_old, "new": E_new},
+        "metadata": {
+            "substituted": md_substituted,
+            "digest_mismatches": md_mismatch,
+            "configs": sorted(metadata_docs)[:32] if metadata_docs else [],
+        },
     }
 
 

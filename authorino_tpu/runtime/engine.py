@@ -121,7 +121,8 @@ class _Snapshot:
     def __init__(self, entries: Sequence[EngineEntry], members_k: int = 16,
                  mesh=None, strict_verify: bool = False,
                  compile_cache=None, prev: "Optional[_Snapshot]" = None,
-                 breaker_threshold: int = 3, breaker_reset_s: float = 5.0):
+                 breaker_threshold: int = 3, breaker_reset_s: float = 5.0,
+                 ovf_assist: Optional[bool] = None):
         self.by_id: Dict[str, EngineEntry] = {e.id: e for e in entries}
         rules = [e.rules for e in entries if e.rules is not None]
         self.policy: Optional[CompiledPolicy] = None
@@ -170,16 +171,19 @@ class _Snapshot:
         if rules:
             if mesh is not None:
                 self._compile_mesh(rules, members_k, mesh, strict_verify,
-                                   prev, breaker_threshold, breaker_reset_s)
+                                   prev, breaker_threshold, breaker_reset_s,
+                                   ovf_assist=ovf_assist)
             else:
                 self._compile_single(rules, members_k, strict_verify,
-                                     compile_cache, prev)
+                                     compile_cache, prev,
+                                     ovf_assist=ovf_assist)
 
     def _compile_mesh(self, rules, members_k: int, mesh,
                       strict_verify: bool,
                       prev: "Optional[_Snapshot]",
                       breaker_threshold: int = 3,
-                      breaker_reset_s: float = 5.0) -> None:
+                      breaker_reset_s: float = 5.0,
+                      ovf_assist: Optional[bool] = None) -> None:
         """Mesh compile → verify → delta upload, each phase timed (the
         control-plane parity half of ISSUE 11):
 
@@ -203,7 +207,7 @@ class _Snapshot:
             rules, mesh, members_k=members_k,
             interner=(prev.sharded.interner if prev_ok else None),
             defer_upload=True, breaker_threshold=breaker_threshold,
-            breaker_reset_s=breaker_reset_s)
+            breaker_reset_s=breaker_reset_s, ovf_assist=ovf_assist)
         self.phase_s["compile"] = time.monotonic() - t0
         memo: Dict[int, str] = {}
         self.fingerprints = {c.name: rules_fingerprint(c, memo)
@@ -219,7 +223,8 @@ class _Snapshot:
         self.phase_s["upload"] = time.monotonic() - t0
 
     def _compile_single(self, rules, members_k: int, strict_verify: bool,
-                        compile_cache, prev: "Optional[_Snapshot]") -> None:
+                        compile_cache, prev: "Optional[_Snapshot]",
+                        ovf_assist: Optional[bool] = None) -> None:
         """Single-corpus compile → verify → diff → upload, each phase
         timed.  With a compile cache and an unchanged corpus the previous
         snapshot's CompiledPolicy AND device params are reused outright:
@@ -234,11 +239,13 @@ class _Snapshot:
             policy, report = compile_cache.compile(
                 rules, members_k=members_k,
                 prev_fps=(prev.fingerprints if prev_ok else None),
-                prev_policy=(prev.policy if prev_ok else None))
+                prev_policy=(prev.policy if prev_ok else None),
+                ovf_assist=ovf_assist)
             self.compile_report = report
             self.fingerprints = dict(report.fingerprints)
         else:
-            policy = compile_corpus(rules, members_k=members_k)
+            policy = compile_corpus(rules, members_k=members_k,
+                                    ovf_assist=ovf_assist)
             memo: Dict[int, str] = {}
             self.fingerprints = {c.name: rules_fingerprint(c, memo)
                                  for c in rules}
@@ -518,6 +525,10 @@ class PolicyEngine:
         snapshot_history: int = 4,
         replay_pregate: bool = False,
         replay_pregate_budget_s: float = 2.0,
+        ovf_assist: Optional[bool] = None,
+        metadata_prefetch: bool = True,
+        metadata_prefetch_max_age_s: float = 300.0,
+        metadata_prefetch_refresh_s: float = 60.0,
     ):
         """``mesh="auto"`` shards the rule corpus over all visible devices
         when more than one is present (dp × mp ShardedPolicyModel);
@@ -651,6 +662,18 @@ class PolicyEngine:
         self.batch_dedup = bool(batch_dedup)
         self.strict_verify = bool(strict_verify)
         self.analyze_policies = bool(analyze_policies)
+        # ISSUE 14: membership-overflow in-kernel assist (None = env
+        # default AUTHORINO_TPU_OVF_ASSIST; compiler/compile.py) and the
+        # metadata prefetch cache (request-independent external documents
+        # pinned at reconcile cadence; relations/prefetch.py)
+        self.ovf_assist = ovf_assist
+        self.metadata_prefetcher = None
+        if metadata_prefetch:
+            from ..relations.prefetch import MetadataPrefetcher
+
+            self.metadata_prefetcher = MetadataPrefetcher(
+                max_age_s=metadata_prefetch_max_age_s,
+                refresh_s=metadata_prefetch_refresh_s)
         # incremental control plane (ISSUE 8): the persistent per-config
         # compile cache (fingerprint → artifact + the cross-reconcile
         # interner/DFA memos) and the latest reconcile's phase/delta
@@ -831,7 +854,8 @@ class PolicyEngine:
                              compile_cache=self.compile_cache,
                              prev=self._snapshot,
                              breaker_threshold=self.breaker.threshold,
-                             breaker_reset_s=self.breaker.reset_s)
+                             breaker_reset_s=self.breaker.reset_s,
+                             ovf_assist=self.ovf_assist)
         except SnapshotRejected as e:
             metrics_mod.snapshot_rejected.labels("engine").inc()
             RECORDER.record("snapshot-rejected", lane="engine", detail={
@@ -933,6 +957,29 @@ class PolicyEngine:
         # BEFORE the advisory analysis: a revoking reconcile must propagate
         # at swap speed, not wait out a bounded-evaluation pass
         self.notify_swap_listeners()
+        # metadata prefetch (ISSUE 14): register this snapshot's request-
+        # independent metadata evaluators and (asynchronously) re-pin
+        # their documents — after the listeners, off the swap-speed path;
+        # a registration failure never fails a reconcile
+        if self.metadata_prefetcher is not None:
+            try:
+                self.metadata_prefetcher.reconcile(entries)
+            except Exception:
+                log.exception("metadata prefetch registration failed "
+                              "(reconcile unaffected)")
+        # relation-table footprint gauges (ISSUE 14)
+        try:
+            from ..analysis.translation_validate import snapshot_policies
+
+            rows = nbytes = 0
+            for pol in snapshot_policies(snap):
+                if getattr(pol, "rel_bits", None) is not None:
+                    rows += int(pol.rel_bits.shape[0])
+                    nbytes += int(pol.rel_bits.nbytes)
+            metrics_mod.relation_table_rows.set(rows)
+            metrics_mod.relation_table_bytes.set(nbytes)
+        except Exception:
+            log.exception("relation-table telemetry failed (swap unaffected)")
 
     def _record_control_plane(self, snap: "_Snapshot") -> None:
         """Reconcile telemetry (ISSUE 8 satellite): phase histograms,
@@ -1600,6 +1647,13 @@ class PolicyEngine:
             report = lowerability_report(entries, snapshot_policies(snap))
             for lane, reason, n in report["series"]:
                 metrics_mod.lowerability_configs.labels(lane, reason).inc(n)
+            # would-be-fast-if-fixed rollup (ISSUE 14): gauges trend the
+            # per-reason exile counts across reconciles
+            for reason, b in (report.get("blocking_reasons") or {}).items():
+                metrics_mod.lowerability_blocking.labels(
+                    reason, "configs").set(b["configs"])
+                metrics_mod.lowerability_blocking.labels(
+                    reason, "sole_blocker").set(b["sole_blocker"])
             report["generation"] = snap.generation
             self._lowerability = report
         except Exception:
@@ -1671,6 +1725,11 @@ class PolicyEngine:
                 },
             },
             "slo": self.slo.to_json() if self.slo is not None else None,
+            # metadata prefetch cache (ISSUE 14): pinned-document counts,
+            # staleness/refresh knobs, hit/miss/stale counters
+            "metadata_prefetch": (self.metadata_prefetcher.to_json()
+                                  if self.metadata_prefetcher is not None
+                                  else None),
             "flight_recorder": RECORDER.to_json(),
             "change_safety": self.change_safety_vars(),
             # traffic replay (ISSUE 13, docs/replay.md): capture-log state
@@ -2327,10 +2386,22 @@ class PolicyEngine:
             # the capture log's own drain thread (encode/persist happen
             # there, never here)
             if CAPTURE.enabled:
+                pf = self.metadata_prefetcher
+                md_digests: Dict[str, Optional[str]] = {}
                 for i in CAPTURE.sample_indices(len(pendings)):
                     pi = pendings[i]
+                    # metadata reproducibility (ISSUE 14): stamp which
+                    # pinned prefetched documents this config's decision
+                    # evaluated under (None: nothing pinned)
+                    md = None
+                    if pf is not None:
+                        if pi.config_name not in md_digests:
+                            md_digests[pi.config_name] = pf.digest_for(
+                                pi.config_name)
+                        md = md_digests[pi.config_name]
                     CAPTURE.offer(pi.config_name, pi.doc, int(firing[i]),
-                                  lane, snap.generation)
+                                  lane, snap.generation,
+                                  metadata_doc_digest=md)
             # canary guards (ISSUE 10): the SAME attribution columns feed
             # the per-cohort deny-rate comparison — batches are cohort-
             # homogeneous, so the evaluating snapshot names the cohort
@@ -2490,6 +2561,11 @@ class PolicyEngine:
                 # stopped native frontend); the canary stays undecided and
                 # cohort routing keeps serving until exit
                 phase.cancel_timer()
+            if self.metadata_prefetcher is not None:
+                # the refresher must not re-pin into a tearing-down
+                # process; stale pins only ever fall through to the live
+                # fetch, so stopping early is always safe
+                self.metadata_prefetcher.stop(timeout_s=0.5)
             RECORDER.record("drain", lane="engine", detail={
                 "queue": len(self._queue), "inflight": self._inflight})
             log.info("engine draining: admission stopped "

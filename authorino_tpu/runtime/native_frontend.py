@@ -1364,6 +1364,26 @@ class NativeFrontend:
         sharded = snap.sharded if snap is not None else None
         mod = self._mod
 
+        # ISSUE 14 lanes: the C++ encoder/kernel predate the numeric and
+        # relation operands and the overflow assist — a corpus using them
+        # must NOT become a C++ fast-lane snapshot (it would silently
+        # mis-evaluate the new leaves).  The Python engine lane serves it
+        # exactly; the native port is tracked work (docs/architecture.md).
+        def _uses_new_lanes(p) -> bool:
+            return (int(getattr(p, "n_num_attrs", 0) or 0) > 0
+                    or int(getattr(p, "n_rel_slots", 0) or 0) > 0
+                    or bool(getattr(p, "ovf_assist", False)))
+
+        pols = ([policy] if policy is not None else
+                list(getattr(sharded, "shards", None) or ()))
+        if any(_uses_new_lanes(p) for p in pols):
+            log.warning(
+                "native fast lane DISABLED for this snapshot: the corpus "
+                "uses numeric/relation/ovf-assist lanes the C++ encoder "
+                "does not implement yet — the engine lane serves it")
+            policy = None
+            sharded = None
+
         if self.strict_verify and snap is not None and (
                 policy is not None or sharded is not None) and not getattr(
                 snap, "lint_ok", False):

@@ -80,7 +80,7 @@ def _collect_patterns(expr: Expression, acc: set) -> None:
                 and getattr(expr, "_regex", None) is not None):
             acc.add(expr.value)
         return
-    for c in expr.children:
+    for c in getattr(expr, "children", ()):  # InGroup: leaf, no regexes
         _collect_patterns(c, acc)
 
 
@@ -178,10 +178,15 @@ class CompileCache:
 
     def _intern_consts(self, expr: Expression) -> None:
         if isinstance(expr, Pattern):
-            if expr.operator is not Operator.MATCHES:
+            from ..expressions.ast import NUMERIC_OPERATORS
+
+            # numeric constants fold to raw int32 at compile time — they
+            # never enter the interner (and must not churn its serial)
+            if expr.operator is not Operator.MATCHES and \
+                    expr.operator not in NUMERIC_OPERATORS:
                 self.interner.intern(expr.value)
             return
-        for c in expr.children:
+        for c in getattr(expr, "children", ()):  # InGroup: no string consts
             self._intern_consts(c)
 
     # ------------------------------------------------------------------
@@ -193,6 +198,7 @@ class CompileCache:
         prev_fps: Optional["OrderedDict[str, str]"] = None,
         prev_policy: Optional[CompiledPolicy] = None,
         enable_dfa: bool = True,
+        ovf_assist: Optional[bool] = None,
     ) -> Tuple[CompiledPolicy, CompileReport]:
         """Incremental corpus compile.  Unchanged configs (fingerprint hit)
         reuse their artifact; a corpus whose ordered fingerprint map equals
@@ -221,5 +227,6 @@ class CompileCache:
                     for name, art in arts]
             policy = compile_corpus(
                 cfgs, members_k=members_k, interner=self.interner,
-                enable_dfa=enable_dfa, dfa_cache=self.dfa_cache)
+                enable_dfa=enable_dfa, dfa_cache=self.dfa_cache,
+                ovf_assist=ovf_assist)
         return policy, report

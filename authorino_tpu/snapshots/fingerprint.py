@@ -67,20 +67,50 @@ def encoding_epoch(policy: CompiledPolicy) -> str:
     # IS the leaf cpu_leaf_list[j], identified canonically (op, selector,
     # pattern / whole-tree digest), never by leaf index
     cpu_desc = []
+    rev = None
     for leaf in policy.cpu_leaf_list.tolist():
         rx = policy.leaf_regex[leaf]
         tree = policy.leaf_tree[leaf]
+        # ovf_assist membership columns are identified by their CONSTANT
+        # too (two incl leaves on one attr are distinct columns)
+        const_s = None
+        if bool(policy.leaf_is_membership[leaf]):
+            if rev is None:
+                rev = policy.interner.reverse()
+            const_s = rev.get(int(policy.leaf_const[leaf]),
+                              f"<id:{int(policy.leaf_const[leaf])}>")
         cpu_desc.append((
             int(policy.leaf_op[leaf]),
             policy.attr_selectors[int(policy.leaf_attr[leaf])],
             rx.pattern if rx is not None else None,
             _tree_digest(tree, tree_memo) if tree is not None else None,
+            const_s,
         ))
     # byte-tensor slots: slot → selector (positional [B, NB, LB] axes)
     byte_slots: Dict[int, str] = {}
     for a_i, slot in enumerate(policy.attr_byte_slot.tolist()):
         if slot >= 0:
             byte_slots[int(slot)] = policy.attr_selectors[a_i]
+    # ISSUE 14 operand lanes: numeric value slots are positional (slot →
+    # selector); relation rows' MEANING is the (relation digest, entity →
+    # row) assignment per slot; assist columns fold in via cpu_desc (the
+    # membership leaves that join cpu_leaf_list change it) plus the
+    # explicit flag (the [B, M] mask's presence itself)
+    num_slots: Dict[int, str] = {}
+    nas = getattr(policy, "num_attr_slot", None)
+    if nas is not None:
+        for a_i, slot in enumerate(nas.tolist()):
+            if slot >= 0:
+                num_slots[int(slot)] = policy.attr_selectors[a_i]
+    rel_desc = []
+    for slot, (attr, inst) in enumerate(getattr(policy, "rel_slots", None)
+                                        or ()):
+        closure = policy.rel_instances[inst]
+        rel_desc.append((
+            policy.attr_selectors[int(attr)], closure.digest,
+            tuple(sorted((e, policy.rel_entity_rows[inst][e])
+                         for e in policy.rel_entity_rows[inst])),
+        ))
     payload = (
         int(policy.interner.serial),
         int(policy.members_k),
@@ -90,6 +120,9 @@ def encoding_epoch(policy: CompiledPolicy) -> str:
         (tuple(cpu_desc), int(policy.n_cpu_leaves)),
         (tuple(byte_slots.get(s) for s in range(policy.n_byte_attrs)),
          DFA_VALUE_BYTES),
+        (tuple(num_slots.get(s)
+               for s in range(int(getattr(policy, "n_num_attrs", 0) or 0))),
+         tuple(rel_desc), bool(getattr(policy, "ovf_assist", False))),
     )
     epoch = hashlib.sha256(repr(payload).encode("utf-8")).hexdigest()[:16]
     policy._enc_epoch = epoch  # type: ignore[attr-defined]

@@ -33,13 +33,20 @@ import numpy as np
 
 from ..compiler.compile import CompiledPolicy
 from ..compiler.intern import StringInterner
-from ..expressions.ast import And, Expression, Operator, Or, Pattern
+from ..expressions.ast import And, Expression, InGroup, Operator, Or, Pattern
+from ..relations.closure import RelationClosure
 
 __all__ = ["serialize_policy", "deserialize_policy", "SnapshotFormatError",
            "expr_to_json", "expr_from_json"]
 
 MAGIC = b"ATPUSNAP1\x00"
+# version 1: the pre-ISSUE-14 layout.  Version 2 adds the numeric and
+# relation operand lanes + the ovf_assist flag; it is emitted ONLY when a
+# corpus actually uses them, so old blobs stay loadable and an old reader
+# REJECTS (typed) a blob whose lanes it cannot evaluate instead of
+# silently dropping them.
 FORMAT_VERSION = 1
+FORMAT_VERSION_RELATIONS = 2
 _DIGEST_LEN = 32
 
 
@@ -54,23 +61,51 @@ class SnapshotFormatError(ValueError):
 # ---------------------------------------------------------------------------
 
 
-def expr_to_json(expr: Expression) -> Any:
+def expr_to_json(expr: Expression,
+                 relations: Optional[Dict[str, int]] = None,
+                 rel_edges: Optional[List[Any]] = None) -> Any:
     if isinstance(expr, Pattern):
         return {"p": [expr.selector, expr.operator.value, expr.value]}
+    if isinstance(expr, InGroup):
+        # closures dedupe into a header-level edge-set table by digest;
+        # the node carries only its index (ISSUE 14) — standalone callers
+        # (no registry) inline the edges
+        if relations is None or rel_edges is None:
+            return {"rel": [expr.selector, expr.group,
+                            [list(e) for e in expr.relation.edges]]}
+        idx = relations.get(expr.relation.digest)
+        if idx is None:
+            idx = relations[expr.relation.digest] = len(rel_edges)
+            rel_edges.append([list(e) for e in expr.relation.edges])
+        return {"rel": [expr.selector, expr.group, idx]}
     tag = "all" if isinstance(expr, And) else "any"
-    return {tag: [expr_to_json(c) for c in expr.children]}
+    return {tag: [expr_to_json(c, relations, rel_edges)
+                  for c in expr.children]}
 
 
-def expr_from_json(d: Any) -> Expression:
+def expr_from_json(d: Any,
+                   closures: Optional[List[RelationClosure]] = None,
+                   ) -> Expression:
     if not isinstance(d, dict) or len(d) != 1:
         raise SnapshotFormatError(f"malformed expression node: {d!r}")
     if "p" in d:
         sel, op, value = d["p"]
         return Pattern(str(sel), Operator.from_string(str(op)), str(value))
+    if "rel" in d:
+        sel, group, ref = d["rel"]
+        if isinstance(ref, list):
+            closure = RelationClosure(ref)  # inline edges (standalone form)
+        else:
+            if closures is None or not (0 <= int(ref) < len(closures)):
+                raise SnapshotFormatError(
+                    f"relation node references closure {ref!r} outside the "
+                    "header registry")
+            closure = closures[int(ref)]
+        return InGroup(str(sel), str(group), closure)
     if "all" in d:
-        return And(tuple(expr_from_json(c) for c in d["all"]))
+        return And(tuple(expr_from_json(c, closures) for c in d["all"]))
     if "any" in d:
-        return Or(tuple(expr_from_json(c) for c in d["any"]))
+        return Or(tuple(expr_from_json(c, closures) for c in d["any"]))
     raise SnapshotFormatError(f"unknown expression node: {list(d)!r}")
 
 
@@ -96,6 +131,21 @@ def serialize_policy(policy: CompiledPolicy,
         arrays[f"levels.{i}.children"] = children
         arrays[f"levels.{i}.is_and"] = is_and
 
+    # ISSUE 14 lanes: arrays + host metadata ride the container only when
+    # a corpus uses them (then the format version bumps, so an older
+    # reader rejects typed instead of silently dropping a lane)
+    has_num = int(getattr(policy, "n_num_attrs", 0) or 0) > 0
+    has_rel = int(getattr(policy, "n_rel_slots", 0) or 0) > 0
+    has_assist = bool(getattr(policy, "ovf_assist", False))
+    if has_num:
+        arrays["num_attr_slot"] = policy.num_attr_slot
+        arrays["num_attrs"] = policy.num_attrs
+    if has_rel:
+        arrays["rel_bits"] = policy.rel_bits
+        arrays["leaf_rel_slot"] = policy.leaf_rel_slot
+        arrays["leaf_rel_col"] = policy.leaf_rel_col
+        arrays["rel_slot_attr"] = policy.rel_slot_attr
+
     directory: Dict[str, Dict[str, Any]] = {}
     payload = bytearray()
     for name, a in arrays.items():
@@ -112,8 +162,11 @@ def serialize_policy(policy: CompiledPolicy,
     for s, i in policy.interner._table.items():
         interner_table[i] = s
 
+    rel_registry: Dict[str, int] = {}
+    rel_edges: List[Any] = []
     header = {
-        "version": FORMAT_VERSION,
+        "version": (FORMAT_VERSION_RELATIONS
+                    if has_num or has_rel or has_assist else FORMAT_VERSION),
         "meta": meta or {},
         "n_levels": len(policy.levels),
         "n_byte_attrs": int(policy.n_byte_attrs),
@@ -128,15 +181,34 @@ def serialize_policy(policy: CompiledPolicy,
                               for a in policy.config_cpu_leaves],
         "leaf_regex": [rx.pattern if rx is not None else None
                        for rx in policy.leaf_regex],
-        "leaf_tree": [expr_to_json(t) if t is not None else None
+        "leaf_tree": [expr_to_json(t, rel_registry, rel_edges)
+                      if t is not None else None
                       for t in policy.leaf_tree],
         "config_exprs": [
-            [[expr_to_json(cond) if cond is not None else None,
-              expr_to_json(rule)] for cond, rule in evs]
+            [[expr_to_json(cond, rel_registry, rel_edges)
+              if cond is not None else None,
+              expr_to_json(rule, rel_registry, rel_edges)]
+             for cond, rule in evs]
             for evs in policy.config_exprs
         ],
         "arrays": directory,
     }
+    if has_num or has_rel or has_assist:
+        header["n_num_attrs"] = int(policy.n_num_attrs)
+        header["n_rel_slots"] = int(policy.n_rel_slots)
+        header["ovf_assist"] = bool(policy.ovf_assist)
+        header["rel_slots"] = [list(map(int, s))
+                               for s in (policy.rel_slots or ())]
+        header["rel_col_names"] = [[int(i), str(g)]
+                                   for i, g in (policy.rel_col_names or ())]
+        header["rel_entity_rows"] = [
+            {str(e): int(r) for e, r in m.items()}
+            for m in (policy.rel_entity_rows or ())]
+        header["rel_instances"] = [
+            [list(e) for e in c.edges]
+            for c in (policy.rel_instances or ())]
+    if rel_edges:
+        header["relations"] = rel_edges
     header_bytes = json.dumps(header, sort_keys=True,
                               separators=(",", ":")).encode("utf-8")
     body = MAGIC + struct.pack("<Q", len(header_bytes)) + header_bytes + payload
@@ -165,7 +237,8 @@ def _read_header(blob: bytes) -> Tuple[Dict[str, Any], int]:
         header = json.loads(body[start:start + hlen].decode("utf-8"))
     except Exception as e:
         raise SnapshotFormatError(f"unparseable snapshot header: {e}")
-    if header.get("version") != FORMAT_VERSION:
+    if header.get("version") not in (FORMAT_VERSION,
+                                     FORMAT_VERSION_RELATIONS):
         raise SnapshotFormatError(
             f"unsupported snapshot format version {header.get('version')!r}")
     return header, start + hlen
@@ -214,13 +287,30 @@ def deserialize_policy(blob: bytes) -> Tuple[CompiledPolicy, Dict[str, Any]]:
     leaf_regex: List[Optional[re.Pattern]] = [
         re.compile(p) if p is not None else None
         for p in header["leaf_regex"]]
+    # relation closures rebuild from the deduped edge-set registry (node
+    # {"rel": [sel, group, idx]} references); digests recompute identically
+    try:
+        node_closures = [RelationClosure(e)
+                         for e in header.get("relations") or ()]
+        rel_instances = [RelationClosure(e)
+                         for e in header.get("rel_instances") or ()]
+    except Exception as e:
+        raise SnapshotFormatError(f"malformed relation edge set: {e}")
     leaf_tree: List[Optional[Expression]] = [
-        expr_from_json(t) if t is not None else None
+        expr_from_json(t, node_closures) if t is not None else None
         for t in header["leaf_tree"]]
     config_exprs = [
-        [(expr_from_json(c) if c is not None else None, expr_from_json(r))
+        [(expr_from_json(c, node_closures) if c is not None else None,
+          expr_from_json(r, node_closures))
          for c, r in evs]
         for evs in header["config_exprs"]]
+
+    has_new = int(header.get("version", 1)) >= FORMAT_VERSION_RELATIONS
+    n_num = int(header.get("n_num_attrs", 0) or 0) if has_new else 0
+    n_rel = int(header.get("n_rel_slots", 0) or 0) if has_new else 0
+
+    def arr_opt(name: str):
+        return arr(name) if name in header["arrays"] else None
 
     policy = CompiledPolicy(
         leaf_op=arr("leaf_op"),
@@ -255,5 +345,21 @@ def deserialize_policy(blob: bytes) -> Tuple[CompiledPolicy, Dict[str, Any]]:
         n_cpu_leaves=int(header["n_cpu_leaves"]),
         config_exprs=config_exprs,
         config_cacheable=arr("config_cacheable"),
+        num_attr_slot=arr_opt("num_attr_slot"),
+        num_attrs=arr_opt("num_attrs"),
+        n_num_attrs=n_num,
+        rel_bits=arr_opt("rel_bits"),
+        leaf_rel_slot=arr_opt("leaf_rel_slot"),
+        leaf_rel_col=arr_opt("leaf_rel_col"),
+        rel_slot_attr=arr_opt("rel_slot_attr"),
+        n_rel_slots=n_rel,
+        rel_instances=rel_instances,
+        rel_entity_rows=[{str(e): int(r) for e, r in m.items()}
+                         for m in (header.get("rel_entity_rows") or ())],
+        rel_slots=[tuple(map(int, s))
+                   for s in (header.get("rel_slots") or ())],
+        rel_col_names=[(int(i), str(g))
+                       for i, g in (header.get("rel_col_names") or ())],
+        ovf_assist=bool(header.get("ovf_assist", False)),
     )
     return policy, dict(header.get("meta") or {})

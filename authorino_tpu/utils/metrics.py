@@ -581,6 +581,45 @@ lowerability_configs = _counter(
     "engine.lowerability carries the exact per-lane config counts.",
     ("lane", "reason"),
 )
+lowerability_blocking = _gauge(
+    "auth_server_lowerability_blocking_configs",
+    "Would-be-fast-if-fixed rollup per slow-lane reason code (ISSUE 14): "
+    "kind = 'configs' (every slow config carrying the reason) or "
+    "'sole_blocker' (configs this reason ALONE exiles — fixing it moves "
+    "exactly that many to the fast lane).  Set once per reconcile from "
+    "the lowerability report's blocking_reasons block, so per-reason "
+    "progress trends across reconciles.",
+    ("reason", "kind"),
+)
+relation_table_rows = _gauge(
+    "auth_server_relation_table_rows",
+    "Entity rows of the compiled relation bitmatrix (ISSUE 14, "
+    "relations/closure.py): the per-snapshot ancestor-closure table the "
+    "kernel's OP_RELATION bitmask gather reads.  0 when the corpus "
+    "declares no relations.",
+    (),
+)
+relation_table_bytes = _gauge(
+    "auth_server_relation_table_bytes",
+    "Bytes of the compiled relation bitmatrix uploaded with the snapshot "
+    "(rows x ceil(queried-group columns / 8)).",
+    (),
+)
+metadata_prefetch = _counter(
+    "auth_server_metadata_prefetch_total",
+    "Metadata prefetch cache outcomes (ISSUE 14, relations/prefetch.py): "
+    "hit = pinned document served with zero network I/O, miss = no pin "
+    "yet (live fetch fall-through), stale = pin older than the staleness "
+    "bound (live fetch fall-through), refresh = background re-pin "
+    "completed, error = a re-pin fetch failed (the previous pin, if any, "
+    "keeps serving until stale).",
+    ("result",),
+)
+metadata_prefetch_docs = _gauge(
+    "auth_server_metadata_prefetch_docs",
+    "Currently pinned (healthy) prefetched metadata documents.",
+    (),
+)
 
 # ---------------------------------------------------------------------------
 # Fault-injected graceful degradation (ISSUE 5): device circuit breaker,

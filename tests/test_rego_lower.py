@@ -89,10 +89,20 @@ NOT_LOWERABLE = [
     'allow { not input.request.headers["x-root"] != "true" }',
     # regex matching "" on a maybe-missing selector
     'allow { regex.match("a*", input.request.headers["x-root"]) }',
-    # non-string comparand (typed vs rendered equality)
-    'allow { input.request.size == 0 }',
-    # numeric path value
+    # numeric path value (string-typed selector vs int const: Rego's
+    # TypeError→False branch has no pattern equivalent)
     'allow { input.request.method == 3 }',
+    # ordered comparison on a string-typed selector (same reason) — only
+    # the provably-int paths (_INT_SCALARS) ride the numeric lane
+    'allow { input.request.headers["x-n"] > 3 }',
+    'allow { input.request.method > 3 }',
+    # != on a maybe-missing int path (missing: Rego false, pattern true)
+    'allow { input.source.port != 80 }',
+    # not(cmp) on a maybe-missing int path (inner undefined → Rego true,
+    # numeric patterns read False on "")
+    'allow { not input.source.port > 80 }',
+    # float const: the numeric lane is integer-only
+    'allow { input.request.size > 1.5 }',
     # auth.* (identity values not provably strings)
     'allow { input.auth.identity.sub == "x" }',
     # data refs

@@ -428,8 +428,14 @@ def test_cli_coverage_report(capsys):
     assert main(["--coverage-report", "--json"]) == 0
     report = json.loads(capsys.readouterr().out)
     cov = report["coverage"]
-    assert cov["fast"] == 4 and cov["slow"] == 4
+    # 4 fast + the 3 ISSUE 14 relations fixtures (hier/quota/roles — all
+    # fast: the coverage corpus compiles with ovf_assist) vs 4 slow
+    assert cov["fast"] == 7 and cov["slow"] == 4
     assert "unsupported-comparator" in cov["by_reason"]
+    # the would-be-fast-if-fixed rollup rides the report (ISSUE 14)
+    assert cov["blocking_reasons"]["unsupported-comparator"] == {
+        "configs": 1, "sole_blocker": 1}
+    assert {"hier", "quota", "roles"} <= set(cov["configs"])
 
 
 # ---------------------------------------------------------------------------
