@@ -132,6 +132,17 @@ def _run_verify_fixtures() -> List[Finding]:
     # bit-identically, and every planted hierarchy-closure /
     # numeric-encoder miscompile class must be REJECTED by the certifier
     errors += _relations_selftest()
+
+    # tenant-label cardinality lint (ISSUE 15 satellite): every metric
+    # family with a `tenant` label must declare its top-K bound, and the
+    # lint must CATCH a planted undeclared family — a blind lint fails
+    # this command, and with it tier-1
+    from .metrics_catalog import tenant_lint_self_test
+
+    for msg in tenant_lint_self_test():
+        errors.append(Finding(
+            kind="tenant-cardinality", layer="metrics_catalog",
+            message=msg, location="utils/metrics.py"))
     return errors
 
 
@@ -459,12 +470,21 @@ def _run_replay(old_path: str, new_path: str, log_src: str,
 def _run_metrics_catalog() -> dict:
     """Metrics-catalogue drift gate (ISSUE 9 satellite): every family
     registered in utils/metrics.py must appear in docs/observability.md
-    and vice versa.  Non-empty drift fails the command (and tier-1)."""
-    from .metrics_catalog import DOC_PATH, catalog_drift
+    and vice versa.  ISSUE 15 adds the tenant-label cardinality lint:
+    every `tenant`-labelled family must declare its top-K bound.
+    Non-empty drift or cardinality violations fail the command (and
+    tier-1)."""
+    from .metrics_catalog import (
+        DOC_PATH,
+        catalog_drift,
+        tenant_cardinality_lint,
+    )
 
     missing, stale = catalog_drift()
+    tenant = tenant_cardinality_lint()
     return {"doc": DOC_PATH, "missing_in_docs": missing,
-            "stale_in_docs": stale, "ok": not missing and not stale}
+            "stale_in_docs": stale, "tenant_cardinality": tenant,
+            "ok": not missing and not stale and not tenant}
 
 
 def _load_json_source(src: str) -> dict:
@@ -723,9 +743,12 @@ def main(argv=None) -> int:
             for name in report["stale_in_docs"]:
                 print(f"STALE: {name} documented in docs/observability.md "
                       f"but not registered in utils/metrics.py")
+            for msg in report["tenant_cardinality"]:
+                print(f"CARDINALITY: {msg}")
             print(f"{'OK' if report['ok'] else 'DRIFT'}: "
                   f"{len(report['missing_in_docs'])} undocumented, "
-                  f"{len(report['stale_in_docs'])} stale")
+                  f"{len(report['stale_in_docs'])} stale, "
+                  f"{len(report['tenant_cardinality'])} cardinality")
         return 0 if report["ok"] else 1
 
     if args.decisions:

@@ -24,7 +24,8 @@ import re
 from typing import List, Set, Tuple
 
 __all__ = ["registered_families", "documented_families", "catalog_drift",
-           "DOC_PATH"]
+           "tenant_label_families", "tenant_cardinality_lint",
+           "tenant_lint_self_test", "DOC_PATH"]
 
 DOC_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -80,6 +81,80 @@ def documented_families(path: str = DOC_PATH) -> Set[str]:
             if name:
                 fams.add(name)
     return fams
+
+
+def _exposition_name(value) -> str:
+    name = getattr(value, "_name", "")
+    return name + "_total" if getattr(value, "_type", None) == "counter" \
+        else name
+
+
+def tenant_label_families(extra=()) -> List[Tuple[str, object]]:
+    """Every registered auth_server_* family carrying a ``tenant`` label
+    (exposition-form names).  ``extra`` lets the planted-violation
+    self-test inject a fake family without registering it."""
+    from ..utils import metrics as metrics_mod
+
+    out: List[Tuple[str, object]] = []
+    for value in list(vars(metrics_mod).values()) + list(extra):
+        name = getattr(value, "_name", None)
+        if not isinstance(name, str) or not name.startswith("auth_server_"):
+            continue
+        labels = getattr(value, "_labelnames", ()) or ()
+        if "tenant" in labels:
+            out.append((_exposition_name(value), value))
+    return out
+
+
+def tenant_cardinality_lint(bounds=None, extra=()) -> List[str]:
+    """Label-cardinality gate (ISSUE 15 satellite): every metric family
+    with a ``tenant`` label MUST declare a positive top-K bound in
+    ``utils.metrics.TENANT_LABEL_BOUNDS`` — the table the tenancy flush
+    clamps its real-label minting to (everything past the bound folds into
+    the reserved `other` bucket).  An undeclared family is exactly the
+    unbounded-cardinality leak this lint exists to stop; wired into
+    ``--verify-fixtures`` and tier-1 with a planted violation."""
+    from ..utils import metrics as metrics_mod
+
+    if bounds is None:
+        bounds = metrics_mod.TENANT_LABEL_BOUNDS
+    violations: List[str] = []
+    for name, _value in tenant_label_families(extra=extra):
+        k = bounds.get(name)
+        if not isinstance(k, int) or k <= 0:
+            violations.append(
+                f"{name}: tenant-labelled family with no positive top-K "
+                f"bound in TENANT_LABEL_BOUNDS (unbounded label "
+                f"cardinality)")
+    # a declared bound for a family that does not exist is doc rot too
+    known = {n for n, _ in tenant_label_families(extra=extra)}
+    for name, k in bounds.items():
+        if name not in known:
+            violations.append(
+                f"{name}: TENANT_LABEL_BOUNDS names an unregistered "
+                f"family (stale bound)")
+    return violations
+
+
+class _PlantedTenantFamily:
+    """A fake tenant-labelled family for the lint's planted-violation
+    self-test — never registered with Prometheus."""
+
+    _name = "auth_server_tenant_planted_violation"
+    _type = "counter"
+    _labelnames = ("tenant",)
+
+
+def tenant_lint_self_test() -> List[str]:
+    """Two proofs in one pass: the REAL registry lints clean, and a
+    planted undeclared tenant-labelled family IS caught.  A blind lint
+    fails this (and with it --verify-fixtures and tier-1)."""
+    errors = list(tenant_cardinality_lint())
+    planted = tenant_cardinality_lint(extra=(_PlantedTenantFamily(),))
+    if not any("planted_violation" in v for v in planted):
+        errors.append("tenant-cardinality lint is BLIND: the planted "
+                      "undeclared tenant family was not flagged")
+    return errors
 
 
 def catalog_drift(path: str = DOC_PATH) -> Tuple[List[str], List[str]]:

@@ -145,6 +145,46 @@ def build_parser() -> argparse.ArgumentParser:
                         "probe rides BOTH lanes and resolves first-wins, "
                         "so clients never wait out a probe against a "
                         "still-sick device)")
+    s.add_argument("--no-tenant-qos", action="store_true",
+                   default=not env_var("TENANT_QOS", True),
+                   help="TENANT QoS (docs/tenancy.md): disable the tenant "
+                        "plane — weighted-fair batch cuts over per-tenant "
+                        "virtual queues, per-tenant quotas + tenant-aware "
+                        "doomed shedding at admission, per-tenant SLO/"
+                        "deny/wait folds, and noisy-neighbor containment. "
+                        "Off returns the globally-fair (FIFO) cut")
+    s.add_argument("--tenant-weight", action="append", default=[],
+                   metavar="TENANT=WEIGHT",
+                   help="Operator weight override for one tenant "
+                        "(AuthConfig id, e.g. ns/name=4).  Repeatable; "
+                        "overrides the authorino.tpu/qos-weight and "
+                        "qos-class annotations")
+    s.add_argument("--tenant-default-weight", type=float,
+                   default=env_var("TENANT_DEFAULT_WEIGHT", 1.0),
+                   help="Fair-share weight of un-annotated tenants (the "
+                        "default QoS class)")
+    s.add_argument("--tenant-quota-rps", type=float,
+                   default=env_var("TENANT_QUOTA_RPS", 0.0),
+                   help="Default per-tenant admission token-bucket rate "
+                        "(requests/s; 0 = no quota).  Per-tenant values "
+                        "come from the authorino.tpu/qos-quota-rps "
+                        "annotation.  Over-quota tenants get typed "
+                        "RESOURCE_EXHAUSTED scoped to THAT tenant — the "
+                        "global OVERLOADED latch is untouched")
+    s.add_argument("--tenant-contain-threshold", type=float,
+                   default=env_var("TENANT_CONTAIN_THRESHOLD", 3.0),
+                   help="Noisy-neighbor containment trigger: contain a "
+                        "tenant whose served share exceeds (weighted "
+                        "share x this) while the global queue wait is "
+                        "over the admission target.  Contained rows "
+                        "answer via the exact host-oracle lane or paced "
+                        "typed rejections; auto-releases on decay")
+    s.add_argument("--tenant-top-k", type=int,
+                   default=env_var("TENANT_TOP_K", 16),
+                   help="Tenant-labelled metric cardinality: only the "
+                        "top-K tenants by volume get their own label "
+                        "value, the rest fold into `other` "
+                        "(docs/tenancy.md cardinality policy)")
     s.add_argument("--expose-deny-reason", action="store_true",
                    default=env_var("EXPOSE_DENY_REASON", False),
                    help="PRIVACY KNOB (decision provenance): name the "
@@ -340,6 +380,23 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_tenant_weights(pairs) -> dict:
+    """--tenant-weight ns/name=4 (repeatable) -> {tenant: weight}.  Junk
+    entries are skipped with a warning — a typo must not stop serving."""
+    out = {}
+    for raw in pairs or []:
+        tenant, sep, w = str(raw).rpartition("=")
+        try:
+            if not sep or not tenant:
+                raise ValueError(raw)
+            out[tenant] = float(w)
+        except ValueError:
+            logging.getLogger("authorino_tpu").warning(
+                "ignoring malformed --tenant-weight %r "
+                "(want TENANT=WEIGHT)", raw)
+    return out
+
+
 def _ssl_ctx(cert: str, key: str, what: str = "--tls-cert"):
     """Server-side TLS context, minimum 1.2 like the reference
     (ref main.go:456-470)."""
@@ -518,6 +575,15 @@ async def run_server(args) -> None:
             getattr(args, "metadata_max_age", 300.0)),
         metadata_prefetch_refresh_s=float(
             getattr(args, "metadata_refresh", 60.0)),
+        tenant_qos=not getattr(args, "no_tenant_qos", False),
+        tenant_default_weight=float(
+            getattr(args, "tenant_default_weight", 1.0)),
+        tenant_weights=_parse_tenant_weights(
+            getattr(args, "tenant_weight", [])),
+        tenant_quota_rps=float(getattr(args, "tenant_quota_rps", 0.0)),
+        tenant_contain_threshold=float(
+            getattr(args, "tenant_contain_threshold", 3.0)),
+        tenant_top_k=int(getattr(args, "tenant_top_k", 16)),
     )
 
     # snapshot distribution (ISSUE 8, docs/control_plane.md): a compile

@@ -231,6 +231,7 @@ class AuthConfigReconciler:
                     labels=meta.get("labels"),
                     cluster=self.cluster,
                     engine=self.engine,
+                    annotations=meta.get("annotations"),
                 )
             except TranslationError as e:
                 self.status.set(id_, STATUS_CACHING_ERROR, str(e))

@@ -251,6 +251,7 @@ async def translate_auth_config(
     labels: Optional[Dict[str, str]] = None,
     cluster: Optional[ClusterReader] = None,
     engine: Optional[PolicyEngine] = None,
+    annotations: Optional[Dict[str, str]] = None,
 ) -> EngineEntry:
     """Returns the EngineEntry (runtime graph + compilable rules)."""
     cfg_id = f"{namespace}/{name}"
@@ -624,4 +625,7 @@ async def translate_auth_config(
         hosts=hosts,
         runtime=runtime,
         rules=ConfigRules(name=cfg_id, evaluators=pattern_slots) if pattern_slots else None,
+        # tenant QoS intent (ISSUE 15): the qos-class/weight/quota
+        # annotations ride the entry into the engine's weight book
+        annotations=dict(annotations) if annotations else None,
     )

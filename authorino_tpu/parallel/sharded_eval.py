@@ -82,7 +82,18 @@ from ..ops.pattern_eval import (
 )
 
 __all__ = ["ShardedPolicyModel", "build_mesh", "MeshUnavailable",
-           "MEMBERS_K_RELIEF_CAP"]
+           "MEMBERS_K_RELIEF_CAP", "flat_config_rows"]
+
+
+def flat_config_rows(shards, rows, configs_per_shard):
+    """Flatten mesh (shard, row) config coordinates to the single flat row
+    key the heat map, the per-authconfig telemetry bins and the tenant QoS
+    folds (ISSUE 15) all share: ``shard * configs_per_shard + row``.  One
+    vectorized expression — callers pass whole batch arrays."""
+    import numpy as _np
+
+    return (_np.asarray(shards, dtype=_np.int64) * int(configs_per_shard)
+            + _np.asarray(rows, dtype=_np.int64))
 
 log = logging.getLogger("authorino_tpu.sharded_eval")
 

@@ -229,13 +229,22 @@ class AdmissionController:
 
     def admit(self, depth: int, now: Optional[float] = None,
               deadline: Optional[float] = None,
-              rtt_s: float = 0.0) -> Optional[Tuple[int, str]]:
+              rtt_s: float = 0.0,
+              doom_depth: Optional[int] = None) -> Optional[Tuple[int, str]]:
         """Admission decision for one submit at queue ``depth``.  Returns
         None (admitted) or (rpc code, reason) — the caller raises the typed
-        CheckAbort and counts the metric via ``count_reject``."""
+        CheckAbort and counts the metric via ``count_reject``.
+
+        ``doom_depth`` (ISSUE 15): the depth the DOOMED-deadline predictor
+        uses, when it differs from the global queue depth — the tenant QoS
+        plane passes the submitting tenant's fair-share effective depth,
+        so one tenant's standing backlog cannot doom another tenant's
+        deadlines (the queue-bound checks below always use the real global
+        ``depth``; fairness must never weaken the memory bound)."""
         now = time.monotonic() if now is None else now
         self._maybe_idle_reset(now)
-        if self._doomed(depth, now, deadline, rtt_s):
+        if self._doomed(depth if doom_depth is None else doom_depth,
+                        now, deadline, rtt_s):
             return (DEADLINE_EXCEEDED, R_DOOMED)
         if self.queue_cap and depth >= self.queue_cap:
             return (RESOURCE_EXHAUSTED, R_QUEUE_FULL)

@@ -95,11 +95,19 @@ class GuardThresholds:
     # an always-denying config can never trip it.
     allow_collapse_ratio: float = 0.5
     min_config_allows: int = 8
+    # per-tenant rejection guard (ISSUE 15): a canaried change that pushes
+    # its OWN tenant's traffic into tenant-scoped rejections (quota /
+    # containment / tenant-aware doomed shedding) at an elevated rate vs
+    # the baseline cohort breaches — the per-config deny deltas above see
+    # only DECIDED requests, so a change that turns a tenant's traffic
+    # into admission rejections would otherwise promote blind.
+    tenant_reject_delta: float = 0.25
+    min_tenant_attempts: int = 16
 
 
 class _CohortStats:
     __slots__ = ("total", "denies", "errors", "slo_total", "slo_bad",
-                 "configs")
+                 "configs", "tenant_rejects")
 
     def __init__(self):
         self.total = 0
@@ -109,6 +117,9 @@ class _CohortStats:
         self.slo_bad = 0
         # authconfig name -> [requests, denies]
         self.configs: Dict[str, List[int]] = {}
+        # tenant (== authconfig) -> tenant-scoped admission rejections
+        # (ISSUE 15: quota / containment / tenant-aware doomed shedding)
+        self.tenant_rejects: Dict[str, int] = {}
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -118,6 +129,7 @@ class _CohortStats:
             "slo_observed": self.slo_total,
             "slo_bad": self.slo_bad,
             "configs_seen": len(self.configs),
+            "tenant_rejections": sum(self.tenant_rejects.values()),
         }
 
 
@@ -154,7 +166,7 @@ class CanaryGuard:
         self._g_delta = {
             g: metrics_mod.canary_guard_delta.labels(g)
             for g in ("deny-rate", "config-deny-rate", "error-rate",
-                      "slo-bad-rate")}
+                      "slo-bad-rate", "tenant-rejection-rate")}
 
     def _side(self, canary: bool) -> _CohortStats:
         return self._canary if canary else self._baseline
@@ -210,6 +222,18 @@ class CanaryGuard:
         with self._lock:
             side.slo_total += int(n)
             side.slo_bad += int(n_bad)
+
+    def observe_tenant_rejection(self, canary: bool, tenant: str,
+                                 n: int = 1) -> None:
+        """Tenant-scoped admission rejections (ISSUE 15) — per-tenant
+        guard evidence: the changed tenant's cohort must not start eating
+        quota/containment rejections the baseline cohort does not."""
+        if n <= 0:
+            return
+        side = self._side(canary)
+        with self._lock:
+            side.tenant_rejects[tenant] = \
+                side.tenant_rejects.get(tenant, 0) + int(n)
 
     # -- deciding ------------------------------------------------------------
 
@@ -286,6 +310,32 @@ class CanaryGuard:
             if suspects:
                 breached.append("config-deny-rate")
                 deltas["config-deny-rate"] = max(d for _, d in suspects)
+            # per-TENANT rejection guard (ISSUE 15): the changed tenant's
+            # cohort specifically — PR 10's per-config deny deltas see only
+            # decided requests; a change that converts its tenant's traffic
+            # into tenant-scoped admission rejections (quota, containment,
+            # tenant-aware doomed shedding) must breach here instead of
+            # promoting blind.  Attempts = decided + rejected per tenant.
+            t_suspects: List[Tuple[str, float]] = []
+            for name in set(c.tenant_rejects) | set(b.tenant_rejects):
+                if self.changed is not None and name not in self.changed:
+                    continue
+                ct, _cd = c.configs.get(name, (0, 0))
+                bt, _bd = b.configs.get(name, (0, 0))
+                cr = c.tenant_rejects.get(name, 0)
+                br = b.tenant_rejects.get(name, 0)
+                c_att, b_att = ct + cr, bt + br
+                if (c_att < th.min_tenant_attempts
+                        or b_att < th.min_tenant_attempts):
+                    continue
+                t_delta = cr / c_att - br / b_att
+                if t_delta > th.tenant_reject_delta:
+                    t_suspects.append((name, t_delta))
+            if t_suspects:
+                breached.append("tenant-rejection-rate")
+                deltas["tenant-rejection-rate"] = max(
+                    d for _, d in t_suspects)
+                suspects.extend(t_suspects)
         if not self._closed:
             for g, child in self._g_delta.items():
                 if g in deltas:

@@ -82,6 +82,7 @@ from .lane_select import (
     LaneSelector,
     Speculation,
 )
+from ..tenancy.quota import R_TENANT_CONTAINED as TEN_R_CONTAINED
 
 __all__ = ["PolicyEngine", "EngineEntry", "SnapshotRejected"]
 
@@ -108,6 +109,11 @@ class EngineEntry:
     hosts: List[str]
     runtime: RuntimeAuthConfig
     rules: Optional[ConfigRules] = None  # compilable pattern surface (may be None)
+    # AuthConfig metadata.annotations (ISSUE 15): the tenant QoS plane
+    # resolves per-tenant weights/quotas from these at every reconcile
+    # (authorino.tpu/qos-class, qos-weight, qos-quota-rps); None = the
+    # default QoS class
+    annotations: Optional[Dict[str, str]] = None
 
 
 class _Snapshot:
@@ -529,6 +535,13 @@ class PolicyEngine:
         metadata_prefetch: bool = True,
         metadata_prefetch_max_age_s: float = 300.0,
         metadata_prefetch_refresh_s: float = 60.0,
+        tenant_qos: bool = True,
+        tenant_default_weight: float = 1.0,
+        tenant_weights: Optional[Dict[str, float]] = None,
+        tenant_quota_rps: float = 0.0,
+        tenant_contain_threshold: float = 3.0,
+        tenant_contain_allowance_rps: float = 100.0,
+        tenant_top_k: int = 16,
     ):
         """``mesh="auto"`` shards the rule corpus over all visible devices
         when more than one is present (dp × mp ShardedPolicyModel);
@@ -783,6 +796,38 @@ class PolicyEngine:
         self.replay_pregate_budget_s = float(replay_pregate_budget_s)
         self._last_pregate: Optional[Dict[str, Any]] = None
         self._g_replay_flips = metrics_mod.replay_diff_flips.labels("engine")
+        # tenant QoS plane (ISSUE 15, docs/tenancy.md): weighted-fair batch
+        # cuts over per-tenant virtual queues inside the submit queue,
+        # per-tenant quotas + CoDel wait tracking + tenant-aware doomed
+        # shedding at admission, the tenant axis of the provenance/SLO
+        # folds, and noisy-neighbor containment (a tenant-scoped
+        # brownout/shed — the global OVERLOADED latch never fires for one
+        # hot tenant).  The tenant is the AuthConfig identity every
+        # encoded row already carries as config_id.
+        from ..tenancy import TenantPlane
+
+        self.tenancy = TenantPlane(
+            "engine", enabled=bool(tenant_qos),
+            default_weight=tenant_default_weight,
+            weight_overrides=tenant_weights,
+            default_quota_rps=tenant_quota_rps,
+            admission_target_s=self.admission.target_s,
+            contain_threshold=tenant_contain_threshold,
+            contain_allowance_rps=tenant_contain_allowance_rps,
+            top_k=tenant_top_k,
+            wait_ewma=lambda: self.admission.wait_ewma,
+            wait_target_s=lambda: self.admission.target_s,
+            # second pressure signal: rising GLOBAL admission rejections
+            # (the wait-targeted cap clamps the queue AT the target, so
+            # the wait gauge alone can read healthy while cold tenants
+            # are being turned away)
+            reject_count=lambda: (
+                self.admission.rejected.get("overload", 0)
+                + self.admission.rejected.get("queue-full", 0)
+                # rising queue-share rejections = a tenant persistently
+                # over-occupying the shared queue: pressure even while
+                # the bound keeps the global wait healthy
+                + self.admission.rejected.get("tenant-queue-share", 0)))
         RECORDER.register_provider("engine", self, "debug_vars")
 
     # swap listeners: the native frontend rebuilds its C++ snapshot after
@@ -931,6 +976,12 @@ class PolicyEngine:
         # to (authconfig, rule source) for THIS snapshot — attribution and
         # the dead-rule report always read the corpus that evaluated
         self._build_heat(snap)
+        # tenant QoS (ISSUE 15): weights/quotas resolve from the entries'
+        # AuthConfig annotations at every reconcile
+        try:
+            self.tenancy.bind_entries(entries)
+        except Exception:
+            log.exception("tenant weight rebuild failed (swap unaffected)")
         with self._swap_lock:
             self.generation += 1
             # the mesh lane's verdict cache keys on snap.generation (the
@@ -1704,6 +1755,10 @@ class PolicyEngine:
             # lane selection (ISSUE 12): cost-model EWMAs, per-reason
             # decision counts, rows served per lane, speculative outcomes
             "lane_select": self.lanes.to_json(),
+            # tenant QoS plane (ISSUE 15, docs/tenancy.md): weights, fair-
+            # cut evidence, per-tenant admission/wait state, top-tenant
+            # stats and containment — also served on /debug/tenants
+            "tenancy": self.tenancy.to_json(),
             "faults": (faults.FAULTS.describe() if faults.ACTIVE else
                        {"armed": False}),
             # decision observability (ISSUE 9, docs/observability.md):
@@ -1886,13 +1941,69 @@ class PolicyEngine:
         # work is rejected HERE, typed, before it queues — never after an
         # encode, never as a raw exception.  A doomed-deadline rejection
         # also counts as a deadline shed (it is one, just earlier).
+        # Tenant-aware doom depth (ISSUE 15): the deadline predictor sees
+        # THIS tenant's fair-share effective depth, not the global queue —
+        # one tenant's standing backlog cannot doom another's deadlines.
+        ten = self.tenancy
+        # tenant-scoped admission (ISSUE 15) runs BEFORE the global gate:
+        # quota token bucket, then containment pacing.  Typed
+        # RESOURCE_EXHAUSTED naming the tenant; the global OVERLOADED
+        # latch and its CoDel state are untouched — every other tenant
+        # keeps its full admission budget.  Ordering matters: a contained
+        # hot tenant's flood must be paced HERE, or it keeps the shared
+        # queue at the global cap and the global gate rejects every
+        # tenant's arrivals indiscriminately — the exact collateral
+        # containment exists to stop.
+        if ten.enabled:
+            trej = ten.admit(config_name, depth=len(self._queue),
+                             effective_cap=self.admission.effective_cap())
+            if trej is not None:
+                code, reason = trej
+                self.admission.count_reject(reason)
+                ten.count_reject(config_name, reason)
+                phase = self._canary
+                if phase is not None:
+                    # per-tenant canary guard feed (ISSUE 15): a canaried
+                    # change that pushes its own tenant into tenant-scoped
+                    # rejections must accumulate breach evidence
+                    try:
+                        in_can = phase.in_cohort(doc) or \
+                            config_name not in phase.baseline.by_id
+                        phase.guard.observe_tenant_rejection(
+                            in_can, config_name)
+                        self._canary_guard_check(phase)
+                    except Exception:
+                        log.exception("tenant canary feed failed")
+                raise CheckAbort(
+                    code, f"tenant {config_name} over its QoS budget "
+                          f"({reason}): admission rejected")
+        doom_depth = ten.doom_depth(config_name, len(self._queue)) \
+            if ten.enabled else None
         rej = self.admission.admit(len(self._queue), deadline=deadline,
-                                   rtt_s=self._device_ewma)
+                                   rtt_s=self._device_ewma,
+                                   doom_depth=doom_depth)
         if rej is not None:
             code, reason = rej
             self.admission.count_reject(reason)
             if code == DEADLINE_EXCEEDED:
                 metrics_mod.deadline_shed.labels("engine").inc()
+                if ten.enabled and doom_depth is not None:
+                    # the tenant-aware predictor doomed it: the shed is
+                    # scoped to this tenant's own standing queue — and it
+                    # feeds the per-tenant canary guard like every other
+                    # tenant-scoped rejection (the guard's documented
+                    # attempt set includes tenant-aware doomed sheds)
+                    ten.count_reject(config_name, "doomed-deadline")
+                    phase = self._canary
+                    if phase is not None:
+                        try:
+                            in_can = phase.in_cohort(doc) or \
+                                config_name not in phase.baseline.by_id
+                            phase.guard.observe_tenant_rejection(
+                                in_can, config_name)
+                            self._canary_guard_check(phase)
+                        except Exception:
+                            log.exception("tenant canary feed failed")
                 raise CheckAbort(code, "rejected at admission: deadline "
                                        "cannot be met")
             raise CheckAbort(code, f"server overloaded ({reason}): "
@@ -1915,6 +2026,7 @@ class PolicyEngine:
                                         deadline=deadline,
                                         canary=in_canary))
             self.controller.observe_arrivals()
+            ten.on_enqueue(config_name)
         loop.call_soon(self._maybe_dispatch)
         rule, skipped, snap = await fut
         if return_snapshot:
@@ -1942,6 +2054,8 @@ class PolicyEngine:
         while True:
             brown = False
             hostsel = None
+            diverted = []
+            ten = self.tenancy
             with self._queue_lock:
                 depth = len(self._queue)
                 if not self._queue:
@@ -1956,22 +2070,51 @@ class PolicyEngine:
                     # controller's advisory target would fragment standing
                     # queues into cold pad shapes — see AdaptiveWindow
                     n = min(depth, self.max_batch)
-                    # lane selection (ISSUE 12): the cost model decides at
-                    # the cut whether these rows are answered host-side
-                    # (small cut, host_cost < device_cost) or ride the
-                    # device — the host lane consumes NO window slot
-                    which, why = self.lanes.decide(
-                        n, self._inflight, self.controller.window)
-                    batch = [self._queue.popleft() for _ in range(n)]
-                    parts = _split_cohorts(batch, phase)
-                    if which == L_HOST:
-                        self.lanes.host_inflight += len(parts)
-                        hostsel = why
+                    # weighted-fair cut (ISSUE 15, docs/tenancy.md): under
+                    # contention (more queued than the cut takes) the cut
+                    # is a deficit-round-robin selection over per-tenant
+                    # virtual queues — a 10x hot tenant fills at most its
+                    # weighted share of THIS batch while cold rows keep
+                    # arrival order.  Uncontended cuts take everything:
+                    # fairness only reorders service, it never re-decides.
+                    if ten.enabled and depth > n:
+                        batch = ten.cut(self._queue, n)
                     else:
-                        self._inflight += len(parts)
-                        if self._inflight > self.inflight_peak:
-                            self.inflight_peak = self._inflight
-                        inflight = self._inflight
+                        batch = [self._queue.popleft() for _ in range(n)]
+                    ten.on_dequeue(batch)
+                    # noisy-neighbor containment (ISSUE 15): a contained
+                    # tenant's rows peel off to the exact host-oracle lane
+                    # (verdicts identical by construction) so the device
+                    # window and the global brownout latch never see its
+                    # overload — bounded by the host concurrency cap;
+                    # past it the rows stay in the (already fair) cut.
+                    if ten.has_contained():
+                        keep, div = ten.split_contained(batch)
+                        if div and (self.lanes.host_inflight
+                                    < self.lanes.host_limit):
+                            batch = keep
+                            diverted = _split_cohorts(div, phase)
+                            self.lanes.host_inflight += len(diverted)
+                    if not batch:
+                        parts = []
+                    else:
+                        # lane selection (ISSUE 12): the cost model decides
+                        # at the cut whether these rows are answered
+                        # host-side (small cut, host_cost < device_cost) or
+                        # ride the device — the host lane consumes NO
+                        # window slot
+                        which, why = self.lanes.decide(
+                            len(batch), self._inflight,
+                            self.controller.window)
+                        parts = _split_cohorts(batch, phase)
+                        if which == L_HOST:
+                            self.lanes.host_inflight += len(parts)
+                            hostsel = why
+                        else:
+                            self._inflight += len(parts)
+                            if self._inflight > self.inflight_peak:
+                                self.inflight_peak = self._inflight
+                            inflight = self._inflight
                 elif (self.brownout
                       and self._brownout_inflight < self._brownout_limit
                       and (time.monotonic() - self._queue[0].t_enq)
@@ -1981,12 +2124,13 @@ class PolicyEngine:
                     # the host lane — no window slot consumed
                     n = min(depth, self.brownout_max_batch)
                     batch = [self._queue.popleft() for _ in range(n)]
+                    ten.on_dequeue(batch)
                     parts = _split_cohorts(batch, phase)
                     self._brownout_inflight += len(parts)
                     brown = True
                 else:
                     break
-            if not brown:
+            if not brown and parts:
                 # ONE decision per CUT (the metric's unit), outside the
                 # queue lock, even when a canary splits the cut into
                 # cohort parts.  The inflight counters stay per PART —
@@ -1995,6 +2139,14 @@ class PolicyEngine:
                 # transiently sit one above host_limit: a throttle, not
                 # an invariant)
                 self.lanes.count(which, why)
+            for is_canary, part in diverted:
+                # contained-tenant rows: host-oracle lane, its own reason
+                # label — NOT brownout (the global spill counters must not
+                # read a tenant-scoped clamp as process overload)
+                self.lanes.count(L_HOST, TEN_R_CONTAINED)
+                _encode_pool(self.dispatch_workers).submit(
+                    self._host_lane_job, self._snap_for(phase, is_canary),
+                    part, None, TEN_R_CONTAINED)
             for is_canary, part in parts:
                 # pinned per batch: double-buffer swap safety.  During a
                 # canary the cohort picks its generation; a phase that
@@ -2359,7 +2511,7 @@ class PolicyEngine:
 
     def _observe_provenance(self, snap: _Snapshot, pendings: List[_Pending],
                             rows, own_rule, own_skipped, shards=None,
-                            lane: str = "engine"):
+                            lane: str = "engine", waits=None):
         """Per-batch decision-observability fold: which-rule-fired columns →
         the snapshot's heat map (vectorized composite-key bincount), plus at
         most ONE head-sampled decision record.  Never raises — a telemetry
@@ -2373,12 +2525,40 @@ class PolicyEngine:
 
             firing = firing_columns(own_rule, own_skipped)
             p = pendings[0] if pendings else None
+            now_m = time.monotonic()
             prov_mod.fold_and_sample(
                 heat, rows, firing, len(pendings), lane=lane, shards=shards,
                 host=_doc_host(p.doc) if p is not None else "",
-                latency_ms=((time.monotonic() - p.t_enq) * 1e3
+                latency_ms=((now_m - p.t_enq) * 1e3
                             if p is not None and p.t_enq else 0.0),
-                generation=snap.generation)
+                generation=snap.generation,
+                # stratified sampling (ISSUE 15): each sampled TENANT's
+                # record carries ITS OWN request's host/latency, not the
+                # batch head's — called only for sampled tenants, bounded
+                host_of=lambda i: _doc_host(pendings[i].doc),
+                latency_of=lambda i: ((now_m - pendings[i].t_enq) * 1e3
+                                      if pendings[i].t_enq else 0.0))
+            # tenant axis (ISSUE 15): the SAME per-batch seam feeds the
+            # per-tenant request/deny counters, wait EWMAs and SLO burn —
+            # and because EVERY lane's completion funnels through here
+            # (device finalize, host lane, brownout spill, host-oracle
+            # degrade), contained and degraded traffic burns the right
+            # tenant's accounting too (the old gap the parity test pins).
+            # Two clocks, deliberately distinct: ``waits`` (queue waits,
+            # captured at the CUT by the device path; sojourn on the
+            # host-oracle lanes where service is microseconds) feed the
+            # per-tenant CoDel wait signal, while the SLO bad mask reads
+            # the full SOJOURN at completion — end-to-end latency is what
+            # the --slo-ms budget is about.
+            if self.tenancy.enabled:
+                sojourn = np.asarray([(now_m - q.t_enq) if q.t_enq else 0.0
+                                      for q in pendings])
+                self.tenancy.fold(
+                    heat, rows, firing=firing, shards=shards,
+                    waits=(waits if waits is not None else sojourn),
+                    bad_mask=(sojourn > self.slo.slo_s
+                              if self.slo is not None else None),
+                    lane=lane)
             # traffic capture (ISSUE 13): the full-fidelity sampled request
             # log rides the same per-batch seam as the decision sampler —
             # one enabled check per batch when off; when on, each sampled
@@ -2770,9 +2950,11 @@ class PolicyEngine:
                                       elig_miss, evict_d)
             # attribution (ISSUE 9): one per-batch fold over the FINAL
             # columns — cache hits, dedup fan-out and fallback rows are
-            # already folded back in, so every path attributes identically
+            # already folded back in, so every path attributes identically.
+            # ``waits`` are the cut-time QUEUE waits (the tenant wait
+            # signal must not absorb the device round trip)
             self._observe_provenance(snap, batch, rows, own_rule,
-                                     own_skipped)
+                                     own_skipped, waits=waits)
             return own_rule, own_skipped, n_fallback
 
         return _Inflight(self, batch, handle, finalize, binfo, waits)
@@ -2863,7 +3045,8 @@ class PolicyEngine:
             metrics_mod.observe_dedup("engine", n, u, len(cached),
                                       elig_miss, evict_d)
             self._observe_provenance(snap, batch, enc.row_of[:n], own_rule,
-                                     own_skipped, shards=enc.shard_of[:n])
+                                     own_skipped, shards=enc.shard_of[:n],
+                                     waits=waits)
             return own_rule, own_skipped, None
 
         item = _Inflight(self, batch, handle, finalize, binfo, waits)

@@ -793,6 +793,16 @@ class NativeFrontend:
             from ..utils.slo import SloTracker
 
             self.slo = SloTracker("native", slo_ms)
+        # tenant QoS (ISSUE 15): the native lane SHARES the engine's tenant
+        # plane — the C++ gather owns its own slot cut (no Python-side
+        # reorder seam), but every completed slot folds its tenant axis
+        # (config_id rows) into the same per-tenant request/deny/SLO
+        # counters the engine lane feeds (queue waits stay C++-clocked and
+        # out of the per-tenant CoDel signal), so detection, weights and
+        # the /debug/tenants view see one multi-lane truth; containment
+        # ENFORCEMENT lands at the engine/slow-lane admission
+        # (docs/tenancy.md names the fast-lane caveat).
+        self.tenancy = getattr(engine, "tenancy", None)
         RECORDER.register_provider("native_frontend", self, "debug_vars")
 
     # ------------------------------------------------------------------
@@ -1000,6 +1010,10 @@ class NativeFrontend:
                          if rec is not None and rec.heat is not None
                          else None),
             },
+            # tenant QoS (ISSUE 15): the shared plane's view — the native
+            # lane feeds the same per-tenant folds the engine lane reads
+            "tenancy": (self.tenancy.to_json()
+                        if self.tenancy is not None else None),
             "slo": self.slo.to_json() if self.slo is not None else None,
             # change-safety mirror (ISSUE 10): the native lane holds the
             # baseline through a canary window (refresh fires on
@@ -2495,6 +2509,13 @@ class NativeFrontend:
                 prov_mod.fold_and_sample(rec.heat, rows, firing, count,
                                          lane="native",
                                          generation=rec.snap_id)
+                # tenant parity (ISSUE 15 satellite): degraded slots used
+                # to bypass per-tenant accounting entirely — a contained
+                # or degraded tenant's traffic must still burn ITS
+                # requests/denies, not vanish from the tenant plane
+                ten = self.tenancy
+                if ten is not None and ten.enabled:
+                    ten.fold(rec.heat, rows, firing=firing, lane="native")
             except Exception:
                 log.exception("degrade provenance fold failed")
 
@@ -2578,6 +2599,29 @@ class NativeFrontend:
                                      lane="native", shards=shards_arr,
                                      latency_ms=dispatch_s * 1e3,
                                      generation=rec.snap_id)
+        # tenant axis (ISSUE 15): every completed slot — device, lane-
+        # selected host AND brownout spill alike (device=False paths
+        # included) — folds per-tenant requests/denies/SLO into the shared
+        # plane, so fast-lane traffic is never invisible to the
+        # noisy-neighbor detector or the per-tenant burn trackers
+        ten = self.tenancy
+        if ten is not None and ten.enabled and heat is not None and count:
+            try:
+                # waits=None: the native lane's per-request queue waits
+                # are C++-clocked — feeding the batch ROUND TRIP as a
+                # "queue wait" would latch every tenant overloaded on
+                # normal device latency.  The SLO bad mask keeps the
+                # lane's established SLI (the batch's on-box round trip,
+                # shared by every member).
+                slo_s = self.slo.slo_s if self.slo is not None else 0.0
+                ten.fold(heat, rows, firing=firing, shards=shards_arr,
+                         bad_mask=(np.full(count, dispatch_s > slo_s)
+                                   if slo_s else None),
+                         denied_mask=(np.asarray(verdict) == 0)
+                         if firing is None else None,
+                         lane="native")
+            except Exception:
+                log.exception("tenant fold failed (telemetry only)")
             # change safety (ISSUE 10): during an engine canary the native
             # fast lane serves the BASELINE (its C++ snapshot only
             # rebuilds on promotion — swap listeners are deferred), so its
@@ -2616,8 +2660,10 @@ class NativeFrontend:
         # per-authconfig request metrics, same counters + labels the
         # pipeline bumps (ref pkg/service/auth_pipeline.go:26-36)
         if shards_arr is not None:
+            from ..parallel.sharded_eval import flat_config_rows
+
             G = rec.sharded.configs_per_shard
-            flat = shards_arr.astype(np.int64) * G + rows
+            flat = flat_config_rows(shards_arr, rows, G)
             n_per = np.bincount(flat)
             ok_per = np.bincount(flat, weights=verdict).astype(np.int64)
             keys = [(int(f // G), int(f % G)) for f in np.nonzero(n_per)[0]]

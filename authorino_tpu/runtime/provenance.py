@@ -385,14 +385,34 @@ def check_decision_schema(payload: Any) -> None:
 
 
 class DecisionLog:
-    """Head-sampled decision ring.  ``should_sample(n)`` is the per-batch
-    gate: one atomic-ish counter add deciding whether this batch's HEAD
-    decision gets a record — O(1) per batch, no per-request work.  The ring
-    is a deque(maxlen), JSON-served on /debug/decisions."""
+    """Head-sampled decision ring, sampled STRATIFIED per tenant (ISSUE 15
+    satellite).  The sampler used to be one global 1-in-N counter with at
+    most one record per batch — under a zipf-headed workload the hot
+    tenant's batches won essentially every fire AND its records evicted
+    every cold-tenant record from the bounded ring, so /debug/decisions
+    showed exactly one tenant.  Now:
 
-    def __init__(self, capacity: int = 1024, sample_n: int = 64):
+    - ``should_sample_tenant(tenant, n)`` keeps an independent 1-in-N
+      counter PER tenant (bounded LRU table), and ``fold_and_sample``
+      fires it once per distinct tenant in the batch — at most one record
+      per tenant per batch, Python work bounded by distinct tenants (the
+      same composite-key discipline as the heat-map fold);
+    - alongside the global ring, each tenant keeps a small per-tenant
+      sub-ring (``tenant_capacity`` newest records, LRU-bounded tenants),
+      so a hot tenant filling the global ring can never evict a cold
+      tenant's last records — ``/debug/decisions?tenant=NAME`` serves
+      them.
+
+    ``should_sample(n)`` (the legacy global gate) remains for callers with
+    no tenant axis."""
+
+    MAX_TENANTS = 512
+
+    def __init__(self, capacity: int = 1024, sample_n: int = 64,
+                 tenant_capacity: int = 4):
         self.capacity = max(1, int(capacity))
         self.sample_n = max(1, int(sample_n))
+        self.tenant_capacity = max(1, int(tenant_capacity))
         self._ring: deque = deque(maxlen=self.capacity)
         # guards ring append vs snapshot: both lanes record concurrently
         # while /debug/decisions lists the ring, and iterating a deque
@@ -400,6 +420,10 @@ class DecisionLog:
         self._lock = threading.Lock()
         self._seen = 0
         self._next_fire = 1  # first decision samples (head of the stream)
+        # tenant -> [seen, next_fire]; insertion order is the LRU axis
+        self._tenant_gate: Dict[str, list] = {}
+        # tenant -> deque(maxlen=tenant_capacity) of its newest records
+        self._tenant_ring: Dict[str, deque] = {}
         self.records_total = 0
 
     def configure(self, capacity: Optional[int] = None,
@@ -413,6 +437,8 @@ class DecisionLog:
             # re-arm from here: a tighter rate must not wait out the fire
             # point the old (possibly much larger) rate scheduled
             self._next_fire = self._seen + self.sample_n
+            with self._lock:
+                self._tenant_gate.clear()
 
     def should_sample(self, n_decisions: int) -> bool:
         """Advance the decision counter by this batch's size; True when the
@@ -424,6 +450,27 @@ class DecisionLog:
         seen = self._seen = self._seen + n_decisions
         if seen >= self._next_fire:
             self._next_fire = seen + self.sample_n
+            return True
+        return False
+
+    def should_sample_tenant(self, tenant: str, n_decisions: int) -> bool:
+        """The stratified gate: this TENANT's own 1-in-N counter, advanced
+        by its decision count within the batch.  The first decision a
+        tenant ever shows always samples (cold tenants become visible on
+        their first batch, not after N of them)."""
+        if n_decisions <= 0:
+            return False
+        gate = self._tenant_gate.get(tenant)
+        if gate is None:
+            if len(self._tenant_gate) >= self.MAX_TENANTS:
+                with self._lock:
+                    # LRU-ish bound: drop the oldest-inserted third
+                    for t in list(self._tenant_gate)[:self.MAX_TENANTS // 3]:
+                        self._tenant_gate.pop(t, None)
+            gate = self._tenant_gate[tenant] = [0, 1]
+        gate[0] += n_decisions
+        if gate[0] >= gate[1]:
+            gate[1] = gate[0] + self.sample_n
             return True
         return False
 
@@ -445,21 +492,43 @@ class DecisionLog:
         with self._lock:
             self._ring.append(rec)
             self.records_total += 1
+            if authconfig:
+                sub = self._tenant_ring.get(authconfig)
+                if sub is None:
+                    if len(self._tenant_ring) >= self.MAX_TENANTS:
+                        for t in list(self._tenant_ring)[
+                                :self.MAX_TENANTS // 3]:
+                            self._tenant_ring.pop(t, None)
+                    sub = self._tenant_ring[authconfig] = deque(
+                        maxlen=self.tenant_capacity)
+                sub.append(rec)
         metrics_mod.decision_records.labels(lane).inc()
 
-    def to_json(self, n: Optional[int] = None) -> Dict[str, Any]:
+    def to_json(self, n: Optional[int] = None,
+                tenant: Optional[str] = None) -> Dict[str, Any]:
         with self._lock:
-            records = list(self._ring)
+            if tenant is not None:
+                records = list(self._tenant_ring.get(tenant, ()))
+            else:
+                records = list(self._ring)
+            tenants_tracked = len(self._tenant_ring)
         if n is not None:
             n = max(0, int(n))
             records = records[-n:] if n else []
-        return {
+        out = {
             "schema": DECISION_SCHEMA,
             "capacity": self.capacity,
             "sample_n": self.sample_n,
             "records_total": self.records_total,
             "records": records,
+            "stratified": {
+                "tenants_tracked": tenants_tracked,
+                "per_tenant_capacity": self.tenant_capacity,
+            },
         }
+        if tenant is not None:
+            out["tenant"] = tenant
+        return out
 
 
 # one ring per process: both lanes sample into it, the analysis CLI and
@@ -469,25 +538,50 @@ DECISIONS = DecisionLog()
 
 def fold_and_sample(heat: HeatMap, rows, firing, n: int, *, lane: str,
                     shards=None, host: str = "", latency_ms: float = 0.0,
-                    generation: Any = None) -> None:
+                    generation: Any = None, host_of=None,
+                    latency_of=None) -> None:
     """The one per-batch observability sequence every lane's completion
-    runs: fold the batch's attribution into the heat map, then head-sample
-    at most one decision record.  Keeping it here means a schema or
-    sampling change lands once, not once per lane."""
+    runs: fold the batch's attribution into the heat map, then sample
+    decision records STRATIFIED per tenant — at most one record per
+    distinct tenant (authconfig) per batch, each tenant gated by its own
+    1-in-N counter, so a zipf-hot tenant can neither win every sample nor
+    evict the cold tenants' records (ISSUE 15 satellite).  Python work is
+    bounded by distinct tenants in the batch, never the batch size.
+    Keeping it here means a schema or sampling change lands once, not once
+    per lane."""
     heat.fold(rows, firing, shards=shards)
-    if n and DECISIONS.should_sample(n):
-        col = int(firing[0])
-        row0 = int(rows[0])
-        shard0 = int(shards[0]) if shards is not None else None
+    if not n:
+        return
+    rows_a = np.asarray(rows, dtype=np.int64)
+    flat = rows_a
+    if shards is not None and heat.configs_per_shard:
+        flat = np.asarray(shards, dtype=np.int64) * \
+            heat.configs_per_shard + rows_a
+    uniq, first, counts = np.unique(flat, return_index=True,
+                                    return_counts=True)
+    for u, i, k in zip(uniq, first, counts):
+        name = heat.name(int(u))
+        if not DECISIONS.should_sample_tenant(name, int(k)):
+            continue
+        i = int(i)
+        col = int(firing[i])
+        row_i = int(rows_a[i])
+        shard_i = int(shards[i]) if shards is not None else None
+        # per-record resolvers (``host_of``/``latency_of``, called only
+        # for SAMPLED tenants): each tenant's record carries ITS OWN
+        # request's host/latency — the batch head's values belong to a
+        # different tenant in a mixed batch, which is exactly the wrong
+        # evidence in the per-tenant sub-rings
         DECISIONS.record(
             lane=lane,
-            host=host,
-            authconfig=heat.name(row0, shard=shard0),
+            host=(host_of(i) if host_of is not None else host),
+            authconfig=name,
             verdict=col < 0,
-            rule=(rule_label(col, heat.source(row0, col, shard=shard0))
+            rule=(rule_label(col, heat.source(row_i, col, shard=shard_i))
                   if col >= 0 else None),
             rule_index=col,
-            latency_ms=latency_ms,
+            latency_ms=(latency_of(i) if latency_of is not None
+                        else latency_ms),
             generation=generation)
 
 

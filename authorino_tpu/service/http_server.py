@@ -248,8 +248,10 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
         """Head-sampled decision log (ISSUE 9, docs/observability.md
         "Decision provenance"): the bounded ring of structured decision
         records — host, authconfig, verdict, firing rule, lane, latency,
-        snapshot generation.  ``?n=K`` returns the newest K records.
-        Query it live, or feed the JSON to
+        snapshot generation.  ``?n=K`` returns the newest K records;
+        ``?tenant=NAME`` (ISSUE 15) returns that tenant's stratified
+        sub-ring — its newest records survive even when a hot tenant has
+        filled the global ring.  Query it live, or feed the JSON to
         ``python -m authorino_tpu.analysis --decisions``."""
         from ..runtime import provenance as prov_mod
 
@@ -259,7 +261,18 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
                 n = int(request.query["n"])
             except ValueError:
                 return web.Response(status=400, text="bad n")
-        return web.json_response(prov_mod.DECISIONS.to_json(n=n))
+        tenant = request.query.get("tenant") or None
+        return web.json_response(
+            prov_mod.DECISIONS.to_json(n=n, tenant=tenant))
+
+    async def debug_tenants(_):
+        """Tenant QoS plane (ISSUE 15, docs/tenancy.md): weights/quotas,
+        fair-cut evidence, per-tenant admission + wait state, top-tenant
+        stats with SLO burn, and the noisy-neighbor containment set."""
+        plane = getattr(engine, "tenancy", None)
+        if plane is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(plane.to_json())
 
     async def debug_replay(request: web.Request):
         """Traffic-replay state (ISSUE 13, docs/replay.md): capture-log
@@ -372,6 +385,7 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
     app.router.add_get("/server-metrics", server_metrics)
     app.router.add_get("/debug/vars", debug_vars)
     app.router.add_get("/debug/decisions", debug_decisions)
+    app.router.add_get("/debug/tenants", debug_tenants)
     app.router.add_get("/debug/canary", debug_canary)
     app.router.add_post("/debug/canary", debug_canary)
     app.router.add_get("/debug/replay", debug_replay)

@@ -1008,3 +1008,87 @@ replay_diff_flips = _gauge(
     "incident evidence until the next preflight.",
     _LANE_LABELS,
 )
+
+# ---------------------------------------------------------------------------
+# Tenant QoS plane (ISSUE 15, docs/tenancy.md): per-tenant serving counters,
+# tenant-scoped admission rejections, and containment state.
+#
+# CARDINALITY POLICY: every family carrying a `tenant` label is
+# bounded-cardinality BY CONSTRUCTION — the tenancy stats flush assigns real
+# tenant names only to the top-K tenants by request volume (K from
+# TENANT_LABEL_BOUNDS below, the declared HARD bound) and folds everything
+# else into the reserved `other` bucket, so a million-tenant corpus can
+# never mint a million label values.  analysis/metrics_catalog.py lints
+# that every tenant-labelled family declares its bound here (tier-1 +
+# --verify-fixtures, with a planted violation self-test).
+# ---------------------------------------------------------------------------
+
+# the reserved fold-over label value for tenants outside the top-K
+TENANT_OTHER = "other"
+
+# family (exposition name) -> max distinct real-tenant label values the
+# flush may mint (the `other` bucket rides on top).  The metrics-catalog
+# lint fails any tenant-labelled family missing from this table.
+TENANT_LABEL_BOUNDS = {
+    "auth_server_tenant_requests_total": 32,
+    "auth_server_tenant_denied_total": 32,
+    "auth_server_tenant_slo_bad_total": 32,
+    "auth_server_tenant_rejected_total": 32,
+    "auth_server_tenant_queue_wait_seconds": 32,
+    "auth_server_tenant_contained": 32,
+}
+
+tenant_requests = _counter(
+    "auth_server_tenant_requests_total",
+    "Requests decided per tenant (AuthConfig identity) and lane, folded "
+    "once per micro-batch from the tenant axis of the provenance fold — "
+    "device, host, brownout and degrade lanes all count (contained and "
+    "degraded traffic still burns the right tenant's accounting).  "
+    "Bounded cardinality: top-K tenants by volume + the `other` bucket "
+    "(docs/tenancy.md).",
+    _LANE_LABELS + ("tenant",),
+)
+tenant_denied = _counter(
+    "auth_server_tenant_denied_total",
+    "Denials per tenant and lane (the same per-batch fold as "
+    "auth_server_tenant_requests_total).  Top-K + `other` bounded.",
+    _LANE_LABELS + ("tenant",),
+)
+tenant_slo_bad = _counter(
+    "auth_server_tenant_slo_bad_total",
+    "Requests counted against the SLO error budget per tenant (latency "
+    "over --slo-ms), the tenant axis of the per-lane burn trackers.  "
+    "Top-K + `other` bounded.",
+    _LANE_LABELS + ("tenant",),
+)
+tenant_rejected = _counter(
+    "auth_server_tenant_rejected_total",
+    "Tenant-SCOPED admission rejections by reason: tenant-quota (the "
+    "tenant's token bucket ran dry), tenant-queue-share (the tenant's "
+    "standing backlog exceeded its weighted share of the bounded submit "
+    "queue while the queue was past half its cap), tenant-contained (the "
+    "noisy-neighbor containment paced this tenant's traffic), "
+    "doomed-deadline (the tenant-aware shedder — the tenant's own "
+    "fair-share wait, not the global queue, doomed the deadline).  The "
+    "global OVERLOADED latch is untouched by all of these.  Top-K + "
+    "`other` bounded.",
+    ("tenant", "reason"),
+)
+tenant_queue_wait = _gauge(
+    "auth_server_tenant_queue_wait_seconds",
+    "Per-tenant queue-wait EWMA (the tenant axis of the CoDel wait "
+    "signal), refreshed on the tenancy flush cadence for the top-K "
+    "tenants by volume.  Top-K bounded (no `other`: a mean over unrelated "
+    "tenants is not a wait).",
+    ("tenant",),
+)
+tenant_contained = _gauge(
+    "auth_server_tenant_contained",
+    "1 while the noisy-neighbor detector has this tenant CONTAINED "
+    "(sustained share above weight x threshold with the global queue wait "
+    "over target): its rows answer via the exact host-oracle lane or "
+    "paced typed rejections instead of flipping the global brownout/"
+    "OVERLOADED latch; 0 after auto-release.  Bounded by the containment "
+    "cap (far below the declared top-K bound).",
+    ("tenant",),
+)

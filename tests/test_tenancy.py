@@ -1,0 +1,749 @@
+"""Tenant QoS plane (ISSUE 15): weighted-fair batch cuts, per-tenant
+quotas/SLO, noisy-neighbor containment, stratified decision sampling, and
+the tenant-label cardinality lint.
+
+Deliberately import-light: collects on images without `cryptography`
+(no evaluators.identity / native_frontend imports)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+
+import numpy as np
+import pytest
+
+from authorino_tpu.compiler import ConfigRules
+from authorino_tpu.expressions import All, Operator, Pattern
+from authorino_tpu.runtime import EngineEntry, PolicyEngine
+from authorino_tpu.runtime import provenance as prov_mod
+from authorino_tpu.runtime.admission import ADMIT, AdmissionController
+from authorino_tpu.runtime.flight_recorder import RECORDER
+from authorino_tpu.tenancy import (
+    R_TENANT_CONTAINED,
+    R_TENANT_QUOTA,
+    FairCutter,
+    NoisyNeighborDetector,
+    TenantAdmission,
+    TenantPlane,
+    TenantStats,
+    WeightBook,
+)
+from authorino_tpu.utils.rpc import RESOURCE_EXHAUSTED, CheckAbort
+from authorino_tpu.utils.slo import KeyedBurn
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+RULE = All(
+    Pattern("auth.identity.roles", Operator.INCL, "admin"),
+    Pattern("auth.identity.groups", Operator.EXCL, "banned"),
+)
+
+
+def build_engine(n_tenants=3, annotations=None, **kw) -> PolicyEngine:
+    kw.setdefault("verdict_cache_size", 0)
+    kw.setdefault("max_batch", 8)
+    engine = PolicyEngine(members_k=4, mesh=None, **kw)
+    engine.apply_snapshot([
+        EngineEntry(id=f"t{i}", hosts=[f"t{i}"], runtime=None,
+                    rules=ConfigRules(name=f"t{i}",
+                                      evaluators=[(None, RULE)]),
+                    annotations=(annotations or {}).get(f"t{i}"))
+        for i in range(n_tenants)
+    ])
+    return engine
+
+
+def doc(i: int, allow: bool = True) -> dict:
+    return {"auth": {"identity": {
+        "roles": ["admin", f"r{i}"] if allow else [f"r{i}"],
+        "groups": []}}}
+
+
+class P:
+    """Minimal _Pending stand-in for the cutter/admission units."""
+
+    def __init__(self, tenant, seq=0):
+        self.config_name = tenant
+        self.seq = seq
+        self.t_enq = time.monotonic()
+
+
+# ---------------------------------------------------------------------------
+# weights from annotations
+# ---------------------------------------------------------------------------
+
+
+class TestWeights:
+    def test_class_weight_quota_resolution(self):
+        book = WeightBook()
+        book.rebuild({
+            "gold": {"authorino.tpu/qos-class": "Gold"},
+            "explicit": {"authorino.tpu/qos-weight": "7.5"},
+            "quota": {"authorino.tpu/qos-quota-rps": "25"},
+            "junk": {"authorino.tpu/qos-weight": "not-a-number"},
+            "plain": None,
+        })
+        assert book.weight("gold") == 4.0
+        assert book.weight("explicit") == 7.5
+        assert book.weight("junk") == 1.0       # typo never zeroes a share
+        assert book.weight("plain") == 1.0
+        assert book.weight("never-seen") == 1.0
+        assert book.quota_rps("quota") == 25.0
+        assert book.quota_rps("plain") == 0.0
+
+    def test_override_beats_annotation(self):
+        book = WeightBook(overrides={"t": 9.0})
+        book.rebuild({"t": {"authorino.tpu/qos-weight": "2"}})
+        assert book.weight("t") == 9.0
+
+    def test_share_is_relative_to_backlogged_set(self):
+        book = WeightBook()
+        book.rebuild({"a": {"authorino.tpu/qos-weight": "3"}, "b": None})
+        assert book.share("a", ["a", "b"]) == pytest.approx(0.75)
+        assert book.share("a", ["a"]) == 1.0
+        assert book.share("b", []) == 1.0
+
+    def test_engine_binds_annotations_at_reconcile(self):
+        engine = build_engine(annotations={
+            "t0": {"authorino.tpu/qos-weight": "4"}})
+        assert engine.tenancy.book.weight("t0") == 4.0
+        assert engine.tenancy.book.weight("t1") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair cut: work conservation, share accuracy, ordering
+# ---------------------------------------------------------------------------
+
+
+class TestFairCut:
+    def test_sole_backlogged_tenant_gets_the_full_batch(self):
+        """Work conservation: with one tenant backlogged, fairness must
+        never leave batch slots empty."""
+        book = WeightBook()
+        book.rebuild({"a": None})
+        cutter = FairCutter(book.weight)
+        q = deque(P("a", i) for i in range(40))
+        batch = cutter.cut(q, 16)
+        assert len(batch) == 16
+        assert [p.seq for p in batch] == list(range(16))
+
+    def test_uncontended_cut_equals_unfair_pop(self):
+        cutter = FairCutter(lambda t: 1.0)
+        q = deque(P("a", i) for i in range(5))
+        batch = cutter.cut(q, 8)
+        assert [p.seq for p in batch] == list(range(5)) and not q
+
+    @pytest.mark.parametrize("weights", [
+        {"a": 1.0, "b": 1.0},
+        {"a": 1.0, "b": 4.0},
+        {"a": 1.0, "b": 2.0, "c": 4.0},
+    ])
+    def test_share_accuracy_within_one_batch_of_slack(self, weights):
+        """Property (ISSUE 15 satellite): with every tenant persistently
+        backlogged, cumulative selected counts track the weight mix within
+        one batch of slack, under three weight mixes."""
+        book = WeightBook()
+        book.rebuild({t: {"authorino.tpu/qos-weight": str(w)}
+                      for t, w in weights.items()})
+        cutter = FairCutter(book.weight)
+        n, cuts = 16, 24
+        got = {t: 0 for t in weights}
+        q = deque()
+        seq = 0
+        for _ in range(cuts):
+            # replenish so every tenant stays deeply backlogged
+            for t in weights:
+                for _ in range(2 * n):
+                    q.append(P(t, seq))
+                    seq += 1
+            for p in cutter.cut(q, n):
+                got[p.config_name] += 1
+        total_w = sum(weights.values())
+        for t, w in weights.items():
+            expected = cuts * n * w / total_w
+            assert abs(got[t] - expected) <= n, (
+                f"tenant {t}: got {got[t]}, expected ~{expected:.0f} "
+                f"(mix {weights})")
+
+    def test_work_conserving_spill_when_a_tenant_drains(self):
+        """Unused share spills: a tenant with fewer rows than its share
+        frees the rest of the batch to the backlogged tenant."""
+        book = WeightBook()
+        book.rebuild({"big": {"authorino.tpu/qos-weight": "8"},
+                      "small": None})
+        cutter = FairCutter(book.weight)
+        q = deque([P("big", i) for i in range(3)]
+                  + [P("small", 100 + i) for i in range(40)])
+        batch = cutter.cut(q, 16)
+        assert len(batch) == 16
+        assert sum(1 for p in batch if p.config_name == "big") == 3
+        assert sum(1 for p in batch if p.config_name == "small") == 13
+
+    def test_arrival_order_preserved_within_batch_and_remainder(self):
+        cutter = FairCutter(lambda t: 1.0)
+        items = []
+        q = deque()
+        for i in range(30):
+            p = P("hot" if i % 3 else "cold", i)
+            q.append(p)
+            items.append(p)
+        batch = cutter.cut(q, 10)
+        assert [p.seq for p in batch] == sorted(p.seq for p in batch)
+        assert [p.seq for p in q] == sorted(p.seq for p in q)
+        # nothing duplicated or lost
+        assert {id(p) for p in batch} | {id(p) for p in q} == \
+            {id(p) for p in items}
+        assert len(batch) + len(q) == 30
+
+    def test_hot_tenant_cannot_starve_cold_rows(self):
+        """The regression the fair cut exists to kill: a 10x hot tenant
+        fills at most its share of each contended cut, so a cold tenant's
+        lone rows ride the NEXT batch, not the end of the hot backlog."""
+        cutter = FairCutter(lambda t: 1.0)
+        q = deque([P("hot", i) for i in range(200)])
+        q.append(P("cold", 999))
+        batch = cutter.cut(q, 16)
+        assert any(p.config_name == "cold" for p in batch)
+
+
+# ---------------------------------------------------------------------------
+# fairness must reorder, never re-decide: byte-identical verdicts
+# ---------------------------------------------------------------------------
+
+
+class TestFairnessExactness:
+    def test_verdict_and_attribution_identical_fair_vs_unfair(self):
+        """Property (ISSUE 15 satellite): the same multi-tenant workload
+        through a fair-cut engine and an unfair (tenant_qos=False) engine
+        produces byte-identical per-request (rule, skipped) columns."""
+        fair = build_engine(n_tenants=3, tenant_qos=True)
+        unfair = build_engine(n_tenants=3, tenant_qos=False)
+        docs = [doc(i, allow=(i % 3 != 1)) for i in range(48)]
+        names = [f"t{i % 3}" for i in range(48)]
+
+        async def burst(engine):
+            outs = await asyncio.gather(
+                *(engine.submit(d, n) for d, n in zip(docs, names)))
+            return outs
+
+        got_fair = run(burst(fair))
+        got_unfair = run(burst(unfair))
+        for (r1, s1), (r2, s2) in zip(got_fair, got_unfair):
+            assert np.array_equal(np.asarray(r1), np.asarray(r2))
+            assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+    def test_contended_cut_is_fair_in_the_engine(self):
+        """Structural: with tenancy on, the engine's contended cuts run
+        through the FairCutter (the cutter's counters move)."""
+        engine = build_engine(n_tenants=2, max_batch=4)
+        docs = [doc(i) for i in range(64)]
+
+        async def burst():
+            await asyncio.gather(*(
+                engine.submit(d, f"t{i % 2}") for i, d in enumerate(docs)))
+
+        run(burst())
+        assert engine.tenancy.cutter.cuts > 0
+
+
+# ---------------------------------------------------------------------------
+# per-tenant quotas + tenant-aware doomed depth
+# ---------------------------------------------------------------------------
+
+
+class TestTenantQuota:
+    def test_over_quota_tenant_rejected_typed_and_scoped(self):
+        engine = build_engine(
+            n_tenants=2,
+            annotations={"t0": {"authorino.tpu/qos-quota-rps": "1"}})
+
+        async def burst():
+            codes = []
+            ok = 0
+            for i in range(40):
+                try:
+                    await engine.submit(doc(i), "t0")
+                    ok += 1
+                except CheckAbort as e:
+                    codes.append((e.code, e.message))
+            # the un-quota'd tenant keeps its full budget
+            for i in range(8):
+                await engine.submit(doc(i), "t1")
+            return ok, codes
+
+        ok, codes = run(burst())
+        assert codes, "quota never fired"
+        assert ok >= 1, "the burst allowance must admit the first arrivals"
+        assert all(c == RESOURCE_EXHAUSTED for c, _ in codes)
+        assert all("tenant t0" in m for _, m in codes)
+        # tenant-scoped: the GLOBAL latch is untouched
+        assert engine.admission.state == ADMIT
+        rej = engine.tenancy.admission.rejected["t0"]
+        assert rej[R_TENANT_QUOTA] == len(codes)
+        assert "t1" not in engine.tenancy.admission.rejected
+
+    def test_doom_depth_is_per_tenant(self):
+        book = WeightBook()
+        book.rebuild({"hot": None, "cold": None})
+        adm = TenantAdmission(book)
+        for _ in range(1000):
+            adm.on_enqueue("hot")
+        # the cold tenant waits behind ITS backlog (none), not the hot
+        # tenant's 1000-deep standing queue
+        assert adm.doom_depth("cold", 1000) == 0
+        # the hot tenant's effective depth: backlog / its fair share (1/2)
+        assert adm.doom_depth("hot", 1000) == 1000  # clamped to global
+        adm.on_dequeue([P("hot") for _ in range(900)])
+        assert adm.doom_depth("hot", 100) == 100
+
+    def test_queue_share_bound_scopes_to_the_flooding_tenant(self):
+        """Per-tenant queue-occupancy bound: once the shared queue is past
+        half its cap, the tenant whose own backlog exceeds its GLOBAL
+        weighted share of the cap is rejected typed — other tenants keep
+        admitting, and below half-cap the bound never bites (work
+        conservation)."""
+        from authorino_tpu.tenancy.quota import R_TENANT_SHARE
+
+        book = WeightBook()
+        book.rebuild({f"t{i}": None for i in range(32)})
+        adm = TenantAdmission(book)
+        for _ in range(200):
+            adm.on_enqueue("t0")
+        for _ in range(3):
+            adm.on_enqueue("t1")
+        # queue past half the cap: the flooder is bounded...
+        rej = adm.share_reject("t0", global_depth=203, effective_cap=256)
+        assert rej is not None and rej[1] == R_TENANT_SHARE
+        # ...its victims are not
+        assert adm.share_reject("t1", 203, 256) is None
+        # an idle queue absorbs bursts whole, whatever the occupancy
+        assert adm.share_reject("t0", 100, 256) is None
+
+    def test_global_share_ignores_backlog_composition(self):
+        book = WeightBook()
+        book.rebuild({f"t{i}": None for i in range(10)})
+        assert book.global_share("t0") == pytest.approx(0.1)
+        # unknown tenants ride the default weight against the known set
+        assert book.global_share("stranger") == pytest.approx(1.0 / 11.0)
+
+    def test_admission_controller_uses_doom_depth(self):
+        ctrl = AdmissionController("x", target_s=0.01)
+        ctrl._service_rate = 100.0  # 100 rows/s
+        now = time.monotonic()
+        deadline = now + 0.5
+        # global depth 1000 -> predicted wait 10s: doomed
+        assert ctrl.admit(1000, now=now, deadline=deadline) is not None
+        # same global depth but a 0-deep tenant view: admitted (depth
+        # bounds still read the REAL depth — min_cap floor admits here)
+        assert ctrl.admit(0, now=now, deadline=deadline,
+                          doom_depth=0) is None
+
+
+# ---------------------------------------------------------------------------
+# per-tenant stats folds + KeyedBurn
+# ---------------------------------------------------------------------------
+
+
+class _StubHeat:
+    configs_per_shard = None
+
+    def __init__(self, names):
+        self.names = names
+
+    def name(self, row, shard=None):
+        return self.names[row] if 0 <= row < len(self.names) else ""
+
+
+class TestTenantStats:
+    def test_fold_is_vectorized_per_batch(self):
+        stats = TenantStats("test-lane")
+        heat = _StubHeat(["a", "b"])
+        rows = np.array([0, 0, 0, 1, 0, 1])
+        firing = np.array([-1, 0, -1, -1, 2, -1])
+        waits = np.array([0.01, 0.02, 0.03, 0.001, 0.02, 0.002])
+        stats.fold(heat, rows, firing=firing, waits=waits,
+                   bad_mask=waits > 0.015)
+        assert stats.fold_calls == 1
+        j = stats.to_json()
+        by = {r["tenant"]: r for r in j["top"]}
+        assert by["a"]["requests"] == 4 and by["a"]["denies"] == 2
+        assert by["b"]["requests"] == 2 and by["b"]["denies"] == 0
+        assert by["a"]["slo_bad"] == 3 and by["b"]["slo_bad"] == 0
+
+    def test_shares_decay_toward_live_traffic(self):
+        stats = TenantStats("test-lane2")
+        heat = _StubHeat(["hot", "cold"])
+        t0 = time.monotonic()
+        for k in range(10):
+            stats.fold(heat, np.array([0] * 9 + [1]),
+                       firing=np.full(10, -1), now=t0 + 0.1 * (k + 1))
+        shares = stats.shares()
+        assert shares["hot"] > 5 * shares["cold"]
+
+    def test_keyed_burn_window(self):
+        burn = KeyedBurn(window_s=10.0, objective=0.9)
+        t0 = 1000.0
+        burn.fold("t", 100, 50, now=t0)
+        assert burn.burn("t", now=t0) == pytest.approx(5.0)
+        # a full window later the old halves age out
+        burn.fold("t", 100, 0, now=t0 + 11.0)
+        assert burn.burn("t", now=t0 + 11.0) == pytest.approx(0.0)
+
+    def test_top_k_bound_caps_minted_labels(self):
+        from authorino_tpu.utils import metrics as metrics_mod
+
+        stats = TenantStats("test-lane3", top_k=4)
+        heat = _StubHeat([f"cfg{i}" for i in range(100)])
+        stats.fold(heat, np.arange(100), firing=np.full(100, -1))
+        stats.flush()
+        bound = metrics_mod.TENANT_LABEL_BOUNDS[
+            "auth_server_tenant_requests_total"]
+        assert len(stats._label_of) <= bound
+
+
+# ---------------------------------------------------------------------------
+# noisy-neighbor containment: detect, contain, auto-release
+# ---------------------------------------------------------------------------
+
+
+class TestContainment:
+    def _detector(self, wait=None):
+        wait = [0.5] if wait is None else wait
+        book = WeightBook()
+        book.rebuild({"hot": None, "c1": None, "c2": None, "c3": None})
+        stats = TenantStats("contain-lane")
+        det = NoisyNeighborDetector(
+            book, stats, wait_ewma=lambda: wait[0],
+            target_s=lambda: 0.05, lane="contain-lane",
+            threshold=2.0, sustain_s=0.0, release_s=0.0)
+        return book, stats, det, wait
+
+    def _feed(self, stats, hot_frac, t0, k0=0, n=10):
+        heat = _StubHeat(["hot", "c1", "c2", "c3"])
+        hot_n = int(16 * hot_frac)
+        rows = np.array([0] * hot_n + [1, 2, 3] * ((16 - hot_n) // 3 + 1))
+        for k in range(n):
+            stats.fold(heat, rows[:16], firing=np.full(16, -1),
+                       now=t0 + 0.1 * (k0 + k + 1))
+
+    def test_contain_fires_and_auto_releases(self):
+        book, stats, det, wait = self._detector()
+        t0 = time.monotonic()
+        self._feed(stats, hot_frac=0.9, t0=t0)
+        ring0 = RECORDER.events_total
+        det.check(now=t0 + 2.0)
+        assert det.is_contained("hot")
+        assert det.contain_total == 1
+        assert RECORDER.events_total > ring0  # tenant-contained recorded
+        # decay: traffic rebalances and the global wait clears
+        self._feed(stats, hot_frac=0.25, t0=t0 + 2.0, k0=20, n=30)
+        wait[0] = 0.0
+        det.check(now=t0 + 10.0)
+        assert not det.is_contained("hot")
+        assert det.release_total == 1
+
+    def test_no_containment_without_global_pressure(self):
+        """A hot tenant on an idle box is just traffic: the fair cut
+        already bounds its share — containment needs BOTH conditions."""
+        book, stats, det, wait = self._detector(wait=[0.0])
+        t0 = time.monotonic()
+        self._feed(stats, hot_frac=0.9, t0=t0)
+        det.check(now=t0 + 2.0)
+        assert not det.has_contained()
+
+    def test_contained_pacing_rejects_past_allowance(self):
+        book, stats, det, wait = self._detector()
+        det.allowance_rps = 1.0
+        t0 = time.monotonic()
+        self._feed(stats, hot_frac=0.9, t0=t0)
+        det.check(now=t0 + 2.0)
+        assert det.is_contained("hot")
+        now = t0 + 2.001  # on the detector's own (synthetic) timeline
+        allowed = sum(1 for _ in range(50)
+                      if not det.pace_reject("hot", now=now))
+        assert 1 <= allowed < 50  # the burst allowance, then paced drops
+
+    def test_engine_wires_contained_rejection_typed(self):
+        engine = build_engine(n_tenants=2)
+        det = engine.tenancy.detector
+        det._contained["t0"] = {"since": time.monotonic()}
+        from authorino_tpu.tenancy.quota import TokenBucket
+
+        det._pacers["t0"] = TokenBucket(0.000001, burst=0.000001)
+
+        async def one():
+            try:
+                await engine.submit(doc(1), "t0")
+                return None
+            except CheckAbort as e:
+                return e
+
+        e = run(one())
+        assert e is not None and e.code == RESOURCE_EXHAUSTED
+        assert "tenant t0" in e.message
+        assert engine.admission.state == ADMIT
+        rej = engine.tenancy.admission.rejected["t0"]
+        assert rej[R_TENANT_CONTAINED] == 1
+        det._contained.clear()
+        det._pacers.clear()
+
+
+# ---------------------------------------------------------------------------
+# lane parity (satellite): degraded batches still burn the right tenant
+# ---------------------------------------------------------------------------
+
+
+class TestLaneParity:
+    def test_degrade_lane_feeds_tenant_fold(self):
+        """Breaker OPEN -> whole batches decide via the host oracle: the
+        tenant counters must move exactly like the device lane's."""
+        engine = build_engine(n_tenants=2, breaker_threshold=1)
+        for _ in range(3):
+            engine.breaker.record_failure()
+
+        async def burst():
+            await asyncio.gather(*(
+                engine.submit(doc(i, allow=False), f"t{i % 2}")
+                for i in range(8)))
+
+        run(burst())
+        j = engine.tenancy.stats.to_json()
+        by = {r["tenant"]: r for r in j["top"]}
+        assert by["t0"]["requests"] == 4 and by["t1"]["requests"] == 4
+        assert by["t0"]["denies"] == 4 and by["t1"]["denies"] == 4
+
+    def test_device_and_host_lane_counts_agree(self):
+        """The same workload with and without a forced-open breaker lands
+        identical per-tenant request/deny counts (parity across lanes)."""
+        counts = {}
+        for mode, threshold in (("device", 5), ("degrade", 1)):
+            engine = build_engine(n_tenants=2, breaker_threshold=threshold)
+            if mode == "degrade":
+                for _ in range(3):
+                    engine.breaker.record_failure()
+
+            async def burst(engine=engine):
+                await asyncio.gather(*(
+                    engine.submit(doc(i, allow=(i % 4 != 1)), f"t{i % 2}")
+                    for i in range(16)))
+
+            run(burst())
+            j = engine.tenancy.stats.to_json()
+            counts[mode] = {r["tenant"]: (r["requests"], r["denies"])
+                            for r in j["top"]}
+        assert counts["device"] == counts["degrade"]
+
+
+# ---------------------------------------------------------------------------
+# stratified decision sampling (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestStratifiedDecisions:
+    def test_cold_tenant_records_survive_hot_flood(self):
+        log = prov_mod.DecisionLog(capacity=8, sample_n=1,
+                                   tenant_capacity=2)
+        log.record(lane="l", host="h", authconfig="cold", verdict=True,
+                   rule=None, rule_index=-1, latency_ms=1, generation=1)
+        for i in range(50):
+            log.record(lane="l", host="h", authconfig="hot", verdict=False,
+                       rule="0:x", rule_index=0, latency_ms=1, generation=1)
+        # the global ring is all hot now...
+        assert all(r["authconfig"] == "hot"
+                   for r in log.to_json()["records"])
+        # ...but the cold tenant's sub-ring survives
+        cold = log.to_json(tenant="cold")["records"]
+        assert len(cold) == 1 and cold[0]["authconfig"] == "cold"
+
+    def test_at_most_one_record_per_tenant_per_batch(self):
+        saved = (prov_mod.DECISIONS.capacity, prov_mod.DECISIONS.sample_n)
+        prov_mod.DECISIONS.configure(sample_n=1)
+        try:
+            heat = prov_mod.HeatMap(["hot", "cold"], [["r"], ["r"]], 1)
+            rows = np.array([0] * 20 + [1])
+            firing = np.full(21, -1)
+            before = prov_mod.DECISIONS.records_total
+            prov_mod.fold_and_sample(heat, rows, firing, 21, lane="l")
+            got = prov_mod.DECISIONS.records_total - before
+            # one batch, two tenants -> exactly two records at 1-in-1
+            assert got == 2
+            names = [r["authconfig"]
+                     for r in prov_mod.DECISIONS.to_json(n=2)["records"]]
+            assert set(names) == {"hot", "cold"}
+        finally:
+            prov_mod.DECISIONS.configure(capacity=saved[0],
+                                         sample_n=saved[1])
+
+    def test_single_tenant_batches_still_one_record_per_batch(self):
+        """The perf-guard contract holds: one tenant -> at most one record
+        per batch whatever the batch size."""
+        saved = prov_mod.DECISIONS.sample_n
+        prov_mod.DECISIONS.configure(sample_n=1)
+        try:
+            heat = prov_mod.HeatMap(["only"], [["r"]], 1)
+            before = prov_mod.DECISIONS.records_total
+            prov_mod.fold_and_sample(heat, np.zeros(64, dtype=int),
+                                     np.full(64, -1), 64, lane="l")
+            assert prov_mod.DECISIONS.records_total - before == 1
+        finally:
+            prov_mod.DECISIONS.configure(sample_n=saved)
+
+    def test_cold_tenant_first_appearance_always_samples(self):
+        saved = prov_mod.DECISIONS.sample_n
+        prov_mod.DECISIONS.configure(sample_n=1000)
+        try:
+            log = prov_mod.DECISIONS
+            assert log.should_sample_tenant("brand-new-tenant", 5)
+            assert not log.should_sample_tenant("brand-new-tenant", 5)
+        finally:
+            prov_mod.DECISIONS.configure(sample_n=saved)
+
+
+# ---------------------------------------------------------------------------
+# tenant-label cardinality lint (satellite, wired as tier-1)
+# ---------------------------------------------------------------------------
+
+
+class TestCardinalityLint:
+    def test_registry_lints_clean(self):
+        from authorino_tpu.analysis.metrics_catalog import (
+            tenant_cardinality_lint,
+        )
+
+        assert tenant_cardinality_lint() == []
+
+    def test_planted_violation_is_caught(self):
+        from authorino_tpu.analysis.metrics_catalog import (
+            _PlantedTenantFamily,
+            tenant_cardinality_lint,
+            tenant_lint_self_test,
+        )
+
+        violations = tenant_cardinality_lint(
+            extra=(_PlantedTenantFamily(),))
+        assert any("planted_violation" in v for v in violations)
+        # the combined self-test (what --verify-fixtures runs) is clean
+        assert tenant_lint_self_test() == []
+
+    def test_stale_bound_is_caught(self):
+        from authorino_tpu.analysis.metrics_catalog import (
+            tenant_cardinality_lint,
+        )
+        from authorino_tpu.utils import metrics as metrics_mod
+
+        bounds = dict(metrics_mod.TENANT_LABEL_BOUNDS)
+        bounds["auth_server_tenant_ghost_total"] = 8
+        assert any("ghost" in v for v in tenant_cardinality_lint(bounds))
+
+    def test_missing_bound_is_caught(self):
+        from authorino_tpu.analysis.metrics_catalog import (
+            tenant_cardinality_lint,
+        )
+        from authorino_tpu.utils import metrics as metrics_mod
+
+        bounds = dict(metrics_mod.TENANT_LABEL_BOUNDS)
+        bounds.pop("auth_server_tenant_requests_total")
+        assert any("tenant_requests" in v
+                   for v in tenant_cardinality_lint(bounds))
+
+
+# ---------------------------------------------------------------------------
+# per-tenant canary guard (tenant-rejection-rate)
+# ---------------------------------------------------------------------------
+
+
+class TestTenantCanaryGuard:
+    def test_tenant_rejection_delta_breaches(self):
+        from authorino_tpu.runtime.change_safety import CanaryGuard
+
+        guard = CanaryGuard(changed={"t"}, check_interval_s=0.0)
+        heat = _StubHeat(["t"])
+        rows = np.zeros(16, dtype=int)
+        firing = np.full(16, -1)
+        for _ in range(4):
+            guard.observe_batch(False, rows, firing, heat)
+            guard.observe_batch(True, rows, firing, heat)
+        # the canary cohort's tenant eats rejections the baseline doesn't
+        guard.observe_tenant_rejection(True, "t", n=64)
+        breach = guard.breach(force=True)
+        assert breach is not None
+        assert "tenant-rejection-rate" in breach["guards"]
+        assert "t" in breach["suspects"]
+
+    def test_unchanged_tenant_rejections_do_not_breach(self):
+        from authorino_tpu.runtime.change_safety import CanaryGuard
+
+        guard = CanaryGuard(changed={"other"}, check_interval_s=0.0)
+        heat = _StubHeat(["t"])
+        rows = np.zeros(16, dtype=int)
+        firing = np.full(16, -1)
+        for _ in range(4):
+            guard.observe_batch(False, rows, firing, heat)
+            guard.observe_batch(True, rows, firing, heat)
+        guard.observe_tenant_rejection(True, "t", n=64)
+        assert guard.breach(force=True) is None
+
+
+# ---------------------------------------------------------------------------
+# /debug/tenants + /debug/decisions?tenant=
+# ---------------------------------------------------------------------------
+
+
+class TestDebugSurfaces:
+    def test_debug_tenants_endpoint(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from authorino_tpu.service.http_server import build_app
+
+        engine = build_engine(n_tenants=2)
+
+        async def body():
+            await engine.submit(doc(1), "t0")
+            client = TestClient(TestServer(build_app(engine)))
+            await client.start_server()
+            try:
+                resp = await client.get("/debug/tenants")
+                assert resp.status == 200
+                plane = await resp.json()
+                resp2 = await client.get("/debug/decisions?tenant=t0")
+                assert resp2.status == 200
+                dec = await resp2.json()
+            finally:
+                await client.close()
+            return plane, dec
+
+        plane, dec = run(body())
+        assert plane["enabled"] is True
+        assert plane["stats"]["requests_total"] >= 1
+        assert dec["tenant"] == "t0"
+
+    def test_engine_debug_vars_carry_tenancy(self):
+        engine = build_engine(n_tenants=1)
+        dv = engine.debug_vars()
+        assert dv["tenancy"]["enabled"] is True
+        assert "containment" in dv["tenancy"]
+        assert "fair_cut" in dv["tenancy"]
+
+
+# ---------------------------------------------------------------------------
+# repo hygiene: the new subsystem stays clean
+# ---------------------------------------------------------------------------
+
+
+def test_tenancy_code_stays_clean():
+    import os
+
+    from authorino_tpu.analysis.code_lint import lint_paths
+
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "authorino_tpu", "tenancy")
+    assert [str(f) for f in lint_paths([root])] == []
