@@ -581,6 +581,63 @@ def _print_flight_bundle(bundle: dict) -> None:
               f"in the bundle)")
 
 
+def _resolve_kernel_cost(report: dict):
+    """Find the kernel_cost block in SRC: top-level (bench artifact or the
+    block itself) or nested under a /debug/vars lane (engine, native)."""
+    if not isinstance(report, dict):
+        return None
+    if "ledger" in report:
+        return report
+    for key in ("kernel_cost", "engine", "native"):
+        sub = report.get(key)
+        if isinstance(sub, dict):
+            kc = _resolve_kernel_cost(sub)
+            if kc is not None:
+                return kc
+    return None
+
+
+def _print_kernel_cost(report: dict) -> None:
+    """Pretty-print a kernel-cost block (ISSUE 16): SRC is a /debug/vars
+    URL or saved JSON (engine or native lane), a bench artifact with a
+    ``kernel_cost`` key, or the block itself."""
+    kc = _resolve_kernel_cost(report)
+    if not isinstance(kc, dict) or "ledger" not in kc:
+        print("no kernel_cost block found (expected a /debug/vars dump, "
+              "a bench artifact, or the block itself)")
+        return
+    ledger = kc.get("ledger") or {}
+    print("kernel cost ledger (structural, per lane):")
+    cols = ("batches", "launches", "launches_per_batch",
+            "zero_launch_batches", "rows", "device_rows", "pad_rows",
+            "pad_waste_rows", "h2d_bytes", "d2h_bytes",
+            "dedup_avoided_rows", "cache_avoided_rows")
+    print(f"  {'lane':<8}" + "".join(f" {c:>19}" for c in cols))
+    for lane, lc in sorted(ledger.items()):
+        print(f"  {lane:<8}" + "".join(
+            f" {lc.get(c, 0):>19}" for c in cols))
+    modeled = kc.get("modeled") or {}
+    cur = modeled.get("current") or {}
+    print(f"modeled cost ({modeled.get('component', '?')}): "
+          f"{modeled.get('generations_analyzed', 0)} generation(s) "
+          f"analyzed, {modeled.get('regressions_seen', 0)} regression(s)")
+    for name, e in sorted((cur.get("entries") or {}).items()):
+        print(f"  {name}: {e.get('flops_per_row')} flops/row, "
+              f"{e.get('bytes_per_row')} bytes/row "
+              f"(pad {e.get('pad')}, eff {e.get('eff')})")
+    for r in cur.get("regressions", []):
+        print(f"  REGRESSION {r.get('entry')}.{r.get('axis')}: "
+              f"{r.get('previous')} -> {r.get('current')} "
+              f"({r.get('ratio')}x vs generation "
+              f"{r.get('previous_generation')})")
+    eps = kc.get("entry_points") or []
+    if eps:
+        print("jit entry points (serving snapshot):")
+        for ep in eps:
+            print(f"  {ep.get('entry')}: {ep.get('kind')}")
+            print(f"    operands: {', '.join(ep.get('operands', []))}")
+
+
 def _run_change_safety_override(server: str, action: str) -> dict:
     """POST the manual change-safety override to a live server's
     /debug/canary endpoint (ISSUE 10, docs/robustness.md "Change safety")
@@ -665,6 +722,12 @@ def main(argv=None) -> int:
                          "server's /debug/decisions URL or a saved JSON "
                          "file (docs/observability.md 'Decision "
                          "provenance')")
+    ap.add_argument("--kernel-cost", metavar="SRC",
+                    help="pretty-print the kernel cost observatory block "
+                         "(ISSUE 16): SRC is a live server's /debug/vars "
+                         "URL, a saved JSON dump, or a bench artifact "
+                         "with a kernel_cost key (docs/performance.md "
+                         "'Kernel cost model')")
     ap.add_argument("--flight-dump", metavar="FILE",
                     help="pretty-print a flight-recorder diagnostic bundle "
                          "(the JSON auto-dumped on anomaly triggers; "
@@ -769,6 +832,15 @@ def main(argv=None) -> int:
             print(json.dumps(report, indent=2, sort_keys=True))
         else:
             _print_decisions(report)
+        return 0
+
+    if args.kernel_cost:
+        report = _load_json_source(args.kernel_cost)
+        if args.as_json:
+            kc = _resolve_kernel_cost(report) or report
+            print(json.dumps(kc, indent=2, sort_keys=True, default=str))
+        else:
+            _print_kernel_cost(report)
         return 0
 
     if args.flight_dump:

@@ -817,6 +817,19 @@ def fuse_batch(db) -> Tuple[np.ndarray, tuple]:
     return np.concatenate(segs), tuple(layout)
 
 
+def staged_h2d_bytes(db) -> int:
+    """Exact request-operand bytes one launch of ``db`` stages host-to-
+    device — the fused staging buffer size (sum of nbytes over the present
+    _FUSED_FIELDS), identical for the per-operand fallback path.  Pure
+    shape arithmetic for the kernel-cost ledger: no copy, no fuse."""
+    total = 0
+    for name in _FUSED_FIELDS:
+        arr = getattr(db, name)
+        if arr is not None:
+            total += arr.nbytes
+    return total
+
+
 def _defuse(buf, layout):
     """Decode the staged operands out of the fused buffer (traced: static
     slices + bitcasts, no data movement beyond the one transfer)."""

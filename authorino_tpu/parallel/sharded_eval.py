@@ -77,9 +77,11 @@ from ..compiler.intern import StringInterner
 from ..ops.pattern_eval import (
     _bitpack_rows,
     eval_verdicts,
+    packed_width,
     to_device,
     unpack_verdicts,
 )
+from ..runtime.kernel_cost import LEDGER
 
 __all__ = ["ShardedPolicyModel", "build_mesh", "MeshUnavailable",
            "MEMBERS_K_RELIEF_CAP", "flat_config_rows"]
@@ -770,7 +772,31 @@ class ShardedPolicyModel:
             packed.copy_to_host_async()
         except Exception:
             pass  # readback degrades to a blocking copy at np.asarray time
+        # ISSUE 16: ONE collective launch per shard-step — the psum merge
+        # is part of the same program, so a 2x4 mesh still counts 1 here
+        LEDGER.observe_launch("mesh", 1,
+                              h2d_bytes=self._encoded_h2d_bytes(encoded),
+                              d2h_bytes=self._d2h_bytes(encoded))
         return packed
+
+    def _encoded_h2d_bytes(self, encoded: _ShardedEncoded) -> int:
+        """Request-operand bytes one launch of ``encoded`` stages (every
+        present operand incl. the shard_of/row_of routing rows) — pure
+        shape arithmetic for the kernel-cost ledger."""
+        total = 0
+        for name in ("attrs_val", "members_c", "cpu_dense", "attr_bytes",
+                     "byte_ovf", "attrs_num", "num_valid", "rel_rows",
+                     "member_ovf", "shard_of", "row_of"):
+            arr = getattr(encoded, name, None)
+            if arr is not None:
+                total += arr.nbytes
+        return total
+
+    def _d2h_bytes(self, encoded: _ShardedEncoded) -> int:
+        """Readback bytes of one launch: the bitpacked [B, W] uint8
+        own-rows result."""
+        E = int(self.shards[0].eval_rule.shape[1])
+        return int(encoded.attrs_val.shape[0]) * packed_width(1 + 2 * E)
 
     # ---- per-device failover (ISSUE 11) ----------------------------------
 
@@ -818,6 +844,11 @@ class ShardedPolicyModel:
             packed.copy_to_host_async()
         except Exception:
             pass
+        # failover lane: a re-dispatch is a REAL extra launch — the ledger
+        # shows it as launches_per_batch > 1 instead of hiding it
+        LEDGER.observe_launch("mesh", 1,
+                              h2d_bytes=self._encoded_h2d_bytes(encoded),
+                              d2h_bytes=self._d2h_bytes(encoded))
         return packed
 
     def dispatch_routed(self, encoded: _ShardedEncoded, lane: str = "engine"

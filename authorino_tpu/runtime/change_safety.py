@@ -411,6 +411,10 @@ class CanaryPhase:
         # and the engine tightened this phase's guard thresholds when the
         # preflighted diff was clean
         self.preflight = preflight
+        # kernel cost stamp (ISSUE 16): the reconcile's modeled-cost
+        # record — a canaried swap whose per-row cost regressed >=2x
+        # carries the evidence on /debug/canary and the bench artifact
+        self.kernel_cost: Optional[Dict[str, Any]] = None
         self.t_start = time.monotonic()
         self.started_unix = time.time()
         self._timer: Optional[threading.Timer] = None
@@ -448,6 +452,7 @@ class CanaryPhase:
             "started_unix": self.started_unix,
             "guard": self.guard.to_json(),
             "preflight": self.preflight,
+            "kernel_cost": self.kernel_cost,
         }
 
 

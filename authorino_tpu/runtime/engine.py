@@ -73,6 +73,8 @@ from ..replay.capture import CAPTURE
 from .admission import AdaptiveWindow, AdmissionController
 from .breaker import CircuitBreaker
 from .flight_recorder import RECORDER
+from . import kernel_cost as kernel_cost_mod
+from .kernel_cost import LEDGER, CostModel
 from .lane_select import (
     DEVICE as L_DEVICE,
     HOST as L_HOST,
@@ -791,6 +793,10 @@ class PolicyEngine:
         self._last_rollback: Optional[Dict[str, Any]] = None
         self._g_canary = metrics_mod.canary_state.labels("engine")
         self._g_quarantine = metrics_mod.quarantined_configs.labels("engine")
+        # kernel cost observatory (ISSUE 16): per-generation modeled-cost
+        # lineage — lower().compile().cost_analysis() at each reconcile,
+        # >=2x per-row regression raises the cost-regression anomaly
+        self._cost_model = CostModel("engine")
         # traffic replay preflight (ISSUE 13): gate state + last verdict
         self.replay_pregate = bool(replay_pregate)
         self.replay_pregate_budget_s = float(replay_pregate_budget_s)
@@ -1069,6 +1075,22 @@ class PolicyEngine:
             }
         except Exception:
             log.exception("control-plane telemetry failed (swap unaffected)")
+        # kernel cost observatory (ISSUE 16): modeled per-row FLOPs/bytes
+        # of the new generation's kernel entry points, diffed against the
+        # previous generation.  Advisory end to end — a >=2x per-row
+        # regression raises the cost-regression flight-recorder anomaly
+        # and stamps the canary phase, but NEVER rejects the swap.
+        try:
+            cost_rec = self._cost_model.analyze(
+                snap.generation, policy=snap.policy, params=snap.params,
+                sharded=snap.sharded, recorder=RECORDER)
+            if isinstance(self._control_plane, dict):
+                self._control_plane["kernel_cost"] = cost_rec
+            phase = self._canary
+            if phase is not None and phase.snap is snap:
+                phase.kernel_cost = cost_rec
+        except Exception:
+            log.exception("kernel cost analysis failed (swap unaffected)")
 
     def _build_heat(self, snap: "_Snapshot") -> None:
         if snap.heat is not None:
@@ -1786,6 +1808,18 @@ class PolicyEngine:
                                   if self.metadata_prefetcher is not None
                                   else None),
             "flight_recorder": RECORDER.to_json(),
+            # kernel cost observatory (ISSUE 16, docs/performance.md
+            # "Kernel cost model"): the process-wide structural ledger
+            # (launches/bytes/pad-waste per lane), the modeled per-row
+            # cost lineage, and the jit entry points the serving snapshot
+            # can dispatch through (the warm-grid audit surface)
+            "kernel_cost": {
+                "ledger": LEDGER.to_json(),
+                "modeled": self._cost_model.to_json(),
+                "entry_points": kernel_cost_mod.entry_points(
+                    policy=getattr(snap, "policy", None),
+                    sharded=getattr(snap, "sharded", None)),
+            },
             "change_safety": self.change_safety_vars(),
             # traffic replay (ISSUE 13, docs/replay.md): capture-log state
             # + the last preflight verdict (also on /debug/replay)
@@ -2375,6 +2409,21 @@ class PolicyEngine:
         before any request-level effect (resolution, SLO burn, admission
         service count, provenance fold), so whichever lane loses the race
         contributes nothing but its own cost-model observation."""
+        released = False
+
+        def release_slot() -> None:
+            # the concurrency slot bounds oracle CPU, not resolution
+            # fan-out: release it as soon as the decisions are computed,
+            # so a caller awaiting one of these futures can land its next
+            # small cut back on the host lane instead of racing the pool
+            # thread to the slot and spilling to the device as host-busy
+            nonlocal released
+            if released:
+                return
+            released = True
+            with self._queue_lock:
+                self.lanes.host_inflight -= 1
+
         try:
             # host lane horizon 0: the oracle answers in microseconds, so
             # only already-expired deadlines shed here
@@ -2411,6 +2460,7 @@ class PolicyEngine:
                         if p.t_enq and now - p.t_enq > self.slo.slo_s))
                     self.slo.observe(n_ok, n_bad)
                 self.lanes.cost.observe_slo(L_HOST, n_ok, n_bad)
+            release_slot()
             self._resolve_host_decisions(by_loop, failed)
         except Exception:
             log.exception("host-lane batch failed")
@@ -2420,8 +2470,7 @@ class PolicyEngine:
                 self._resolve_error(batch, CheckAbort(
                     UNAVAILABLE, "policy evaluation unavailable"))
         finally:
-            with self._queue_lock:
-                self.lanes.host_inflight -= 1
+            release_slot()
             self._maybe_dispatch()
 
     def _host_decide_batch(self, snap: _Snapshot, batch: List[_Pending],
@@ -2472,6 +2521,10 @@ class PolicyEngine:
         # selection with the optimistic cold-start estimate)
         if batch:
             self.lanes.cost.observe_host(time.monotonic() - t0, len(batch))
+            # structural cost fold (ISSUE 16): every host-oracle batch —
+            # lane-selected, brownout, degrade — counts ZERO device
+            # launches and zero H2D/D2H bytes, exactly
+            LEDGER.observe("host", rows=len(batch))
         if fold:
             self._fold_host_provenance(snap, batch, results, lane=lane)
         return by_loop, failed, n_ok, results
@@ -2869,7 +2922,8 @@ class PolicyEngine:
             return self._encode_and_launch_sharded(
                 snap, batch, docs, names, n, pad, t0, binfo, waits)
         from ..compiler.pack import batch_row_keys, pack_batch, select_rows
-        from ..ops.pattern_eval import dispatch_fused, unpack_verdicts
+        from ..ops.pattern_eval import (dispatch_fused, packed_width,
+                                        staged_h2d_bytes, unpack_verdicts)
 
         policy = snap.policy
         rows = [policy.config_ids[name] for name in names]
@@ -2916,6 +2970,18 @@ class PolicyEngine:
         metrics_mod.observe_pipeline_stage(
             "engine", "launch", time.monotonic() - t1)
         E = int(policy.eval_rule.shape[1])
+        # structural cost fold (ISSUE 16): ONE launch per well-formed cut;
+        # a fully cache/dedup-resolved cut counts zero launches and zero
+        # bytes.  H2D = the fused staging buffer bytes, D2H = the bitpacked
+        # [pad_u, W] readback
+        LEDGER.observe(
+            "engine", rows=n, device_rows=u,
+            launches=1 if db_u is not None else 0,
+            h2d_bytes=staged_h2d_bytes(db_u) if db_u is not None else 0,
+            d2h_bytes=pad_u * packed_width(1 + 2 * E) if db_u is not None else 0,
+            pad_rows=pad_u,
+            dedup_avoided_rows=len(miss_rows) - u,
+            cache_avoided_rows=len(cached))
         max_fallback = self.max_fallback_per_batch
 
         def finalize(packed):
@@ -3024,6 +3090,14 @@ class PolicyEngine:
             handle = np.zeros((0, 1), dtype=np.uint8)
         metrics_mod.observe_pipeline_stage(
             "engine", "launch", time.monotonic() - t1)
+        # structural cost fold (ISSUE 16), mesh lane: the shard-step
+        # launch + bytes were counted at the dispatch site (one collective
+        # launch per step, failovers included); this fold adds the
+        # batch-level story — real rows, dedup/cache cuts, pad waste
+        LEDGER.observe(
+            "mesh", rows=n, device_rows=u, pad_rows=binfo["pad"],
+            dedup_avoided_rows=len(miss_rows) - u,
+            cache_avoided_rows=len(cached))
         E = int(sharded.shards[0].eval_rule.shape[1])
         max_fallback = self.max_fallback_per_batch
 

@@ -53,6 +53,10 @@ ANOMALY_KINDS = frozenset({
     # weights and wait state that justified the clamp.  The auto-release
     # (kind `tenant-released`) rides the ring as context only.
     "tenant-contained",
+    # ISSUE 16: a reconcile whose XLA-modeled per-row kernel cost regressed
+    # >=2x vs the previous generation (advisory — the swap still lands; the
+    # bundle freezes the modeled flops/bytes diff per entry point)
+    "cost-regression",
 })
 
 

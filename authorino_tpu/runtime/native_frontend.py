@@ -74,6 +74,8 @@ from . import provenance as prov_mod
 from .admission import AdmissionController
 from .breaker import CircuitBreaker
 from .flight_recorder import RECORDER
+from . import kernel_cost as kernel_cost_mod
+from .kernel_cost import LEDGER, CostModel
 from .lane_select import DEVICE as L_DEVICE, HOST as L_HOST, LaneSelector
 
 log = logging.getLogger("authorino_tpu.native_frontend")
@@ -692,6 +694,10 @@ class NativeFrontend:
         self._mod = None
         self._snaps: Dict[int, _SnapRec] = {}
         self._next_snap_id = 1
+        # kernel-cost observatory (ISSUE 16): XLA-modeled per-row cost per
+        # snapshot generation; >=2x per-row regressions raise an advisory
+        # cost-regression anomaly (the refresh swap is never blocked)
+        self._cost_model = CostModel("native_frontend")
         self._running = False
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
@@ -1022,6 +1028,16 @@ class NativeFrontend:
             "change_safety": (self.engine.change_safety_vars()
                               if hasattr(self.engine, "change_safety_vars")
                               else None),
+            # kernel cost observatory (ISSUE 16): the process-wide ledger
+            # plus this lane's modeled-cost lineage and the jit entry
+            # points the serving snapshot can dispatch through
+            "kernel_cost": {
+                "ledger": LEDGER.to_json(),
+                "modeled": self._cost_model.to_json(),
+                "entry_points": kernel_cost_mod.entry_points(
+                    policy=rec.policy if rec is not None else None,
+                    sharded=rec.sharded if rec is not None else None),
+            },
             "snapshot": None,
         }
         if rec is not None:
@@ -1756,6 +1772,15 @@ class NativeFrontend:
                 log.exception("jit pre-warm (swap gate) failed")
         mod.fe_swap(spec)
         metrics_mod.snapshot_generation.labels("native_frontend").set(snap_id)
+        try:
+            # kernel-cost analysis (ISSUE 16) — advisory, after the swap is
+            # live; the process-wide shape memo makes engine/native overlap
+            # for the same snapshot essentially free
+            self._cost_model.analyze(snap_id, policy=rec.policy,
+                                     params=rec.params, sharded=rec.sharded,
+                                     recorder=RECORDER)
+        except Exception:
+            log.exception("kernel cost analysis failed (swap unaffected)")
         if grid:
             # NON-daemon and tracked: a daemon thread mid-XLA-compile at
             # interpreter exit force-unwinds through native code and aborts
@@ -2017,6 +2042,21 @@ class NativeFrontend:
             unique_rows, inverse = miss_rows, np.arange(len(miss_rows))
         return ckeys, eligible, cached, miss_rows, unique_rows, inverse, elig_miss
 
+    def _row_h2d_bytes(self, a: Dict[str, np.ndarray], eff: int,
+                       has_dfa: bool, sharded: bool) -> int:
+        """Per-row operand bytes one launch stages from this slot's
+        arrays at byte-width ``eff`` (pure shape arithmetic — numpy basic
+        indexing views, no copies): multiply by the pad bucket for the
+        ledger's exact H2D count."""
+        per = (a["attrs_val"][0].nbytes + a["members"][0].nbytes
+               + a["cpu_dense"][0].nbytes + a["config_id"].dtype.itemsize)
+        if has_dfa:
+            per += (a["attr_bytes"][0][..., :eff].nbytes
+                    + a["byte_ovf"][0].nbytes)
+        if sharded:
+            per += a["shard_of"].dtype.itemsize  # mesh routing row
+        return int(per)
+
     def _dispatch(self, snap_id: int, slot: int, count: int,
                   attempt: int = 0, spill: bool = True) -> None:
         """Launch stage: non-blocking kernel dispatch for one C++-encoded
@@ -2128,6 +2168,7 @@ class NativeFrontend:
             has_dfa = sh.has_dfa
         else:
             has_dfa = rec.params["dfa_tables"] is not None
+        cost_lane = "native" if rec.sharded is None else "mesh"
         if u == 0:
             # every row cache-resolved: complete through the readback queue
             # with no device work at all
@@ -2135,10 +2176,18 @@ class NativeFrontend:
             packed = np.zeros((0, 1), dtype=np.uint8)
             t0 = time.monotonic()
             t0_ns = time.time_ns()
+            # structural cost fold (ISSUE 16): ZERO launches, zero bytes —
+            # the parity the perf_guard tests pin exactly
+            LEDGER.observe(
+                cost_lane, rows=count,
+                dedup_avoided_rows=(len(fan[3]) if fan is not None else 0),
+                cache_avoided_rows=(len(fan[2]) if fan is not None else 0))
         else:
-            eff = (_trim_bytes(a["attr_bytes"][:count] if u == count
-                               else a["attr_bytes"][unique_rows]).shape[-1]
-                   if has_dfa else 0)
+            eff_need = (_trim_bytes(a["attr_bytes"][:count] if u == count
+                                    else a["attr_bytes"][unique_rows]
+                                    ).shape[-1]
+                        if has_dfa else 0)
+            eff = eff_need
             # round the batch/byte buckets up to an already-compiled variant
             # so XLA compiles never land on live requests (rows past the
             # unique count carry stale/repeated operands; results discarded)
@@ -2184,6 +2233,35 @@ class NativeFrontend:
                 packed.copy_to_host_async()
             except Exception:
                 pass
+            # structural cost fold (ISSUE 16): ONE launch per slot, the
+            # exact H2D operand bytes this (pad, eff) variant staged and
+            # the bitpacked [pad, W] readback.  eff-column slack is the
+            # warm-shape round-up (eff - eff_need); sharded slots count
+            # their collective launch on the mesh lane instead (one per
+            # shard-step — LEDGER.observe_launch fires in sh._step's
+            # dispatch path only for dispatch_full, so count it here)
+            h2d = pad * self._row_h2d_bytes(a, eff, has_dfa,
+                                            rec.sharded is not None)
+            d2h = int(packed.shape[0]) * int(packed.shape[1])
+            if rec.sharded is not None:
+                LEDGER.observe_launch("mesh", 1, h2d_bytes=h2d,
+                                      d2h_bytes=d2h)
+                LEDGER.observe(
+                    "mesh", rows=count, device_rows=u, pad_rows=pad,
+                    eff_slack_cols=eff - eff_need,
+                    dedup_avoided_rows=(len(fan[3]) - u
+                                        if fan is not None else 0),
+                    cache_avoided_rows=(len(fan[2])
+                                        if fan is not None else 0))
+            else:
+                LEDGER.observe(
+                    "native", rows=count, device_rows=u, launches=1,
+                    h2d_bytes=h2d, d2h_bytes=d2h, pad_rows=pad,
+                    eff_slack_cols=eff - eff_need,
+                    dedup_avoided_rows=(len(fan[3]) - u
+                                        if fan is not None else 0),
+                    cache_avoided_rows=(len(fan[2])
+                                        if fan is not None else 0))
         with self._rb_lock:
             self._rb_inflight += 1
             if self._rb_inflight > self.rb_inflight_peak:
@@ -2227,6 +2305,9 @@ class NativeFrontend:
                 return
             dur = time.monotonic() - t0
             self.lanes.cost.observe_host(dur, count)
+            # kernel-cost ledger (ISSUE 16): a host-lane batch performs
+            # ZERO device launches and moves zero device bytes — exactly
+            LEDGER.observe("host", rows=count)
             if lane_sel:
                 self.lanes.count_rows(L_HOST, count)
             else:
@@ -2488,6 +2569,9 @@ class NativeFrontend:
                 # a frontend that spent its warm-up degrading must not
                 # enter lane selection on the cold-start estimate
                 self.lanes.cost.observe_host(time.monotonic() - t0, count)
+                # kernel-cost ledger (ISSUE 16): degrade = host lane, zero
+                # device launches
+                LEDGER.observe("host", rows=count)
             except Exception:
                 log.exception("native host degrade failed (fail-closed deny)")
         if verdict is not None:

@@ -1092,3 +1092,77 @@ tenant_contained = _gauge(
     "cap (far below the declared top-K bound).",
     ("tenant",),
 )
+
+
+# ---------------------------------------------------------------------------
+# Kernel cost observatory (ISSUE 16, docs/performance.md "Kernel cost
+# model"): structural device-cost counters folded ONCE PER MICRO-BATCH by
+# runtime/kernel_cost.py's CostLedger.  Unlike the wall-clock series above,
+# these count things that do not swing with the host (launches, bytes,
+# rows), so tier-1 perf_guard tests pin them as exact values.
+# ---------------------------------------------------------------------------
+
+kernel_launches = _counter(
+    "auth_server_kernel_launches_total",
+    "Device-computation launches (jitted calls reaching the device) per "
+    "lane.  One well-formed micro-batch = ONE launch (ROADMAP item 2's "
+    "target); cache/dedup-resolved batches and host/degrade evals count "
+    "ZERO.  The mesh lane counts one collective launch per shard-step, "
+    "not one per shard.",
+    _LANE_LABELS,
+)
+kernel_h2d_bytes = _counter(
+    "auth_server_kernel_h2d_bytes_total",
+    "Request-operand bytes staged host-to-device per lane (the fused "
+    "staging buffer / per-operand upload sizes of each launch).  Snapshot "
+    "upload traffic is accounted separately by "
+    "auth_server_{delta,full}_upload_bytes_total — together the two give "
+    "total H2D.",
+    _LANE_LABELS,
+)
+kernel_d2h_bytes = _counter(
+    "auth_server_kernel_d2h_bytes_total",
+    "Verdict readback bytes device-to-host per lane: the bitpacked "
+    "[pad, packed_width(1+2E)] uint8 result of each launch.",
+    _LANE_LABELS,
+)
+kernel_pad_waste_rows = _counter(
+    "auth_server_kernel_pad_waste_rows_total",
+    "Padded-minus-real rows per launch (device cycles spent on discarded "
+    "rows), the counter twin of the auth_server_batch_pad_occupancy "
+    "ratio.  Eff-column slack rides the ledger's /debug/vars block.",
+    _LANE_LABELS,
+)
+kernel_modeled_flops_per_row = _gauge(
+    "auth_server_kernel_modeled_flops_per_row",
+    "XLA-modeled FLOPs per padded row of the serving snapshot's kernel "
+    "(lower().compile().cost_analysis() at reconcile, representative "
+    "(pad, eff) shape).  Modeled, not measured: compare generations, "
+    "not wall clock.  A >=2x jump vs the previous generation raises the "
+    "cost-regression flight-recorder anomaly.",
+    ("entry",),
+)
+
+_kernel_children: dict = {}
+
+
+def observe_kernel_cost(lane, launches, h2d_bytes, d2h_bytes,
+                        pad_waste_rows) -> None:
+    """Fold one batch's structural device cost (cached label children —
+    runs once per micro-batch, zero values skipped)."""
+    ch = _kernel_children.get(lane)
+    if ch is None:
+        ch = _kernel_children[lane] = (
+            kernel_launches.labels(lane),
+            kernel_h2d_bytes.labels(lane),
+            kernel_d2h_bytes.labels(lane),
+            kernel_pad_waste_rows.labels(lane),
+        )
+    if launches:
+        ch[0].inc(launches)
+    if h2d_bytes:
+        ch[1].inc(h2d_bytes)
+    if d2h_bytes:
+        ch[2].inc(d2h_bytes)
+    if pad_waste_rows:
+        ch[3].inc(pad_waste_rows)

@@ -58,6 +58,16 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def kernel_cost_block():
+    """Structural device-cost ledger for bench artifacts (ISSUE 16):
+    launches / H2D+D2H bytes / pad waste per lane, as counted at the
+    dispatch sites over everything this process ran so far.  Structural
+    counts — exact on any platform, unlike the RPS numbers."""
+    from authorino_tpu.runtime.kernel_cost import LEDGER
+
+    return LEDGER.to_json()
+
+
 def build_corpus(n_configs: int, rules_per_config: int, seed: int = 42):
     from authorino_tpu.compiler import ConfigRules
     from authorino_tpu.expressions import All, Any_, Operator, Pattern
@@ -2637,6 +2647,7 @@ def run_mesh_mode(args):
                 k: per_shape[k]["members_k_eff"] for k in per_shape},
             "overflow_rows_in_corpus": 64,
         },
+        "kernel_cost": kernel_cost_block(),
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "MULTICHIP_r06.json")
@@ -2880,6 +2891,7 @@ def run_tenancy_mode(args):
     artifact = {
         "round": "r01",
         "issue": 15,
+        "kernel_cost": kernel_cost_block(),
         "platform_caveat": "CPU driver image: ratios (cold goodput/p99 vs "
                            "no-burst baseline), not absolute RPS "
                            "(ROADMAP bench-reality note)",
@@ -3176,6 +3188,7 @@ def run_relations_mode(args):
             "relation-bit-flip", "relation-col-redirect",
             "numeric-const-corrupt", "numeric-op-flip",
             "numeric-slot-collision"],
+        "kernel_cost": kernel_cost_block(),
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "RELATIONS_r01.json")
@@ -3431,6 +3444,7 @@ def main():
             "unit": "req/s",
             "vs_baseline": round(ns / 100_000.0, 4),
             "classes": classes,
+            "kernel_cost": kernel_cost_block(),
         }))
         return
 
@@ -3448,6 +3462,7 @@ def main():
                 "value": round(rps, 1),
                 "unit": "req/s",
                 "vs_baseline": round(rps / 100_000.0, 4),
+                "kernel_cost": kernel_cost_block(),
                 **stats,
             }))
             return
@@ -3539,6 +3554,7 @@ def main():
             "load_model": "closed-loop",
             "coordinated_omission": "uncorrected (closed-loop: offered == "
                                     "achieved by construction)",
+            "kernel_cost": kernel_cost_block(),
         }
         if args.mode == "engine":
             dv = engine.debug_vars()

@@ -287,6 +287,119 @@ async def run_benchmarks(seconds: float, batch: int, workers: int):
     return results
 
 
+def run_kernel_cost_grid(args):
+    """Structural device-cost grid (ISSUE 16, KERNELCOST_r01.json):
+    launches / H2D+D2H bytes / pad occupancy per row over a
+    (batch, members_k, n_dfa_tables) grid, counted by the runtime's own
+    CostLedger at the engine dispatch site, plus the XLA-modeled
+    flops/bytes per row at each shape.  Deliberately cryptography-free
+    (no FakeIdP): everything here is compile + device dispatch.  The
+    numbers are STRUCTURAL — exact on any platform; no RPS claims."""
+    import jax
+
+    from authorino_tpu.compiler import ConfigRules
+    from authorino_tpu.expressions import All, Operator, Pattern
+    from authorino_tpu.runtime import EngineEntry, PolicyEngine
+    from authorino_tpu.runtime.kernel_cost import LEDGER
+
+    def cell_configs(n_dfa):
+        configs = []
+        for i in range(8):
+            pats = [Pattern("request.method", Operator.EQ, "GET"),
+                    Pattern("auth.identity.roles", Operator.INCL,
+                            f"role-{i}")]
+            # each distinct device-lowerable regex mints its own DFA
+            # table: n_dfa scales the attr_bytes/byte_ovf operand lane
+            for d in range(n_dfa):
+                pats.append(Pattern("request.url_path", Operator.MATCHES,
+                                    rf"^/api/v{d}/x{i}"))
+            configs.append(ConfigRules(
+                name=f"cfg-{i}", evaluators=[(None, All(*pats))]))
+        return configs
+
+    async def run_cell(engine, batch):
+        docs = [{"request": {"method": "GET", "host": "cfg-0",
+                             "url_path": f"/api/v0/x{j % 8}",
+                             "headers": {"x-row": f"r{j}"}},
+                 "auth": {"identity": {"roles": [f"role-{j % 8}"],
+                                       "org": f"org-{j}"}}}
+                for j in range(batch)]
+        await asyncio.gather(*(engine.submit(d, f"cfg-{j % 8}")
+                               for j, d in enumerate(docs)))
+
+    raw = ("batches", "launches", "rows", "device_rows", "pad_rows",
+           "pad_waste_rows", "h2d_bytes", "d2h_bytes")
+    grid = []
+    for members_k in args.grid_members_k:
+        for n_dfa in args.grid_dfa:
+            configs = cell_configs(n_dfa)
+            for batch in args.grid_batches:
+                # dedup/cache off: the grid measures the device cost of
+                # B REAL rows, not the avoidance planes
+                engine = PolicyEngine(max_batch=batch,
+                                      members_k=members_k, mesh=None,
+                                      lane_select=False, batch_dedup=False,
+                                      verdict_cache_size=0)
+                engine.apply_snapshot([
+                    EngineEntry(id=c.name, hosts=[c.name], runtime=None,
+                                rules=c) for c in configs])
+                policy = engine._snapshot.policy
+                before = LEDGER.snapshot("engine")
+                asyncio.run(run_cell(engine, batch))
+                after = LEDGER.snapshot("engine")
+                d = {k: after[k] - before[k] for k in raw}
+                modeled = (engine.debug_vars()["kernel_cost"]["modeled"]
+                           ["current"] or {}).get("entries", {})
+                mb = modeled.get("eval_bitpacked") or {}
+                cell = {
+                    "batch": batch,
+                    "members_k": members_k,
+                    "n_dfa_tables": int(policy.dfa_tables.shape[0]
+                                        if policy.n_byte_attrs else 0),
+                    "launches_per_batch": round(
+                        d["launches"] / max(d["batches"], 1), 4),
+                    "h2d_bytes_per_device_row": round(
+                        d["h2d_bytes"] / max(d["device_rows"], 1), 2),
+                    "d2h_bytes_per_pad_row": round(
+                        d["d2h_bytes"] / max(d["pad_rows"], 1), 2),
+                    "pad_occupancy": round(
+                        d["device_rows"] / max(d["pad_rows"], 1), 4),
+                    "modeled_flops_per_row": mb.get("flops_per_row"),
+                    "modeled_bytes_per_row": mb.get("bytes_per_row"),
+                    "ledger_delta": d,
+                }
+                grid.append(cell)
+                log(f"cell batch={batch} members_k={members_k} "
+                    f"n_dfa={cell['n_dfa_tables']}: "
+                    f"launches/batch={cell['launches_per_batch']} "
+                    f"h2d/row={cell['h2d_bytes_per_device_row']} "
+                    f"d2h/pad-row={cell['d2h_bytes_per_pad_row']} "
+                    f"occupancy={cell['pad_occupancy']}")
+
+    artifact = {
+        "round": "r01",
+        "issue": 16,
+        "metric": "kernel_cost_structural",
+        "platform": f"jax {jax.__version__} {jax.devices()}",
+        "caveat": "structural counts and per-row ratios ONLY (launches, "
+                  "bytes, pad occupancy, modeled flops) — exact on any "
+                  "platform; no RPS/latency claims (ROADMAP bench-reality "
+                  "note)",
+        "grid_axes": {"batch": list(args.grid_batches),
+                      "members_k": list(args.grid_members_k),
+                      "n_dfa_regexes_per_config": list(args.grid_dfa)},
+        "grid": grid,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "KERNELCOST_r01.json")
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+    log(f"wrote {path}")
+    print(json.dumps({"metric": "kernel_cost_structural",
+                      "cells": len(grid), "artifact": path}))
+    return artifact
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds-per-bench", type=float, default=2.0)
@@ -297,6 +410,17 @@ def main():
                          "(~100ms RTT, ~25MB/s) and the batched number is "
                          "bandwidth-bound at ~70B/request — a co-located "
                          "chip pays PCIe/HBM rates instead")
+    ap.add_argument("--kernel-cost-grid", action="store_true",
+                    help="ISSUE 16: emit the structural kernel-cost grid "
+                         "(KERNELCOST_r01.json) instead of the reference "
+                         "micro-benchmarks — cryptography-free")
+    ap.add_argument("--grid-batches", type=int, nargs="+",
+                    default=[16, 128])
+    ap.add_argument("--grid-members-k", type=int, nargs="+",
+                    default=[4, 16])
+    ap.add_argument("--grid-dfa", type=int, nargs="+", default=[0, 2],
+                    help="device-lowerable regexes per config (each mints "
+                         "DFA tables, scaling the attr_bytes operand lane)")
     args = ap.parse_args()
 
     import jax
@@ -304,6 +428,10 @@ def main():
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
+
+    if args.kernel_cost_grid:
+        run_kernel_cost_grid(args)
+        return
 
     results = asyncio.run(run_benchmarks(args.seconds_per_bench, args.batch, args.workers))
 
@@ -321,6 +449,26 @@ def main():
         print(f"| {name} | {ref_s} | {us:,.3f} µs/op ({ops} ops) | {speed} |")
     print()
     print(json.dumps({"metric": "micro_bench", "platform": platform, "results": rows}))
+
+    # file artifact alongside the stdout markdown (ISSUE 16 satellite —
+    # BENCH_*-style, platform-stamped): the driver can diff runs without
+    # scraping the table
+    from authorino_tpu.runtime.kernel_cost import LEDGER
+
+    artifact = {
+        "metric": "micro_bench",
+        "platform": f"jax {jax.__version__} {jax.devices()}",
+        "caveat": "single-process µs/op vs the Go reference geomeans "
+                  "(BASELINE.md); only benchmark 4b touches the device",
+        "reference_us": REFERENCE_US,
+        "results": rows,
+        "kernel_cost": LEDGER.to_json(),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_MICRO_r01.json")
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+    log(f"wrote {path}")
 
 
 if __name__ == "__main__":
