@@ -80,7 +80,8 @@ from ..compiler.compile import (
 __all__ = ["DevicePolicy", "to_device", "eval_verdicts", "eval_batch_jit",
            "fuse_batch", "eval_fused_jit", "dispatch_fused",
            "fused_h2d_supported", "eval_bitpacked_jit", "unpack_verdicts",
-           "packed_width", "firing_columns", "unpack_attribution"]
+           "packed_width", "firing_columns", "unpack_attribution",
+           "kernel_lane_of"]
 
 # exact integer range of f32 accumulation — larger interners must use the
 # gather lane
@@ -91,6 +92,25 @@ _HIGH = jax.lax.Precision.HIGHEST
 
 def _eval_lane() -> str:
     return os.environ.get("AUTHORINO_TPU_EVAL_LANE", "matmul")
+
+
+def _kernel_lane() -> str:
+    """Env mirror of ``--kernel-lane``: ``fused`` arms the ISSUE 17
+    mega-kernel, ``gather``/``matmul`` force those lanes, ``auto``
+    (default) picks fused only on a real TPU backend — off-TPU the Pallas
+    kernel runs in interpret mode, which is bit-exact but an emulation
+    (docs/performance.md "Fused mega-kernel")."""
+    return os.environ.get("AUTHORINO_TPU_KERNEL_LANE", "auto")
+
+
+def kernel_lane_of(params) -> str:
+    """Which kernel lane a params pytree dispatches through — structural,
+    mirroring eval_verdicts' trace-time branch order."""
+    if params.get("fused") is not None:
+        return "fused"
+    if params.get("matmul") is not None:
+        return "matmul"
+    return "gather"
 
 
 def _mm_dtype(device=None):
@@ -228,17 +248,28 @@ def to_device(policy: CompiledPolicy, device=None, lane: Optional[str] = None,
         put = np.asarray
     else:
         put = partial(jax.device_put, device=device) if device is not None else jax.device_put
-    lane = lane or _eval_lane()
+    if lane is None:
+        kl = _kernel_lane()
+        if kl in ("fused", "gather", "matmul"):
+            lane = kl
+        else:  # auto: the mega-kernel only pays off on a real TPU backend
+            lane = "fused" if jax.default_backend() == "tpu" else _eval_lane()
     if lane == "matmul" and len(policy.interner) + 4 >= _F32_EXACT:
         lane = "gather"  # ids no longer exact in f32 accumulation
     # per-dfa-row byte-tensor slot (attr → slot mapping folded in here);
-    # shared by both lanes
+    # shared by all lanes
     dfa_byte_slot = np.maximum(policy.attr_byte_slot[policy.dfa_leaf_attr], 0)
     mm = (
         jax.tree.map(put, _matmul_operands(policy, dfa_byte_slot, device=device))
         if lane == "matmul"
         else None
     )
+    if lane == "fused":
+        from . import fused_kernel as _fk  # lazy: fused_kernel imports us
+
+        fz = jax.tree.map(put, _fk.fused_operands(policy, dfa_byte_slot))
+    else:
+        fz = None
     # gather-lane helpers for the compact payload
     L = policy.n_leaves
     member_slot_of_leaf = np.maximum(
@@ -253,6 +284,9 @@ def to_device(policy: CompiledPolicy, device=None, lane: Optional[str] = None,
     # no-op for host=True), so nothing ever stages on the default device
     return {
         "matmul": mm,
+        # fused mega-kernel subtree (ISSUE 17): int8 op codes + the
+        # table-grouped DFA row layout; None (structural) on other lanes
+        "fused": fz,
         "leaf_op": put(policy.leaf_op),
         "leaf_attr": put(policy.leaf_attr),
         "leaf_const": put(policy.leaf_const),
@@ -604,6 +638,13 @@ def eval_verdicts(
         attrs_val = attrs_val.astype(jnp.int32)
     if members_c.dtype != jnp.int32:
         members_c = members_c.astype(jnp.int32)
+    if params.get("fused") is not None:
+        from . import fused_kernel as _fk  # lazy: fused_kernel imports us
+
+        return _fk._eval_verdicts_fused(
+            params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf,
+            attrs_num, num_valid, rel_rows, member_ovf
+        )
     if params.get("matmul") is not None:
         return _eval_verdicts_matmul(
             params, attrs_val, members_c, cpu_dense, attr_bytes, byte_ovf,
@@ -897,6 +938,16 @@ def dispatch_fused(params, db) -> "jax.Array":
     [B, W] uint8 readback (decode with ``unpack_verdicts``); the device→
     host copy starts eagerly so a later np.asarray only waits, never
     initiates."""
+    try:
+        from ..utils.metrics import observe_kernel_lane
+
+        observe_kernel_lane(kernel_lane_of(params))
+    except Exception:
+        pass  # metrics are advisory; never fail a dispatch over them
+    if params.get("fused") is not None:
+        from . import fused_kernel as _fk
+
+        return _fk.dispatch_megakernel(params, db)
     if fused_h2d_supported():
         buf, layout = fuse_batch(db)
         out = eval_fused_jit(params, jnp.asarray(buf), layout)

@@ -280,6 +280,14 @@ def entry_points(policy=None, sharded=None) -> List[Dict[str, Any]]:
                     "backend bitcast probe fails)",
             "operands": ops,
         })
+        out.append({
+            "entry": "fused_kernel",
+            "kind": "one-launch mega-kernel (ISSUE 17): Pallas on TPU, "
+                    "interpret-mode Pallas on CPU, single-jit lax "
+                    "fallback; every lane + circuit + in-kernel bitpack "
+                    "in one executable, armed by --kernel-lane fused",
+            "operands": ops,
+        })
     return out
 
 

@@ -645,8 +645,13 @@ class NativeFrontend:
                  admission_target_s: float = 0.05,
                  brownout: bool = True, brownout_max_rows: int = 64,
                  lane_select: bool = True, lane_host_max_rows: int = 64,
-                 slo_ms: float = 0.0):
+                 slo_ms: float = 0.0,
+                 kernel_lane: Optional[str] = None):
         self.engine = engine
+        # ISSUE 17: kernel lane override (None = env default
+        # AUTHORINO_TPU_KERNEL_LANE) applied when refresh() builds params
+        # for snapshots the engine did not already upload
+        self.kernel_lane = kernel_lane
         # fault tolerance (ISSUE 5, docs/robustness.md): a failed device
         # batch retries once, then degrades to the SAME kernel on the CPU
         # backend (fail-closed deny only if that fails too); consecutive
@@ -1240,6 +1245,29 @@ class NativeFrontend:
             jnp.asarray(np.zeros((pad, NB), dtype=bool)) if eff else None,
         )
         jax.block_until_ready(out)
+        # fused mega-kernel entry (ISSUE 17): the bitpacked warm above
+        # compiles the routed compute, but the serving dispatch enters
+        # through the one-launch per-operand fused entry — warm that
+        # executable too so the first post-swap batch pays no Pallas
+        # lowering (same (pad, eff) bucket, same operand signature as
+        # _dispatch's fused branch)
+        if rec.params is not None and rec.params.get("fused") is not None:
+            from ..ops import fused_kernel as fused_mod
+
+            out = fused_mod._fused_ops_jit(
+                rec.params,
+                jnp.asarray(np.zeros((pad, A), dtype=dt)),
+                jnp.asarray(np.full((pad, M, K), PAD, dtype=dt)),
+                jnp.asarray(np.zeros((pad, C), dtype=bool)),
+                jnp.asarray(np.zeros((pad,), dtype=np.int32)),
+                jnp.asarray(np.zeros((pad, NB, eff), dtype=np.uint8))
+                if eff else None,
+                jnp.asarray(np.zeros((pad, NB), dtype=bool))
+                if eff else None,
+                None, None, None, None,
+                use_pallas=fused_mod.fused_kernel_supported(),
+            )
+            jax.block_until_ready(out)
         rec.warm.add((pad, eff))
 
     def _prewarm_rest(self, rec: _SnapRec, grid: List[Tuple[int, int]]) -> None:
@@ -1508,7 +1536,8 @@ class NativeFrontend:
             enc = get_native_encoder(policy)
             if enc is not None:
                 rec.encoder = enc
-                rec.params = snap.params if snap.params is not None else to_device(policy)
+                rec.params = (snap.params if snap.params is not None
+                              else to_device(policy, lane=self.kernel_lane))
                 spec["policy"] = enc._handle
                 dt = wire_dtype(policy)
                 A, M, K = policy.n_attrs, policy.n_member_attrs, policy.members_k
@@ -2214,6 +2243,27 @@ class NativeFrontend:
                         jnp.asarray(sel("shard_of")),
                         jnp.asarray(sel("config_id")),
                     )
+            elif rec.params.get("fused") is not None:
+                # fused lane (ISSUE 17): the ONE-launch mega-kernel entry
+                # (operands are already separate arrays here, so the
+                # per-operand variant stages them; compute + in-kernel
+                # bitpack are a single executable either way)
+                from ..ops import fused_kernel as fused_mod
+
+                packed = fused_mod._fused_ops_jit(
+                    rec.params,
+                    jnp.asarray(sel("attrs_val")),
+                    jnp.asarray(sel("members")),
+                    jnp.asarray(sel("cpu_dense").view(bool)),
+                    jnp.asarray(sel("config_id")),
+                    jnp.asarray(np.ascontiguousarray(
+                        sel("attr_bytes")[..., :eff]))
+                    if has_dfa else None,
+                    jnp.asarray(sel("byte_ovf").view(bool))
+                    if has_dfa else None,
+                    None, None, None, None,
+                    use_pallas=fused_mod.fused_kernel_supported(),
+                )
             else:
                 packed = eval_bitpacked_jit(
                     rec.params,
@@ -2229,6 +2279,14 @@ class NativeFrontend:
                 )
             if faults.ACTIVE:
                 packed = faults.FAULTS.wrap_handle(packed, "native")
+            if rec.sharded is None:
+                try:
+                    from ..ops.pattern_eval import kernel_lane_of
+
+                    metrics_mod.observe_kernel_lane(
+                        kernel_lane_of(rec.params))
+                except Exception:
+                    pass  # metrics are advisory
             try:
                 packed.copy_to_host_async()
             except Exception:
