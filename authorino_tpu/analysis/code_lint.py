@@ -69,7 +69,12 @@ _DRAIN_PATH = re.compile(
     # selection/cancellation paths run exactly when one lane is slow or
     # half-open — an unbounded wait there turns the latency rescue into
     # the latency it rescues from
-    r"|lane|speculat|cost_model)",
+    r"|lane|speculat|cost_model"
+    # fleet plane (ISSUE 18): router decisions, replica join/leave/crash
+    # choreography and warm-join run exactly when a peer replica may be
+    # dead or wedged — an unbounded wait there stalls the whole fleet's
+    # routing, not one process
+    r"|router|fleet|replica|join)",
     re.IGNORECASE)
 _WAITISH_METHODS = {"wait", "join"}
 

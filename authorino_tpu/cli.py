@@ -15,6 +15,7 @@ import logging
 import os
 import signal
 import sys
+import threading
 from typing import Any, Optional
 
 
@@ -357,6 +358,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "previous one keeps serving")
     s.add_argument("--snapshot-poll", type=float, default=env_var("SNAPSHOT_POLL_S", 5.0),
                    help="Replica poll interval in seconds (default 5)")
+    s.add_argument("--fleet-hotset-k", type=int,
+                   default=env_var("FLEET_HOTSET_K", 1024),
+                   help="Verdict-cache warm-join (docs/fleet.md): a leader "
+                        "publishes its top-K hot verdict-cache entries as "
+                        "HOTSET.json next to the snapshot manifest, and a "
+                        "replica seeds its cache from it at join, so a "
+                        "cold replica joining mid-flood inherits the hot "
+                        "set instead of re-missing it. 0 disables")
+    s.add_argument("--fleet-hotset-s", type=float,
+                   default=env_var("FLEET_HOTSET_S", 30.0),
+                   help="Leader hot-set publish cadence in seconds "
+                        "(default 30)")
     s.add_argument("--native-frontend", choices=["auto", "on", "off"],
                    default=env_var("NATIVE_FRONTEND", "auto"),
                    help="Serve the ext_authz gRPC port from the C++ device-owner "
@@ -627,9 +640,36 @@ async def run_server(args) -> None:
     if publish_dir:
         from .snapshots.distribution import SnapshotPublisher
 
-        SnapshotPublisher(publish_dir).attach(engine)
+        publisher = SnapshotPublisher(publish_dir)
+        publisher.attach(engine)
         log.info("snapshot leader: publishing vetted snapshots to %s",
                  publish_dir)
+        hotset_k = int(getattr(args, "fleet_hotset_k", 1024) or 0)
+        if hotset_k > 0:
+            # warm-join hot-set cadence (ISSUE 18, docs/fleet.md): fold
+            # the verdict cache's top-K into HOTSET.json next to the
+            # manifest.  Advisory end to end — a failed publish only
+            # costs joiners a cold cache
+            from .fleet import warmjoin as warmjoin_mod
+
+            hotset_stop = threading.Event()
+            hotset_s = max(1.0, float(getattr(args, "fleet_hotset_s", 30.0)))
+
+            def _hotset_loop() -> None:
+                while not hotset_stop.wait(hotset_s):
+                    try:
+                        digest = warmjoin_mod.export_hotset(
+                            engine, k=hotset_k)
+                        if digest is not None:
+                            publisher.publish_hotset(digest)
+                    except Exception:
+                        log.exception("hot-set publish failed (warm-join "
+                                      "is advisory; serving unaffected)")
+
+            threading.Thread(target=_hotset_loop, daemon=True,
+                             name="atpu-fleet-hotset").start()
+            log.info("fleet hot-set: publishing top-%d verdicts every "
+                     "%.0fs", hotset_k, hotset_s)
     if snapshot_source:
         from .snapshots.distribution import SnapshotReplica
 
@@ -642,6 +682,20 @@ async def run_server(args) -> None:
             poll_s=float(getattr(args, "snapshot_poll", 5.0)))
         try:
             snapshot_replica.poll_once()  # best-effort warm start
+            if int(getattr(args, "fleet_hotset_k", 1024) or 0) > 0:
+                # verdict-cache warm-join (ISSUE 18, docs/fleet.md): seed
+                # the cache from the leader's published hot-set digest so
+                # a replica joining mid-flood starts warm.  Fail-open:
+                # mismatch or absence just means joining cold
+                from .fleet import warmjoin as warmjoin_mod
+                from .snapshots.distribution import load_hotset
+
+                imported, _ = warmjoin_mod.import_hotset(
+                    engine, load_hotset(snapshot_source))
+                if imported:
+                    log.info("warm-join: inherited %d hot verdict(s) "
+                             "from the leader's published hot set",
+                             imported)
         except Exception:
             log.exception("snapshot warm start failed (replica keeps "
                           "polling; serving an empty index until a vetted "

@@ -65,3 +65,22 @@ class StringInterner:
         out = StringInterner()
         out._table = dict(self._table)
         return out
+
+    def content_digest(self) -> str:
+        """Content hash of the string→id mapping (insertion order IS the id
+        assignment).  The ``serial`` above is identity, deliberately
+        process-unique — two replicas deserializing the SAME published
+        snapshot get different serials but identical tables, so their
+        encoded operand ids (and verdict-cache row keys) agree.  The fleet
+        warm-join protocol (fleet/warmjoin.py) keys hot-set portability on
+        this digest: same content ⇒ same row-key bytes ⇒ the leader's hot
+        verdicts are valid under the joining replica's own epoch."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for s, i in self._table.items():
+            h.update(s.encode("utf-8", "surrogatepass"))
+            h.update(b"\x00")
+            h.update(str(i).encode("ascii"))
+            h.update(b"\x01")
+        return h.hexdigest()[:16]

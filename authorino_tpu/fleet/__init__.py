@@ -1,0 +1,35 @@
+"""Fleet serving plane (ISSUE 18): N replicas as one engine.
+
+PR 8 ships compiled tensors leader→replica; nothing before this package
+made N engine processes *behave as one system* under load.  Three planes,
+one package (docs/fleet.md):
+
+- :mod:`.router` — consistent-hash (rendezvous, by the verdict-cache
+  routing key: dedup and cache locality survive routing) / least-loaded
+  hybrid router shim with per-replica health gating, deadline-aware
+  spillover to the second choice, and drain awareness;
+- :mod:`.aggregate` — fleet-wide folds of the PR 9 SLO burn and PR 15
+  tenant stats, the GLOBAL noisy-neighbor containment check (fires when
+  every per-replica share is individually under threshold), and the
+  fleet canary guard (one replica canaries the candidate snapshot while
+  the fleet holds baseline, judged on global cohort counts through the
+  PR 10 guard machinery);
+- :mod:`.warmjoin` — the verdict-cache hot-set digest a leader publishes
+  next to the snapshot manifest, so a cold replica joining mid-flood
+  inherits the hot set instead of re-missing it;
+- :mod:`.replica` / :mod:`.harness` — the in-process replica wrapper and
+  the elastic choreography harness the bench and tier-1 drive
+  (add/remove/crash/canary, SIGTERM-style drain).
+
+Everything in router/aggregate/warmjoin is import-light: numpy + the
+package's own utils only — the cross-replica guard math must be loadable
+on images without the identity-evaluator dependency set."""
+
+from .aggregate import FleetAggregator, GlobalContainment
+from .harness import FleetHarness
+from .replica import InProcessReplica
+from .router import FleetRouter, in_fleet_cohort, routing_key
+
+__all__ = ["FleetAggregator", "GlobalContainment", "FleetHarness",
+           "FleetRouter", "InProcessReplica", "in_fleet_cohort",
+           "routing_key"]

@@ -81,7 +81,7 @@ __all__ = ["DevicePolicy", "to_device", "eval_verdicts", "eval_batch_jit",
            "fuse_batch", "eval_fused_jit", "dispatch_fused",
            "fused_h2d_supported", "eval_bitpacked_jit", "unpack_verdicts",
            "packed_width", "firing_columns", "unpack_attribution",
-           "kernel_lane_of"]
+           "kernel_lane_of", "auto_lane", "last_auto_decision"]
 
 # exact integer range of f32 accumulation — larger interners must use the
 # gather lane
@@ -101,6 +101,40 @@ def _kernel_lane() -> str:
     kernel runs in interpret mode, which is bit-exact but an emulation
     (docs/performance.md "Fused mega-kernel")."""
     return os.environ.get("AUTHORINO_TPU_KERNEL_LANE", "auto")
+
+
+# last `--kernel-lane auto` resolution (ISSUE 18 satellite): what got
+# armed, over which device platforms, surfaced on /debug/vars
+# kernel_cost.entry_points so an operator can see WHY fused is (not) on
+_AUTO_DECISION: dict = {}
+
+
+def auto_lane(device=None) -> str:
+    """Resolve ``--kernel-lane auto`` for one operand upload: fused iff
+    EVERY device the operands can land on is a real TPU.
+    ``jax.default_backend()`` alone is the wrong oracle — it names the
+    highest-priority platform, so a single TPU in a mixed device set used
+    to arm the Pallas kernel mesh-wide and run it in interpret mode on
+    every non-TPU shard.  The consulted set is the explicit target device
+    when one is given, else the FULL visible device set (``mesh="auto"``
+    shards over exactly that set, so all-TPU here implies all-TPU on the
+    mesh)."""
+    devices = [device] if device is not None else list(jax.devices())
+    platforms = sorted({str(getattr(d, "platform", "unknown"))
+                        for d in devices})
+    lane = "fused" if platforms == ["tpu"] else _eval_lane()
+    _AUTO_DECISION.clear()
+    _AUTO_DECISION.update({
+        "requested": "auto", "lane": lane,
+        "devices": len(devices), "platforms": platforms,
+    })
+    return lane
+
+
+def last_auto_decision() -> Optional[dict]:
+    """The most recent auto-lane resolution, or None before any auto
+    upload (explicit --kernel-lane values never consult this path)."""
+    return dict(_AUTO_DECISION) if _AUTO_DECISION else None
 
 
 def kernel_lane_of(params) -> str:
@@ -252,8 +286,8 @@ def to_device(policy: CompiledPolicy, device=None, lane: Optional[str] = None,
         kl = _kernel_lane()
         if kl in ("fused", "gather", "matmul"):
             lane = kl
-        else:  # auto: the mega-kernel only pays off on a real TPU backend
-            lane = "fused" if jax.default_backend() == "tpu" else _eval_lane()
+        else:  # auto: fused iff every target device is a real TPU
+            lane = auto_lane(device)
     if lane == "matmul" and len(policy.interner) + 4 >= _F32_EXACT:
         lane = "gather"  # ids no longer exact in f32 accumulation
     # per-dfa-row byte-tensor slot (attr → slot mapping folded in here);

@@ -294,6 +294,20 @@ class AdmissionController:
 
     # -- introspection -------------------------------------------------------
 
+    def health_signal(self, depth: int) -> Dict[str, Any]:
+        """Router-facing health slice (ISSUE 18): the per-replica load and
+        pressure signals the fleet router's spillover/load-shift decisions
+        consume, at the caller's observed queue ``depth``.  One shape for
+        in-process replicas and /readyz-polled process replicas — the
+        router never knows the difference."""
+        return {
+            "overloaded": self.overloaded,
+            "queue_depth": int(depth),
+            "predicted_wait_s": self.predicted_wait(depth),
+            "effective_cap": self.effective_cap(),
+            "rejected_total": sum(self.rejected.values()),
+        }
+
     def _set_state(self, state: str) -> None:
         # caller holds _lock
         if state != self._state:

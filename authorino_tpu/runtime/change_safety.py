@@ -205,6 +205,35 @@ class CanaryGuard:
                 st[0] += int(t)
                 st[1] += int(d)
 
+    def observe_counts(self, canary: bool, total: int = 0, denies: int = 0,
+                       errors: int = 0, slo_total: int = 0,
+                       slo_bad: int = 0, configs=None,
+                       tenant_rejects=None) -> None:
+        """Count-level cohort feed (ISSUE 18): fold pre-aggregated deltas
+        into one cohort's stats.  The fleet aggregator replays each
+        replica's published fold deltas through this — the canary
+        replica's counts land on the canary side, the rest of the fleet's
+        on the baseline side — so ``breach()`` judges GLOBAL deny/error/
+        SLO deltas with the exact thresholds, minimum-sample gates, and
+        changed-set restriction the in-process canary uses.  ``configs``
+        maps authconfig name → (requests, denies); ``tenant_rejects``
+        maps tenant → tenant-scoped rejection count."""
+        side = self._side(canary)
+        with self._lock:
+            side.total += max(0, int(total))
+            side.denies += max(0, int(denies))
+            side.errors += max(0, int(errors))
+            side.slo_total += max(0, int(slo_total))
+            side.slo_bad += max(0, int(slo_bad))
+            for name, td in (configs or {}).items():
+                st = side.configs.setdefault(str(name), [0, 0])
+                st[0] += max(0, int(td[0]))
+                st[1] += max(0, int(td[1]))
+            for name, n in (tenant_rejects or {}).items():
+                if n > 0:
+                    side.tenant_rejects[str(name)] = \
+                        side.tenant_rejects.get(str(name), 0) + int(n)
+
     def observe_errors(self, canary: bool, n: int) -> None:
         """Typed serving errors (UNAVAILABLE-class — deadline sheds and
         overload rejections are the protection mechanism working and stay

@@ -742,6 +742,10 @@ class PolicyEngine:
         self.breaker = CircuitBreaker("engine", threshold=breaker_threshold,
                                       reset_s=breaker_reset_s)
         self._draining = False
+        # cumulative typed serving errors (fleet fold, ISSUE 18): requests
+        # failed UNAVAILABLE after every degrade lane was exhausted.
+        # Deadline sheds stay out — they are the protection working.
+        self.error_total = 0
         # EWMA of the device stage (launch→readback) — the shedding
         # headroom: a request whose deadline lands inside one expected
         # device round trip cannot be answered in time
@@ -2713,6 +2717,7 @@ class PolicyEngine:
                 log.warning("micro-batch of %d re-decided host-side after "
                             "device failure (%r)", len(batch), exc)
         n_failed = sum(len(futs) for futs in failed.values())
+        self.error_total += n_failed
         phase = self._canary
         if n_failed and phase is not None and batch:
             # typed-error guard feed (ISSUE 10): rows the degrade oracle
@@ -2854,6 +2859,54 @@ class PolicyEngine:
                         len(self._queue), self._inflight,
                         self._brownout_inflight)
         return False
+
+    # ---- fleet plane (ISSUE 18) ------------------------------------------
+
+    def fleet_health(self) -> Dict[str, Any]:
+        """The fleet router's per-replica health dict — exactly the
+        /readyz + admission + breaker evidence (service/http_server.py
+        readyz; runtime/admission.py health_signal), so in-process
+        replicas and process replicas polled over HTTP publish one shape.
+        Every read here is GIL-atomic: safe from the router's decision
+        path under load."""
+        h = self.admission.health_signal(len(self._queue))
+        h["ready"] = self._snapshot is not None and not self._draining
+        h["draining"] = self._draining
+        h["breaker_open"] = self.breaker.state != "closed"
+        h["generation"] = self.generation
+        return h
+
+    def fleet_fold(self) -> Dict[str, Any]:
+        """One replica's fold for the fleet aggregator (fleet/aggregate.py):
+        health + CUMULATIVE counters (the aggregator differences
+        consecutive folds into deltas; cumulatives survive a missed
+        publish) + the per-tenant rate EWMAs whose fleet-wide sum is the
+        global tenant share.  Small and cadence-published — never anything
+        per-request."""
+        fold = self.fleet_health()
+        fold["errors"] = self.error_total
+        if self.slo is not None:
+            fold["slo_total"] = self.slo.total
+            fold["slo_bad"] = self.slo.bad_total
+        else:
+            fold["slo_total"] = fold["slo_bad"] = 0
+        ten = self.tenancy
+        if ten.enabled:
+            fold["tenants"] = ten.stats.export_fold()
+            fold["tenant_rejects"] = {
+                t: sum(r.values())
+                for t, r in list(ten.admission.rejected.items())}
+        else:
+            fold["tenants"] = {}
+            fold["tenant_rejects"] = {}
+        # fleet-pressure gate for the GLOBAL containment check: this
+        # replica's wait is hot or its admission gate left HEALTHY
+        fold["wait_hot"] = bool(
+            self.admission.wait_ewma > self.admission.target_s
+            or self.admission.overloaded)
+        fold["admission_state"] = ("OVERLOADED" if self.admission.overloaded
+                                   else "HEALTHY")
+        return fold
 
     def _cache_keys(self, keys, n, snap, rows=None):
         """Full verdict-cache keys for one batch.  Single-corpus snapshots
@@ -3332,6 +3385,8 @@ class PolicyEngine:
             # a post-completion telemetry failure arrives here AFTER the
             # success path already observed the batch — don't double-burn
             self.slo.observe_errors(len(batch))
+        if exc.code != DEADLINE_EXCEEDED:
+            self.error_total += len(batch)
         phase = self._canary
         if phase is not None and batch and exc.code != DEADLINE_EXCEEDED:
             # typed-error guard (ISSUE 10): a canary generation whose

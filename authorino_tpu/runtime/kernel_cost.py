@@ -288,6 +288,20 @@ def entry_points(policy=None, sharded=None) -> List[Dict[str, Any]]:
                     "in one executable, armed by --kernel-lane fused",
             "operands": ops,
         })
+    # --kernel-lane auto provenance (ISSUE 18 satellite): the last auto
+    # resolution (lane armed + the device platforms consulted) rides the
+    # dispatchable entries as a FIELD — the entry list itself is a pinned
+    # audit surface and must not grow phantom entry points
+    try:
+        from ..ops.pattern_eval import last_auto_decision
+
+        dec = last_auto_decision()
+    except Exception:  # pragma: no cover - import cycle hygiene
+        dec = None
+    if dec is not None:
+        for e in out:
+            if e["entry"] in ("fused_kernel", "sharded_step"):
+                e["kernel_lane_auto"] = dec
     return out
 
 

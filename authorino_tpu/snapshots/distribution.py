@@ -36,11 +36,16 @@ from .serialize import SnapshotFormatError, deserialize_policy, serialize_policy
 __all__ = [
     "SnapshotLoadError", "LoadedSnapshot", "SnapshotPublisher",
     "load_latest", "load_snapshot_blob", "SnapshotReplica",
+    "load_hotset",
 ]
 
 log = logging.getLogger("authorino_tpu.snapshots")
 
 MANIFEST = "MANIFEST.json"
+# verdict-cache hot-set digest (ISSUE 18, fleet/warmjoin.py): published
+# NEXT TO the manifest, never inside it — a replica that predates the
+# fleet plane keeps loading MANIFEST.json untouched
+HOTSET = "HOTSET.json"
 
 
 class SnapshotLoadError(RuntimeError):
@@ -142,6 +147,22 @@ class SnapshotPublisher:
                     os.unlink(os.path.join(self.directory, n))
                 except OSError:
                     pass
+
+    def publish_hotset(self, digest: Dict[str, Any]) -> str:
+        """Atomically publish the verdict-cache hot-set digest (ISSUE 18,
+        fleet/warmjoin.py export_hotset) next to the manifest.  Same
+        tmp+rename discipline as the blob: a joining replica never reads a
+        torn digest.  Advisory data — a stale or missing HOTSET.json only
+        costs a cold cache, never correctness (entries are re-validated
+        against the joining snapshot's tokens at import)."""
+        path = os.path.join(self.directory, HOTSET)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(digest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
 
     def publish_from_engine(self, engine) -> Optional[str]:
         """Serialize + publish the engine's current snapshot.  Returns the
@@ -279,11 +300,27 @@ def load_latest(source: str) -> LoadedSnapshot:
     return load_snapshot_blob(blob, digest=got)
 
 
+def load_hotset(source: str) -> Optional[Dict[str, Any]]:
+    """Load the published verdict-cache hot-set digest, or None when the
+    source has none (a pre-fleet leader, or hot-set publishing off).
+    Malformed digests also resolve to None — warm-join is advisory; a
+    replica must join cold rather than fail to join."""
+    try:
+        doc = json.loads(_read_source(source, HOTSET).decode("utf-8"))
+    except Exception:
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
 class SnapshotReplica:
     """Poll a snapshot source and apply each new vetted snapshot to a local
     engine.  The engine's ``apply_published`` is the admission gate: an
     uncertified or locally-failing snapshot is rejected and the previous
     one keeps serving — leader down simply means no new generations."""
+
+    # load-failure backoff: ceiling multiple of poll_s (a dead leader
+    # settles at poll_s * 2**MAX_BACKOFF_DOUBLINGS between attempts)
+    MAX_BACKOFF_DOUBLINGS = 5
 
     def __init__(self, engine, source: str, poll_s: float = 5.0):
         self.engine = engine
@@ -295,6 +332,11 @@ class SnapshotReplica:
         self.applied = 0
         self.rejected = 0
         self.errors = 0
+        # consecutive load failures — drives the exponential poll backoff
+        # and demotes repeat WARNINGs to DEBUG (a dead leader must not
+        # flood the replica's log at the poll cadence); any successful
+        # load (or a rejection — the source IS reachable) resets it
+        self.error_streak = 0
         self.last_error: Optional[str] = None
 
     def poll_once(self) -> bool:
@@ -307,11 +349,23 @@ class SnapshotReplica:
             loaded = load_latest(self.source)
         except SnapshotLoadError as e:
             self.errors += 1
+            self.error_streak += 1
             self.last_error = str(e)
-            metrics_mod.snapshot_distribution.labels("replica", "error").inc()
-            log.warning("replica load failed (serving snapshot unchanged): "
-                        "%s", e)
+            if self.error_streak == 1:
+                metrics_mod.snapshot_distribution.labels(
+                    "replica", "error").inc()
+                log.warning("replica load failed (serving snapshot "
+                            "unchanged; backing polls off): %s", e)
+            else:
+                # retries of a standing failure: counted, logged quietly —
+                # the WARNING above already said the leader is unreadable
+                metrics_mod.snapshot_distribution.labels(
+                    "replica", "retry").inc()
+                log.debug("replica load retry %d failed (next poll in "
+                          "%.1fs): %s", self.error_streak,
+                          self.next_poll_s(), e)
             return False
+        self.error_streak = 0
         if loaded.digest and loaded.digest == self._seen_digest:
             return False
         try:
@@ -344,13 +398,23 @@ class SnapshotReplica:
                                         daemon=True)
         self._thread.start()
 
+    def next_poll_s(self) -> float:
+        """Current poll interval: poll_s while healthy, doubling per
+        consecutive load failure up to poll_s * 2**MAX_BACKOFF_DOUBLINGS.
+        A success (or an admission rejection — the source answered)
+        snaps it back to poll_s."""
+        if self.error_streak <= 0:
+            return self.poll_s
+        doublings = min(self.error_streak, self.MAX_BACKOFF_DOUBLINGS)
+        return self.poll_s * (1 << doublings)
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
                 self.poll_once()
             except Exception:
                 log.exception("replica poll failed")
-            self._stop.wait(self.poll_s)
+            self._stop.wait(self.next_poll_s())
 
     def stop(self, timeout_s: float = 5.0) -> None:
         self._stop.set()
@@ -361,6 +425,8 @@ class SnapshotReplica:
     def to_json(self) -> Dict[str, Any]:
         return {
             "source": self.source, "poll_s": self.poll_s,
+            "next_poll_s": self.next_poll_s(),
             "applied": self.applied, "rejected": self.rejected,
-            "errors": self.errors, "last_error": self.last_error,
+            "errors": self.errors, "error_streak": self.error_streak,
+            "last_error": self.last_error,
         }

@@ -190,6 +190,23 @@ class TenantStats:
             c = self._t.get(tenant)
             return c.rate_ewma if c is not None else 0.0
 
+    def export_fold(self) -> Dict[str, Dict[str, float]]:
+        """Raw per-tenant counters for the fleet fold publisher (ISSUE 18):
+        cumulative requests/denies/slo_bad plus the live served-rate EWMA.
+        Cumulative counts let the aggregator difference consecutive folds
+        into deltas; the rate EWMAs are what global tenant share sums —
+        per-replica SHARES cannot be averaged (consistent-hash routing
+        concentrates tenants, so a fleet-hot tenant can look locally
+        entitled on every replica at once — the exact blindness the global
+        fold exists to remove)."""
+        with self._lock:
+            return {name: {
+                "requests": c.requests,
+                "denies": c.denies,
+                "slo_bad": c.slo_bad,
+                "rate": c.rate_ewma,
+            } for name, c in self._t.items()}
+
     # -- prometheus flush (top-K + other) -----------------------------------
 
     def _labels(self) -> Dict[str, str]:

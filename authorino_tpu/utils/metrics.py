@@ -860,7 +860,9 @@ snapshot_distribution = _counter(
     "Leader/replica snapshot distribution outcomes: role = leader | "
     "replica; result = published | applied | rejected (admission gate: "
     "uncertified or locally-failing snapshot, old snapshot keeps serving) "
-    "| error (unreadable/corrupt source).",
+    "| error (unreadable/corrupt source) | retry (a poll retried after a "
+    "load failure under exponential backoff — a dead leader backs the "
+    "replica's polling off instead of flooding its log).",
     ("role", "result"),
 )
 
@@ -1186,3 +1188,54 @@ def observe_kernel_lane(lane: str) -> None:
     if ch is None:
         ch = _kernel_lane_children[lane] = kernel_lane.labels(lane)
     ch.inc()
+
+
+# ---------------------------------------------------------------------------
+# Fleet serving plane (ISSUE 18, docs/fleet.md): N replicas behind the
+# consistent-hash/least-loaded router shim, fleet-wide guard aggregation,
+# and the verdict-cache warm-join protocol.  No tenant labels here — the
+# tenant axis stays on the per-replica families above; fleet aggregation
+# folds tenant evidence in-process, it never re-exports per-tenant series.
+# ---------------------------------------------------------------------------
+
+fleet_routed = _counter(
+    "auth_server_fleet_routed_total",
+    "Routing decisions by the fleet router shim, by outcome: primary (the "
+    "rendezvous-hash first choice took it — cache/dedup locality "
+    "preserved), spillover (deadline-aware spill to the second-choice "
+    "replica: the first choice's predicted wait could not meet the "
+    "request deadline), load-shift (least-loaded hybrid: the first "
+    "choice's backlog exceeded the second's by the imbalance factor), "
+    "unhealthy (the first choice was not ready / draining / breaker-open "
+    "and the second took it), failover (the routed replica failed typed "
+    "mid-flight and the request re-routed), no-replica (every candidate "
+    "was unroutable — the caller saw a typed UNAVAILABLE).",
+    ("outcome",),
+)
+fleet_replicas = _gauge(
+    "auth_server_fleet_replicas",
+    "Replicas currently registered with the fleet router, by state: "
+    "ready (routable), draining (SIGTERM choreography in progress — no "
+    "new work), down (crashed/removed but not yet deregistered).",
+    ("state",),
+)
+fleet_warm_join = _counter(
+    "auth_server_fleet_warm_join_total",
+    "Verdict-cache warm-join outcomes when a replica joins the fleet: "
+    "imported (hot-set entries adopted under the local snapshot's cache "
+    "tokens), skipped (entries whose config fingerprint the joining "
+    "snapshot does not carry — a reconcile moved on), mismatch (the "
+    "whole digest refused: interner content or encoding epoch diverged "
+    "from the joining replica's snapshot, nothing imported).",
+    ("result",),
+)
+fleet_guard_breach = _counter(
+    "auth_server_fleet_guard_breach_total",
+    "Fleet-wide guard breaches raised by the fold aggregator, by guard "
+    "(the same guard names as auth_server_canary_guard_delta, judged on "
+    "GLOBAL cohort counts: the canary replica's fold vs the rest of the "
+    "fleet; plus global-tenant-share for the cross-replica containment "
+    "check that fires when every per-replica share is individually under "
+    "threshold).",
+    ("guard",),
+)

@@ -101,6 +101,17 @@ class SloTracker:
                 bad += self._bad[i]
         return total, bad
 
+    def window_counts(self, window_s: int,
+                      now: Optional[float] = None) -> Tuple[int, int]:
+        """(total, bad) observed inside the trailing window — the COUNT
+        view of ``burn_rate``, for folds that must aggregate before
+        dividing (the fleet aggregator sums per-replica counts and takes
+        one global burn; averaging per-replica burn rates would weight an
+        idle replica's 0/0 the same as a flooded one's)."""
+        sec = int(time.time() if now is None else now)
+        with self._lock:
+            return self._window_counts(window_s, sec)
+
     def burn_rate(self, window_s: int, now: Optional[float] = None) -> float:
         sec = int(time.time() if now is None else now)
         with self._lock:

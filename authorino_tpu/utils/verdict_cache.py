@@ -71,3 +71,19 @@ class VerdictCache:
         return {"hits": self.hits, "misses": self.misses,
                 "adds": self.adds, "evictions": self.evictions,
                 "entries": len(self._entries)}
+
+    def hottest(self, k: int) -> list:
+        """Top-``k`` (key, value) pairs, most-recently-used first — the
+        fleet warm-join hot-set export (ISSUE 18).  The LRU order IS the
+        heat signal this cache keeps: the MRU head is exactly the working
+        set a cold replica joining mid-flood would otherwise re-miss.
+        Values are returned as stored (callers must not mutate them)."""
+        if k <= 0:
+            return []
+        with self._lock:
+            out = []
+            for key in reversed(self._entries):
+                out.append((key, self._entries[key]))
+                if len(out) >= k:
+                    break
+            return out

@@ -1,0 +1,570 @@
+"""Fleet serving plane (ISSUE 18): N replicas as one engine.
+
+The properties that make a replica set one system: rendezvous routing
+moves only the departed replica's keys on membership change; router
+outcomes (unhealthy / spillover / load-shift) are health- and deadline-
+driven, never random; fold deltas replay through the GLOBAL canary guard
+so a poison canaried on one replica breaches on fleet evidence and rolls
+the whole fleet back via the manifest; the global containment inequality
+fires on fleet-wide tenant share when every per-replica share is
+individually clean; and a cold replica joining mid-flood inherits the
+leader's verdict-cache hot set bit-exactly — or refuses it when the
+interner content doesn't match.
+
+Deliberately import-light: collects on images without `cryptography`
+(no evaluators.identity / native_frontend imports); JAX_PLATFORMS=cpu."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from authorino_tpu.compiler import ConfigRules
+from authorino_tpu.expressions import Operator, Pattern
+from authorino_tpu.fleet import (
+    FleetAggregator,
+    FleetHarness,
+    FleetRouter,
+    GlobalContainment,
+    in_fleet_cohort,
+    routing_key,
+)
+from authorino_tpu.fleet import warmjoin
+from authorino_tpu.runtime import EngineEntry, PolicyEngine
+from authorino_tpu.runtime.change_safety import GuardThresholds
+from authorino_tpu.snapshots.distribution import (
+    SnapshotPublisher,
+    load_hotset,
+    load_latest,
+)
+from authorino_tpu.utils.rpc import UNAVAILABLE, CheckAbort
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def org_corpus(orgs):
+    """name -> org constant; each config allows exactly that org (so a
+    constant typo is a CONSTANT-DENY poison — the verdict actually
+    flips, unlike structural mutations rescued by a sibling branch)."""
+    return [ConfigRules(name=n,
+                        evaluators=[(None, Pattern("auth.identity.org",
+                                                   Operator.EQ, org))])
+            for n, org in orgs.items()]
+
+
+def entries_of(cfgs):
+    return [EngineEntry(id=c.name, hosts=[c.name], runtime=None, rules=c)
+            for c in cfgs]
+
+
+def build_engine(cfgs=None, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("verdict_cache_size", 4096)
+    kw.setdefault("lane_select", False)
+    # leaders must certify what they publish: replicas reject
+    # uncertified snapshots at admission (from_published)
+    kw.setdefault("strict_verify", True)
+    engine = PolicyEngine(members_k=4, mesh=None, **kw)
+    if cfgs is not None:
+        engine.apply_snapshot(entries_of(cfgs))
+    return engine
+
+
+def cdoc(j, org):
+    return {"request": {"host": f"h{j}", "path": f"/p{j}", "method": "GET"},
+            "auth": {"identity": {"org": org}}}
+
+
+V1 = {f"c{i}": f"org-{i}" for i in range(6)}
+
+# low-volume thresholds for deterministic tier-1 canary tests (the
+# defaults need hundreds of requests per cohort)
+TH = GuardThresholds(min_requests=8, min_config_requests=4,
+                     min_config_allows=2, min_tenant_attempts=8)
+
+
+def static_health(**over):
+    h = {"ready": True, "draining": False, "breaker_open": False,
+         "overloaded": False, "queue_depth": 0, "predicted_wait_s": 0.0}
+    h.update(over)
+    return h
+
+
+# ---------------------------------------------------------------------------
+# router: rendezvous placement + hybrid outcomes
+# ---------------------------------------------------------------------------
+
+
+def test_routing_key_stable_and_distinct():
+    a = routing_key("c1", cdoc(1, "org-1"))
+    assert a == routing_key("c1", cdoc(1, "org-1"))
+    assert a != routing_key("c2", cdoc(1, "org-1"))
+    assert a != routing_key("c1", cdoc(2, "org-1"))
+
+
+def test_rendezvous_moves_only_departed_replicas_keys():
+    """The consistent-hash property: removing one replica reassigns
+    exactly the keys that lived on it — every other key keeps its
+    placement (cache locality survives membership churn)."""
+    router = FleetRouter()
+    for name in ("ra", "rb", "rc", "rd"):
+        router.add_replica(name, static_health)
+    keys = [routing_key(f"c{i % 7}", cdoc(i, f"org-{i % 7}"))
+            for i in range(300)]
+    before = {k: router.route(k)[0] for k in keys}
+    router.remove_replica("rb")
+    after = {k: router.route(k)[0] for k in keys}
+    for k in keys:
+        if before[k] != "rb":
+            assert after[k] == before[k]
+        else:
+            assert after[k] != "rb"
+    moved = sum(1 for k in keys if before[k] == "rb")
+    assert 0 < moved < len(keys) / 2  # ~1/4 of the keyspace, never more
+
+
+def test_router_unhealthy_routes_to_best_routable():
+    router = FleetRouter()
+    key = routing_key("c1", cdoc(1, "org-1"))
+    router.add_replica("ra", static_health)
+    router.add_replica("rb", static_health)
+    primary = router.route(key)[0]
+    other = "rb" if primary == "ra" else "ra"
+    router.remove_replica(primary)
+    router.add_replica(primary, lambda: static_health(ready=False))
+    first, second = router.route(key)
+    assert first == other and second is None
+    assert router.outcomes.get("unhealthy", 0) >= 1
+    # draining counts as unroutable too (the SIGTERM choreography)
+    router.remove_replica(primary)
+    router.add_replica(primary, lambda: static_health(draining=True))
+    assert router.route(key)[0] == other
+
+
+def test_router_deadline_spillover_and_load_shift():
+    router = FleetRouter(load_factor=2.0, min_shift_depth=8)
+    key = routing_key("c1", cdoc(1, "org-1"))
+    router.add_replica("ra", static_health)
+    router.add_replica("rb", static_health)
+    primary = router.route(key)[0]
+    backup = "rb" if primary == "ra" else "ra"
+    # spillover: the first choice's predicted wait eats the budget
+    router.remove_replica(primary)
+    router.add_replica(primary, lambda: static_health(predicted_wait_s=0.5))
+    first, second = router.route(key, deadline_budget_s=0.1)
+    assert (first, second) == (backup, primary)
+    assert router.outcomes.get("spillover", 0) == 1
+    # without a deadline the same health routes primary (affinity wins)
+    assert router.route(key)[0] == primary
+    # load-shift: backlog ratio past load_factor beyond min_shift_depth
+    router.remove_replica(primary)
+    router.add_replica(primary, lambda: static_health(queue_depth=64))
+    first, second = router.route(key)
+    assert (first, second) == (backup, primary)
+    assert router.outcomes.get("load-shift", 0) == 1
+
+
+def test_router_exclude_is_policy_not_unhealthy():
+    router = FleetRouter()
+    key = routing_key("c1", cdoc(1, "org-1"))
+    router.add_replica("ra", static_health)
+    router.add_replica("rb", static_health)
+    primary = router.route(key)[0]
+    backup = "rb" if primary == "ra" else "ra"
+    first, second = router.route(key, exclude=primary)
+    assert (first, second) == (backup, None)
+    # exclusion is caller policy: never counted as an unhealthy outcome
+    assert router.outcomes.get("unhealthy", 0) == 0
+
+
+def test_router_no_replica_and_health_probe_exception():
+    router = FleetRouter()
+    key = routing_key("c1", cdoc(1, "org-1"))
+    assert router.route(key) == (None, None)
+    assert router.outcomes.get("no-replica") == 1
+
+    def bad_probe():
+        raise RuntimeError("probe died")
+
+    router.add_replica("ra", bad_probe)  # a raising probe is a down replica
+    assert router.route(key) == (None, None)
+
+
+def test_in_fleet_cohort_fraction_and_determinism():
+    keys = [routing_key(f"c{i % 5}", cdoc(i, f"org-{i % 5}"))
+            for i in range(1000)]
+    assert not any(in_fleet_cohort(k, 0.0) for k in keys)
+    assert all(in_fleet_cohort(k, 1.0) for k in keys)
+    half = [in_fleet_cohort(k, 0.5) for k in keys]
+    assert half == [in_fleet_cohort(k, 0.5) for k in keys]
+    assert 0.35 < sum(half) / len(half) < 0.65
+
+
+# ---------------------------------------------------------------------------
+# aggregator: fold deltas -> global guard; global containment
+# ---------------------------------------------------------------------------
+
+
+def fold(requests=0, denies=0, errors=0, slo_total=0, slo_bad=0,
+         tenants=None, wait_hot=False):
+    return {"errors": errors, "slo_total": slo_total, "slo_bad": slo_bad,
+            "tenants": tenants or {}, "tenant_rejects": {},
+            "wait_hot": wait_hot,
+            "admission_state": "OVERLOADED" if wait_hot else "HEALTHY"}
+
+
+def tfold(**tenants):
+    """tenant -> (requests, denies, rate)."""
+    return {n: {"requests": r, "denies": d, "slo_bad": 0, "rate": rate}
+            for n, (r, d, rate) in tenants.items()}
+
+
+def test_aggregator_deltas_feed_global_guard_cohorts():
+    """The canary replica's fold deltas land on the canary side, the
+    rest of the fleet's on the baseline side; a poison deny spike local
+    to the canary breaches on GLOBAL evidence."""
+    agg = FleetAggregator()
+    agg.ingest("rc", fold(tenants=tfold(c3=(10, 0, 1.0))))
+    agg.ingest("rb", fold(tenants=tfold(c3=(10, 0, 1.0))))
+    agg.arm_guard("rc", changed={"c3"}, thresholds=TH)
+    # canary replica: 16 more c3 requests, ALL denied; fleet: clean
+    agg.ingest("rc", fold(tenants=tfold(c3=(26, 16, 1.0))))
+    agg.ingest("rb", fold(tenants=tfold(c3=(40, 0, 1.0))))
+    b = agg.guard_breach()
+    assert b is not None and "config-deny-rate" in b["guards"]
+    assert "c3" in b["suspects"]
+    assert agg.breaches and agg.breaches[0] is b
+
+
+def test_aggregator_arm_rebaselines_and_clamps_counter_resets():
+    agg = FleetAggregator()
+    agg.ingest("ra", fold(tenants=tfold(c0=(500, 500, 1.0))))
+    agg.arm_guard("ra", thresholds=TH)
+    # identical fold again: zero delta, nothing leaks into the cohort
+    agg.ingest("ra", fold(tenants=tfold(c0=(500, 500, 1.0))))
+    assert agg.guard._canary.total == 0
+    # a restarted replica reports SMALLER cumulatives: clamp, not negative
+    agg.ingest("ra", fold(tenants=tfold(c0=(5, 2, 1.0))))
+    assert agg.guard._canary.total == 0
+    assert agg.guard._canary.denies == 0
+    assert agg.guard_breach() is None
+
+
+def test_global_containment_fires_when_every_local_share_is_clean():
+    """The acceptance property: consistent-hash concentration makes a
+    fleet-hot tenant look locally entitled on EVERY replica (few tenants
+    share its replicas, so local entitlement is large); only the global
+    fold sees the outsized fleet share."""
+    local = {
+        "ra": {"hot": 10.0, "t1": 1.0, "t2": 1.0},
+        "rb": {"t3": 1.0, "t4": 1.0, "t5": 1.0},
+        "rc": {"t6": 1.0, "t7": 1.0, "t8": 1.0},
+    }
+    t0 = time.monotonic()
+    # per-replica containment (the pre-fleet check) clears every replica
+    for rates in local.values():
+        checker = GlobalContainment()
+        assert checker.check(rates, pressure=True, now=t0) == {}
+        assert checker.check(rates, pressure=True, now=t0 + 0.6) == {}
+    # the global fold: 9 active tenants, hot's share 10/18 > 3x entitled
+    agg = FleetAggregator()
+    for name, rates in local.items():
+        agg.ingest(name, fold(
+            tenants=tfold(**{t: (100, 0, r) for t, r in rates.items()}),
+            wait_hot=(name == "ra")))
+    assert agg.containment_check(now=t0) == {}        # sustain arming
+    suspects = agg.containment_check(now=t0 + 0.6)
+    assert "hot" in suspects and suspects["hot"]["ratio"] > 3.0
+    # forgetting the hot replica's fold drops the suspicion with it
+    agg.forget("ra")
+    assert agg.containment_check(now=t0 + 1.2) == {}
+
+
+def test_global_containment_needs_fleet_pressure():
+    agg = FleetAggregator()
+    agg.ingest("ra", fold(tenants=tfold(hot=(100, 0, 10.0),
+                                        t1=(10, 0, 0.1))))
+    t0 = time.monotonic()
+    assert agg.containment_check(now=t0) == {}
+    assert agg.containment_check(now=t0 + 0.6) == {}  # idle fleet: traffic
+
+
+# ---------------------------------------------------------------------------
+# warm-join: hot-set export/import
+# ---------------------------------------------------------------------------
+
+
+def serve(engine, docs_cfgs):
+    async def _go():
+        return await asyncio.gather(
+            *[engine.submit(dict(d), c) for d, c in docs_cfgs])
+    return run(_go())
+
+
+def leader_with_published(tmp_path):
+    leader = build_engine(org_corpus(V1))
+    pub = SnapshotPublisher(str(tmp_path))
+    pub.publish_from_engine(leader)
+    return leader, pub
+
+
+def test_hotset_roundtrip_imports_and_hits(tmp_path):
+    leader, pub = leader_with_published(tmp_path)
+    traffic = [(cdoc(j, f"org-{j % 6}"), f"c{j % 6}") for j in range(24)]
+    serve(leader, traffic)
+    digest = warmjoin.export_hotset(leader, k=64)
+    assert digest is not None and len(digest["entries"]) > 0
+    pub.publish_hotset(digest)
+
+    joiner = build_engine()
+    joiner.apply_published(load_latest(str(tmp_path)))
+    imported, skipped = warmjoin.import_hotset(
+        joiner, load_hotset(str(tmp_path)))
+    assert imported == len(digest["entries"]) and skipped == 0
+    # a warm-imported entry serves as a HIT: zero new misses on replay
+    cache = joiner._verdict_cache
+    h0, m0 = cache.hits, cache.misses
+    (rule, skipped_col), = serve(joiner, traffic[:1])
+    assert cache.hits > h0 and cache.misses == m0
+    # ...and the verdict is bit-exact vs the leader serving the same doc
+    (lrule, lskip), = serve(leader, traffic[:1])
+    np.testing.assert_array_equal(rule, lrule)
+    np.testing.assert_array_equal(skipped_col, lskip)
+
+
+def test_hotset_refuses_interner_and_version_mismatch(tmp_path):
+    leader, pub = leader_with_published(tmp_path)
+    serve(leader, [(cdoc(j, f"org-{j % 6}"), f"c{j % 6}")
+                   for j in range(12)])
+    digest = warmjoin.export_hotset(leader, k=64)
+    joiner = build_engine()
+    joiner.apply_published(load_latest(str(tmp_path)))
+    # wrong interner content: every entry refused (the row-key byte
+    # layout is interner-relative — importing would poison verdicts)
+    assert warmjoin.import_hotset(
+        joiner, dict(digest, interner="0" * 16)) == (0, 0)
+    assert warmjoin.import_hotset(joiner, dict(digest, version=99)) == (0, 0)
+    assert warmjoin.import_hotset(joiner, None) == (0, 0)
+
+
+# ---------------------------------------------------------------------------
+# harness: join/leave/crash choreography, canary, bit-exactness
+# ---------------------------------------------------------------------------
+
+
+def make_fleet(tmp_path, n_replicas=2, warm=False):
+    h = FleetHarness(str(tmp_path), build_engine, poll_s=0.05)
+    h.add_leader(entries=entries_of(org_corpus(V1)))
+    for i in range(1, n_replicas + 1):
+        h.add_replica(f"r{i}", warm_join=warm)
+    return h
+
+
+def fleet_traffic(h, n, start=0, collect=False):
+    """Open-loop round-robin over the corpus; returns (ok, typed, outs)."""
+    ok = typed = 0
+    outs = []
+    for j in range(start, start + n):
+        cfg = f"c{j % 6}"
+        try:
+            r, s = h.check(cfg, cdoc(j, V1[cfg]))
+        except CheckAbort:
+            typed += 1
+        else:
+            ok += 1
+            if collect:
+                outs.append((cfg, j, bool(r[0])))
+    return ok, typed, outs
+
+
+def test_fleet_serves_and_crash_degrades_typed_only(tmp_path):
+    h = make_fleet(tmp_path, n_replicas=2)
+    try:
+        ok, typed, outs = fleet_traffic(h, 60, collect=True)
+        assert (ok, typed) == (60, 0)
+        assert all(allowed for _, _, allowed in outs)
+        h.crash_replica("r2")
+        # only TYPED rejections may surface; anything raw fails the test
+        ok, typed, _ = fleet_traffic(h, 60, start=60)
+        assert ok + typed == 60 and ok > 0
+        # the crashed replica's health collapses out of the routable set
+        assert "r2" not in {h.router.route(
+            routing_key(f"c{i}", cdoc(i, "x")))[0] for i in range(20)}
+        # graceful leave: drain completes bounded, fold forgotten
+        assert h.remove_replica("r1") is True
+        ok2, typed2, _ = fleet_traffic(h, 30, start=120)
+        assert ok2 == 30  # leader alone still serves everything
+    finally:
+        h.shutdown()
+
+
+def test_fleet_no_routable_replica_is_typed(tmp_path):
+    h = FleetHarness(str(tmp_path), build_engine)
+    with pytest.raises(CheckAbort) as ei:
+        h.check("c0", cdoc(1, "org-0"))
+    assert ei.value.code == UNAVAILABLE
+
+
+def test_fleet_warm_join_beats_cold(tmp_path):
+    h = make_fleet(tmp_path, n_replicas=0)
+    try:
+        trace = [(cdoc(j, f"org-{j % 6}"), f"c{j % 6}") for j in range(30)]
+        serve(h.leader.engine, trace)
+        assert h.publish_hotset(k=256) is True
+        cold = h.add_replica("cold", warm_join=False)
+        warm = h.add_replica("warm", warm_join=True)
+        assert warm.warm_imported > 0 and cold.warm_imported == 0
+        for rep in (cold, warm):
+            for d, c in trace:
+                rep.check(c, dict(d)).result(timeout=10)
+        cold_hits = cold.engine._verdict_cache.hits
+        warm_hits = warm.engine._verdict_cache.hits
+        assert warm_hits > cold_hits  # the whole point of the hot set
+    finally:
+        h.shutdown()
+
+
+def test_fleet_verdicts_bit_exact_across_replicas(tmp_path):
+    """Every replica — and a cold independent compile of the same corpus
+    (the host-side oracle) — serves bit-identical verdict columns."""
+    h = make_fleet(tmp_path, n_replicas=2, warm=True)
+    oracle = build_engine(org_corpus(V1))
+    try:
+        trace = [(cdoc(j, f"org-{j % 9}" if j % 3 else "org-elsewhere"),
+                  f"c{j % 6}") for j in range(40)]
+        want = serve(oracle, trace)
+        for rep in h.replicas.values():
+            got = [rep.check(c, dict(d)).result(timeout=10)
+                   for d, c in trace]
+            for (wr, ws), (gr, gs) in zip(want, got):
+                np.testing.assert_array_equal(wr, gr)
+                np.testing.assert_array_equal(ws, gs)
+    finally:
+        h.shutdown()
+
+
+def test_fleet_canary_breach_rolls_back_fleet_wide(tmp_path):
+    """The tentpole end to end: poison canaried on ONE replica, judged on
+    fleet folds, rolled back everywhere via the manifest — late joiners
+    included."""
+    h = make_fleet(tmp_path, n_replicas=2)
+    try:
+        fleet_traffic(h, 60)
+        h.publish_folds()
+        poison = dict(V1, c3="org-NEVER")
+        h.start_canary("r1", entries_of(org_corpus(poison)),
+                       changed={"c3"}, thresholds=TH, fraction=0.5)
+        gen_canary = h.replicas["r1"].engine.generation
+        breach = None
+        for round_ in range(8):
+            fleet_traffic(h, 60, start=1000 * (round_ + 1))
+            h.publish_folds()
+            breach = h.canary_tick()
+            if breach:
+                break
+        assert breach is not None, h.aggregator.to_json()
+        assert "config-deny-rate" in breach["breach"]["guards"]
+        assert "c3" in breach["breach"]["suspects"]
+        assert breach["detection_s"] > 0 and breach["mttr_s"] >= 0
+        # the canary re-adopted baseline: the poison verdict is gone
+        r, _ = h.replicas["r1"].check(
+            "c3", cdoc(7777, "org-3")).result(timeout=10)
+        assert bool(r[0])
+        assert h.replicas["r1"].engine.generation > gen_canary
+        # the manifest carries the rollback record fleet-wide
+        man = json.load(open(os.path.join(str(tmp_path), "MANIFEST.json")))
+        assert man["rollback"]["reason"] == "fleet-guard-breach"
+        assert man["rollback"]["canary_replica"] == "r1"
+        assert man["quarantine"]["configs"] == ["c3"]
+        # a replica joining AFTER the breach converges on baseline
+        late = h.add_replica("late", warm_join=False)
+        r, _ = late.check("c3", cdoc(7778, "org-3")).result(timeout=10)
+        assert bool(r[0])
+        # guard disarmed: cohort pinning is over, ticks return nothing
+        assert h.canary_tick() is None
+    finally:
+        h.shutdown()
+
+
+def test_fleet_canary_cohort_pins_traffic(tmp_path):
+    """While armed, the cohort slice lands on the canary replica and
+    NOTHING else does — the split that makes the fold cohorts
+    comparable."""
+    h = make_fleet(tmp_path, n_replicas=2)
+    try:
+        h.start_canary("r1", entries_of(org_corpus(V1)), changed=set(),
+                       thresholds=TH, fraction=0.5)
+        canary_engine = h.replicas["r1"].engine
+        before = canary_engine.tenancy.stats.total_requests
+        in_cohort = out_cohort = 0
+        for j in range(80):
+            cfg = f"c{j % 6}"
+            d = cdoc(j, V1[cfg])
+            if in_fleet_cohort(routing_key(cfg, d), 0.5):
+                in_cohort += 1
+            else:
+                out_cohort += 1
+            h.check(cfg, d)
+        assert in_cohort > 0 and out_cohort > 0
+        served = canary_engine.tenancy.stats.total_requests - before
+        assert served == in_cohort  # the cohort, the whole cohort, and
+    finally:                        # nothing but the cohort
+        h.shutdown()
+
+
+def test_engine_fleet_fold_shape():
+    """The fold contract the aggregator and process replicas share."""
+    engine = build_engine(org_corpus({"ca": "org-a"}))
+    serve(engine, [(cdoc(j, "org-a" if j % 2 else "org-x"), "ca")
+                   for j in range(8)])
+    h = engine.fleet_health()
+    assert h["ready"] is True and h["draining"] is False
+    assert h["breaker_open"] is False and "predicted_wait_s" in h
+    f = engine.fleet_fold()
+    assert f["tenants"]["ca"]["requests"] == 8
+    assert f["tenants"]["ca"]["denies"] == 4  # org-x rows deny
+    assert f["admission_state"] in ("HEALTHY", "OVERLOADED")
+    engine.drain(5.0)
+    assert engine.fleet_health()["ready"] is False
+
+
+# ---------------------------------------------------------------------------
+# code lint: the fleet plane rides the unbounded-wait gate (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def test_code_lint_flags_unbounded_waits_on_fleet_paths():
+    """router/fleet/replica/join functions run exactly when a peer
+    replica may be dead or wedged — a timeoutless wait there stalls the
+    whole fleet's routing, not one process."""
+    from authorino_tpu.analysis.code_lint import lint_source
+
+    src = (
+        "def router_pick(self):\n"
+        "    self._evt.wait()\n"
+        "def fleet_tick(self):\n"
+        "    self._thread.join()\n"
+        "def replica_sync(self):\n"
+        "    self._evt.wait()\n"
+        "async def warm_join(self):\n"
+        "    await self._done.wait()\n"
+        "def replica_sync_bounded(self):\n"
+        "    self._evt.wait(0.5)\n"   # bounded: clean
+        "def rejoin_paths(self):\n"
+        "    os.path.join('a', 'b')\n"  # args present: not waitish
+    )
+    found = lint_source(src, "planted.py")
+    assert [f.kind for f in found] == ["unbounded-wait"] * 4
+    assert [f.location for f in found] == [
+        "planted.py:2", "planted.py:4", "planted.py:6", "planted.py:8"]

@@ -398,6 +398,33 @@ def test_kernel_lane_env_and_auto_resolution(monkeypatch):
     assert pe.to_device(policy, lane="fused")["fused"] is not None
 
 
+def test_kernel_lane_auto_consults_every_device(monkeypatch):
+    """ISSUE 18 satellite: auto arms the fused lane iff EVERY device is a
+    real TPU.  jax.default_backend() names only the highest-priority
+    platform, so a single TPU in a mixed device set used to arm the
+    Pallas kernel for devices that can only interpret it."""
+
+    class _Dev:
+        def __init__(self, platform):
+            self.platform = platform
+
+    assert pe.auto_lane(_Dev("tpu")) == "fused"
+    assert pe.auto_lane(_Dev("cpu")) != "fused"
+    # the regression: mixed visibility must NOT arm fused, whatever the
+    # default backend claims
+    monkeypatch.setattr(pe.jax, "devices",
+                        lambda *a, **k: [_Dev("tpu"), _Dev("cpu")])
+    assert pe.auto_lane() != "fused"
+    dec = pe.last_auto_decision()
+    assert dec == {"requested": "auto", "lane": dec["lane"],
+                   "devices": 2, "platforms": ["cpu", "tpu"]}
+    # all-TPU visibility is the one case that arms it
+    monkeypatch.setattr(pe.jax, "devices",
+                        lambda *a, **k: [_Dev("tpu"), _Dev("tpu")])
+    assert pe.auto_lane() == "fused"
+    assert pe.last_auto_decision()["platforms"] == ["tpu"]
+
+
 def test_occupancy_pad_shapes():
     # pow2 floor, never below the real row count, busiest-shard * dp
     assert fk.occupancy_pad([1, 1], dp=2, n_rows=2) == 16

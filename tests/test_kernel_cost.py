@@ -403,6 +403,28 @@ class TestEntryPointAudit:
     def test_no_snapshot_is_empty(self):
         assert entry_points() == []
 
+    def test_auto_lane_decision_rides_the_audit_surface(self):
+        """ISSUE 18 satellite: the `--kernel-lane auto` resolution is
+        recorded on the kernel-dispatch entries of /debug/vars
+        kernel_cost.entry_points — as a FIELD, never a phantom entry
+        (the entry list and operand lanes above are a pinned surface)."""
+        from authorino_tpu.ops import pattern_eval as pe
+
+        pol = compile_corpus([self._cfg(
+            Pattern("m", Operator.EQ, "GET"))],
+            members_k=4, ovf_assist=False)
+        pe.auto_lane()  # resolve against this process's visible devices
+        ep = entry_points(policy=pol)
+        assert [e["entry"] for e in ep] == ["eval_bitpacked", "eval_fused",
+                                            "fused_kernel"]
+        dec = [e for e in ep if e["entry"] == "fused_kernel"][0][
+            "kernel_lane_auto"]
+        assert dec["requested"] == "auto"
+        assert dec["lane"] == pe.last_auto_decision()["lane"]
+        assert dec["devices"] >= 1 and dec["platforms"]
+        # eval-stage entries never carry it: auto arms the DISPATCH lane
+        assert "kernel_lane_auto" not in ep[0]
+
 
 # ---------------------------------------------------------------------------
 # modeled-cost regression gate: >=2x per-row jump between generations ->

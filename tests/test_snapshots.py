@@ -593,6 +593,65 @@ def test_replica_never_republishes_loaded_snapshots(tmp_path):
     assert not [f for f in os.listdir(d2) if f.endswith(".atpusnap")]
 
 
+def test_join_during_quarantine_adopts_manifest_not_newest_blob(tmp_path):
+    """ISSUE 18: a replica joining MID-CANARY — after the fleet guard
+    rolled the candidate back — must serve the manifest's ``current``/
+    ``active_generation`` (the leader's serving DECISION) and adopt its
+    rollback/quarantine record, never the newest blob file in the
+    directory: the quarantined candidate is still on disk (gc keeps
+    recent blobs) and its filename sorts NEWEST."""
+    d = str(tmp_path / "pub")
+    baseline = make_corpus(8)
+    leader = build_engine(baseline, strict_verify=True)
+    base_gen = leader.generation
+    pub = SnapshotPublisher(d)
+    pub.publish_from_engine(leader)
+
+    # the candidate reconcile publishes (generation base+1)...
+    cand_blob, _ = _serialize_corpus(make_corpus(8, mutated={2}),
+                                     certified=True,
+                                     generation=base_gen + 1)
+    pub.publish_blob(cand_blob, base_gen + 1)
+    # ...then breaches the fleet guard: the leader republishes BASELINE
+    # with the rollback/quarantine record — the manifest moves backwards
+    # semantically while the candidate blob file stays on disk
+    leader._snapshot.change_safety = {
+        "rollback": {"reason": "fleet-guard-breach",
+                     "guards": ["config-deny-rate"]},
+        "quarantine": {"reason": "fleet-guard-breach",
+                       "configs": ["cfg-2"]},
+    }
+    pub.publish_from_engine(leader)
+
+    blobs = sorted(f for f in os.listdir(d) if f.endswith(".atpusnap"))
+    man = json.loads(open(os.path.join(d, "MANIFEST.json")).read())
+    assert blobs[-1] == f"snapshot-{base_gen + 1:012d}.atpusnap"
+    assert man["current"] == f"snapshot-{base_gen:012d}.atpusnap"
+    assert man["active_generation"] == base_gen
+    assert man["rollback"]["reason"] == "fleet-guard-breach"
+
+    # the joiner: manifest-directed adoption, never newest-blob
+    joiner = build_engine()
+    rep = SnapshotReplica(joiner, d, poll_s=0.2)
+    assert rep.poll_once() is True
+    assert (joiner._snapshot.change_safety or {})["rollback"][
+        "reason"] == "fleet-guard-breach"
+    assert joiner._snapshot.change_safety["quarantine"][
+        "configs"] == ["cfg-2"]
+    # the candidate flipped cfg-2's org constant; this doc allows ONLY
+    # under baseline (no url_path rescue) — the joiner must allow
+    probe = {"request": {"method": "GET", "url_path": "/other/x"},
+             "auth": {"identity": {"org": "org-2", "roles": []}}}
+    out = run(joiner.submit(dict(probe), "cfg-2"))
+    assert bool(out[0][0])
+    want = run(leader.submit(dict(probe), "cfg-2"))
+    np.testing.assert_array_equal(out[0], want[0])
+    # re-polling the unchanged manifest is a no-op (digest dedup), and
+    # the quarantined blob never gets another look
+    assert rep.poll_once() is False
+    assert rep.rejected == 0 and rep.errors == 0
+
+
 # ---------------------------------------------------------------------------
 # diff engine + CLI
 # ---------------------------------------------------------------------------
