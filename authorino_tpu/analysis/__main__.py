@@ -27,7 +27,7 @@ import argparse
 import json
 import os
 import sys
-from typing import List
+from typing import List, Optional
 
 from . import Finding, findings_to_json
 
@@ -143,6 +143,152 @@ def _run_verify_fixtures() -> List[Finding]:
         errors.append(Finding(
             kind="tenant-cardinality", layer="metrics_catalog",
             message=msg, location="utils/metrics.py"))
+
+    # corpus self-test (ISSUE 19): a planted constant-deny edit on a rule
+    # with ZERO captured traffic must be caught by the corpus pregate on
+    # synthesized rows alone — a blind synthesizer (or a pregate that only
+    # judges captured evidence) fails this command, and with it tier-1
+    errors += _corpus_selftest(policy)
+
+    # pickle-import lint self-test (ISSUE 19 satellite): the planted
+    # fixture must fire outside tests/, stay quiet inside tests/, and
+    # honor `# lint-ok:` — a blind lint fails this command
+    errors += _pickle_lint_selftest()
+    return errors
+
+
+def _pickle_lint_selftest() -> List[Finding]:
+    from .code_lint import lint_source
+
+    errors: List[Finding] = []
+
+    def _err(msg: str) -> None:
+        errors.append(Finding(kind="lint-blind", layer="code_lint",
+                              message=msg, location="fixtures"))
+
+    planted = "import pickle\nfrom cloudpickle import dumps\n"
+    got = [f.kind for f in lint_source(planted, path="authorino_tpu/x.py")]
+    if got != ["pickle-import", "pickle-import"]:
+        _err(f"pickle-import lint BLIND to planted imports: {got}")
+    if lint_source(planted, path="tests/test_x.py"):
+        _err("pickle-import lint fired inside tests/ (exempt by design)")
+    if lint_source("import pickle  # lint-ok: pickle-import -- fixture\n",
+                   path="authorino_tpu/x.py"):
+        _err("pickle-import lint ignored a `# lint-ok:` suppression")
+    return errors
+
+
+def _corpus_selftest(policy) -> List[Finding]:
+    import os
+    import tempfile
+
+    from ..compiler.compile import compile_corpus
+    from ..corpus import (
+        CorpusFormatError,
+        distill_records,
+        read_corpus_file,
+        write_corpus,
+    )
+    from ..corpus.pregate import corpus_preflight
+    from ..corpus.synthesize import augment_corpus
+    from ..expressions import All, Operator, Pattern
+    from ..runtime.change_safety import GuardThresholds
+    from .fixtures import fixture_configs
+
+    errors: List[Finding] = []
+
+    def _err(msg: str) -> None:
+        errors.append(Finding(kind="corpus-blind", layer="corpus",
+                              message=msg, location="fixtures"))
+
+    # captured traffic hits ONLY 'api' — 'admin' and 'public' are the
+    # zero-traffic configs whose rules only synthesis can witness
+    api_doc = {"request": {"method": "GET", "url_path": "/api/v1/x",
+                           "host": "h", "headers": {"x-tag": "aa"}},
+               "auth": {"identity": {"org": "acme", "roles": ["admin"],
+                                     "groups": []}}}
+    records = [{"authconfig": "api", "doc": api_doc, "t": 1.0 + i * 0.01}
+               for i in range(64)]
+    d = distill_records(records, policy)
+    if d["counters"]["distilled"] != 1 \
+            or d["rows"][0]["weight"] != 64:
+        _err(f"distillation lost the frequency weight: 64 identical "
+             f"records -> {d['counters']} / "
+             f"weights {[r['weight'] for r in d['rows']]}")
+
+    # corpus container round-trip + typed corruption rejection (the PR 8
+    # pickle-free invariant, corpus flavor)
+    tmp = tempfile.mktemp(suffix=".atpucorp")
+    try:
+        write_corpus(tmp, d["rows"])
+        _, rt = read_corpus_file(tmp)
+        if rt != d["rows"]:
+            _err("corpus container did not round-trip bit-identically")
+        with open(tmp, "rb") as f:
+            blob = bytearray(f.read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(tmp, "wb") as f:
+            f.write(bytes(blob))
+        try:
+            read_corpus_file(tmp)
+            _err("corrupted corpus container was NOT rejected")
+        except CorpusFormatError:
+            pass
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+    # synthesis must RAISE coverage over the captured-only corpus — a
+    # blind synthesizer (zero rows, no admin witness) fails right here
+    aug = augment_corpus(policy, d["rows"])
+    if aug["coverage_after"]["fraction"] <= aug["coverage_before"]["fraction"]:
+        _err(f"synthesis did not raise coverage "
+             f"({aug['coverage_before']['fraction']} -> "
+             f"{aug['coverage_after']['fraction']})")
+    synth = aug["rows"]
+    if not any(r["authconfig"] == "admin" and r["verdict"] == "allow"
+               for r in synth):
+        _err("synthesizer produced no 'admin' allow witness (the row a "
+             "constant-deny edit must flip)")
+    if any(r["origin"] != "synthetic" for r in synth):
+        _err("synthesized rows not flagged origin=synthetic")
+
+    # planted constant-deny edit on zero-traffic 'admin' evaluator 0
+    org = Pattern("auth.identity.org", Operator.EQ, "acme")
+    norg = Pattern("auth.identity.org", Operator.NEQ, "acme")
+    mutated = fixture_configs()
+    for i, c in enumerate(mutated):
+        if c.name == "admin":
+            mutated[i] = type(c)(name="admin", evaluators=[
+                (None, All(org, norg)), c.evaluators[1]])
+    candidate = compile_corpus(mutated)
+    th = GuardThresholds(min_requests=8, min_config_requests=1,
+                         min_config_allows=1)
+
+    # captured-only evidence MUST miss it (zero 'admin' traffic) ...
+    blind = corpus_preflight(policy, candidate, d["rows"], th,
+                             changed={"admin"})
+    if blind["breach"] is not None:
+        _err("captured-only corpus breached on a zero-traffic edit "
+             "(self-test premise broken: 'admin' traffic leaked in)")
+    # ... and the synthesized rows MUST catch it, attributed to 'admin'
+    pf = corpus_preflight(policy, candidate, d["rows"] + synth, th,
+                          changed={"admin"})
+    breach = pf["breach"]
+    if breach is None or "admin" not in breach.get("suspects", []):
+        _err(f"corpus pregate BLIND to the planted zero-traffic "
+             f"constant-deny edit: {breach}")
+    else:
+        origins = pf["report"]["origins"]
+        if origins.get("captured", {}).get("flips", 0) != 0 \
+                or origins.get("synthetic", {}).get("flips", 0) < 1:
+            _err(f"the catch did not come from synthetic-origin rows: "
+                 f"{origins}")
+    # clean churn (fresh tree objects, identical corpus) must stay quiet
+    clean = corpus_preflight(policy, compile_corpus(fixture_configs()),
+                             d["rows"] + synth, th, changed={"admin"})
+    if clean["breach"] is not None:
+        _err("corpus pregate breached on a CLEAN churn")
     return errors
 
 
@@ -467,6 +613,116 @@ def _run_replay(old_path: str, new_path: str, log_src: str,
     return report
 
 
+def _corpus_analysis(policy) -> Optional[dict]:
+    """Static findings in the shape corpus synthesis consumes (the
+    /debug/vars policy_analysis block): lets a statically-dead column get
+    its honest reason code instead of 'unsatisfiable'.  Best-effort — a
+    failed analysis only degrades reason codes, never the corpus."""
+    try:
+        from .policy_analysis import analyze_policy
+
+        findings, _ = analyze_policy(policy)
+        return {"findings": findings_to_json(findings)}
+    except Exception:
+        return None
+
+
+def _run_corpus_distill(snapshot_path: str, log_src: str,
+                        out_path: str) -> dict:
+    """``--corpus-distill`` (ISSUE 19, docs/policy_ci.md): fold a captured
+    traffic log into the long-retention decision corpus — rows deduplicated
+    by the canonical encoded row key, carrying frequency weights and
+    first/last-seen — and write it as a checksummed ``.atpucorp``
+    container.  Also synthesizes rows for every (config, rule) column the
+    captured traffic never exercised, so the corpus covers the whole truth
+    table, not just the traffic that happened."""
+    from ..corpus import distill_records, write_corpus
+    from ..corpus.synthesize import augment_corpus
+    from ..replay.capture import read_capture
+
+    snap = _load_snapshot_arg(snapshot_path)
+    records = read_capture(log_src)
+    d = distill_records(records, snap.policy)
+    aug = augment_corpus(snap.policy, d["rows"],
+                         analysis=_corpus_analysis(snap.policy))
+    rows = d["rows"] + aug["rows"]
+    if out_path:
+        write_corpus(out_path, rows)
+    return {
+        "schema": 1,
+        "generation": snap.generation,
+        "counters": d["counters"],
+        "dedup_ratio": d["dedup_ratio"],
+        "captured_rows": len(d["rows"]),
+        "synthetic_rows": len(aug["rows"]),
+        "coverage_before": aug["coverage_before"]["fraction"],
+        "coverage_after": aug["coverage_after"]["fraction"],
+        "synthesis": aug["synthesis"],
+        "out": out_path,
+    }
+
+
+def _run_corpus_report(snapshot_path: str, corpus_src: str) -> dict:
+    """``--corpus-report`` (ISSUE 19): per-(config, rule) exercised /
+    unexercised coverage of an existing corpus against a snapshot,
+    cross-referenced with static findings, plus the synthesis plan for
+    the gaps (every uncoverable column with its typed reason code)."""
+    from ..corpus import read_corpus
+    from ..corpus.synthesize import augment_corpus, coverage_report
+
+    snap = _load_snapshot_arg(snapshot_path)
+    rows = read_corpus(corpus_src)
+    analysis = _corpus_analysis(snap.policy)
+    cov = coverage_report(snap.policy, rows, analysis=analysis)
+    aug = augment_corpus(snap.policy, rows, analysis=analysis)
+    origins = {"captured": 0, "synthetic": 0}
+    for r in rows:
+        o = r.get("origin", "captured")
+        origins[o] = origins.get(o, 0) + 1
+    return {
+        "schema": 1,
+        "generation": snap.generation,
+        "rows": len(rows),
+        "origins": origins,
+        "coverage": cov,
+        "synthesis": aug["synthesis"],
+        "coverage_after_synthesis": aug["coverage_after"]["fraction"],
+    }
+
+
+def _run_corpus_diff(chain_dir: str, corpus_src: str) -> dict:
+    """``--corpus-diff`` (ISSUE 19): re-decide the corpus across every
+    published snapshot generation in ``chain_dir`` (oldest -> newest) and
+    attribute each verdict flip to the EXACT generation that introduced
+    it — offline history bisection with no live traffic."""
+    from ..corpus import read_corpus
+    from ..corpus.bisect import corpus_diff, load_generation_chain
+
+    chain = load_generation_chain(chain_dir)
+    if len(chain) < 2:
+        raise SystemExit(
+            f"--corpus-diff needs >=2 loadable generations in {chain_dir!r}, "
+            f"found {len(chain)}")
+    rows = read_corpus(corpus_src)
+    return corpus_diff(chain, rows)
+
+
+def _print_corpus_diff(report: dict) -> None:
+    gens = report["generations"]
+    print(f"corpus-diff: {report['rows']} rows across generations "
+          f"{gens[0]}..{gens[-1]} ({len(gens)} published)")
+    print(f"  flipped rows: {report['flipped_rows']} "
+          f"(weighted flips by generation: {report['by_generation'] or '{}'})")
+    for f in report["flips"]:
+        print(f"  gen {f['from_generation']} -> {f['generation']}: "
+              f"{f['authconfig']} {f['direction']} x{f['count']} "
+              f"(rule {f['rule_index']}{' ' + f['rule'] if f['rule'] else ''},"
+              f" origins {','.join(f['origins'])})")
+    if not report["flips"]:
+        print("  no verdict flips: every generation decides the corpus "
+              "identically")
+
+
 def _run_metrics_catalog() -> dict:
     """Metrics-catalogue drift gate (ISSUE 9 satellite): every family
     registered in utils/metrics.py must appear in docs/observability.md
@@ -712,6 +968,25 @@ def main(argv=None) -> int:
                          "substituted into auth.metadata before "
                          "re-deciding; captured metadata_doc_digest "
                          "mismatches are counted in the report")
+    ap.add_argument("--corpus-distill", metavar="SNAPSHOT", default="",
+                    help="distill --log captured traffic into a deduplicated "
+                         "decision corpus against SNAPSHOT (blob file or "
+                         "publish dir), synthesize rows for unexercised "
+                         "rule columns, and write it to --corpus-out "
+                         "(ISSUE 19, docs/policy_ci.md)")
+    ap.add_argument("--corpus-report", metavar="SNAPSHOT", default="",
+                    help="per-(config, rule) coverage of the --corpus rows "
+                         "against SNAPSHOT, plus the synthesis plan with "
+                         "typed uncoverable-reason codes")
+    ap.add_argument("--corpus-diff", metavar="CHAIN_DIR", default="",
+                    help="re-decide the --corpus rows across every "
+                         "published generation in CHAIN_DIR and name the "
+                         "exact generation introducing each verdict flip")
+    ap.add_argument("--corpus", metavar="SRC", default="",
+                    help="corpus source for --corpus-report/--corpus-diff: "
+                         "an .atpucorp file or a directory of them")
+    ap.add_argument("--corpus-out", metavar="FILE", default="",
+                    help="output .atpucorp path for --corpus-distill")
     ap.add_argument("--metrics-catalog", action="store_true",
                     help="drift gate: every metric family registered in "
                          "utils/metrics.py must appear in "
@@ -794,6 +1069,63 @@ def main(argv=None) -> int:
             print(f"pregate verdict (default thresholds): "
                   f"{'BREACH ' + ','.join(gate['guards']) if gate else 'pass'}")
         return 1 if report["flips"]["total"] else 0
+
+    if args.corpus_distill:
+        if not args.log:
+            ap.error("--corpus-distill requires --log (a capture segment "
+                     "or directory)")
+        report = _run_corpus_distill(args.corpus_distill, args.log,
+                                     args.corpus_out)
+        if args.as_json:
+            print(json.dumps(report, indent=2, sort_keys=True, default=str))
+        else:
+            c = report["counters"]
+            print(f"corpus-distill @ generation {report['generation']}: "
+                  f"{c['records_in']} records -> {report['captured_rows']} "
+                  f"distinct rows (dedup x{report['dedup_ratio']:.1f}, "
+                  f"{c['dropped_unparseable']} dropped)")
+            print(f"  synthesis: +{report['synthetic_rows']} rows, coverage "
+                  f"{report['coverage_before']:.2f} -> "
+                  f"{report['coverage_after']:.2f}; reasons: "
+                  f"{report['synthesis']['reasons'] or '{}'}")
+            if report["out"]:
+                print(f"  wrote {report['out']}")
+        return 0
+
+    if args.corpus_report:
+        if not args.corpus:
+            ap.error("--corpus-report requires --corpus (an .atpucorp "
+                     "file or directory)")
+        report = _run_corpus_report(args.corpus_report, args.corpus)
+        if args.as_json:
+            print(json.dumps(report, indent=2, sort_keys=True, default=str))
+        else:
+            cov = report["coverage"]
+            print(f"corpus-report @ generation {report['generation']}: "
+                  f"{report['rows']} rows ({report['origins']}), coverage "
+                  f"{cov['columns_exercised']}/{cov['columns_total']} "
+                  f"columns ({cov['fraction']:.2f})")
+            for name, cfg in sorted(cov["configs"].items()):
+                gaps = cfg["unexercised"]
+                print(f"  {name}: {cfg['evaluators'] - len(gaps)}"
+                      f"/{cfg['evaluators']} exercised, "
+                      f"{cfg['allow_rows']} allow rows"
+                      + (f", gaps {gaps}" if gaps else ""))
+            for u in report["synthesis"]["uncoverable"]:
+                print(f"  uncoverable: {u['config']}/{u['evaluator']} "
+                      f"({u['reason']})")
+        return 0
+
+    if args.corpus_diff:
+        if not args.corpus:
+            ap.error("--corpus-diff requires --corpus (an .atpucorp "
+                     "file or directory)")
+        report = _run_corpus_diff(args.corpus_diff, args.corpus)
+        if args.as_json:
+            print(json.dumps(report, indent=2, sort_keys=True, default=str))
+        else:
+            _print_corpus_diff(report)
+        return 1 if report["flips"] else 0
 
     if args.metrics_catalog:
         report = _run_metrics_catalog()

@@ -23,6 +23,12 @@ jitted JAX code — each with a hazard class generic linters don't know:
                       exists for the case where a peer is WEDGED — an
                       unbounded wait there turns the recovery path itself
                       into the hang it guards against (ISSUE 5)
+  pickle-import       ``import pickle`` / ``cloudpickle`` outside tests/:
+                      every container in this repo (snapshots PR 8, capture
+                      segments PR 13, the decision corpus PR 19) is
+                      pickle-free checksummed JSON BY INVARIANT — loading
+                      operator-writable blobs through pickle is arbitrary
+                      code execution at deserialization time (ISSUE 19)
 
 Suppression (docs/static_analysis.md): append ``# lint-ok: <kind>`` to the
 flagged line — with a reason after ``--`` by convention.  A bare
@@ -45,7 +51,13 @@ __all__ = ["lint_source", "lint_file", "lint_paths", "iter_py_files",
 _LAYER = "code_lint"
 
 HAZARD_KINDS = ("blocking-in-async", "lock-across-await", "tracer-branch",
-                "bare-except", "unbounded-wait")
+                "bare-except", "unbounded-wait", "pickle-import")
+
+# pickle-family module roots flagged by pickle-import (dotted submodule
+# imports count by their root); tests/ paths are exempt — tests may build
+# adversarial pickles to prove the containers reject them
+_PICKLE_MODULES = {"pickle", "cloudpickle", "cPickle", "dill"}
+_TESTS_PATH = re.compile(r"(^|[/\\])tests?([/\\]|$)")
 
 # calls that block the calling thread; flagged inside async def unless
 # awaited (module.attr form, or bare attribute for methods)
@@ -310,6 +322,29 @@ class _FuncVisitor(ast.NodeVisitor):
             return any(traced(c) for c in ast.iter_child_nodes(node))
 
         return traced(side)
+
+    # -- pickle-import -----------------------------------------------------
+
+    def _check_pickle(self, node: ast.AST, module: Optional[str]) -> None:
+        root = (module or "").split(".", 1)[0]
+        if root in _PICKLE_MODULES and not _TESTS_PATH.search(self.path):
+            self._report(
+                "pickle-import", node,
+                f"`{root}` import outside tests/: the repo's containers "
+                "are pickle-free checksummed JSON by invariant (snapshots, "
+                "capture segments, the decision corpus) — unpickling an "
+                "operator-writable blob is code execution at load time "
+                "(serialize with the snapshots/serialize.py idiom)")
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self._check_pickle(node, alias.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.level == 0:       # relative imports cannot name stdlib pickle
+            self._check_pickle(node, node.module)
+        self.generic_visit(node)
 
     # -- bare-except -------------------------------------------------------
 

@@ -268,6 +268,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "replay; records past the budget are reported as "
                         "truncated (partial evidence), never silently "
                         "skipped")
+    s.add_argument("--corpus-pregate", default=env_var("CORPUS_PREGATE", ""),
+                   help="POLICY CI (docs/policy_ci.md): a decision-corpus "
+                        "file or directory (*.atpucorp — build with "
+                        "'analysis --corpus-distill').  Before a "
+                        "corpus-changing reconcile starts its canary, the "
+                        "frequency-weighted corpus PLUS synthesized "
+                        "truth-table witness rows for never-fired rules "
+                        "are replayed old-vs-new; a weighted verdict diff "
+                        "breaching the canary guard thresholds REJECTS "
+                        "the swap (typed SnapshotRejected + "
+                        "corpus-pregate-breach flight bundle) — including "
+                        "edits to rules live traffic never exercised")
+    s.add_argument("--corpus-pregate-budget-ms", type=float,
+                   default=env_var("CORPUS_PREGATE_BUDGET_MS", 2000.0),
+                   help="Wall-clock bound on the reconcile-path corpus "
+                        "replay; rows past the budget are reported as "
+                        "truncated (partial evidence), never silently "
+                        "skipped")
     s.add_argument("--snapshot-history", type=int,
                    default=env_var("SNAPSHOT_HISTORY", 4),
                    help="Previous snapshot generations retained for "
@@ -599,6 +617,9 @@ async def run_server(args) -> None:
         replay_pregate=bool(getattr(args, "replay_pregate", False)),
         replay_pregate_budget_s=float(
             getattr(args, "replay_pregate_budget_ms", 2000.0)) / 1e3,
+        corpus_pregate=str(getattr(args, "corpus_pregate", "") or ""),
+        corpus_pregate_budget_s=float(
+            getattr(args, "corpus_pregate_budget_ms", 2000.0)) / 1e3,
         ovf_assist=bool(getattr(args, "ovf_assist", False)) or None,
         kernel_lane=kernel_lane_arg if kernel_lane_arg != "auto" else None,
         metadata_prefetch=not getattr(args, "no_metadata_prefetch", False),

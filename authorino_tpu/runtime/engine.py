@@ -544,6 +544,8 @@ class PolicyEngine:
         snapshot_history: int = 4,
         replay_pregate: bool = False,
         replay_pregate_budget_s: float = 2.0,
+        corpus_pregate: str = "",
+        corpus_pregate_budget_s: float = 2.0,
         ovf_assist: Optional[bool] = None,
         kernel_lane: Optional[str] = None,
         metadata_prefetch: bool = True,
@@ -822,6 +824,17 @@ class PolicyEngine:
         self.replay_pregate_budget_s = float(replay_pregate_budget_s)
         self._last_pregate: Optional[Dict[str, Any]] = None
         self._g_replay_flips = metrics_mod.replay_diff_flips.labels("engine")
+        # corpus preflight (ISSUE 19, docs/policy_ci.md): the long-retention
+        # decision corpus replayed frequency-weighted before the canary —
+        # synthetic witness rows (built lazily against the serving baseline,
+        # cached per generation) extend the judgment to rules live traffic
+        # never exercised
+        self.corpus_pregate = str(corpus_pregate or "")
+        self.corpus_pregate_budget_s = float(corpus_pregate_budget_s)
+        self._corpus_rows: Optional[list] = None   # loaded captured rows
+        self._corpus_load_error: Optional[str] = None
+        self._corpus_synth: Tuple[int, list, Dict[str, Any]] = (-1, [], {})
+        self._last_corpus_pregate: Optional[Dict[str, Any]] = None
         # tenant QoS plane (ISSUE 15, docs/tenancy.md): weighted-fair batch
         # cuts over per-tenant virtual queues inside the submit queue,
         # per-tenant quotas + CoDel wait tracking + tenant-aware doomed
@@ -957,6 +970,12 @@ class PolicyEngine:
         if self.replay_pregate and allow_canary and not self._draining \
                 and self._comparable_change(snap):
             preflight = self._run_replay_pregate(snap)
+        # corpus preflight (ISSUE 19): the same judgment over the
+        # long-retention corpus + synthetic witnesses — catches a breaching
+        # edit to a rule the capture ring never exercised, zero exposure
+        if self.corpus_pregate and allow_canary and not self._draining \
+                and self._comparable_change(snap):
+            self._run_corpus_pregate(snap)
         if allow_canary and self._should_canary(snap):
             self._enter_canary(snap, entries, override=override,
                                preflight=preflight)
@@ -1293,6 +1312,170 @@ class PolicyEngine:
                  "%.0fms", report["replayed"], report["flips"]["total"],
                  elapsed_ms)
         return self._last_pregate
+
+    def _corpus_pregate_rows(self, baseline: "_Snapshot") -> Optional[list]:
+        """Captured corpus rows (loaded once from --corpus-pregate) plus
+        synthetic witness rows built against the BASELINE policy (cached
+        per baseline generation — synthesis is a reconcile-path cost only
+        on the first swap of each generation).  None when the corpus
+        source is unreadable (the pregate skips, loudly)."""
+        from ..corpus import read_corpus
+        from ..corpus.synthesize import augment_corpus
+
+        if self._corpus_rows is None and self._corpus_load_error is None:
+            try:
+                self._corpus_rows = read_corpus(self.corpus_pregate)
+            except Exception as e:
+                self._corpus_load_error = str(e)
+                log.error("corpus pregate: corpus unreadable at %s: %s",
+                          self.corpus_pregate, e)
+        if self._corpus_rows is None:
+            return None
+        gen, synth, _rep = self._corpus_synth
+        if gen != baseline.generation:
+            synth, rep = [], {}
+            if baseline.policy is not None:
+                try:
+                    aug = augment_corpus(baseline.policy, self._corpus_rows)
+                    synth, rep = aug["rows"], {
+                        "reasons": aug["synthesis"]["reasons"],
+                        "uncoverable": aug["synthesis"]["uncoverable"][:20],
+                        "coverage_before":
+                            aug["coverage_before"]["fraction"],
+                        "coverage_after": aug["coverage_after"]["fraction"],
+                    }
+                except Exception:
+                    # synthesis is additive evidence: a synthesis bug must
+                    # not disarm the captured-row judgment
+                    log.exception("corpus pregate: synthesis errored "
+                                  "(captured rows only this generation)")
+            self._corpus_synth = (baseline.generation, synth, rep)
+            try:
+                metrics_mod.corpus_rows.labels("captured").set(
+                    len(self._corpus_rows))
+                metrics_mod.corpus_rows.labels("synthetic").set(len(synth))
+            except Exception:
+                pass
+        return self._corpus_rows + self._corpus_synth[1]
+
+    def _run_corpus_pregate(self, snap: "_Snapshot") -> Dict[str, Any]:
+        """Judge the candidate snapshot on the frequency-weighted decision
+        corpus (ISSUE 19, docs/policy_ci.md "Corpus pregate") — same
+        state machine as the replay pregate, but the evidence is the
+        long-retention corpus plus synthetic truth-table witnesses, so a
+        breaching edit to a ZERO-TRAFFIC rule is rejected here with zero
+        live exposure.  Raises typed SnapshotRejected on breach."""
+        from ..corpus import pregate as corpus_pregate_mod
+        from ..snapshots.diff import snapshot_diff
+
+        t0 = time.monotonic()
+        baseline = self._snapshot
+        thresholds = self.canary_thresholds or safety_mod.GuardThresholds()
+        rows = self._corpus_pregate_rows(baseline)
+        if not rows:
+            self._last_corpus_pregate = {
+                "result": "skipped",
+                "reason": (f"corpus unreadable: {self._corpus_load_error}"
+                           if self._corpus_load_error else
+                           f"corpus at {self.corpus_pregate} holds no rows"),
+                "replayed": 0,
+            }
+            metrics_mod.corpus_pregate.labels("skipped").inc()
+            RECORDER.record("corpus-pregate", lane="engine",
+                            detail=self._last_corpus_pregate)
+            log.warning("corpus pregate SKIPPED: %s",
+                        self._last_corpus_pregate["reason"])
+            return self._last_corpus_pregate
+        changed = set(snapshot_diff(baseline.fingerprints or {},
+                                    snap.fingerprints or {})["recompile"])
+        try:
+            pf = corpus_pregate_mod.corpus_preflight(
+                baseline, snap, rows, thresholds, changed=changed,
+                time_budget_s=self.corpus_pregate_budget_s)
+        except Exception:
+            log.exception("corpus pregate errored (swap proceeds under "
+                          "canary protection only)")
+            self._last_corpus_pregate = {"result": "skipped",
+                                         "reason": "pregate error (see "
+                                                   "logs)",
+                                         "replayed": 0}
+            metrics_mod.corpus_pregate.labels("skipped").inc()
+            return self._last_corpus_pregate
+        report, breach = pf["report"], pf["breach"]
+        elapsed_ms = round((time.monotonic() - t0) * 1e3, 3)
+        if breach is None and report["replayed"] < thresholds.min_requests:
+            # below the weighted evidence floor: absent evidence, recorded
+            # as skipped — never a false 'pass'
+            self._last_corpus_pregate = {
+                "result": "skipped",
+                "reason": (f"weighted corpus evidence {report['replayed']} "
+                           f"< min_requests {thresholds.min_requests}"),
+                "replayed": report["replayed"],
+                "skipped_detail": report["skipped"],
+                "elapsed_ms": elapsed_ms,
+            }
+            metrics_mod.corpus_pregate.labels("skipped").inc()
+            RECORDER.record("corpus-pregate", lane="engine",
+                            detail=self._last_corpus_pregate)
+            log.warning("corpus pregate SKIPPED: %s",
+                        self._last_corpus_pregate["reason"])
+            return self._last_corpus_pregate
+        if breach is not None:
+            metrics_mod.corpus_pregate.labels("breach").inc()
+            metrics_mod.snapshot_rejected.labels("engine").inc()
+            self._last_corpus_pregate = {
+                "result": "breach",
+                "replayed": report["replayed"],
+                "replayed_rows": report.get("replayed_rows", 0),
+                "flips": report["flips"],
+                "guards": breach["guards"],
+                "suspects": breach["suspects"],
+                "origins": report.get("origins", {}),
+                "elapsed_ms": elapsed_ms,
+            }
+            RECORDER.record(corpus_pregate_mod.CORPUS_PREGATE_ANOMALY,
+                            lane="engine", detail={
+                                "baseline_generation": baseline.generation,
+                                "breach": breach,
+                                "origins": report.get("origins", {}),
+                                "replayed": report["replayed"],
+                                "elapsed_ms": elapsed_ms,
+                            })
+            top = breach["top_flips"][:3]
+            findings = [
+                f"corpus pregate breach: {', '.join(breach['guards'])} over "
+                f"{report['replayed']} weighted corpus decision(s) "
+                f"({report['flips']['newly_denied']} newly denied, "
+                f"{report['flips']['newly_allowed']} newly allowed)"
+            ] + [
+                f"{g['authconfig']} rule[{g['rule_index']}] {g['rule']} "
+                f"{g['direction']} weight {g['count']} "
+                f"(origins: {', '.join(g.get('origins') or []) or 'n/a'})"
+                for g in top
+            ]
+            log.error("corpus pregate REJECTED the candidate snapshot "
+                      "(generation %d keeps serving, zero live exposure): "
+                      "%s", baseline.generation, "; ".join(findings))
+            exc = SnapshotRejected(findings)
+            exc.corpus_diff = breach  # the full attributed evidence
+            raise exc
+        self._last_corpus_pregate = {
+            "result": "pass",
+            "replayed": report["replayed"],
+            "replayed_rows": report.get("replayed_rows", 0),
+            "flips": report["flips"],
+            "origins": report.get("origins", {}),
+            "truncated": report["skipped"]["truncated"],
+            "elapsed_ms": elapsed_ms,
+        }
+        metrics_mod.corpus_pregate.labels("pass").inc()
+        RECORDER.record("corpus-pregate", lane="engine",
+                        detail=self._last_corpus_pregate)
+        log.info("corpus pregate PASS: %d weighted decision(s) "
+                 "(%d row(s)) replayed, %d flip(s), %.0fms",
+                 report["replayed"], report.get("replayed_rows", 0),
+                 report["flips"]["total"], elapsed_ms)
+        return self._last_corpus_pregate
 
     def _enter_canary(self, snap: "_Snapshot",
                       entries: Sequence[EngineEntry],
@@ -1862,6 +2045,20 @@ class PolicyEngine:
                     "budget_s": self.replay_pregate_budget_s,
                     "last": self._last_pregate,
                 },
+            },
+            # decision corpus (ISSUE 19, docs/policy_ci.md): the pregate
+            # corpus source, its row counts by origin, the synthesis
+            # summary for the serving baseline, and the last verdict
+            "corpus": {
+                "enabled": bool(self.corpus_pregate),
+                "source": self.corpus_pregate or None,
+                "budget_s": self.corpus_pregate_budget_s,
+                "rows_captured": (len(self._corpus_rows)
+                                  if self._corpus_rows is not None else 0),
+                "rows_synthetic": len(self._corpus_synth[1]),
+                "synthesis": self._corpus_synth[2] or None,
+                "load_error": self._corpus_load_error,
+                "last": self._last_corpus_pregate,
             },
             "snapshot": None,
         }

@@ -48,6 +48,10 @@ ANOMALY_KINDS = frozenset({
     # ISSUE 13: a reconcile whose replay preflight breached — the bundle
     # freezes the top-N verdict-diff rows (attributed flips) as evidence
     "replay-pregate-breach",
+    # ISSUE 19: a reconcile whose CORPUS preflight breached — same evidence
+    # shape, but the flips may be synthetic-origin rows (a rule no live
+    # traffic ever exercised), which is exactly the zero-exposure catch
+    "corpus-pregate-breach",
     # ISSUE 15: the noisy-neighbor detector CONTAINED a tenant (tenant-
     # scoped brownout/shed) — the bundle freezes the per-tenant shares,
     # weights and wait state that justified the clamp.  The auto-release

@@ -1012,6 +1012,51 @@ replay_diff_flips = _gauge(
 )
 
 # ---------------------------------------------------------------------------
+# Policy CI decision corpus (ISSUE 19, docs/policy_ci.md): distillation
+# accounting, synthesis outcomes, and the corpus pregate verdict counters.
+# ---------------------------------------------------------------------------
+
+corpus_records = _counter(
+    "auth_server_corpus_records_total",
+    "Corpus distillation accounting by result: distilled (distinct "
+    "decision rows emitted), deduped (captured records that collapsed "
+    "into an existing row — its frequency weight absorbs them), "
+    "dropped-unparseable (records with no authconfig or a non-JSON "
+    "document — accounted, never silently discarded, so a "
+    "segment-pruning byte budget can never quietly eat coverage).",
+    ("result",),
+)
+corpus_rows = _gauge(
+    "auth_server_corpus_rows",
+    "Rows in the corpus the engine's --corpus-pregate loaded, by origin: "
+    "captured (distilled from real traffic, frequency-weighted) vs "
+    "synthetic (truth-table witnesses for never-fired rules). A zero "
+    "synthetic count with unexercised rules means synthesis could not "
+    "cover them — see the corpus block's reason codes on /debug/vars.",
+    ("origin",),
+)
+corpus_pregate = _counter(
+    "auth_server_corpus_pregate_total",
+    "Corpus preflights by result: pass (weighted verdict diff under the "
+    "canary guard thresholds), breach (the candidate snapshot was "
+    "REJECTED on corpus evidence — possibly a synthetic-only row, i.e. "
+    "zero live traffic ever exercised the breaching rule; a "
+    "corpus-pregate-breach flight bundle carries the attributed diff), "
+    "skipped (no corpus loaded or below the evidence floor).",
+    ("result",),
+)
+corpus_synth = _counter(
+    "auth_server_corpus_synth_total",
+    "Truth-table row synthesis outcomes by reason: ok (a verified "
+    "witness document was admitted) or a typed uncoverability code "
+    "(atom-budget-exceeded, statically-dead, unsatisfiable, "
+    "unsupported-selector, selector-conflict, opaque-cpu-tree, "
+    "materialization-failed — docs/policy_ci.md lists the semantics). "
+    "Uncoverable rules are REPORTED, never silently skipped.",
+    ("reason",),
+)
+
+# ---------------------------------------------------------------------------
 # Tenant QoS plane (ISSUE 15, docs/tenancy.md): per-tenant serving counters,
 # tenant-scoped admission rejections, and containment state.
 #

@@ -275,6 +275,53 @@ def lowerability_block(engine=None, configs=None, policy=None):
             "blocking_reasons": rep["blocking_reasons"]}
 
 
+def corpus_block(corpus_dir, engine=None, policy=None, budget_s=2.0):
+    """Artifact block (ISSUE 19, docs/policy_ci.md): the decision-corpus
+    health stamp — distinct rows with their captured/synthetic split,
+    the dedup ratio (total captured weight over distinct captured rows),
+    rule-column coverage before/after synthesis, and a timed identity
+    pregate replay of the whole corpus against the serving policy so the
+    artifact shows whether the --corpus-pregate fits its reconcile
+    budget on THIS corpus at THIS size."""
+    from authorino_tpu.corpus import read_corpus
+    from authorino_tpu.corpus.pregate import replay_corpus
+    from authorino_tpu.corpus.synthesize import augment_corpus
+
+    if engine is not None:
+        snap = engine._snapshot
+        policy = snap.policy if snap is not None else None
+    if policy is None:
+        return {"source": corpus_dir, "error": "no serving policy"}
+    try:
+        rows = read_corpus(corpus_dir)
+    except Exception as e:
+        return {"source": corpus_dir, "error": repr(e)}
+    captured = [r for r in rows if r.get("origin") != "synthetic"]
+    weight = sum(max(1, int(r.get("weight", 1) or 1)) for r in captured)
+    aug = augment_corpus(policy, rows)
+    t0 = time.perf_counter()
+    rep = replay_corpus(policy, policy, rows, time_budget_s=budget_s)
+    replay_s = time.perf_counter() - t0
+    return {
+        "source": corpus_dir,
+        "rows": len(rows),
+        "captured_rows": len(captured),
+        "synthetic_rows": len(rows) - len(captured),
+        "captured_weight": weight,
+        "dedup_ratio": round(weight / len(captured), 2) if captured else None,
+        "coverage_before": aug["coverage_before"]["fraction"],
+        "coverage_after": aug["coverage_after"]["fraction"],
+        "uncoverable": aug["synthesis"]["reasons"],
+        "pregate_replay_ms": round(replay_s * 1e3, 2),
+        "pregate_budget_ms": round(budget_s * 1e3, 2),
+        "pregate_within_budget": replay_s <= budget_s,
+        "pregate_replayed_rows": rep.get("replayed_rows", 0),
+        "pregate_truncated": (rep.get("skipped") or {}).get("truncated", 0),
+        # identity replay: any nonzero flip count here is a corpus bug
+        "identity_flips": (rep.get("flips") or {}).get("total", 0),
+    }
+
+
 def provenance_block(engine=None, fe=None, configs=None, docs=None,
                      rows=None, elapsed=None, sample_n=64):
     """Artifact block (ISSUE 9, docs/observability.md "Decision
@@ -3625,6 +3672,17 @@ def main():
                          "'analysis --replay OLD NEW --log DIR'")
     ap.add_argument("--capture-sample", type=int, default=1,
                     help="with --capture-log: capture 1-in-N decisions")
+    ap.add_argument("--corpus", default="",
+                    help="ISSUE 19 (docs/policy_ci.md): stamp a decision-"
+                         "corpus health block into the artifact — distinct "
+                         "rows, dedup ratio, coverage before/after row "
+                         "synthesis, and a timed identity pregate replay "
+                         "vs --corpus-budget-ms.  DIR is an .atpucorp "
+                         "file or a directory of them (from 'analysis "
+                         "--corpus-distill')")
+    ap.add_argument("--corpus-budget-ms", type=float, default=2000.0,
+                    help="with --corpus: the reconcile-time budget the "
+                         "pregate replay is judged against")
     ap.add_argument("--replay-log", default="",
                     help="engine mode (ISSUE 13): REPLAY a captured "
                          "traffic log as the open-loop timetable — "
@@ -4031,6 +4089,16 @@ def main():
             log(f"capture log flushed: {CAPTURE.stored_total} record(s), "
                 f"{CAPTURE.segments_written} segment(s) in "
                 f"{args.capture_log}")
+        if args.mode == "engine" and args.corpus:
+            detail["corpus"] = corpus_block(
+                args.corpus, engine=engine,
+                budget_s=args.corpus_budget_ms / 1e3)
+            cb = detail["corpus"]
+            log(f"corpus: {cb.get('rows')} rows "
+                f"(dedup x{cb.get('dedup_ratio')}), coverage "
+                f"{cb.get('coverage_before')} -> {cb.get('coverage_after')}, "
+                f"pregate replay {cb.get('pregate_replay_ms')}ms / "
+                f"budget {cb.get('pregate_budget_ms')}ms")
         print(json.dumps(detail))
         return
 
@@ -4118,6 +4186,10 @@ def main():
                 "batch_p99_ms": round(p99, 3),
                 "trials": trial_rps,
                 "lowerability": lowerability_block(configs=configs, policy=p),
+                **({"corpus": corpus_block(
+                    args.corpus, policy=p,
+                    budget_s=args.corpus_budget_ms / 1e3)}
+                   if args.corpus else {}),
             }
         )
     )

@@ -374,6 +374,26 @@ def test_code_lint_suppression_scopes():
                        "    import time\n    time.sleep(1)\n") == []
 
 
+def test_code_lint_pickle_import_kind():
+    """ISSUE 19 satellite: every on-disk artifact (snapshots, capture
+    segments, the decision corpus) is a pickle-free checksummed
+    container by design — a module-level pickle import outside tests/
+    is a lint error, not a style choice."""
+    src = "import pickle\nfrom cloudpickle import dumps\nimport dill\n"
+    kinds = [f.kind for f in lint_source(src, "authorino_tpu/x.py")]
+    assert kinds == ["pickle-import"] * 3
+    # tests/ may unpickle fixtures; paths under tests/ are exempt
+    assert lint_source(src, "tests/test_x.py") == []
+    assert lint_source(src, "pkg/tests/helper.py") == []
+    # suppressible only explicitly, with the usual reasoned syntax
+    ok = "import pickle  # lint-ok: pickle-import -- trusted local cache\n"
+    assert lint_source(ok, "authorino_tpu/x.py") == []
+    # a RELATIVE `from .pickle import x` is someone's own module, not
+    # stdlib pickle — no finding
+    assert lint_source("from .pickle import x\n",
+                       "authorino_tpu/x.py") == []
+
+
 def test_repo_stays_lint_clean():
     """The tier-1 gate: the new code lint over authorino_tpu/ must report
     no findings — a new blocking call in an async path, a lock held across
