@@ -154,6 +154,12 @@ def _run_verify_fixtures() -> List[Finding]:
     # fixture must fire outside tests/, stay quiet inside tests/, and
     # honor `# lint-ok:` — a blind lint fails this command
     errors += _pickle_lint_selftest()
+
+    # non-atomic-write lint self-test (ISSUE 20 satellite): a planted raw
+    # open-for-write into a durable-state path must fire, the tmp+fsync+
+    # rename discipline must pass, tests/ stay exempt, and `# lint-ok:`
+    # suppresses — a blind lint fails this command, and with it tier-1
+    errors += _atomic_write_lint_selftest()
     return errors
 
 
@@ -175,6 +181,46 @@ def _pickle_lint_selftest() -> List[Finding]:
     if lint_source("import pickle  # lint-ok: pickle-import -- fixture\n",
                    path="authorino_tpu/x.py"):
         _err("pickle-import lint ignored a `# lint-ok:` suppression")
+    return errors
+
+
+def _atomic_write_lint_selftest() -> List[Finding]:
+    from .code_lint import lint_source
+
+    errors: List[Finding] = []
+
+    def _err(msg: str) -> None:
+        errors.append(Finding(kind="lint-blind", layer="code_lint",
+                              message=msg, location="fixtures"))
+
+    planted = (
+        "import os\n"
+        "def persist(state_dir, blob):\n"
+        "    with open(os.path.join(state_dir, 'MANIFEST.json'), 'w') as f:\n"
+        "        f.write(blob)\n"
+    )
+    got = [f.kind for f in lint_source(planted, path="authorino_tpu/x.py")]
+    if got != ["non-atomic-write"]:
+        _err(f"non-atomic-write lint BLIND to a planted raw write: {got}")
+    if lint_source(planted, path="tests/test_x.py"):
+        _err("non-atomic-write lint fired inside tests/ (exempt by design)")
+    disciplined = (
+        "import os\n"
+        "def persist(state_dir, blob):\n"
+        "    path = os.path.join(state_dir, 'MANIFEST.json')\n"
+        "    with open(path + '.tmp', 'w') as f:\n"
+        "        f.write(blob)\n"
+        "        f.flush()\n"
+        "        os.fsync(f.fileno())\n"
+        "    os.replace(path + '.tmp', path)\n"
+    )
+    if lint_source(disciplined, path="authorino_tpu/x.py"):
+        _err("non-atomic-write lint fired on the tmp+fsync+rename "
+             "discipline itself")
+    suppressed = planted.replace(
+        "as f:", "as f:  # lint-ok: non-atomic-write -- fixture", 1)
+    if lint_source(suppressed, path="authorino_tpu/x.py"):
+        _err("non-atomic-write lint ignored a `# lint-ok:` suppression")
     return errors
 
 
@@ -227,7 +273,7 @@ def _corpus_selftest(policy) -> List[Finding]:
         with open(tmp, "rb") as f:
             blob = bytearray(f.read())
         blob[len(blob) // 2] ^= 0xFF
-        with open(tmp, "wb") as f:
+        with open(tmp, "wb") as f:  # lint-ok: non-atomic-write -- deliberately planting corruption
             f.write(bytes(blob))
         try:
             read_corpus_file(tmp)
@@ -379,7 +425,7 @@ def _replay_selftest(policy) -> List[Finding]:
         with open(tmp, "rb") as f:
             blob = bytearray(f.read())
         blob[len(blob) // 2] ^= 0xFF
-        with open(tmp, "wb") as f:
+        with open(tmp, "wb") as f:  # lint-ok: non-atomic-write -- deliberately planting corruption
             f.write(bytes(blob))
         try:
             read_segment(tmp)

@@ -29,6 +29,17 @@ jitted JAX code — each with a hazard class generic linters don't know:
                       pickle-free checksummed JSON BY INVARIANT — loading
                       operator-writable blobs through pickle is arbitrary
                       code execution at deserialization time (ISSUE 19)
+  non-atomic-write    an ``open(path, "w"/"wb")`` inside a function that
+                      handles durable-state-shaped paths (state/publish/
+                      flight/capture/corpus/snapshot/manifest/hotset/
+                      artifact names or literals) without the tmp + fsync +
+                      os.replace discipline in the same scope: a crash
+                      mid-write surfaces a torn artifact under a valid
+                      name.  Route through utils/atomicio.py — which also
+                      gives the writer fs-stage fault coverage (ISSUE 20).
+                      Function-scope, lexical: hand-rolled atomicity (both
+                      an ``os.fsync`` and an ``os.replace``/``os.rename``
+                      in the same function) passes; tests/ are exempt
 
 Suppression (docs/static_analysis.md): append ``# lint-ok: <kind>`` to the
 flagged line — with a reason after ``--`` by convention.  A bare
@@ -51,7 +62,8 @@ __all__ = ["lint_source", "lint_file", "lint_paths", "iter_py_files",
 _LAYER = "code_lint"
 
 HAZARD_KINDS = ("blocking-in-async", "lock-across-await", "tracer-branch",
-                "bare-except", "unbounded-wait", "pickle-import")
+                "bare-except", "unbounded-wait", "pickle-import",
+                "non-atomic-write")
 
 # pickle-family module roots flagged by pickle-import (dotted submodule
 # imports count by their root); tests/ paths are exempt — tests may build
@@ -92,6 +104,13 @@ _WAITISH_METHODS = {"wait", "join"}
 
 _SUPPRESS = re.compile(r"#\s*lint-ok(?::\s*(?P<kinds>[\w\-, ]+?))?\s*(?:--.*)?$")
 _SKIP_FILE = re.compile(r"#\s*lint:\s*skip-file")
+
+# durable-state shapes (non-atomic-write kind): a function whose names or
+# string literals smell like the repo's durable artifacts is held to the
+# tmp+fsync+rename discipline for every raw open-for-write in its scope
+_DURABLE = re.compile(
+    r"state|publish|flight|captur|corpus|snapshot|manifest|hotset|artifact",
+    re.IGNORECASE)
 
 
 def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
@@ -197,10 +216,81 @@ class _FuncVisitor(ast.NodeVisitor):
                 self._jit_params.add(args.vararg.arg)
         else:
             self._jit_params = None
+        self._check_atomic_writes(node)
         for child in node.body:
             self.visit(child)
         self._async_depth, self._jit_params = prev_async, prev_jit
         self._drain_path = prev_drain
+
+    # -- non-atomic-write --------------------------------------------------
+
+    @classmethod
+    def _own_scope(cls, node: ast.AST):
+        """Every node in ``node``'s body, pruning nested def/lambda
+        subtrees (they get their own _enter_function pass and verdict)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            yield child
+            yield from cls._own_scope(child)
+
+    @staticmethod
+    def _open_write_mode(call: ast.Call) -> Optional[str]:
+        """The constant write mode of an ``open()`` call, or None for
+        reads / non-open calls / non-constant modes."""
+        if not (isinstance(call.func, ast.Name) and call.func.id == "open"):
+            return None
+        mode = None
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+                and isinstance(call.args[1].value, str):
+            mode = call.args[1].value
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                mode = kw.value.value
+        return mode if mode is not None and "w" in mode else None
+
+    def _check_atomic_writes(self, node) -> None:
+        """One function-scope pass: a raw open-for-write in a function
+        that handles durable-state-shaped names/literals must ride the
+        tmp+fsync+rename discipline in the SAME scope (or, better, route
+        through utils/atomicio.py and never open() at all).  Tests are
+        exempt — they corrupt artifacts on purpose."""
+        if _TESTS_PATH.search(self.path):
+            return
+        writes: List[Tuple[ast.Call, str]] = []
+        durable = has_fsync = has_rename = False
+        for n in self._own_scope(node):
+            if isinstance(n, ast.Call):
+                mode = self._open_write_mode(n)
+                if mode is not None:
+                    writes.append((n, mode))
+                d = _dotted(n.func)
+                if d is not None:
+                    if d[-1] == "fsync":
+                        has_fsync = True
+                    # os.replace/os.rename only: a str.replace() must not
+                    # count as the atomic-rename half of the discipline
+                    if d[0] == "os" and d[-1] in ("replace", "rename"):
+                        has_rename = True
+            if isinstance(n, ast.Name) and _DURABLE.search(n.id):
+                durable = True
+            elif isinstance(n, ast.Attribute) and _DURABLE.search(n.attr):
+                durable = True
+            elif isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                    and _DURABLE.search(n.value):
+                durable = True
+        if not writes or not durable or (has_fsync and has_rename):
+            return
+        for call, mode in writes:
+            self._report(
+                "non-atomic-write", call,
+                f"open(..., {mode!r}) into a durable-state-shaped path "
+                "without tmp+fsync+rename in the same scope: a crash "
+                "mid-write surfaces a torn artifact under a valid name "
+                "(route through utils/atomicio.py atomic_write_*, which "
+                "also adds fs fault-injection coverage)")
 
     # -- blocking-in-async -------------------------------------------------
 

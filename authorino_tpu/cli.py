@@ -388,6 +388,20 @@ def build_parser() -> argparse.ArgumentParser:
                    default=env_var("FLEET_HOTSET_S", 30.0),
                    help="Leader hot-set publish cadence in seconds "
                         "(default 30)")
+    s.add_argument("--state-dir", default=env_var("STATE_DIR", ""),
+                   help="Durable local state plane (docs/robustness.md "
+                        "'Crash recovery & warm restart'): persist the last "
+                        "vetted snapshot + verdict-cache hot set here and, "
+                        "at boot, serve them fail-statically BEFORE the "
+                        "control plane connects — a SIGKILLed process "
+                        "restarts warm.  Must not equal --snapshot-source")
+    s.add_argument("--max-snapshot-age", type=float,
+                   default=env_var("MAX_SNAPSHOT_AGE_S", 0.0),
+                   help="Staleness bound in seconds for a warm-restart "
+                        "snapshot (0 = unbounded): past it the engine "
+                        "still serves (fail-static) but /readyz degrades "
+                        "to 'ok (degraded: stale snapshot, age=...)' and "
+                        "a stale-snapshot flight anomaly records evidence")
     s.add_argument("--native-frontend", choices=["auto", "on", "off"],
                    default=env_var("NATIVE_FRONTEND", "auto"),
                    help="Serve the ext_authz gRPC port from the C++ device-owner "
@@ -581,6 +595,15 @@ async def run_server(args) -> None:
                     "(replicas only admit certified snapshots): enabling it")
         args.strict_verify = True
 
+    if str(getattr(args, "state_dir", "") or "") and not args.strict_verify:
+        # same admissibility argument as the publish dir: the warm-restart
+        # loader IS the replica admission gate, and it only admits
+        # certified blobs — persisting uncertified local reconciles would
+        # make every warm restart a silent cold start
+        log.warning("--state-dir implies --strict-verify (the warm-restart "
+                    "loader only admits certified snapshots): enabling it")
+        args.strict_verify = True
+
     device_timeout_ms = int(getattr(args, "device_timeout", 0) or 0)
     # NOTE: --batch-window-us no longer reaches the engine (the old
     # max_delay_s mirror was a documented no-op since the pipelined
@@ -691,6 +714,42 @@ async def run_server(args) -> None:
                              name="atpu-fleet-hotset").start()
             log.info("fleet hot-set: publishing top-%d verdicts every "
                      "%.0fs", hotset_k, hotset_s)
+    # Durable local state plane (ISSUE 20, docs/robustness.md "Crash
+    # recovery & warm restart"): warm-start from the local blob BEFORE the
+    # replica's first poll, so a restarted process serves exact verdicts
+    # fail-statically and the first successful poll swaps in the leader's
+    # snapshot via the normal delta path (a reachable leader always wins).
+    state_plane = None
+    state_dir = str(getattr(args, "state_dir", "") or "")
+    if state_dir:
+        for other, flag in ((snapshot_source, "--snapshot-source"),
+                            (publish_dir, "--snapshot-publish-dir")):
+            if (other and not other.startswith(("http://", "https://"))
+                    and os.path.realpath(state_dir)
+                    == os.path.realpath(other)):
+                # the state dir persists LOADED snapshots by design
+                # (include_loaded) — pointed at the distribution feed it
+                # would republish what it consumed (the exact loop the
+                # published_origin breaker exists to prevent), and pointed
+                # at the publish dir two writers would fight over MANIFEST
+                raise RuntimeError(
+                    f"--state-dir and {flag} point at the same directory: "
+                    "the state plane is this process's private "
+                    "crash-recovery store, never a distribution feed")
+        from .runtime.state_plane import StatePlane
+
+        state_plane = StatePlane(
+            engine, state_dir,
+            max_snapshot_age_s=float(getattr(args, "max_snapshot_age", 0.0)),
+            hotset_k=int(getattr(args, "fleet_hotset_k", 1024) or 1024),
+            hotset_s=max(1.0, float(getattr(args, "fleet_hotset_s", 30.0))))
+        engine.state_plane = state_plane
+        summary = state_plane.warm_start()
+        state_plane.start()
+        log.info("state plane: %s (snapshot=%s hotset=%s, "
+                 "max_snapshot_age=%.0fs)", state_dir,
+                 summary.get("snapshot"), summary.get("hotset"),
+                 state_plane.max_snapshot_age_s)
     if snapshot_source:
         from .snapshots.distribution import SnapshotReplica
 
@@ -929,6 +988,12 @@ async def run_server(args) -> None:
             # lose its newest window to an orderly shutdown
             await best_effort(loop.run_in_executor(
                 None, lambda: CAPTURE.flush(min(2.0, drain_left()))))
+        if state_plane is not None:
+            # best-effort final state flush (ISSUE 20): the last vetted
+            # snapshot rides the publisher flush, the hot set exports once
+            # more — so the NEXT boot warm-starts from the freshest state
+            await best_effort(loop.run_in_executor(
+                None, lambda: state_plane.shutdown(min(2.0, drain_left()))))
         await best_effort(runner.cleanup())
         await best_effort(oidc_runner.cleanup())
         from .utils.tracing import shutdown_tracing

@@ -38,6 +38,8 @@ import struct
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..utils.atomicio import atomic_write_bytes
+
 __all__ = ["CORPUS_SCHEMA", "CORPUS_FORMAT_VERSION", "CORPUS_SUFFIX",
            "CORPUS_FIELDS", "CorpusFormatError", "encode_row",
            "write_corpus", "read_corpus_file", "read_corpus"]
@@ -82,13 +84,7 @@ def write_corpus(path: str, rows: Sequence[Dict[str, Any]],
                     separators=(",", ":")).encode("utf-8")
     body = MAGIC + struct.pack("<Q", len(hb)) + hb + payload
     blob = body + hashlib.sha256(body).digest()
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    return path
+    return atomic_write_bytes(path, blob, artifact="corpus")
 
 
 def read_corpus_file(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:

@@ -43,6 +43,7 @@ from collections import deque
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..utils import metrics as metrics_mod
+from ..utils.atomicio import atomic_write_bytes
 
 __all__ = ["CAPTURE", "CaptureLog", "CaptureFormatError", "CAPTURE_SCHEMA",
            "CAPTURE_FORMAT_VERSION", "SEGMENT_SUFFIX", "write_segment",
@@ -112,13 +113,7 @@ def write_segment(path: str, records: Sequence[Dict[str, Any]],
     publisher)."""
     payload = b"".join(encode_record(r) for r in records)
     blob = _build_container(payload, len(records), meta)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    return path
+    return atomic_write_bytes(path, blob, artifact="capture")
 
 
 def read_segment(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
@@ -377,10 +372,10 @@ class CaptureLog:
         try:
             blob = _build_container(payload, count,
                                     meta={"sample_n": self.sample_n})
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, path)
+            # shared atomic writer (ISSUE 20): the old inline tmp+replace
+            # here skipped fsync, so a crash mid-rotation could surface a
+            # truncated segment under a fully-renamed name
+            atomic_write_bytes(path, blob, artifact="capture")
             self.segments_written += 1
             self._prune_dir()
         except Exception:

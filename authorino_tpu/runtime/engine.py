@@ -2023,6 +2023,13 @@ class PolicyEngine:
                                   if self.metadata_prefetcher is not None
                                   else None),
             "flight_recorder": RECORDER.to_json(),
+            # durable local state plane (ISSUE 20, docs/robustness.md
+            # "Crash recovery & warm restart"): warm-start outcome per
+            # phase, live staleness, write-behind cadence.  Set by cli.py
+            # when --state-dir is armed; None otherwise.
+            "state_plane": (self.state_plane.to_json()
+                            if getattr(self, "state_plane", None) is not None
+                            else None),
             # kernel cost observatory (ISSUE 16, docs/performance.md
             # "Kernel cost model"): the process-wide structural ledger
             # (launches/bytes/pad-waste per lane), the modeled per-row

@@ -16,11 +16,13 @@ bench --chaos)::
 
     spec  := profile | rule (";" rule)*
     rule  := stage ":" mode [":" key=value]*
-    stage := encode | h2d | kernel | dispatch (= kernel) | readback
-    mode  := raise | hang | delay
+    stage := encode | h2d | kernel | dispatch (= kernel) | readback | fs
+    mode  := raise | hang | delay                       (device stages)
+    mode  := torn | short | rename-fail | eio | enospc  (fs stage)
     keys  := p=<probability 0..1> n=<max firings> delay=<seconds>
              for=<seconds active> after=<seconds before active>
              lane=<engine|native> device=<device id>
+             artifact=<snapshot-blob|manifest|hotset|capture|corpus|...>
 
 Named profiles::
 
@@ -79,8 +81,21 @@ PROFILES = {
     "wedge": "kernel:hang",
 }
 
-_STAGES = ("encode", "h2d", "kernel", "readback")
+_STAGES = ("encode", "h2d", "kernel", "readback", "fs")
 _MODES = ("raise", "hang", "delay")
+# The fs stage models filesystem failure at a durable-artifact writer
+# (utils/atomicio.py consults fs_fault() under the same ACTIVE gate the
+# device hooks use).  Its modes are crash shapes, not exception shapes:
+#   torn        a prefix of the new bytes lands over the DESTINATION
+#               (power cut after a non-atomic overwrite) — readers must
+#               reject the torn artifact typed, never crash or serve it
+#   short       the tmp file ends up shorter than requested (quota,
+#               interrupted write); the writer's size check catches it
+#               and the destination is untouched
+#   rename-fail os.replace itself fails; tmp is discarded, old state wins
+#   eio         open/write raises EIO before any byte lands
+#   enospc      a partial tmp write then ENOSPC; destination untouched
+_FS_MODES = ("torn", "short", "rename-fail", "eio", "enospc")
 
 
 class InjectedFault(RuntimeError):
@@ -96,10 +111,11 @@ class InjectedFault(RuntimeError):
 
 @dataclass
 class FaultRule:
-    stage: str                    # encode | h2d | kernel | readback
-    mode: str                     # raise | hang | delay
+    stage: str                    # encode | h2d | kernel | readback | fs
+    mode: str                     # raise | hang | delay | <fs mode>
     lane: str = "*"               # engine | native | *
     device: Optional[int] = None  # scope to one mesh device id (None = any)
+    artifact: str = "*"           # fs stage: scope to one artifact kind
     p: float = 1.0                # firing probability per eligible batch
     n: int = -1                   # max firings (-1 = unlimited)
     delay_s: float = 0.05         # mode=delay: added latency
@@ -122,6 +138,8 @@ class FaultRule:
             extras.append(f"lane={self.lane}")
         if self.device is not None:
             extras.append(f"device={self.device}")
+        if self.artifact != "*":
+            extras.append(f"artifact={self.artifact}")
         if self.p < 1.0:
             extras.append(f"p={self.p}")
         if self.n >= 0:
@@ -172,7 +190,11 @@ def _parse_rule(text: str) -> FaultRule:
     if stage not in _STAGES:
         raise ValueError(f"fault rule {text!r}: unknown stage {stage!r} "
                          f"(want one of {_STAGES})")
-    if mode not in _MODES:
+    if stage == "fs":
+        if mode not in _FS_MODES:
+            raise ValueError(f"fault rule {text!r}: unknown fs mode {mode!r} "
+                             f"(want one of {_FS_MODES})")
+    elif mode not in _MODES:
         raise ValueError(f"fault rule {text!r}: unknown mode {mode!r} "
                          f"(want one of {_MODES})")
     rule = FaultRule(stage=stage, mode=mode)
@@ -197,6 +219,8 @@ def _parse_rule(text: str) -> FaultRule:
             rule.lane = v.strip().lower()
         elif k == "device":
             rule.device = int(v)
+        elif k == "artifact":
+            rule.artifact = v.strip().lower()
         else:
             raise ValueError(f"fault rule {text!r}: unknown key {k!r}")
     return rule
@@ -314,6 +338,43 @@ class FaultPlane:
                 device_id=rule.device if rule.device is not None else None)
         if rule.mode == "delay":
             time.sleep(rule.delay_s)
+
+    def fs_fault(self, artifact: str) -> Optional[FaultRule]:
+        """Durable-writer hook: return the armed ``fs`` rule matching
+        ``artifact`` (or None).  The caller — utils/atomicio.py — realizes
+        the crash shape (torn/short/rename-fail/eio/enospc); this method
+        only does the rule bookkeeping so firing counts, ``n=``/``for=``
+        windows and the deterministic rng behave exactly like the device
+        stages.  Callers gate on ``faults.ACTIVE`` first (zero-cost off)."""
+        with self._lock:
+            elapsed = time.monotonic() - self._armed_at
+            for r in self._rules:
+                if r.stage != "fs":
+                    continue
+                if r.artifact not in ("*", artifact):
+                    continue
+                if not r.live(elapsed):
+                    continue
+                if r.p < 1.0 and self._rng.random() >= r.p:
+                    continue
+                r.fired += 1
+                key = f"fs:{r.mode}:{artifact}"
+                self.fired[key] = self.fired.get(key, 0) + 1
+                rule = r
+                break
+            else:
+                return None
+        from ..utils import metrics as metrics_mod
+
+        metrics_mod.injected_faults.labels("fs", rule.mode, artifact).inc()
+        return rule
+
+    def rand(self) -> float:
+        """One draw from the deterministic rng (seeded at arm time) —
+        fs-mode writers use it to pick torn/short prefix lengths so a
+        given AUTHORINO_TPU_FAULT_SEED reproduces the same crash bytes."""
+        with self._lock:
+            return self._rng.random()
 
     def wrap_handle(self, handle: Any, lane: str) -> Any:
         """Launch-time hook for device-stage ``hang`` and ``delay`` rules:

@@ -31,6 +31,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from ..utils import metrics as metrics_mod
+from ..utils.atomicio import atomic_write_json
 
 __all__ = ["FlightRecorder", "RECORDER", "BUNDLE_SCHEMA"]
 
@@ -61,6 +62,11 @@ ANOMALY_KINDS = frozenset({
     # >=2x vs the previous generation (advisory — the swap still lands; the
     # bundle freezes the modeled flops/bytes diff per entry point)
     "cost-regression",
+    # ISSUE 20: a warm restart served the state-dir snapshot past its
+    # --max-snapshot-age bound — fail-static by design (old verdicts beat
+    # no verdicts), but the bundle freezes the age/generation evidence and
+    # /readyz degrades until a live control-plane snapshot lands
+    "stale-snapshot",
 })
 
 
@@ -221,10 +227,9 @@ class FlightRecorder:
         fname = "flight-%d-%s-%d.json" % (
             int(bundle["t"]), trigger.replace("/", "_"), os.getpid())
         path = os.path.join(self.dump_dir, fname)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(bundle, f, default=str)
-        os.replace(tmp, path)
+        # shared atomic writer (ISSUE 20): the old inline tmp+replace here
+        # skipped fsync, so a crash could surface a zero-length bundle
+        atomic_write_json(path, bundle, artifact="flight", default=str)
         metrics_mod.flight_dumps.labels(trigger).inc()
         self.dumps.append(path)
         del self.dumps[:-32]

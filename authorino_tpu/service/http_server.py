@@ -200,6 +200,18 @@ def build_app(engine: PolicyEngine, readiness=None, max_body: int = DEFAULT_MAX_
             # healthy config with them
             if getattr(engine, "quarantine_active", False):
                 degraded.append("quarantine active")
+            # crash-safe warm restart (ISSUE 20): a state-dir snapshot
+            # older than --max-snapshot-age is surfaced but STAYS ready —
+            # fail-static old verdicts beat no verdicts; the first live
+            # control-plane swap clears the reason
+            plane = getattr(engine, "state_plane", None)
+            if plane is not None:
+                try:
+                    stale = plane.stale_reason()
+                except Exception:
+                    stale = None
+                if stale:
+                    degraded.append(stale)
             if degraded:
                 return web.Response(
                     text=f"ok (degraded: {'; '.join(degraded)})")

@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..utils.atomicio import atomic_write_bytes, atomic_write_json
 from .serialize import SnapshotFormatError, deserialize_policy, serialize_policy
 
 __all__ = [
@@ -92,9 +93,17 @@ class SnapshotPublisher:
     routing included) — attach it as a swap listener on the leader and
     every vetted reconcile becomes a published artifact."""
 
-    def __init__(self, directory: str, keep: int = 4):
+    def __init__(self, directory: str, keep: int = 4,
+                 include_loaded: bool = False):
         self.directory = directory
         self.keep = max(1, int(keep))
+        # include_loaded=True turns the publisher into a STATE-PLANE writer
+        # (ISSUE 20, --state-dir): snapshots this process itself loaded
+        # from an upstream publisher (published_origin set) are persisted
+        # too, so a SIGKILLed replica restarts warm from its own disk.
+        # The default (False) keeps the fleet loop breaker: replicas never
+        # republish into a distribution directory.
+        self.include_loaded = bool(include_loaded)
         os.makedirs(directory, exist_ok=True)
         # async publish machinery (attach): serialize+fsync must never sit
         # on the swap-listener critical path — a revoking reconcile has to
@@ -113,12 +122,7 @@ class SnapshotPublisher:
         can see WHY the manifest moved backwards semantically."""
         name = f"snapshot-{generation:012d}.atpusnap"
         path = os.path.join(self.directory, name)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        atomic_write_bytes(path, blob, artifact="snapshot-blob")
         manifest = {
             "current": name,
             "generation": int(generation),
@@ -129,12 +133,8 @@ class SnapshotPublisher:
         }
         if extra:
             manifest.update(extra)
-        mtmp = os.path.join(self.directory, MANIFEST + ".tmp")
-        with open(mtmp, "w") as f:
-            json.dump(manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(mtmp, os.path.join(self.directory, MANIFEST))
+        atomic_write_json(os.path.join(self.directory, MANIFEST), manifest,
+                          artifact="manifest")
         self._gc(keep_name=name)
         return path
 
@@ -156,12 +156,7 @@ class SnapshotPublisher:
         costs a cold cache, never correctness (entries are re-validated
         against the joining snapshot's tokens at import)."""
         path = os.path.join(self.directory, HOTSET)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(digest, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        atomic_write_json(path, digest, artifact="hotset")
         return path
 
     def publish_from_engine(self, engine) -> Optional[str]:
@@ -172,9 +167,13 @@ class SnapshotPublisher:
         snap = engine._snapshot
         if snap is None or snap.policy is None:
             return None
-        if getattr(snap, "published_origin", None):
+        if getattr(snap, "published_origin", None) and not self.include_loaded:
             # this snapshot was itself loaded from a publisher: replicas
-            # never republish (loop breaker — see engine.from_published)
+            # never republish (loop breaker — see engine.from_published).
+            # A state-plane publisher (include_loaded=True) opts out: its
+            # directory is this process's own crash-recovery store, never
+            # another replica's source (cli.py refuses --state-dir ==
+            # --snapshot-source), so persisting loaded snapshots is safe.
             return None
         change_safety = getattr(snap, "change_safety", None)
         meta = {

@@ -1284,3 +1284,40 @@ fleet_guard_breach = _counter(
     "threshold).",
     ("guard",),
 )
+
+# ---------------------------------------------------------------------------
+# Durable local state plane (ISSUE 20, docs/robustness.md "Crash recovery &
+# warm restart"): --state-dir snapshot/hotset persistence, warm-restart
+# phases, and the atomic-writer failure ledger.
+# ---------------------------------------------------------------------------
+
+warm_restart = _counter(
+    "auth_server_warm_restart_total",
+    "Warm-restart phase outcomes at boot when --state-dir is set, by phase "
+    "(snapshot = load + strict re-lint + apply of the local blob before "
+    "the control plane connects; hotset = verdict-cache import from the "
+    "local HOTSET.json) and result (ok; stale = served fail-static but "
+    "older than --max-snapshot-age, readyz degrades and a stale-snapshot "
+    "anomaly fires; miss = no artifact on disk, cold start for that "
+    "phase; error = artifact present but rejected typed — corrupt blob, "
+    "lint refusal, interner mismatch — also a cold start, never a crash).",
+    ("phase", "result"),
+)
+snapshot_age = _gauge(
+    "auth_server_snapshot_age_seconds",
+    "Age of the state-dir snapshot being served fail-statically (manifest "
+    "published_unix to now), set at warm start and zeroed once a live "
+    "control-plane snapshot replaces it.  Nonzero past --max-snapshot-age "
+    "is the staleness signal behind the readyz degraded reason.",
+    (),
+)
+state_write_failures = _counter(
+    "auth_server_state_write_failures_total",
+    "Durable-artifact writes that failed inside the shared atomic writer "
+    "(utils/atomicio.py), by artifact kind (snapshot-blob, manifest, "
+    "hotset, capture, corpus, flight, bench, ...).  Counts both real "
+    "filesystem errors and injected fs-stage faults; the destination is "
+    "left old-valid in every case except an injected torn write, whose "
+    "whole point is that readers must then reject it typed.",
+    ("artifact",),
+)

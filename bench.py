@@ -58,6 +58,17 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def write_artifact(path, artifact):
+    """Bench artifacts ride the shared atomic writer (ISSUE 20): a crash
+    mid-write must not leave a torn *_rNN.json where a prior good run's
+    artifact used to be."""
+    from authorino_tpu.utils.atomicio import atomic_write_json
+
+    atomic_write_json(path, artifact, artifact="bench", indent=1,
+                      sort_keys=True)
+    log(f"wrote {path}")
+
+
 def kernel_cost_block():
     """Structural device-cost ledger for bench artifacts (ISSUE 16):
     launches / H2D+D2H bytes / pad waste per lane, as counted at the
@@ -2698,9 +2709,7 @@ def run_mesh_mode(args):
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "MULTICHIP_r06.json")
-    with open(path, "w") as f:
-        json.dump(artifact, f, indent=1, sort_keys=True)
-    log(f"wrote {path}")
+    write_artifact(path, artifact)
     return artifact
 
 
@@ -3012,9 +3021,7 @@ def run_tenancy_mode(args):
     faults_mod.FAULTS.disarm()
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "TENANCY_r01.json")
-    with open(path, "w") as f:
-        json.dump(artifact, f, indent=1, sort_keys=True)
-    log(f"wrote {path}")
+    write_artifact(path, artifact)
     return artifact
 
 
@@ -3239,9 +3246,7 @@ def run_relations_mode(args):
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "RELATIONS_r01.json")
-    with open(path, "w") as f:
-        json.dump(artifact, f, indent=1, sort_keys=True)
-    log(f"wrote {path}")
+    write_artifact(path, artifact)
     print(json.dumps(artifact, indent=1, sort_keys=True))
     return artifact
 
@@ -3571,9 +3576,145 @@ def run_fleet_mode(args):
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "FLEET_r01.json")
-    with open(path, "w") as f:
-        json.dump(artifact, f, indent=1, sort_keys=True)
-    log(f"wrote {path}")
+    write_artifact(path, artifact)
+    return artifact
+
+
+# ---------------------------------------------------------------------------
+# --mode restart (ISSUE 20, RESTART_r01.json): restart MTTR — cold compile vs
+# warm restart from a --state-dir style local store, time-to-first-verdict
+# split per phase (deserialize, verify+apply/upload, hotset import, first
+# verdict).  Ratio-only per the ROADMAP bench-reality note: both passes run
+# in THIS process on THIS image, so cold/warm is trustworthy, absolute
+# seconds are not.
+# ---------------------------------------------------------------------------
+
+
+def run_restart_mode(args):
+    import asyncio
+    import shutil
+    import tempfile
+
+    from authorino_tpu.fleet.warmjoin import export_hotset, import_hotset
+    from authorino_tpu.runtime import EngineEntry, PolicyEngine
+    from authorino_tpu.snapshots.distribution import (SnapshotPublisher,
+                                                      load_hotset,
+                                                      load_latest)
+
+    def run(coro):
+        loop = asyncio.new_event_loop()
+        try:
+            return loop.run_until_complete(coro)
+        finally:
+            loop.close()
+
+    n_cfg = min(args.configs, 256)
+    configs = build_corpus(n_cfg, args.rules)
+    docs = build_docs(min(args.docs, 2048))
+    names = [f"cfg-{i % n_cfg}" for i in range(len(docs))]
+    entries = [EngineEntry(id=c.name, hosts=[c.name], runtime=None, rules=c)
+               for c in configs]
+    probe_doc, probe_name = docs[0], names[0]
+
+    # -- cold: full compile path to the first verdict -----------------------
+    t0 = time.perf_counter()
+    cold_engine = PolicyEngine(max_batch=args.batch, strict_verify=True)
+    cold_engine.apply_snapshot(entries)
+    t_compile = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    run(cold_engine.submit(probe_doc, probe_name))
+    t_cold_first = time.perf_counter() - t1
+    cold_phases = dict(getattr(cold_engine._snapshot, "phase_s", {}) or {})
+    cold_ttfv = t_compile + t_cold_first
+    log(f"cold: compile+verify {t_compile:.3f}s, first verdict "
+        f"{t_cold_first * 1e3:.1f}ms (ttfv {cold_ttfv:.3f}s)")
+
+    # -- seed the state dir: snapshot + a warmed hot set --------------------
+    state_dir = tempfile.mkdtemp(prefix="atpu-restart-")
+    try:
+        warm_traffic = min(512, len(docs))
+
+        async def warm_pump():
+            await asyncio.gather(*[
+                cold_engine.submit(docs[j], names[j])
+                for j in range(warm_traffic)])
+
+        run(warm_pump())
+        publisher = SnapshotPublisher(state_dir, include_loaded=True)
+        publisher.publish_from_engine(cold_engine)
+        digest = export_hotset(cold_engine, k=4096)
+        hotset_entries = len((digest or {}).get("entries", []))
+        if digest is not None:
+            publisher.publish_hotset(digest)
+
+        # -- warm: deserialize + verify + upload + hotset, no compile -------
+        t0 = time.perf_counter()
+        warm_engine = PolicyEngine(max_batch=args.batch, strict_verify=True)
+        t_build = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        loaded = load_latest(state_dir)
+        t_load = time.perf_counter() - t1
+        t2 = time.perf_counter()
+        warm_engine.apply_published(loaded)   # strict re-lint + host upload
+        t_apply = time.perf_counter() - t2
+        t3 = time.perf_counter()
+        imported, skipped = import_hotset(warm_engine, load_hotset(state_dir))
+        t_hotset = time.perf_counter() - t3
+        t4 = time.perf_counter()
+        run(warm_engine.submit(probe_doc, probe_name))
+        t_warm_first = time.perf_counter() - t4
+        warm_phases = dict(getattr(warm_engine._snapshot, "phase_s", {}) or {})
+        warm_ttfv = t_build + t_load + t_apply + t_hotset + t_warm_first
+        log(f"warm: load {t_load * 1e3:.1f}ms, verify+apply "
+            f"{t_apply * 1e3:.1f}ms, hotset import {imported} "
+            f"({t_hotset * 1e3:.1f}ms), first verdict "
+            f"{t_warm_first * 1e3:.1f}ms (ttfv {warm_ttfv:.3f}s)")
+    finally:
+        shutil.rmtree(state_dir, ignore_errors=True)
+
+    ratio = round(cold_ttfv / warm_ttfv, 4) if warm_ttfv > 0 else None
+    artifact = {
+        "mode": "restart",
+        "load_model": "in-process cold-vs-warm restart (ratio-only: both "
+                      "passes share this image's CPU, so the split and the "
+                      "ratio are trustworthy, absolute seconds are not)",
+        "jax": jax_version_string(),
+        "configs": n_cfg,
+        "rules_per_config": args.rules,
+        "warm_traffic_decisions": warm_traffic,
+        "cold": {
+            "ttfv_s": round(cold_ttfv, 4),
+            "phases_s": {
+                "compile_and_verify": round(t_compile, 4),
+                "first_verdict": round(t_cold_first, 4),
+            },
+            "snapshot_phase_s": {k: round(v, 4)
+                                 for k, v in cold_phases.items()},
+        },
+        "warm": {
+            "ttfv_s": round(warm_ttfv, 4),
+            "phases_s": {
+                "engine_build": round(t_build, 4),
+                "snapshot_deserialize": round(t_load, 4),
+                "verify_and_upload": round(t_apply, 4),
+                "hotset_import": round(t_hotset, 4),
+                "first_verdict": round(t_warm_first, 4),
+            },
+            "snapshot_phase_s": {k: round(v, 4)
+                                 for k, v in warm_phases.items()},
+            "hotset": {"published_entries": hotset_entries,
+                       "imported": imported, "skipped": skipped},
+        },
+        "ttfv_ratio_cold_over_warm": ratio,
+        "kernel_cost": kernel_cost_block(),
+        "acceptance": {
+            "warm_beats_cold": bool(ratio is not None and ratio > 1.0),
+            "hotset_imported": imported > 0,
+        },
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "RESTART_r01.json")
+    write_artifact(path, artifact)
     return artifact
 
 
@@ -3594,7 +3735,8 @@ def main():
                     help="concurrent in-flight batches (pipelined mode)")
     ap.add_argument("--mode", choices=["native", "mix", "slowlane", "pipelined",
                                        "serial", "engine", "grpc", "mesh",
-                                       "relations", "tenancy", "fleet"],
+                                       "relations", "tenancy", "fleet",
+                                       "restart"],
                     default="native",
                     help="native (default): full-wire Check() through the C++ "
                          "device-owner frontend + C++ loadgen; mix: the five "
@@ -3800,6 +3942,17 @@ def main():
                 "rps_ratio_vs_1"],
             "unit": f"x ({top} replicas vs 1, ratio — see load_model)",
             "detail": acc,
+        }))
+        return
+
+    if args.mode == "restart":
+        artifact = run_restart_mode(args)
+        print(json.dumps({
+            "metric": "restart_warm_vs_cold_ttfv_ratio",
+            "value": artifact["ttfv_ratio_cold_over_warm"],
+            "unit": "x (cold/warm time-to-first-verdict, ratio — see "
+                    "load_model)",
+            "detail": artifact["acceptance"],
         }))
         return
 

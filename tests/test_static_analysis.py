@@ -394,6 +394,52 @@ def test_code_lint_pickle_import_kind():
                        "authorino_tpu/x.py") == []
 
 
+def test_code_lint_non_atomic_write_kind():
+    """ISSUE 20 satellite: a durable artifact written with a bare
+    ``open(path, "w")`` is a torn-write waiting for a SIGKILL — every
+    durable writer must ride utils/atomicio.py (or hand-roll the same
+    tmp + fsync + os.replace discipline)."""
+    src = ("def dump(snapshot_path, blob):\n"
+           "    with open(snapshot_path, 'wb') as f:\n"
+           "        f.write(blob)\n")
+    kinds = [f.kind for f in lint_source(src, "authorino_tpu/x.py")]
+    assert kinds == ["non-atomic-write"]
+    # the full discipline in the same scope passes: fsync + os.replace
+    ok = ("import os\n"
+          "def dump(snapshot_path, blob):\n"
+          "    with open(snapshot_path + '.tmp', 'wb') as f:\n"
+          "        f.write(blob)\n"
+          "        f.flush()\n"
+          "        os.fsync(f.fileno())\n"
+          "    os.replace(snapshot_path + '.tmp', snapshot_path)\n")
+    assert lint_source(ok, "authorino_tpu/x.py") == []
+    # str.replace is NOT os.replace: the finding survives
+    bad = ("import os\n"
+           "def dump(snapshot_path, blob):\n"
+           "    with open(snapshot_path, 'wb') as f:\n"
+           "        f.write(blob)\n"
+           "        os.fsync(f.fileno())\n"
+           "    snapshot_path.replace('.tmp', '')\n")
+    assert [f.kind for f in lint_source(bad, "authorino_tpu/x.py")] \
+        == ["non-atomic-write"]
+    # non-durable paths (no durable-artifact word in scope) are exempt —
+    # this lint hunts restart-critical state, not every scratch file
+    scratch = ("def dump(p, blob):\n"
+               "    with open(p, 'wb') as f:\n"
+               "        f.write(blob)\n")
+    assert lint_source(scratch, "authorino_tpu/x.py") == []
+    # reads never fire, tests/ are exempt, suppression is reasoned
+    assert lint_source("def load(manifest_path):\n"
+                       "    return open(manifest_path).read()\n",
+                       "authorino_tpu/x.py") == []
+    assert lint_source(src, "tests/test_x.py") == []
+    ok2 = ("def dump(snapshot_path, blob):\n"
+           "    with open(snapshot_path, 'wb') as f:"
+           "  # lint-ok: non-atomic-write -- sentinel file\n"
+           "        f.write(blob)\n")
+    assert lint_source(ok2, "authorino_tpu/x.py") == []
+
+
 def test_repo_stays_lint_clean():
     """The tier-1 gate: the new code lint over authorino_tpu/ must report
     no findings — a new blocking call in an async path, a lock held across
